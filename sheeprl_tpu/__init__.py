@@ -1,0 +1,29 @@
+"""sheeprl_tpu — a TPU-native (JAX/XLA/Pallas) deep-RL framework with the capability
+surface of SheepRL (reference: balloch/sheeprl).
+
+Importing the package eagerly imports every algorithm module so the registries are
+populated (role of sheeprl/__init__.py:17-51).
+"""
+
+from __future__ import annotations
+
+import os
+
+__version__ = "0.1.0"
+
+# keep XLA from grabbing all host memory in tests / multi-tool environments
+os.environ.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE  # noqa: E402
+
+# populate the algorithm/evaluation registries (role of sheeprl/__init__.py:17-51)
+_ALGO_MODULES = [
+    "sheeprl_tpu.algos.a2c.a2c",
+    "sheeprl_tpu.algos.ppo.ppo",
+    "sheeprl_tpu.algos.ppo.evaluate",
+]
+
+import importlib  # noqa: E402
+
+for _mod in list(_ALGO_MODULES):
+    importlib.import_module(_mod)

@@ -1,0 +1,268 @@
+"""A2C, coupled training (capability parity with sheeprl/algos/a2c/a2c.py:30-383).
+
+The reference accumulates gradients over minibatches and steps once per rollout
+(a2c.py:63-96); in JAX that collapses into a single jitted full-rollout update —
+with ``loss_reduction=sum`` (the A2C default) the math is identical, with fewer
+dispatches and one fused XLA program. Under the ``dp`` strategy the rollout batch is
+sharded over the mesh ``data`` axis and XLA inserts the gradient psum.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.agent import build_agent, policy_output
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, save_configs
+
+
+@register_algorithm(decoupled=False)
+def main(fabric, cfg: Dict[str, Any]):
+    rank = fabric.global_rank
+    world_size = fabric.world_size
+
+    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
+    logger = get_logger(fabric, cfg, log_dir=log_dir)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict())
+    fabric.print(f"Log dir: {log_dir}")
+
+    total_num_envs = int(cfg.env.num_envs * world_size)
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * total_num_envs + i,
+                rank * total_num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(total_num_envs)
+        ]
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `algo.mlp_keys.encoder=[state]`")
+    # A2C is vector-only (reference a2c.py)
+    for k in cfg.algo.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                "Only environments with vector-only observations are supported by the A2C agent. "
+                f"The observation with key '{k}' has shape {observation_space[k].shape}"
+            )
+    cfg.algo.cnn_keys.encoder = []
+    obs_keys = cfg.algo.mlp_keys.encoder
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+
+    key = fabric.seed_everything(cfg.seed + rank)
+    key, agent_key = jax.random.split(key)
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
+    if state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+
+    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(total_num_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+
+    tx = instantiate(cfg.algo.optimizer)
+    if cfg.algo.max_grad_norm > 0.0:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), tx)
+    opt_state = tx.init(params)
+    if state is not None and "optimizer" in state:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        total_num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        obs_keys=obs_keys,
+    )
+
+    loss_reduction = cfg.algo.loss_reduction
+
+    @jax.jit
+    def policy_step_fn(params, obs: Dict[str, jax.Array], step_key):
+        norm_obs = {k: v.astype(jnp.float32) for k, v in obs.items()}
+        actor_outs, values = agent.apply({"params": params}, norm_obs)
+        out = policy_output(actor_outs, values, step_key, actions_dim, is_continuous)
+        if is_continuous:
+            real_actions = out["actions"]
+        else:
+            split = jnp.split(out["actions"], np.cumsum(actions_dim)[:-1].tolist(), axis=-1)
+            real_actions = jnp.stack([s.argmax(axis=-1) for s in split], axis=-1)
+        return out, real_actions
+
+    @jax.jit
+    def get_values(params, obs: Dict[str, jax.Array]):
+        _, values = agent.apply({"params": params}, obs)
+        return values
+
+    @jax.jit
+    def compute_gae(rewards, values, dones, next_values):
+        return gae(
+            rewards, values, dones, next_values, cfg.algo.rollout_steps, cfg.algo.gamma, cfg.algo.gae_lambda
+        )
+
+    def loss_fn(params, batch):
+        obs = {k: batch[k] for k in obs_keys}
+        actor_outs, values = agent.apply({"params": params}, obs)
+        out = policy_output(
+            actor_outs, values, jax.random.PRNGKey(0), actions_dim, is_continuous, actions=batch["actions"]
+        )
+        pg = policy_loss(out["logprob"], batch["advantages"], loss_reduction)
+        vl = value_loss(out["values"], batch["returns"], loss_reduction)
+        return pg + vl, (pg, vl)
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        grads, (pg, vl) = jax.grad(loss_fn, has_aux=True)(params, batch)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, {"pg": pg, "vl": vl}
+
+    if world_size > 1:
+        params = fabric.replicate_pytree(params)
+        opt_state = fabric.replicate_pytree(opt_state)
+
+    step_data: Dict[str, np.ndarray] = {}
+    next_obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = next_obs[k][np.newaxis]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        with timer("Time/env_interaction_time"):
+            for _ in range(cfg.algo.rollout_steps):
+                policy_step += total_num_envs
+
+                obs_jax = {k: jnp.asarray(next_obs[k], dtype=jnp.float32) for k in obs_keys}
+                key, step_key = jax.random.split(key)
+                out, real_actions = policy_step_fn(params, obs_jax, step_key)
+                real_actions_np = np.asarray(real_actions)
+                if is_continuous:
+                    env_actions = real_actions_np.reshape(envs.action_space.shape)
+                else:
+                    env_actions = real_actions_np.reshape(
+                        (total_num_envs, -1) if is_multidiscrete else (total_num_envs,)
+                    )
+
+                obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                dones = np.logical_or(terminated, truncated).reshape(total_num_envs, 1).astype(np.float32)
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, 1)
+
+                step_data["dones"] = dones[np.newaxis]
+                step_data["values"] = np.asarray(out["values"], dtype=np.float32)[np.newaxis]
+                step_data["actions"] = np.asarray(out["actions"], dtype=np.float32)[np.newaxis]
+                step_data["rewards"] = rewards[np.newaxis]
+                if cfg.buffer.memmap:
+                    step_data["returns"] = np.zeros_like(rewards)[np.newaxis]
+                    step_data["advantages"] = np.zeros_like(rewards)[np.newaxis]
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                next_obs = obs
+                for k in obs_keys:
+                    step_data[k] = obs[k][np.newaxis]
+
+                if "episode" in info:
+                    mask = info.get("_episode", np.ones(total_num_envs, bool))
+                    rews = info["episode"]["r"][mask]
+                    lens = info["episode"]["l"][mask]
+                    if aggregator and not aggregator.disabled and len(rews) > 0:
+                        aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                        aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+
+        obs_jax = {k: jnp.asarray(next_obs[k], dtype=jnp.float32) for k in obs_keys}
+        next_values = get_values(params, obs_jax)
+
+        with timer("Time/train_time"):
+            returns, advantages = compute_gae(
+                jnp.asarray(np.asarray(rb["rewards"])),
+                jnp.asarray(np.asarray(rb["values"])),
+                jnp.asarray(np.asarray(rb["dones"])),
+                next_values,
+            )
+            local_data = {k: np.asarray(rb[k]).reshape(-1, *rb[k].shape[2:]) for k in rb.buffer.keys()}
+            local_data["returns"] = np.asarray(returns).reshape(-1, 1)
+            local_data["advantages"] = np.asarray(advantages).reshape(-1, 1)
+            batch = fabric.shard_pytree(local_data) if world_size > 1 else local_data
+            params, opt_state, metrics = train_step(params, opt_state, batch)
+            if aggregator and not aggregator.disabled:
+                aggregator.update("Loss/policy_loss", np.asarray(metrics["pg"]))
+                aggregator.update("Loss/value_loss", np.asarray(metrics["vl"]))
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
+        ):
+            metrics_dict = aggregator.compute() if aggregator else {}
+            if logger is not None:
+                logger.log_metrics(metrics_dict, policy_step)
+            timer.to_dict(reset=True)
+            if aggregator:
+                aggregator.reset()
+            last_log = policy_step
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "optimizer": opt_state,
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(agent.apply, params, fabric, cfg, log_dir)
+    if logger is not None:
+        logger.finalize()

@@ -1,0 +1,211 @@
+"""PPO agent, Flax-native.
+
+Capability parity with the reference agent (sheeprl/algos/ppo/agent.py:19-298):
+multi-key CNN+MLP feature extraction, actor backbone with one head per discrete action
+dimension (or a single mean/log-std head for continuous control), a critic MLP.
+
+The reference's agent/player duality with tied weights (agent.py:254-298 +
+get_single_device_fabric) collapses here: one Flax module definition, one params
+pytree, and pure jitted functions for acting and training.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.models.models import MLP, MultiEncoder, NatureCNN
+from sheeprl_tpu.utils.distribution import Independent, Normal, OneHotCategorical
+
+
+class CNNEncoder(nn.Module):
+    keys: Sequence[str]
+    features_dim: int
+    screen_size: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-3)  # channel-first input
+        # flatten any frame-stack dim into channels
+        if x.ndim >= 4 and x.shape[-4] > 1 and x.ndim > 4:
+            x = jnp.reshape(x, (*x.shape[:-4], -1, *x.shape[-2:]))
+        return NatureCNN(features_dim=self.features_dim, screen_size=self.screen_size, dtype=self.dtype)(x)
+
+
+class MLPEncoder(nn.Module):
+    keys: Sequence[str]
+    features_dim: Optional[int]
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: Any = "relu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            output_dim=self.features_dim,
+            activation=self.dense_act,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+        )(x)
+
+
+class PPOAgent(nn.Module):
+    """Returns (actor_outs, values); heads follow the reference convention: continuous
+    → a single head emitting concat(mean, log_std); discrete → one logits head per
+    action dim."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    screen_size: int
+    encoder_cfg: Dict[str, Any]
+    actor_cfg: Dict[str, Any]
+    critic_cfg: Dict[str, Any]
+    dtype: Any = jnp.float32
+
+    def setup(self) -> None:
+        cnn_encoder = (
+            CNNEncoder(
+                keys=self.cnn_keys,
+                features_dim=self.encoder_cfg["cnn_features_dim"],
+                screen_size=self.screen_size,
+                dtype=self.dtype,
+            )
+            if len(self.cnn_keys) > 0
+            else None
+        )
+        mlp_encoder = (
+            MLPEncoder(
+                keys=self.mlp_keys,
+                features_dim=self.encoder_cfg["mlp_features_dim"],
+                dense_units=self.encoder_cfg["dense_units"],
+                mlp_layers=self.encoder_cfg["mlp_layers"],
+                dense_act=self.encoder_cfg["dense_act"],
+                layer_norm=self.encoder_cfg["layer_norm"],
+                dtype=self.dtype,
+            )
+            if len(self.mlp_keys) > 0
+            else None
+        )
+        self.feature_extractor = MultiEncoder(cnn_encoder, mlp_encoder)
+        self.critic = MLP(
+            hidden_sizes=(self.critic_cfg["dense_units"],) * self.critic_cfg["mlp_layers"],
+            output_dim=1,
+            activation=self.critic_cfg["dense_act"],
+            layer_norm=self.critic_cfg["layer_norm"],
+            dtype=self.dtype,
+        )
+        self.actor_backbone = MLP(
+            hidden_sizes=(self.actor_cfg["dense_units"],) * self.actor_cfg["mlp_layers"],
+            output_dim=None,
+            activation=self.actor_cfg["dense_act"],
+            layer_norm=self.actor_cfg["layer_norm"],
+            dtype=self.dtype,
+        )
+        if self.is_continuous:
+            self.actor_heads = [nn.Dense(sum(self.actions_dim) * 2, dtype=self.dtype)]
+        else:
+            self.actor_heads = [nn.Dense(dim, dtype=self.dtype) for dim in self.actions_dim]
+
+    def __call__(self, obs: Dict[str, jax.Array]) -> Tuple[List[jax.Array], jax.Array]:
+        feat = self.feature_extractor(obs)
+        pre = self.actor_backbone(feat)
+        actor_outs = [head(pre) for head in self.actor_heads]
+        values = self.critic(feat)
+        return actor_outs, values
+
+
+def make_dists(actor_outs: List[jax.Array], is_continuous: bool):
+    """Build the per-head action distributions from raw actor outputs."""
+    if is_continuous:
+        mean, log_std = jnp.split(actor_outs[0], 2, axis=-1)
+        return [Independent(Normal(mean, jnp.exp(log_std)), 1)]
+    return [OneHotCategorical(logits=logits) for logits in actor_outs]
+
+
+def policy_output(
+    actor_outs: List[jax.Array],
+    values: jax.Array,
+    key: jax.Array,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    actions: Optional[jax.Array] = None,
+    greedy: bool = False,
+) -> Dict[str, jax.Array]:
+    """Shared sample/evaluate path: samples (or re-evaluates given concatenated
+    ``actions``) and returns dict(actions, logprob, entropy, values).
+
+    ``actions`` follows the storage convention: a single concatenated array —
+    continuous values, or per-dim one-hot blocks for discrete spaces.
+    """
+    dists = make_dists(actor_outs, is_continuous)
+    if is_continuous:
+        dist = dists[0]
+        if actions is None:
+            act = dist.mode if greedy else dist.sample(key)
+        else:
+            act = actions
+        logprob = dist.log_prob(act)[..., None]
+        entropy = dist.entropy()[..., None]
+        return {"actions": act, "logprob": logprob, "entropy": entropy, "values": values}
+    split_actions = None
+    if actions is not None:
+        import numpy as _np
+
+        split_actions = jnp.split(actions, _np.cumsum(actions_dim)[:-1].tolist(), axis=-1)
+    keys = jax.random.split(key, len(dists))
+    sampled, logprobs, entropies = [], [], []
+    for i, dist in enumerate(dists):
+        if split_actions is None:
+            a = dist.mode if greedy else dist.sample(keys[i])
+        else:
+            a = split_actions[i]
+        sampled.append(a)
+        logprobs.append(dist.log_prob(a))
+        entropies.append(dist.entropy())
+    return {
+        "actions": jnp.concatenate(sampled, axis=-1),
+        "logprob": jnp.stack(logprobs, axis=-1).sum(axis=-1, keepdims=True),
+        "entropy": jnp.stack(entropies, axis=-1).sum(axis=-1, keepdims=True),
+        "values": values,
+    }
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    key: jax.Array,
+) -> Tuple[PPOAgent, Any]:
+    """Create the module + initialized params (replaces the reference's
+    build_agent/Fabric-wrapping dance, sheeprl/algos/ppo/agent.py:254-298)."""
+    agent = PPOAgent(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        cnn_keys=tuple(cfg.algo.cnn_keys.encoder),
+        mlp_keys=tuple(cfg.algo.mlp_keys.encoder),
+        screen_size=cfg.env.screen_size,
+        encoder_cfg=dict(cfg.algo.encoder),
+        actor_cfg=dict(cfg.algo.actor),
+        critic_cfg=dict(cfg.algo.critic),
+        dtype=fabric.compute_dtype,
+    )
+    dummy_obs = {}
+    for k in tuple(cfg.algo.cnn_keys.encoder):
+        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), dtype=jnp.float32)
+    for k in tuple(cfg.algo.mlp_keys.encoder):
+        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), dtype=jnp.float32)
+    params = agent.init(key, dummy_obs)["params"]
+    return agent, params

@@ -1,0 +1,51 @@
+"""PPO losses (reference: sheeprl/algos/ppo/loss.py:1-72), jnp-native."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    if reduction == "none":
+        return x
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def policy_loss(
+    new_logprobs: jax.Array,
+    old_logprobs: jax.Array,
+    advantages: jax.Array,
+    clip_coef: float,
+    reduction: str = "mean",
+) -> jax.Array:
+    """Clipped-surrogate objective."""
+    logratio = new_logprobs - old_logprobs
+    ratio = jnp.exp(logratio)
+    pg_loss1 = -advantages * ratio
+    pg_loss2 = -advantages * jnp.clip(ratio, 1 - clip_coef, 1 + clip_coef)
+    return _reduce(jnp.maximum(pg_loss1, pg_loss2), reduction)
+
+
+def value_loss(
+    new_values: jax.Array,
+    old_values: jax.Array,
+    returns: jax.Array,
+    clip_coef: float,
+    clip_vloss: bool,
+    reduction: str = "mean",
+) -> jax.Array:
+    if not clip_vloss:
+        return _reduce(0.5 * jnp.square(new_values - returns), reduction)
+    v_loss_unclipped = jnp.square(new_values - returns)
+    v_clipped = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
+    v_loss_clipped = jnp.square(v_clipped - returns)
+    return 0.5 * _reduce(jnp.maximum(v_loss_unclipped, v_loss_clipped), reduction)
+
+
+def entropy_loss(entropy: jax.Array, reduction: str = "mean") -> jax.Array:
+    return -_reduce(entropy, reduction)

@@ -1,0 +1,418 @@
+"""PPO, coupled training (capability parity with sheeprl/algos/ppo/ppo.py:106-452).
+
+TPU-native structure:
+- one controller process drives ``num_envs * world_size`` vectorized envs; "ranks" are
+  mesh devices, so per-rank sizes keep their meaning as per-device shards;
+- the act path is one jitted ``policy_step`` (the reference pays a per-step
+  ``.cpu().numpy()`` sync, ppo.py:279-282 — here a single fused device program per
+  vector step);
+- GAE is a jitted ``lax.scan`` (reference: reversed Python loop, utils/utils.py:92-98);
+- the optimization phase is a jitted minibatch step; under the ``dp`` strategy the
+  minibatch is device_put with a ``data``-axis sharding and XLA inserts the gradient
+  psum over ICI (replacing DDP allreduce at reference ppo.py:93).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import build_agent, policy_output
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
+
+
+def _build_optimizer(cfg, total_iters: int) -> optax.GradientTransformation:
+    num_minibatches = max(
+        1, (cfg.algo.rollout_steps * cfg.env.num_envs) // cfg.algo.per_rank_batch_size
+    )
+    lr = cfg.algo.optimizer.lr
+    if cfg.algo.anneal_lr:
+        lr = optax.linear_schedule(
+            init_value=lr,
+            end_value=0.0,
+            transition_steps=total_iters * cfg.algo.update_epochs * num_minibatches,
+        )
+    tx = instantiate(cfg.algo.optimizer, lr=lr)
+    if cfg.algo.max_grad_norm > 0.0:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), tx)
+    return tx
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    initial_ent_coef = float(cfg.algo.ent_coef)
+    initial_clip_coef = float(cfg.algo.clip_coef)
+
+    rank = fabric.global_rank
+    world_size = fabric.world_size
+
+    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
+    logger = get_logger(fabric, cfg, log_dir=log_dir)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict())
+    fabric.print(f"Log dir: {log_dir}")
+
+    # ranks = mesh devices: the controller drives num_envs * world_size envs
+    total_num_envs = int(cfg.env.num_envs * world_size)
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * total_num_envs + i,
+                rank * total_num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(total_num_envs)
+        ]
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder == []:
+        raise RuntimeError(
+            "You should specify at least one CNN or MLP key for the encoder: "
+            "`algo.cnn_keys.encoder=[rgb]` or `algo.mlp_keys.encoder=[state]`"
+        )
+    if cfg.metric.log_level > 0:
+        fabric.print("Encoder CNN keys:", cfg.algo.cnn_keys.encoder)
+        fabric.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+    cnn_keys = cfg.algo.cnn_keys.encoder
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+
+    key = fabric.seed_everything(cfg.seed + rank)
+    key, agent_key = jax.random.split(key)
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
+    if state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+
+    # counters (semantics of reference ppo.py:216-231)
+    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(total_num_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+
+    tx = _build_optimizer(cfg, total_iters)
+    opt_state = tx.init(params)
+    if state is not None and "optimizer" in state:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    if cfg.buffer.size < cfg.algo.rollout_steps:
+        raise ValueError(
+            f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
+            f"than the rollout steps ({cfg.algo.rollout_steps})"
+        )
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        total_num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        obs_keys=obs_keys,
+    )
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    # ---------------- jitted programs ----------------
+    # Latency design: the act path runs on the HOST CPU jax backend (microsecond
+    # dispatch — envs are host-side anyway), the optimization phase is ONE jitted
+    # device program per iteration (all epochs x minibatches fused via lax.scan), and
+    # weights cross host<->device once per iteration. This replaces the reference's
+    # per-step .cpu().numpy() syncs + per-minibatch optimizer steps (ppo.py:279-372).
+    loss_reduction = cfg.algo.loss_reduction
+    vf_coef = float(cfg.algo.vf_coef)
+    clip_vloss = bool(cfg.algo.clip_vloss)
+    normalize_advantages = bool(cfg.algo.normalize_advantages)
+    global_bs = int(cfg.algo.per_rank_batch_size * world_size)
+    num_rows = int(cfg.algo.rollout_steps * total_num_envs)
+    num_minibatches = max(1, num_rows // global_bs)
+
+    cpu_device = jax.devices("cpu")[0]
+    act_on_cpu = fabric.device.platform != "cpu"
+
+    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+    def policy_step_fn(params, obs: Dict[str, jax.Array], step_key):
+        norm_obs = normalize_obs(obs, cnn_keys, obs_keys)
+        norm_obs = {k: v.astype(jnp.float32) for k, v in norm_obs.items()}
+        actor_outs, values = agent.apply({"params": params}, norm_obs)
+        out = policy_output(actor_outs, values, step_key, actions_dim, is_continuous)
+        if is_continuous:
+            real_actions = out["actions"]
+        else:
+            split = jnp.split(out["actions"], np.cumsum(actions_dim)[:-1].tolist(), axis=-1)
+            real_actions = jnp.stack([s.argmax(axis=-1) for s in split], axis=-1)
+        return out, real_actions
+
+    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+    def get_values(params, obs: Dict[str, jax.Array]):
+        norm_obs = normalize_obs(obs, cnn_keys, obs_keys)
+        norm_obs = {k: v.astype(jnp.float32) for k, v in norm_obs.items()}
+        _, values = agent.apply({"params": params}, norm_obs)
+        return values
+
+    def loss_fn(params, batch, clip_coef, ent_coef):
+        norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
+        actor_outs, new_values = agent.apply({"params": params}, norm_obs)
+        out = policy_output(
+            actor_outs, new_values, jax.random.PRNGKey(0), actions_dim, is_continuous, actions=batch["actions"]
+        )
+        advantages = batch["advantages"]
+        if normalize_advantages:
+            advantages = normalize_tensor(advantages)
+        pg_loss = policy_loss(out["logprob"], batch["logprobs"], advantages, clip_coef, loss_reduction)
+        v_loss = value_loss(
+            out["values"], batch["values"], batch["returns"], clip_coef, clip_vloss, loss_reduction
+        )
+        ent_loss = entropy_loss(out["entropy"], loss_reduction)
+        loss = pg_loss + vf_coef * v_loss + ent_coef * ent_loss
+        return loss, (pg_loss, v_loss, ent_loss)
+
+    @jax.jit
+    def train_phase(params, opt_state, data, next_values, train_key, clip_coef, ent_coef):
+        """One fused device program per iteration: GAE + update_epochs x minibatches."""
+        returns, advantages = gae(
+            data["rewards"],
+            data["values"],
+            data["dones"],
+            next_values,
+            cfg.algo.rollout_steps,
+            cfg.algo.gamma,
+            cfg.algo.gae_lambda,
+        )
+        flat = {k: v.reshape(-1, *v.shape[2:]) for k, v in data.items()}
+        flat["returns"] = returns.reshape(-1, 1)
+        flat["advantages"] = advantages.reshape(-1, 1)
+
+        def epoch_body(carry, epoch_key):
+            params, opt_state = carry
+            perm = jax.random.permutation(epoch_key, num_rows)
+            mb_idx = perm[: num_minibatches * global_bs].reshape(num_minibatches, global_bs)
+
+            def mb_body(carry, idx):
+                params, opt_state = carry
+                batch = {k: jnp.take(v, idx, axis=0) for k, v in flat.items()}
+                grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
+                    params, batch, clip_coef, ent_coef
+                )
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), jnp.stack([pg, vl, ent])
+
+            (params, opt_state), losses = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
+            return (params, opt_state), losses.mean(axis=0)
+
+        epoch_keys = jax.random.split(train_key, cfg.algo.update_epochs)
+        (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
+        mean_losses = losses.mean(axis=0)
+        return params, opt_state, mean_losses
+
+    # replicate params/opt_state over the mesh once; rollout data arrives data-sharded
+    if world_size > 1:
+        params = fabric.replicate_pytree(params)
+        opt_state = fabric.replicate_pytree(opt_state)
+
+    act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
+
+    # ---------------- main loop ----------------
+    ent_coef = initial_ent_coef
+    clip_coef = initial_clip_coef
+
+    # host-side PRNG chain lives on the CPU backend: splitting keys must never cost a
+    # device roundtrip
+    if act_on_cpu:
+        key = jax.device_put(key, cpu_device)
+
+    step_data: Dict[str, np.ndarray] = {}
+    next_obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = next_obs[k][np.newaxis]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        with timer("Time/env_interaction_time"):
+            for _ in range(cfg.algo.rollout_steps):
+                policy_step += total_num_envs
+
+                obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
+                key, step_key = jax.random.split(key)
+                out, real_actions = policy_step_fn(act_params, obs_host, step_key)
+                real_actions_np = np.asarray(real_actions)
+                if is_continuous:
+                    env_actions = real_actions_np.reshape(envs.action_space.shape)
+                else:
+                    env_actions = real_actions_np.reshape(
+                        (total_num_envs, -1) if is_multidiscrete else (total_num_envs,)
+                    )
+
+                obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                dones = np.logical_or(terminated, truncated).reshape(total_num_envs, 1).astype(np.float32)
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, 1)
+
+                # truncation bootstrap (reference ppo.py:286-305)
+                if "final_observation" in info or "final_obs" in info:
+                    final_obs_arr = info.get("final_observation", info.get("final_obs"))
+                    truncated_envs = np.nonzero(truncated)[0]
+                    if len(truncated_envs) > 0:
+                        real_next_obs = {
+                            k: np.stack(
+                                [np.asarray(final_obs_arr[i][k], dtype=np.float32) for i in truncated_envs]
+                            )
+                            for k in obs_keys
+                        }
+                        vals = np.asarray(get_values(act_params, real_next_obs)).reshape(
+                            len(truncated_envs)
+                        )
+                        rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(-1, 1)
+
+                step_data["dones"] = dones[np.newaxis]
+                step_data["values"] = np.asarray(out["values"], dtype=np.float32)[np.newaxis]
+                step_data["actions"] = np.asarray(out["actions"], dtype=np.float32)[np.newaxis]
+                step_data["logprobs"] = np.asarray(out["logprob"], dtype=np.float32)[np.newaxis]
+                step_data["rewards"] = rewards[np.newaxis]
+                if cfg.buffer.memmap:
+                    step_data["returns"] = np.zeros_like(rewards)[np.newaxis]
+                    step_data["advantages"] = np.zeros_like(rewards)[np.newaxis]
+
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                next_obs = obs
+                for k in obs_keys:
+                    step_data[k] = obs[k][np.newaxis]
+
+                if "episode" in info:
+                    mask = info["_episode"] if "_episode" in info else np.ones(total_num_envs, bool)
+                    rews = info["episode"]["r"][mask]
+                    lens = info["episode"]["l"][mask]
+                    if aggregator and not aggregator.disabled and len(rews) > 0:
+                        aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                        aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+
+        # bootstrap value for the last step
+        obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
+        next_values = np.asarray(get_values(act_params, obs_host))
+
+        with timer("Time/train_time"):
+            # single host->device upload of the whole rollout (sharded under dp)
+            data = {k: np.asarray(rb[k]) for k in rb.buffer.keys() if k not in ("returns", "advantages")}
+            if world_size > 1:
+                data = jax.device_put(data, fabric.sharding(None, "data"))
+            key, train_key = jax.random.split(key)
+            params, opt_state, mean_losses = train_phase(
+                params, opt_state, data, next_values, np.asarray(train_key), clip_coef, ent_coef
+            )
+            if aggregator and not aggregator.disabled:
+                losses_np = np.asarray(mean_losses)
+                aggregator.update("Loss/policy_loss", losses_np[0])
+                aggregator.update("Loss/value_loss", losses_np[1])
+                aggregator.update("Loss/entropy_loss", losses_np[2])
+            if act_on_cpu:
+                act_params = jax.device_put(params, cpu_device)
+            else:
+                act_params = params
+
+        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run):
+            metrics_dict = aggregator.compute() if aggregator else {}
+            if logger is not None:
+                logger.log_metrics(metrics_dict, policy_step)
+                timers = timer.to_dict(reset=False)
+                if "Time/train_time" in timers and timers["Time/train_time"] > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                        policy_step,
+                    )
+                if "Time/env_interaction_time" in timers and timers["Time/env_interaction_time"] > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (policy_step - last_log)
+                            / max(timers["Time/env_interaction_time"], 1e-9)
+                        },
+                        policy_step,
+                    )
+            timer.to_dict(reset=True)
+            if aggregator:
+                aggregator.reset()
+            last_log = policy_step
+
+        # anneal lr/clip/ent (reference ppo.py:414-424); lr anneal is an optax schedule
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(
+                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(
+                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "optimizer": opt_state,
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(agent.apply, params, fabric, cfg, log_dir)
+    if logger is not None:
+        logger.finalize()

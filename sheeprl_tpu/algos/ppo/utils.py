@@ -1,0 +1,90 @@
+"""PPO helpers: metric whitelist, obs preparation, greedy test rollout
+(reference: sheeprl/algos/ppo/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss", "Loss/entropy_loss"}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def normalize_obs(
+    obs: Dict[str, Any], cnn_keys: Sequence[str], obs_keys: Sequence[str]
+) -> Dict[str, Any]:
+    """Pixels → [-0.5, 0.5]; vectors pass through (reference utils.py:normalize_obs)."""
+    return {k: obs[k] / 255.0 - 0.5 if k in cnn_keys else obs[k] for k in obs_keys}
+
+
+def prepare_obs(
+    fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **_: Any
+) -> Dict[str, jax.Array]:
+    """Host obs dict → normalized float device arrays shaped [num_envs, ...]."""
+    out = {}
+    for k in obs.keys():
+        v = np.asarray(obs[k], dtype=np.float32)
+        if k in cnn_keys:
+            v = v.reshape(num_envs, -1, *v.shape[-2:])
+        else:
+            v = v.reshape(num_envs, -1)
+        out[k] = jnp.asarray(v)
+    return normalize_obs(out, cnn_keys, list(obs.keys()))
+
+
+def test(agent_apply, params, fabric, cfg, log_dir: str) -> None:
+    """Greedy single-env rollout logging Test/cumulative_reward
+    (reference utils.py:test)."""
+    from sheeprl_tpu.algos.ppo.agent import policy_output
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, None, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    key = jax.random.PRNGKey(cfg.seed)
+    while not done:
+        jobs = prepare_obs(fabric, obs, cnn_keys=cfg.algo.cnn_keys.encoder)
+        actor_outs, values = agent_apply({"params": params}, jobs)
+        key, sub = jax.random.split(key)
+        out = policy_output(
+            actor_outs, values, sub, agent_actions_dim(cfg, env), is_continuous(env), greedy=True
+        )
+        actions = np.asarray(out["actions"])
+        if is_continuous(env):
+            real_actions = actions.reshape(env.action_space.shape)
+        else:
+            dims = agent_actions_dim(cfg, env)
+            split = np.split(actions, np.cumsum(dims)[:-1].tolist(), axis=-1)
+            real_actions = np.concatenate([s.argmax(axis=-1) for s in split], axis=-1).reshape(
+                env.action_space.shape
+            )
+        obs, reward, terminated, truncated, _ = env.step(real_actions)
+        done = bool(terminated) or bool(truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    fabric.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None):
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+
+
+def is_continuous(env) -> bool:
+    import gymnasium as gym
+
+    return isinstance(env.action_space, gym.spaces.Box)
+
+
+def agent_actions_dim(cfg, env) -> Sequence[int]:
+    import gymnasium as gym
+
+    space = env.action_space
+    if isinstance(space, gym.spaces.Box):
+        return list(space.shape)
+    if isinstance(space, gym.spaces.MultiDiscrete):
+        return space.nvec.tolist()
+    return [space.n]

@@ -1,0 +1,26 @@
+"""Rich table of registered agents (role of sheeprl/available_agents.py:7-38)."""
+
+from __future__ import annotations
+
+
+def available_agents() -> None:
+    import sheeprl_tpu  # noqa: F401 - populate registries
+
+    from rich.console import Console
+    from rich.table import Table
+
+    from sheeprl_tpu.utils.registry import algorithm_registry
+
+    table = Table(title="SheepRL-TPU Agents")
+    table.add_column("Module")
+    table.add_column("Algorithm")
+    table.add_column("Entrypoint")
+    table.add_column("Decoupled")
+    for algo, regs in sorted(algorithm_registry.items()):
+        for reg in regs:
+            table.add_row(reg["module"], algo, reg["entrypoint"], str(reg["decoupled"]))
+    Console().print(table)
+
+
+if __name__ == "__main__":
+    available_agents()

@@ -1,0 +1,216 @@
+"""CLI verbs: run / evaluation / registration (role of sheeprl/cli.py:23-449).
+
+``run`` composes the config from dotted CLI overrides, applies resume-merge and config
+policing, resolves the algorithm through the registry, instantiates the Fabric runtime
+from config and launches the registered entrypoint — the same flow as the reference
+(cli.py:357-365 → run_algorithm cli.py:59-198), minus process spawning: JAX SPMD runs
+one controller process per host.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+import warnings
+from datetime import datetime
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+from sheeprl_tpu.config import Composer, compose, deep_merge, dotdict, instantiate
+from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import print_config
+
+# config keys that must not be taken from the old config on resume (reference cli.py:23-56)
+_NON_RESUMABLE_KEYS = (
+    "checkpoint",
+    "exp_name",
+    "run_name",
+    "root_dir",
+    "metric",
+)
+
+
+def resume_from_checkpoint(cfg: dotdict) -> dotdict:
+    """Force-merge the checkpoint's config over the current one, keeping the
+    non-resumable keys, and hard-validate env/algo identity (reference cli.py:23-56)."""
+    import yaml
+
+    ckpt_path = Path(cfg.checkpoint.resume_from)
+    old_cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not old_cfg_path.is_file():
+        old_cfg_path = ckpt_path.parent / "config.yaml"
+    if not old_cfg_path.is_file():
+        raise ValueError(
+            f"cannot resume from {ckpt_path}: no config.yaml found next to the checkpoint"
+        )
+    with open(old_cfg_path) as f:
+        old_cfg = yaml.safe_load(f)
+    if old_cfg["env"]["id"] != cfg.env.id:
+        raise ValueError(
+            f"This experiment is run with a different environment from the one of the "
+            f"experiment you want to restart: got {cfg.env.id}, expected {old_cfg['env']['id']}"
+        )
+    if old_cfg["algo"]["name"] != cfg.algo.name:
+        raise ValueError(
+            f"This experiment is run with a different algorithm from the one of the "
+            f"experiment you want to restart: got {cfg.algo.name}, expected {old_cfg['algo']['name']}"
+        )
+    preserved = {k: cfg[k] for k in _NON_RESUMABLE_KEYS if k in cfg}
+    merged = dict(old_cfg)
+    deep_merge(merged, preserved)
+    merged["checkpoint"]["resume_from"] = str(ckpt_path)
+    return dotdict(merged)
+
+
+def check_configs(cfg: dotdict) -> None:
+    """Config policing (role of reference cli.py:270-344)."""
+    entry = algorithm_registry.get(cfg.algo.name)
+    if entry is None:
+        available = ", ".join(sorted(algorithm_registry.keys()))
+        raise ValueError(f"algorithm {cfg.algo.name!r} is not registered; available: {available}")
+    decoupled = entry[0]["decoupled"]
+    if decoupled and int(os.environ.get("SHEEPRL_NUM_ACTORS", "1")) < 0:
+        raise ValueError("decoupled algorithms need at least one actor process")
+    if cfg.fabric.strategy not in ("auto", "dp", "single_device"):
+        raise ValueError(f"unknown fabric.strategy {cfg.fabric.strategy!r}")
+    if cfg.fabric.strategy == "single_device" and int(cfg.fabric.devices) > 1:
+        raise ValueError("single_device strategy requires fabric.devices=1")
+
+
+def _setup_xla_env(cfg: dotdict) -> None:
+    """Apply the XLA/runtime knobs (replacing torch/cuDNN knobs, reference cli.py:186-196)."""
+    import jax
+
+    prec = str(cfg.get("float32_matmul_precision", "high"))
+    mapping = {"high": "bfloat16_3x", "highest": "float32", "default": "bfloat16"}
+    try:
+        jax.config.update("jax_default_matmul_precision", mapping.get(prec, prec))
+    except Exception:
+        warnings.warn(f"could not set matmul precision {prec!r}")
+    if cfg.get("xla_deterministic_ops", False):
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_gpu_deterministic_ops=true"
+
+
+def run_algorithm(cfg: dotdict) -> None:
+    """Registry lookup → module import → fabric instantiation → launch
+    (reference cli.py:59-198)."""
+    entry = algorithm_registry[cfg.algo.name][0]
+    module = importlib.import_module(entry["module"])
+    main = getattr(module, entry["entrypoint"])
+
+    # metric key filtering: keep only the algo's whitelisted metrics (reference cli.py:150-164)
+    utils_mod = None
+    try:
+        utils_mod = importlib.import_module(f"{entry['module'].rsplit('.', 1)[0]}.utils")
+    except ImportError:
+        pass
+    if utils_mod is not None and hasattr(utils_mod, "AGGREGATOR_KEYS") and cfg.metric.log_level > 0:
+        keys = set(utils_mod.AGGREGATOR_KEYS)
+        metrics = cfg.metric.aggregator.metrics
+        cfg.metric.aggregator.metrics = dotdict(
+            {k: v for k, v in metrics.items() if k in keys}
+        )
+    if cfg.metric.log_level == 0 or cfg.metric.disable_timer:
+        timer.disabled = True
+
+    fabric = instantiate(cfg.fabric)
+    fabric.launch(main, cfg)
+
+
+def run(args: Optional[Sequence[str]] = None) -> None:
+    """Entry point: ``python -m sheeprl_tpu exp=ppo env=gym ...``."""
+    import sheeprl_tpu  # ensure registries are populated
+
+    overrides = list(args if args is not None else sys.argv[1:])
+    cfg = compose(overrides)
+    if cfg.checkpoint.resume_from:
+        cfg = resume_from_checkpoint(cfg)
+    check_configs(cfg)
+    _setup_xla_env(cfg)
+    if cfg.metric.log_level > 0:
+        print_config(cfg)
+    run_algorithm(cfg)
+
+
+def check_configs_evaluation(cfg: dotdict) -> None:
+    if cfg.float32_matmul_precision not in ("default", "high", "highest"):
+        raise ValueError(
+            f"float32_matmul_precision must be one of default/high/highest, got {cfg.float32_matmul_precision}"
+        )
+    if cfg.checkpoint_path is None:
+        raise ValueError("checkpoint_path must be specified")
+
+
+def eval_algorithm(cfg: dotdict) -> None:
+    """Single-device evaluation dispatch (reference cli.py:201-267)."""
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    entry = evaluation_registry.get(cfg.algo.name)
+    if entry is None:
+        available = ", ".join(sorted(evaluation_registry.keys()))
+        raise ValueError(
+            f"no evaluation registered for algorithm {cfg.algo.name!r}; available: {available}"
+        )
+    entry = entry[0]
+    module = importlib.import_module(entry["module"])
+    evaluate_fn = getattr(module, entry["entrypoint"])
+    fabric = Fabric(
+        devices=1,
+        accelerator=cfg.fabric.get("accelerator", "auto"),
+        precision=cfg.fabric.get("precision", "32-true"),
+    )
+    state = None
+    if cfg.checkpoint_path:
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        state = load_checkpoint(cfg.checkpoint_path)
+    fabric.launch(evaluate_fn, cfg, state)
+
+
+def evaluation(args: Optional[Sequence[str]] = None) -> None:
+    """``sheeprl-eval checkpoint_path=... [overrides]`` (reference cli.py:368-404)."""
+    import yaml
+
+    import sheeprl_tpu  # noqa: F401 - populate registries
+
+    overrides = list(args if args is not None else sys.argv[1:])
+    kv = dict(o.split("=", 1) for o in overrides if "=" in o)
+    ckpt_path = kv.get("checkpoint_path")
+    if ckpt_path is None:
+        raise ValueError("you must specify checkpoint_path=...")
+    ckpt_path = Path(ckpt_path)
+    cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not cfg_path.is_file():
+        cfg_path = ckpt_path.parent / "config.yaml"
+    with open(cfg_path) as f:
+        base = yaml.safe_load(f)
+    base["env"]["num_envs"] = 1
+    base["env"]["capture_video"] = yaml.safe_load(kv.get("env.capture_video", "true"))
+    base.setdefault("fabric", {})
+    base["fabric"]["devices"] = 1
+    base["checkpoint_path"] = str(ckpt_path)
+    base["seed"] = int(kv.get("seed", base.get("seed", 42)))
+    if "fabric.accelerator" in kv:
+        base["fabric"]["accelerator"] = kv["fabric.accelerator"]
+    cfg = dotdict(base)
+    check_configs_evaluation(cfg)
+    eval_algorithm(cfg)
+
+
+def registration(args: Optional[Sequence[str]] = None) -> None:
+    """Model-registry publication from a checkpoint (reference cli.py:407-449).
+    Requires mlflow, which is optional."""
+    from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+    if not _IS_MLFLOW_AVAILABLE:
+        raise ModuleNotFoundError(
+            "mlflow is not installed; the model-manager CLI requires it. "
+            "Install mlflow to register models."
+        )
+    from sheeprl_tpu.utils.mlflow import register_model_from_checkpoint
+
+    overrides = list(args if args is not None else sys.argv[1:])
+    kv = dict(o.split("=", 1) for o in overrides if "=" in o)
+    register_model_from_checkpoint(kv)

@@ -1,0 +1,16 @@
+from sheeprl_tpu.config.composer import Composer, ConfigError, MissingMandatoryValue, compose, deep_merge
+from sheeprl_tpu.config.dotdict import dotdict, get_by_path, set_by_path
+from sheeprl_tpu.config.instantiate import instantiate, locate
+
+__all__ = [
+    "Composer",
+    "ConfigError",
+    "MissingMandatoryValue",
+    "compose",
+    "deep_merge",
+    "dotdict",
+    "get_by_path",
+    "set_by_path",
+    "instantiate",
+    "locate",
+]

@@ -1,0 +1,15 @@
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+    get_tensor,
+)
+
+__all__ = [
+    "EnvIndependentReplayBuffer",
+    "EpisodeBuffer",
+    "ReplayBuffer",
+    "SequentialReplayBuffer",
+    "get_tensor",
+]

@@ -1,0 +1,87 @@
+"""Deterministic fake environments for tests/CI (role of sheeprl/envs/dummy.py:8-90):
+dict observations with an ``rgb`` image and a ``state`` vector, zero rewards, fixed
+episode length."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+
+class BaseDummyEnv(gym.Env):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (3, 64, 64),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+    ):
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(0, 255, shape=image_size, dtype=np.uint8),
+                "state": gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32),
+            }
+        )
+        self.reward_range = (-np.inf, np.inf)
+        self.render_mode = "rgb_array"
+        self._current_step = 0
+        self._n_steps = n_steps
+
+    def get_obs(self) -> Dict[str, np.ndarray]:
+        return {
+            "rgb": np.zeros(self.observation_space["rgb"].shape, dtype=np.uint8),
+            "state": np.zeros(self.observation_space["state"].shape, dtype=np.float32),
+        }
+
+    def step(self, action):
+        done = self._current_step == self._n_steps
+        self._current_step += 1
+        return self.get_obs(), 0.0, done, False, {}
+
+    def reset(self, seed=None, options=None):
+        self._current_step = 0
+        return self.get_obs(), {}
+
+    def render(self):
+        rgb = self.get_obs()["rgb"]
+        return np.transpose(rgb, (1, 2, 0))
+
+    def close(self):
+        pass
+
+
+class ContinuousDummyEnv(BaseDummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (3, 64, 64),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+        action_dim: int = 2,
+    ):
+        self.action_space = gym.spaces.Box(-1.0, 1.0, shape=(action_dim,))
+        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape)
+
+
+class DiscreteDummyEnv(BaseDummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (3, 64, 64),
+        n_steps: int = 4,
+        vector_shape: Tuple[int, ...] = (10,),
+        action_dim: int = 2,
+    ):
+        self.action_space = gym.spaces.Discrete(action_dim)
+        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape)
+
+
+class MultiDiscreteDummyEnv(BaseDummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (3, 64, 64),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+        action_dims: List[int] = [2, 2],
+    ):
+        self.action_space = gym.spaces.MultiDiscrete(action_dims)
+        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape)

@@ -1,0 +1,313 @@
+"""Generic environment wrappers.
+
+Same capability set as the reference's wrapper suite (sheeprl/envs/wrappers.py:13-342):
+velocity masking, action repeat, crash-restart with a fail-window budget, dilated frame
+stacking, reward/actions-as-observation, grayscale render. Written against the
+gymnasium 1.x API (the reference targets 0.x).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import gymnasium as gym
+import numpy as np
+
+
+class MaskVelocityWrapper(gym.ObservationWrapper):
+    """Zero out velocity entries to make the MDP partially observable."""
+
+    velocity_indices: Dict[str, np.ndarray] = {
+        "CartPole-v0": np.array([1, 3]),
+        "CartPole-v1": np.array([1, 3]),
+        "MountainCar-v0": np.array([1]),
+        "MountainCarContinuous-v0": np.array([1]),
+        "Pendulum-v1": np.array([2]),
+        "LunarLander-v2": np.array([2, 3, 5]),
+        "LunarLander-v3": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v2": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v3": np.array([2, 3, 5]),
+    }
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        assert env.unwrapped.spec is not None
+        env_id: str = env.unwrapped.spec.id
+        self.mask = np.ones_like(env.observation_space.sample())
+        try:
+            self.mask[self.velocity_indices[env_id]] = 0.0
+        except KeyError as e:
+            raise NotImplementedError(f"Velocity masking not implemented for {env_id}") from e
+
+    def observation(self, observation: np.ndarray) -> np.ndarray:
+        return observation * self.mask
+
+
+class ActionRepeat(gym.Wrapper):
+    """Repeat each action ``amount`` times, accumulating reward, stopping on done."""
+
+    def __init__(self, env: gym.Env, amount: int = 1):
+        super().__init__(env)
+        if amount <= 0:
+            raise ValueError("`amount` should be a positive integer")
+        self._amount = amount
+
+    @property
+    def action_repeat(self) -> int:
+        return self._amount
+
+    def step(self, action):
+        terminated = truncated = False
+        total_reward = 0.0
+        obs, info = None, {}
+        for _ in range(self._amount):
+            obs, reward, terminated, truncated, info = self.env.step(action)
+            total_reward += float(reward)
+            if terminated or truncated:
+                break
+        return obs, total_reward, terminated, truncated, info
+
+
+class RestartOnException(gym.Wrapper):
+    """Rebuild a crashed env in place, with at most ``maxfails`` failures per
+    ``window`` seconds (reference sheeprl/envs/wrappers.py:74-124). Dreamer-V3 wraps
+    every env in this for long-running fault tolerance."""
+
+    def __init__(
+        self,
+        env_fn: Callable[[], gym.Env],
+        exceptions: Union[type, Tuple[type, ...], List[type]] = (Exception,),
+        window: float = 300,
+        maxfails: int = 2,
+        wait: float = 20,
+    ):
+        if not isinstance(exceptions, (tuple, list)):
+            exceptions = [exceptions]
+        self._env_fn = env_fn
+        self._exceptions = tuple(exceptions)
+        self._window = window
+        self._maxfails = maxfails
+        self._wait = wait
+        self._last = time.time()
+        self._fails = 0
+        super().__init__(env_fn())
+
+    def _register_fail(self, err: Exception, where: str) -> None:
+        if time.time() > self._last + self._window:
+            self._last = time.time()
+            self._fails = 1
+        else:
+            self._fails += 1
+        if self._fails > self._maxfails:
+            raise RuntimeError(f"The env crashed too many times: {self._fails}") from err
+        gym.logger.warn(f"{where} - Restarting env after crash with {type(err).__name__}: {err}")
+        time.sleep(self._wait)
+
+    def step(self, action):
+        try:
+            return self.env.step(action)
+        except self._exceptions as e:
+            self._register_fail(e, "STEP")
+            self.env = self._env_fn()
+            new_obs, info = self.env.reset()
+            info.update({"restart_on_exception": True})
+            return new_obs, 0.0, False, False, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        try:
+            return self.env.reset(seed=seed, options=options)
+        except self._exceptions as e:
+            self._register_fail(e, "RESET")
+            self.env = self._env_fn()
+            new_obs, info = self.env.reset(seed=seed, options=options)
+            info.update({"restart_on_exception": True})
+            return new_obs, info
+
+
+class FrameStack(gym.Wrapper):
+    """Stack the last ``num_stack`` image frames (optionally dilated) of each cnn key
+    along a new leading axis: (num_stack, C, H, W)."""
+
+    def __init__(self, env: gym.Env, num_stack: int, cnn_keys: Sequence[str], dilation: int = 1) -> None:
+        super().__init__(env)
+        if num_stack <= 0:
+            raise ValueError(f"Invalid value for num_stack, expected a value greater than zero, got {num_stack}")
+        if not isinstance(env.observation_space, gym.spaces.Dict):
+            raise RuntimeError(
+                f"Expected an observation space of type gym.spaces.Dict, got: {type(env.observation_space)}"
+            )
+        self._num_stack = num_stack
+        self._dilation = dilation
+        self._cnn_keys = []
+        self.observation_space = copy.deepcopy(env.observation_space)
+        for k, v in env.observation_space.spaces.items():
+            if cnn_keys and k in cnn_keys and len(v.shape) == 3:
+                self._cnn_keys.append(k)
+                self.observation_space[k] = gym.spaces.Box(
+                    np.repeat(v.low[None, ...], num_stack, axis=0),
+                    np.repeat(v.high[None, ...], num_stack, axis=0),
+                    (num_stack, *v.shape),
+                    v.dtype,
+                )
+        if not self._cnn_keys:
+            raise RuntimeError("Specify at least one valid cnn key to be stacked")
+        self._frames = {k: deque(maxlen=num_stack * dilation) for k in self._cnn_keys}
+
+    def _get_obs(self, key: str) -> np.ndarray:
+        frames = list(self._frames[key])[self._dilation - 1 :: self._dilation]
+        assert len(frames) == self._num_stack
+        return np.stack(frames, axis=0)
+
+    def step(self, action):
+        obs, reward, terminated, truncated, infos = self.env.step(action)
+        for k in self._cnn_keys:
+            self._frames[k].append(obs[k])
+            obs[k] = self._get_obs(k)
+        return obs, reward, terminated, truncated, infos
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs, infos = self.env.reset(seed=seed, options=options)
+        for k in self._cnn_keys:
+            self._frames[k].clear()
+            for _ in range(self._num_stack * self._dilation):
+                self._frames[k].append(obs[k])
+            obs[k] = self._get_obs(k)
+        return obs, infos
+
+
+class RewardAsObservationWrapper(gym.Wrapper):
+    """Expose the last reward as a (1,)-shaped observation under the ``reward`` key."""
+
+    def __init__(self, env: gym.Env) -> None:
+        super().__init__(env)
+        reward_range = getattr(self.env, "reward_range", None) or (-np.inf, np.inf)
+        reward_space = gym.spaces.Box(*reward_range, (1,), np.float32)
+        if isinstance(self.env.observation_space, gym.spaces.Dict):
+            self.observation_space = gym.spaces.Dict(
+                {"reward": reward_space, **dict(self.env.observation_space.items())}
+            )
+        else:
+            self.observation_space = gym.spaces.Dict(
+                {"obs": self.env.observation_space, "reward": reward_space}
+            )
+
+    def _convert_obs(self, obs: Any, reward: Union[float, np.ndarray]) -> Dict[str, Any]:
+        reward_obs = np.asarray(reward, dtype=np.float32).reshape(-1)
+        if isinstance(obs, dict):
+            obs["reward"] = reward_obs
+            return obs
+        return {"obs": obs, "reward": reward_obs}
+
+    def step(self, action):
+        obs, reward, terminated, truncated, infos = self.env.step(action)
+        return self._convert_obs(obs, copy.deepcopy(reward)), reward, terminated, truncated, infos
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs, infos = self.env.reset(seed=seed, options=options)
+        return self._convert_obs(obs, 0), infos
+
+
+class GrayscaleRenderWrapper(gym.Wrapper):
+    """Expand grayscale render frames to 3 channels so video encoders accept them."""
+
+    def render(self):
+        frame = super().render()
+        if isinstance(frame, np.ndarray):
+            if frame.ndim == 2:
+                frame = frame[..., np.newaxis]
+            if frame.ndim == 3 and frame.shape[-1] == 1:
+                frame = frame.repeat(3, axis=-1)
+        return frame
+
+
+class ActionsAsObservationWrapper(gym.Wrapper):
+    """Expose the last ``num_stack`` (dilated) actions, one-hot for (multi)discrete
+    spaces, under the ``action_stack`` observation key."""
+
+    def __init__(self, env: gym.Env, num_stack: int, noop: Union[float, int, List[int]], dilation: int = 1):
+        super().__init__(env)
+        if num_stack < 1:
+            raise ValueError(
+                f"The number of actions to stack must be greater or equal than 1, got: {num_stack}"
+            )
+        if dilation < 1:
+            raise ValueError(f"The actions stack dilation argument must be greater than zero, got: {dilation}")
+        if not isinstance(noop, (int, float, list)):
+            raise ValueError(f"The noop action must be an integer or float or list, got: {noop} ({type(noop)})")
+        self._num_stack = num_stack
+        self._dilation = dilation
+        self._actions: deque = deque(maxlen=num_stack * dilation)
+        self._is_continuous = isinstance(self.env.action_space, gym.spaces.Box)
+        self._is_multidiscrete = isinstance(self.env.action_space, gym.spaces.MultiDiscrete)
+        self.observation_space = copy.deepcopy(self.env.observation_space)
+        if self._is_continuous:
+            self._action_shape = self.env.action_space.shape[0]
+            low = np.resize(self.env.action_space.low, self._action_shape * num_stack)
+            high = np.resize(self.env.action_space.high, self._action_shape * num_stack)
+        elif self._is_multidiscrete:
+            low, high = 0, 1
+            self._action_shape = int(sum(self.env.action_space.nvec))
+        else:
+            low, high = 0, 1
+            self._action_shape = int(self.env.action_space.n)
+        self.observation_space["action_stack"] = gym.spaces.Box(
+            low=low, high=high, shape=(self._action_shape * num_stack,), dtype=np.float32
+        )
+        if self._is_continuous:
+            if isinstance(noop, list):
+                raise ValueError(f"The noop actions must be a float for continuous action spaces, got: {noop}")
+            self.noop = np.full((self._action_shape,), noop, dtype=np.float32)
+        elif self._is_multidiscrete:
+            if not isinstance(noop, list):
+                raise ValueError(f"The noop actions must be a list for multi-discrete action spaces, got: {noop}")
+            if len(self.env.action_space.nvec) != len(noop):
+                raise RuntimeError(
+                    "The number of noop actions must equal the number of actions of the environment. "
+                    f"Got env_action_space = {self.env.action_space.nvec} and noop = {noop}"
+                )
+            pieces = []
+            for noop_act, n in zip(noop, self.env.action_space.nvec):
+                piece = np.zeros((int(n),), dtype=np.float32)
+                piece[int(noop_act)] = 1.0
+                pieces.append(piece)
+            self.noop = np.concatenate(pieces, axis=-1)
+        else:
+            if isinstance(noop, (list, float)):
+                raise ValueError(f"The noop actions must be an integer for discrete action spaces, got: {noop}")
+            self.noop = np.zeros((self._action_shape,), dtype=np.float32)
+            self.noop[int(noop)] = 1.0
+
+    def _one_hot(self, action: Any) -> np.ndarray:
+        if self._is_continuous:
+            return np.asarray(action, dtype=np.float32).reshape(-1)
+        if self._is_multidiscrete:
+            pieces = []
+            for act, n in zip(action, self.env.action_space.nvec):
+                piece = np.zeros((int(n),), dtype=np.float32)
+                piece[int(act)] = 1.0
+                pieces.append(piece)
+            return np.concatenate(pieces, axis=-1)
+        one_hot = np.zeros((self._action_shape,), dtype=np.float32)
+        one_hot[int(np.asarray(action).reshape(()))] = 1.0
+        return one_hot
+
+    def _get_actions_stack(self) -> np.ndarray:
+        stack = list(self._actions)[self._dilation - 1 :: self._dilation]
+        return np.concatenate(stack, axis=-1).astype(np.float32)
+
+    def step(self, action):
+        self._actions.append(self._one_hot(action))
+        obs, reward, terminated, truncated, info = super().step(action)
+        obs["action_stack"] = self._get_actions_stack()
+        return obs, reward, terminated, truncated, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs, info = super().reset(seed=seed, options=options)
+        self._actions.clear()
+        for _ in range(self._num_stack * self._dilation):
+            self._actions.append(self.noop)
+        obs["action_stack"] = self._get_actions_stack()
+        return obs, info

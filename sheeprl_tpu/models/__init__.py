@@ -1,0 +1,21 @@
+from sheeprl_tpu.models.models import (
+    CNN,
+    MLP,
+    DeCNN,
+    LayerNormGRUCell,
+    MultiDecoder,
+    MultiEncoder,
+    NatureCNN,
+    resolve_activation,
+)
+
+__all__ = [
+    "CNN",
+    "MLP",
+    "DeCNN",
+    "LayerNormGRUCell",
+    "MultiDecoder",
+    "MultiEncoder",
+    "NatureCNN",
+    "resolve_activation",
+]

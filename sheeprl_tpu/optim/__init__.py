@@ -1,0 +1,116 @@
+"""Optimizers: reference-style constructor signatures mapped onto optax.
+
+The config tree instantiates optimizers with torch-style keys (lr/eps/betas/alpha/
+weight_decay — see sheeprl/configs/optim/*.yaml); these helpers translate them into
+optax gradient transformations. ``rmsprop_tf`` reimplements the reference's TF-flavored
+RMSprop (eps inside the sqrt, momentum applied on lr-scaled update —
+sheeprl/optim/rmsprop_tf.py:14-156) as an optax transform.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _maybe_weight_decay(tx: optax.GradientTransformation, weight_decay: float) -> optax.GradientTransformation:
+    if weight_decay and weight_decay > 0:
+        return optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def adam(
+    lr: float = 1e-3,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    betas: Sequence[float] = (0.9, 0.999),
+    **_: Any,
+) -> optax.GradientTransformation:
+    b1, b2 = float(betas[0]), float(betas[1])
+    if weight_decay and weight_decay > 0:
+        return optax.adamw(lr, b1=b1, b2=b2, eps=float(eps), weight_decay=float(weight_decay))
+    return optax.adam(lr, b1=b1, b2=b2, eps=float(eps))
+
+
+def sgd(
+    lr: float = 1e-3,
+    momentum: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    **_: Any,
+) -> optax.GradientTransformation:
+    tx = optax.sgd(lr, momentum=float(momentum) or None, nesterov=bool(nesterov))
+    return _maybe_weight_decay(tx, weight_decay)
+
+
+def rmsprop(
+    lr: float = 1e-2,
+    alpha: float = 0.99,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    momentum: float = 0.0,
+    centered: bool = False,
+    **_: Any,
+) -> optax.GradientTransformation:
+    tx = optax.rmsprop(
+        lr, decay=float(alpha), eps=float(eps), centered=bool(centered), momentum=float(momentum) or None
+    )
+    return _maybe_weight_decay(tx, weight_decay)
+
+
+def scale_by_rms_tf(alpha: float = 0.99, eps: float = 1e-8, centered: bool = False) -> optax.GradientTransformation:
+    """RMS scaling with epsilon *inside* the square root (TF semantics), matching the
+    reference's RMSpropTF update rule (sheeprl/optim/rmsprop_tf.py:103-156: square_avg
+    initialized at ones, ``avg = sqrt(square_avg + eps)``)."""
+
+    def init(params):
+        sq = jax.tree_util.tree_map(jnp.ones_like, params)
+        if centered:
+            return {"square_avg": sq, "grad_avg": jax.tree_util.tree_map(jnp.zeros_like, params)}
+        return {"square_avg": sq}
+
+    def update(updates, state, params=None):
+        del params
+        square_avg = jax.tree_util.tree_map(
+            lambda s, g: alpha * s + (1 - alpha) * jnp.square(g), state["square_avg"], updates
+        )
+        if centered:
+            grad_avg = jax.tree_util.tree_map(
+                lambda m, g: alpha * m + (1 - alpha) * g, state["grad_avg"], updates
+            )
+            denom = jax.tree_util.tree_map(
+                lambda s, m: jnp.sqrt(s - jnp.square(m) + eps), square_avg, grad_avg
+            )
+            new_state = {"square_avg": square_avg, "grad_avg": grad_avg}
+        else:
+            denom = jax.tree_util.tree_map(lambda s: jnp.sqrt(s + eps), square_avg)
+            new_state = {"square_avg": square_avg}
+        scaled = jax.tree_util.tree_map(lambda g, d: g / d, updates, denom)
+        return scaled, new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+def rmsprop_tf(
+    lr: float = 1e-2,
+    alpha: float = 0.99,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    momentum: float = 0.0,
+    centered: bool = False,
+    **_: Any,
+) -> optax.GradientTransformation:
+    parts = [scale_by_rms_tf(alpha=float(alpha), eps=float(eps), centered=bool(centered))]
+    if momentum and momentum > 0:
+        # TF-style: momentum buffer accumulates the lr-scaled update
+        parts.append(optax.scale(float(lr)))
+        parts.append(optax.trace(decay=float(momentum)))
+        parts.append(optax.scale(-1.0))
+        tx = optax.chain(*parts)
+    else:
+        parts.append(optax.scale(-float(lr)))
+        tx = optax.chain(*parts)
+    return _maybe_weight_decay(tx, weight_decay)

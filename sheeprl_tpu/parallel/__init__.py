@@ -1,0 +1,4 @@
+from sheeprl_tpu.parallel import distributed
+from sheeprl_tpu.parallel.fabric import Fabric, get_single_device_fabric
+
+__all__ = ["Fabric", "distributed", "get_single_device_fabric"]

@@ -1,0 +1,90 @@
+"""Host-side distributed helpers: the object plane.
+
+The reference moves config/metrics/log-dirs/buffers between ranks as pickled objects
+over Gloo (sheeprl/utils/logger.py:53-89, sheeprl/utils/callback.py:42-52,
+sheeprl/algos/ppo/ppo_decoupled.py:114-117). JAX has no object collectives, so the
+TPU-native object plane is: pickle → uint8 device array → XLA collective over DCN via
+``jax.experimental.multihost_utils``. On a single host every helper is the identity, so
+algorithm code can call them unconditionally.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List
+
+import numpy as np
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def initialize(coordinator_address: str | None = None, num_processes: int | None = None, process_id: int | None = None) -> None:
+    """Multi-host bring-up (maps the reference's torch.distributed init to
+    jax.distributed.initialize). No-op when already initialized or single-host."""
+    import jax
+
+    if jax.process_count() > 1:
+        return
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+
+
+def host_allsum(value: float) -> float:
+    if process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    import jax.numpy as jnp
+
+    out = multihost_utils.process_allgather(jnp.asarray([value], dtype=jnp.float64))
+    return float(np.asarray(out).sum())
+
+
+def host_broadcast_object(obj: Any, src: int = 0) -> Any:
+    if process_count() == 1:
+        return obj
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(obj) if process_index() == src else b""
+    # length first (fixed shape), then padded payload
+    length = np.asarray([len(payload)], dtype=np.int64)
+    length = int(np.asarray(multihost_utils.broadcast_one_to_all(length, is_source=process_index() == src))[0])
+    buf = np.zeros(max(length, 1), dtype=np.uint8)
+    if process_index() == src:
+        buf[:length] = np.frombuffer(payload, dtype=np.uint8)
+    buf = np.asarray(multihost_utils.broadcast_one_to_all(buf, is_source=process_index() == src))
+    return pickle.loads(buf[:length].tobytes())
+
+
+def host_allgather_object(obj: Any) -> List[Any]:
+    if process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    length = np.asarray([payload.size], dtype=np.int64)
+    lengths = np.asarray(multihost_utils.process_allgather(length)).reshape(-1)
+    max_len = int(lengths.max())
+    buf = np.zeros(max_len, dtype=np.uint8)
+    buf[: payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return [pickle.loads(gathered[i, : int(lengths[i])].tobytes()) for i in range(gathered.shape[0])]
+
+
+def barrier(name: str = "barrier") -> None:
+    if process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -1,0 +1,217 @@
+"""The runtime/distribution layer (L8): a mesh-based replacement for Lightning Fabric.
+
+The reference drives everything through ``lightning.fabric.Fabric`` (instantiated from
+config at sheeprl/cli.py:148, strategies policed at cli.py:281-331). The TPU-native
+equivalent keeps the same *user surface* (``fabric.devices``, ``strategy``,
+``precision``, ``fabric.launch(main, cfg)``, ``fabric.call(...)``, ``fabric.save``)
+but is built on:
+
+- a ``jax.sharding.Mesh`` with a ``data`` axis over the selected chips — DP is sharding
+  inside one jitted program (psum over ICI), not multi-process DDP;
+- "ranks" = mesh devices for batch-size math (``per_rank_batch_size`` keeps meaning:
+  the per-device shard), while host-process rank gates logging/checkpoint IO;
+- a precision policy (param/compute dtypes) replacing AMP strings;
+- callbacks (CheckpointCallback) invoked via ``fabric.call`` exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.parallel import distributed
+
+
+class Fabric:
+    def __init__(
+        self,
+        devices: int | str = 1,
+        num_nodes: int = 1,
+        strategy: str = "auto",
+        accelerator: str = "auto",
+        precision: str = "32-true",
+        callbacks: Optional[Sequence[Any]] = None,
+    ) -> None:
+        self.requested_devices = devices
+        self.num_nodes = num_nodes
+        self.strategy = strategy
+        self.accelerator = accelerator
+        self.precision = precision
+        self._callbacks = []
+        for cb in callbacks or []:
+            if isinstance(cb, dict) and "_target_" in cb:
+                from sheeprl_tpu.config import instantiate
+
+                cb = instantiate(dict(cb))
+            self._callbacks.append(cb)
+        self._mesh: Optional[Mesh] = None
+        self._launched = False
+
+    # -- topology ------------------------------------------------------------------
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self._setup()
+        return self._mesh  # type: ignore[return-value]
+
+    @property
+    def devices(self) -> List[jax.Device]:
+        return list(self.mesh.devices.reshape(-1))
+
+    @property
+    def world_size(self) -> int:
+        """Number of devices on the data axis — the unit 'per_rank' sizes refer to."""
+        return len(self.devices)
+
+    @property
+    def global_rank(self) -> int:
+        """Host-process rank: gates logger/checkpoint IO (single-controller JAX)."""
+        return distributed.process_index()
+
+    @property
+    def node_rank(self) -> int:
+        return distributed.process_index()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return self.global_rank == 0
+
+    @property
+    def device(self) -> jax.Device:
+        return self.devices[0]
+
+    # -- precision policy ----------------------------------------------------------
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.bfloat16 if str(self.precision).startswith("bf16") else jnp.float32
+
+    @property
+    def param_dtype(self) -> jnp.dtype:
+        return jnp.bfloat16 if str(self.precision) == "bf16-true" else jnp.float32
+
+    # -- setup / launch ------------------------------------------------------------
+
+    def _resolve_platform(self) -> str:
+        if self.accelerator in ("auto", None):
+            platforms = {d.platform for d in jax.devices()}
+            return "tpu" if "tpu" in platforms else jax.devices()[0].platform
+        if self.accelerator in ("tpu", "cpu", "gpu"):
+            return self.accelerator
+        raise ValueError(f"unknown accelerator {self.accelerator!r}")
+
+    def _setup(self) -> None:
+        if self.accelerator == "cpu":
+            # restrict platform discovery so a cpu run never initializes (or blocks on)
+            # an accelerator backend
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        platform = self._resolve_platform()
+        try:
+            all_devices = jax.devices(platform)
+        except RuntimeError:
+            all_devices = jax.devices()
+        n = self.requested_devices
+        if n in ("auto", -1, "-1", None):
+            n = len(all_devices)
+        n = int(n)
+        if n > len(all_devices):
+            raise RuntimeError(
+                f"requested {n} devices but only {len(all_devices)} {platform} devices are "
+                "available; for CPU-simulated meshes set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N"
+            )
+        mesh_devices = np.asarray(all_devices[:n])
+        self._mesh = Mesh(mesh_devices, axis_names=("data",))
+        # make uncommitted computations follow the selected accelerator (otherwise a
+        # `fabric.accelerator=cpu` run would still trace onto a default TPU device)
+        jax.config.update("jax_default_device", all_devices[0])
+
+    def launch(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        """Run ``fn(self, *args)`` with the mesh set up. Unlike torch DDP there is no
+        process spawn: SPMD parallelism lives inside jitted programs; multi-host runs
+        are N externally-launched identical processes (jax.distributed)."""
+        self._setup()
+        self._launched = True
+        return fn(self, *args, **kwargs)
+
+    # -- sharding helpers ----------------------------------------------------------
+
+    def sharding(self, *axes: Optional[str]) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*axes))
+
+    @property
+    def data_sharding(self) -> NamedSharding:
+        """Leading-axis sharding over the data axis of the mesh."""
+        return NamedSharding(self.mesh, P("data"))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_pytree(self, tree: Any) -> Any:
+        """Device-put a host pytree with its leading axis sharded over ``data``."""
+        return jax.device_put(tree, self.data_sharding)
+
+    def replicate_pytree(self, tree: Any) -> Any:
+        return jax.device_put(tree, self.replicated)
+
+    def all_gather(self, tree: Any) -> Any:
+        """Host-visible gather of per-device data (reference fabric.all_gather,
+        used for buffer.share_data at sheeprl/algos/ppo/ppo.py:362-369)."""
+        return jax.tree_util.tree_map(np.asarray, tree)
+
+    # -- callbacks / io ------------------------------------------------------------
+
+    def call(self, hook: str, **kwargs: Any) -> None:
+        for cb in self._callbacks:
+            fn = getattr(cb, hook, None)
+            if fn is not None:
+                fn(fabric=self, **kwargs)
+
+    def save(self, path: str, state: Dict[str, Any]) -> None:
+        from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+        if self.is_global_zero:
+            save_checkpoint(path, state)
+        distributed.barrier("checkpoint")
+
+    def load(self, path: str) -> Dict[str, Any]:
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        return load_checkpoint(path)
+
+    def print(self, *args: Any, **kwargs: Any) -> None:
+        if self.is_global_zero:
+            print(*args, **kwargs)
+
+    # -- misc ----------------------------------------------------------------------
+
+    def seed_everything(self, seed: int) -> jax.Array:
+        import random
+
+        random.seed(seed)
+        np.random.seed(seed)
+        return jax.random.PRNGKey(seed)
+
+
+def get_single_device_fabric(fabric: Fabric) -> Fabric:
+    """Single-device view sharing accelerator/precision (role of
+    sheeprl/utils/fabric.py:8-36). Used by player-side code that must not shard."""
+    f = Fabric(
+        devices=1,
+        num_nodes=1,
+        strategy="single_device",
+        accelerator=fabric.accelerator,
+        precision=fabric.precision,
+        callbacks=[],
+    )
+    return f

@@ -1,0 +1,93 @@
+"""Checkpoint callback (role of sheeprl/utils/callback.py:14-153).
+
+Hooks are invoked through ``fabric.call`` from the training loops. Replay-buffer state
+is included when ``buffer.checkpoint`` is set; before writing, the last inserted row of
+each buffer is flagged truncated (and restored afterwards) so a resumed buffer never
+straddles a live episode — the reference's ``_ckpt_rb`` protocol
+(sheeprl/utils/callback.py:91-146).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+
+class CheckpointCallback:
+    def __init__(self, keep_last: Optional[int] = None, **_: Any) -> None:
+        self.keep_last = keep_last
+
+    def on_checkpoint_coupled(
+        self,
+        fabric,
+        ckpt_path: str,
+        state: Dict[str, Any],
+        replay_buffer=None,
+    ) -> None:
+        if replay_buffer is not None:
+            true_dones = self._ckpt_rb(replay_buffer)
+            state["rb"] = replay_buffer
+        fabric.save(ckpt_path, state)
+        if replay_buffer is not None:
+            self._experiment_consistent_rb(replay_buffer, true_dones)
+            state.pop("rb", None)
+        if fabric.is_global_zero:
+            self._delete_old_checkpoints(os.path.dirname(ckpt_path))
+
+    def on_checkpoint_player(self, fabric, ckpt_path: str, state: Dict[str, Any], replay_buffer=None) -> None:
+        # decoupled topology: the player holds the buffer, the trainer sent the weights
+        self.on_checkpoint_coupled(fabric, ckpt_path, state, replay_buffer)
+
+    def on_checkpoint_trainer(self, fabric, player_channel, state: Dict[str, Any], ckpt_path: str) -> None:
+        player_channel.put(("checkpoint", ckpt_path, state))
+
+    # -- truncated-flag protocol ---------------------------------------------------
+
+    def _ckpt_rb(self, rb) -> Union[List, Any]:
+        """Mark the most recently written row as truncated; returns the saved flags so
+        they can be restored after the write."""
+        from sheeprl_tpu.data.buffers import (
+            EnvIndependentReplayBuffer,
+            EpisodeBuffer,
+            ReplayBuffer,
+        )
+
+        if isinstance(rb, ReplayBuffer):
+            if "dones" not in rb.buffer and "terminated" in rb.buffer:
+                state = (rb["terminated"][(rb._pos - 1) % rb.buffer_size, :].copy(),
+                         rb["truncated"][(rb._pos - 1) % rb.buffer_size, :].copy())
+                rb["terminated"][(rb._pos - 1) % rb.buffer_size, :] = True
+                rb["truncated"][(rb._pos - 1) % rb.buffer_size, :] = True
+                return state
+            state = rb["dones"][(rb._pos - 1) % rb.buffer_size, :].copy()
+            rb["dones"][(rb._pos - 1) % rb.buffer_size, :] = True
+            return state
+        if isinstance(rb, EnvIndependentReplayBuffer):
+            return [self._ckpt_rb(b) for b in rb.buffer]
+        if isinstance(rb, EpisodeBuffer):
+            return None
+        return None
+
+    def _experiment_consistent_rb(self, rb, true_dones) -> None:
+        from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, ReplayBuffer
+
+        if isinstance(rb, ReplayBuffer):
+            if isinstance(true_dones, tuple):
+                rb["terminated"][(rb._pos - 1) % rb.buffer_size, :] = true_dones[0]
+                rb["truncated"][(rb._pos - 1) % rb.buffer_size, :] = true_dones[1]
+            elif true_dones is not None:
+                rb["dones"][(rb._pos - 1) % rb.buffer_size, :] = true_dones
+        elif isinstance(rb, EnvIndependentReplayBuffer):
+            for b, flags in zip(rb.buffer, true_dones):
+                self._experiment_consistent_rb(b, flags)
+
+    def _delete_old_checkpoints(self, ckpt_folder: str) -> None:
+        if not self.keep_last:
+            return
+        ckpts = sorted(glob.glob(os.path.join(ckpt_folder, "*.ckpt")), key=os.path.getmtime)
+        for stale in ckpts[: max(0, len(ckpts) - self.keep_last)]:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass

@@ -1,0 +1,41 @@
+"""Checkpoint serialization.
+
+The reference saves a consolidated state dict per checkpoint via ``fabric.save``
+(sheeprl/utils/callback.py:31-57). Here a checkpoint is a single file: every jax array
+in the state pytree is pulled to host numpy and the whole tree is pickled (optax states,
+numpy replay buffers, counters and plain objects all serialize uniformly). Orbax-style
+sharded async checkpointing can layer on top for XL models; the file format is an
+implementation detail behind save/load.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+def _to_host(tree: Any) -> Any:
+    def leaf(x: Any) -> Any:
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def save_checkpoint(path: str, state: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    host_state = _to_host(state)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        return pickle.load(f)

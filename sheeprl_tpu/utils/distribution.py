@@ -1,0 +1,425 @@
+"""Probability distributions, JAX-native.
+
+Re-provides the reference's distribution toolbox (sheeprl/utils/distribution.py:
+TruncatedNormal:55, SymlogDistribution:152, MSEDistribution:196,
+TwoHotEncodingDistribution:224, OneHotCategorical(+ST):281/386, BernoulliSafeMode:407)
+as lightweight stateless classes. Everything is traceable under jit: sampling takes an
+explicit PRNG key, straight-through gradients use ``stop_gradient`` composition.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.utils import symexp, symlog
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+def _sum_rightmost(x: jax.Array, ndims: int) -> jax.Array:
+    if ndims == 0:
+        return x
+    return x.sum(axis=tuple(range(-ndims, 0)))
+
+
+class Distribution:
+    """Minimal distribution protocol: mean/mode/sample/log_prob/entropy."""
+
+    @property
+    def mean(self) -> jax.Array:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @property
+    def mode(self) -> jax.Array:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def sample(self, key: jax.Array) -> jax.Array:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def log_prob(self, value: jax.Array) -> jax.Array:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def entropy(self) -> jax.Array:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc: jax.Array, scale: jax.Array):
+        self.loc = loc
+        self.scale = scale
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.loc
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.loc
+
+    @property
+    def stddev(self) -> jax.Array:
+        return self.scale
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return self.loc + self.scale * jax.random.normal(key, self.loc.shape, self.loc.dtype)
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        return self.sample(key)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        var = jnp.square(self.scale)
+        return -jnp.square(value - self.loc) / (2 * var) - jnp.log(self.scale) - _HALF_LOG_2PI
+
+    def entropy(self) -> jax.Array:
+        return 0.5 + _HALF_LOG_2PI + jnp.log(self.scale)
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost batch dims of a base distribution as event dims."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_ndims: int = 1):
+        self.base = base
+        self.ndims = reinterpreted_batch_ndims
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.base.mean
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.base.mode
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return self.base.sample(key)
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        return self.base.rsample(key) if hasattr(self.base, "rsample") else self.base.sample(key)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        return _sum_rightmost(self.base.log_prob(value), self.ndims)
+
+    def entropy(self) -> jax.Array:
+        return _sum_rightmost(self.base.entropy(), self.ndims)
+
+
+class Categorical(Distribution):
+    """Integer-valued categorical over the last axis of ``logits``."""
+
+    def __init__(self, logits: Optional[jax.Array] = None, probs: Optional[jax.Array] = None):
+        if logits is None and probs is None:
+            raise ValueError("either logits or probs must be given")
+        if logits is None:
+            logits = jnp.log(jnp.clip(probs, 1e-38, None))
+        self.logits = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    @property
+    def num_categories(self) -> int:
+        return self.logits.shape[-1]
+
+    @property
+    def mean(self) -> jax.Array:
+        return jnp.sum(self.probs * jnp.arange(self.num_categories), axis=-1)
+
+    @property
+    def mode(self) -> jax.Array:
+        return jnp.argmax(self.logits, axis=-1)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jax.random.categorical(key, self.logits, axis=-1)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        value = value.astype(jnp.int32)
+        return jnp.take_along_axis(self.logits, value[..., None], axis=-1)[..., 0]
+
+    def entropy(self) -> jax.Array:
+        return -jnp.sum(self.probs * self.logits, axis=-1)
+
+
+class OneHotCategorical(Distribution):
+    """One-hot-valued categorical (reference OneHotCategoricalValidateArgs:281)."""
+
+    def __init__(self, logits: Optional[jax.Array] = None, probs: Optional[jax.Array] = None):
+        self._cat = Categorical(logits=logits, probs=probs)
+
+    @property
+    def logits(self) -> jax.Array:
+        return self._cat.logits
+
+    @property
+    def probs(self) -> jax.Array:
+        return self._cat.probs
+
+    @property
+    def mean(self) -> jax.Array:
+        return self._cat.probs
+
+    @property
+    def mode(self) -> jax.Array:
+        return jax.nn.one_hot(self._cat.mode, self._cat.num_categories, dtype=self.logits.dtype)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        idx = self._cat.sample(key)
+        return jax.nn.one_hot(idx, self._cat.num_categories, dtype=self.logits.dtype)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        return jnp.sum(self.logits * value, axis=-1)
+
+    def entropy(self) -> jax.Array:
+        return self._cat.entropy()
+
+
+class OneHotCategoricalStraightThrough(OneHotCategorical):
+    """Sampling carries straight-through gradients w.r.t. the probs
+    (reference OneHotCategoricalStraightThroughValidateArgs:386) — the discrete-latent
+    sampler of Dreamer-V2/V3."""
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        sample = jax.lax.stop_gradient(self.sample(key))
+        probs = self.probs
+        return sample + probs - jax.lax.stop_gradient(probs)
+
+
+class TruncatedNormal(Distribution):
+    """Normal truncated to [low, high] (reference TruncatedNormal:55-147, used for
+    Dreamer-V1/V2 continuous actions)."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, low: float = -1.0, high: float = 1.0):
+        self.loc = loc
+        self.scale = scale
+        self.low = low
+        self.high = high
+        self._alpha = (low - loc) / scale
+        self._beta = (high - loc) / scale
+        sqrt2 = math.sqrt(2.0)
+        self._big_phi_alpha = 0.5 * (1 + jax.scipy.special.erf(self._alpha / sqrt2))
+        self._big_phi_beta = 0.5 * (1 + jax.scipy.special.erf(self._beta / sqrt2))
+        self._z = jnp.clip(self._big_phi_beta - self._big_phi_alpha, 1e-8, None)
+
+    @property
+    def mean(self) -> jax.Array:
+        phi_a = jnp.exp(-0.5 * jnp.square(self._alpha)) / math.sqrt(2 * math.pi)
+        phi_b = jnp.exp(-0.5 * jnp.square(self._beta)) / math.sqrt(2 * math.pi)
+        return self.loc + self.scale * (phi_a - phi_b) / self._z
+
+    @property
+    def mode(self) -> jax.Array:
+        return jnp.clip(self.loc, self.low, self.high)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        raw = jax.random.truncated_normal(key, self._alpha, self._beta, self.loc.shape)
+        return self.loc + self.scale * raw
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        # reparameterized via inverse-cdf with straight-through clipping
+        u = jax.random.uniform(key, self.loc.shape, minval=1e-6, maxval=1 - 1e-6)
+        p = self._big_phi_alpha + u * (self._big_phi_beta - self._big_phi_alpha)
+        raw = jnp.sqrt(2.0) * jax.scipy.special.erfinv(2 * p - 1)
+        return jnp.clip(self.loc + self.scale * raw, self.low, self.high)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        std_lp = -jnp.square((value - self.loc) / self.scale) / 2 - _HALF_LOG_2PI
+        return std_lp - jnp.log(self.scale) - jnp.log(self._z)
+
+    def entropy(self) -> jax.Array:
+        # differential entropy of the untruncated normal as an upper bound surrogate
+        return 0.5 + _HALF_LOG_2PI + jnp.log(self.scale)
+
+
+class TanhTransformedNormal(Distribution):
+    """Normal squashed through tanh with exact log-prob correction — the SAC policy
+    head (the reference computes the correction inline, sheeprl/algos/sac/agent.py)."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, eps: float = 1e-6):
+        self.base = Normal(loc, scale)
+        self._eps = eps
+
+    @property
+    def mean(self) -> jax.Array:
+        return jnp.tanh(self.base.mean)
+
+    @property
+    def mode(self) -> jax.Array:
+        return jnp.tanh(self.base.mode)
+
+    def sample_and_log_prob(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = self.base.sample(key)
+        y = jnp.tanh(x)
+        lp = self.base.log_prob(x) - jnp.log1p(-jnp.square(y) + self._eps)
+        return y, lp
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jnp.tanh(self.base.sample(key))
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        return self.sample(key)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        value = jnp.clip(value, -1 + self._eps, 1 - self._eps)
+        x = jnp.arctanh(value)
+        return self.base.log_prob(x) - jnp.log1p(-jnp.square(value) + self._eps)
+
+    def entropy(self) -> jax.Array:
+        return self.base.entropy()
+
+
+class SymlogDistribution(Distribution):
+    """-||pred - symlog(x)||^2 surrogate log-prob (reference distribution.py:152-193)."""
+
+    def __init__(self, mode: jax.Array, dims: int, dist: str = "mse", agg: str = "sum", tol: float = 1e-8):
+        self._mode = mode
+        self._dims = dims
+        self._dist = dist
+        self._agg = agg
+        self._tol = tol
+
+    @property
+    def mode(self) -> jax.Array:
+        return symexp(self._mode)
+
+    @property
+    def mean(self) -> jax.Array:
+        return symexp(self._mode)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        assert self._mode.shape == value.shape, (self._mode.shape, value.shape)
+        if self._dist == "mse":
+            distance = jnp.square(self._mode - symlog(value))
+        elif self._dist == "abs":
+            distance = jnp.abs(self._mode - symlog(value))
+        else:
+            raise NotImplementedError(self._dist)
+        distance = jnp.where(distance < self._tol, 0.0, distance)
+        if self._agg == "mean":
+            return -distance.mean(axis=tuple(range(-self._dims, 0)))
+        if self._agg == "sum":
+            return -_sum_rightmost(distance, self._dims)
+        raise NotImplementedError(self._agg)
+
+
+class MSEDistribution(Distribution):
+    """-||pred - x||^2 surrogate log-prob (reference distribution.py:196-221)."""
+
+    def __init__(self, mode: jax.Array, dims: int, agg: str = "sum"):
+        self._mode = mode
+        self._dims = dims
+        self._agg = agg
+
+    @property
+    def mode(self) -> jax.Array:
+        return self._mode
+
+    @property
+    def mean(self) -> jax.Array:
+        return self._mode
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        assert self._mode.shape == value.shape, (self._mode.shape, value.shape)
+        distance = jnp.square(self._mode - value)
+        if self._agg == "mean":
+            return -distance.mean(axis=tuple(range(-self._dims, 0)))
+        if self._agg == "sum":
+            return -_sum_rightmost(distance, self._dims)
+        raise NotImplementedError(self._agg)
+
+
+class TwoHotEncodingDistribution(Distribution):
+    """255-bin symexp-twohot distribution (reference distribution.py:224-278) — the
+    reward/value head of Dreamer-V3."""
+
+    def __init__(
+        self,
+        logits: jax.Array,
+        dims: int = 0,
+        low: float = -20.0,
+        high: float = 20.0,
+        transfwd: Callable[[jax.Array], jax.Array] = symlog,
+        transbwd: Callable[[jax.Array], jax.Array] = symexp,
+    ):
+        self.logits = logits
+        self.dims = dims
+        self.bins = jnp.linspace(low, high, logits.shape[-1], dtype=logits.dtype)
+        self.low = low
+        self.high = high
+        self.transfwd = transfwd
+        self.transbwd = transbwd
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    @property
+    def mean(self) -> jax.Array:
+        agg = jnp.sum(self.probs * self.bins, axis=-1, keepdims=True)
+        if self.dims > 1:
+            agg = agg.sum(axis=tuple(range(-self.dims, -1)))
+        return self.transbwd(agg)
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.mean
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        x = self.transfwd(x)
+        n_bins = self.bins.shape[-1]
+        below = jnp.sum((self.bins <= x).astype(jnp.int32), axis=-1, keepdims=True) - 1
+        above = below + 1
+        above = jnp.minimum(above, n_bins - 1)
+        below = jnp.maximum(below, 0)
+        equal = below == above
+        dist_to_below = jnp.where(equal, 1, jnp.abs(self.bins[below] - x))
+        dist_to_above = jnp.where(equal, 1, jnp.abs(self.bins[above] - x))
+        total = dist_to_below + dist_to_above
+        weight_below = dist_to_above / total
+        weight_above = dist_to_below / total
+        target = (
+            jax.nn.one_hot(below, n_bins, dtype=self.logits.dtype) * weight_below[..., None]
+            + jax.nn.one_hot(above, n_bins, dtype=self.logits.dtype) * weight_above[..., None]
+        )[..., 0, :]
+        log_pred = self.logits - jax.nn.logsumexp(self.logits, axis=-1, keepdims=True)
+        lp = jnp.sum(target * log_pred, axis=-1, keepdims=True)
+        return _sum_rightmost(lp, self.dims) if self.dims > 0 else lp[..., 0]
+
+
+class Bernoulli(Distribution):
+    def __init__(self, logits: Optional[jax.Array] = None, probs: Optional[jax.Array] = None):
+        if logits is None and probs is None:
+            raise ValueError("either logits or probs must be given")
+        if logits is None:
+            probs = jnp.clip(probs, 1e-7, 1 - 1e-7)
+            logits = jnp.log(probs) - jnp.log1p(-probs)
+        self.logits = logits
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.sigmoid(self.logits)
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.probs
+
+    @property
+    def mode(self) -> jax.Array:
+        return (self.probs > 0.5).astype(self.logits.dtype)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jax.random.bernoulli(key, self.probs).astype(self.logits.dtype)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        return -jnp.logaddexp(0.0, jnp.where(value > 0.5, -self.logits, self.logits))
+
+    def entropy(self) -> jax.Array:
+        p = self.probs
+        return -(p * jnp.log(jnp.clip(p, 1e-8, None)) + (1 - p) * jnp.log(jnp.clip(1 - p, 1e-8, None)))
+
+
+class BernoulliSafeMode(Bernoulli):
+    """Bernoulli whose mode never NaNs at p=0.5 (reference distribution.py:407-414) —
+    the continue head of Dreamer."""

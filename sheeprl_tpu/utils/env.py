@@ -1,0 +1,257 @@
+"""Environment factory — builds thunks that normalize every env to dict observations.
+
+Same pipeline as the reference's ``make_env`` (sheeprl/utils/env.py:26-237): instantiate
+``cfg.env.wrapper`` from config, apply action repeat / velocity masking, coerce the
+observation space to ``gym.spaces.Dict``, run images through a resize/grayscale/
+channel-first pipeline, frame stacking, actions/reward-as-observation, TimeLimit,
+episode statistics and optional video capture. Written against gymnasium 1.x (the
+reference's PixelObservationWrapper / TransformObservation idioms are 0.x-only, so the
+dict coercion and pixel pipeline are dedicated wrappers here).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    MaskVelocityWrapper,
+    RewardAsObservationWrapper,
+)
+
+
+class _DictObservation(gym.ObservationWrapper):
+    """Coerce a Box observation space into a single-key Dict space."""
+
+    def __init__(self, env: gym.Env, key: str):
+        super().__init__(env)
+        self._key = key
+        self.observation_space = gym.spaces.Dict({key: env.observation_space})
+
+    def observation(self, observation):
+        return {self._key: observation}
+
+
+class _RenderObservation(gym.Wrapper):
+    """Add the rendered frame as a pixel observation next to (or instead of) the
+    vector state (role of the reference's PixelObservationWrapper usage)."""
+
+    def __init__(self, env: gym.Env, pixel_key: str, state_key: Optional[str] = None):
+        super().__init__(env)
+        self._pixel_key = pixel_key
+        self._state_key = state_key
+        frame = self._render_frame(env)
+        spaces = {pixel_key: gym.spaces.Box(0, 255, frame.shape, np.uint8)}
+        if state_key is not None:
+            spaces[state_key] = env.observation_space
+        self.observation_space = gym.spaces.Dict(spaces)
+
+    @staticmethod
+    def _render_frame(env: gym.Env) -> np.ndarray:
+        frame = env.render()
+        if frame is None:
+            raise RuntimeError(
+                "The environment returned no render frame; set render_mode='rgb_array' "
+                "to use pixel observations"
+            )
+        return np.asarray(frame)
+
+    def _convert(self, obs):
+        out = {self._pixel_key: self._render_frame(self.env)}
+        if self._state_key is not None:
+            out[self._state_key] = obs
+        return out
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self._convert(obs), reward, terminated, truncated, info
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._convert(obs), info
+
+
+class _PixelPipeline(gym.ObservationWrapper):
+    """Resize / grayscale / channel-first pipeline for the cnn keys (the reference's
+    ``transform_obs`` closure, sheeprl/utils/env.py:163-196)."""
+
+    def __init__(self, env: gym.Env, cnn_keys, screen_size: int, grayscale: bool):
+        super().__init__(env)
+        self._cnn_keys = list(cnn_keys)
+        self._screen_size = screen_size
+        self._grayscale = grayscale
+        self.observation_space = gym.spaces.Dict(dict(env.observation_space.spaces.items()))
+        for k in self._cnn_keys:
+            self.observation_space[k] = gym.spaces.Box(
+                0, 255, (1 if grayscale else 3, screen_size, screen_size), np.uint8
+            )
+
+    def observation(self, obs):
+        import cv2
+
+        for k in self._cnn_keys:
+            current = obs[k]
+            shape = current.shape
+            is_3d = len(shape) == 3
+            is_grayscale = not is_3d or shape[0] == 1 or shape[-1] == 1
+            channel_first = not is_3d or shape[0] in (1, 3)
+            if not is_3d:
+                current = np.expand_dims(current, axis=0)
+            if channel_first:
+                current = np.transpose(current, (1, 2, 0))
+            if current.shape[:-1] != (self._screen_size, self._screen_size):
+                current = cv2.resize(
+                    current, (self._screen_size, self._screen_size), interpolation=cv2.INTER_AREA
+                )
+            if self._grayscale and not is_grayscale:
+                current = cv2.cvtColor(current, cv2.COLOR_RGB2GRAY)
+            if current.ndim == 2:
+                current = np.expand_dims(current, axis=-1)
+                if not self._grayscale:
+                    current = np.repeat(current, 3, axis=-1)
+            obs[k] = current.transpose(2, 0, 1)
+        return obs
+
+
+def make_env(
+    cfg: Dict[str, Any],
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    vector_env_idx: int = 0,
+) -> Callable[[], gym.Env]:
+    """Build a thunk creating a fully-wrapped env with Dict observations."""
+
+    def thunk() -> gym.Env:
+        instantiate_kwargs = {}
+        if "seed" in cfg.env.wrapper:
+            instantiate_kwargs["seed"] = seed
+        if "rank" in cfg.env.wrapper:
+            instantiate_kwargs["rank"] = rank + vector_env_idx
+        env = instantiate(cfg.env.wrapper, **instantiate_kwargs)
+
+        try:
+            env_spec = str(gym.spec(cfg.env.id).entry_point)
+        except Exception:
+            env_spec = ""
+
+        if cfg.env.action_repeat > 1 and "atari" not in env_spec:
+            env = ActionRepeat(env, cfg.env.action_repeat)
+
+        if cfg.env.get("mask_velocities", False):
+            env = MaskVelocityWrapper(env)
+
+        cnn_enc = cfg.algo.cnn_keys.encoder
+        mlp_enc = cfg.algo.mlp_keys.encoder
+        if not (isinstance(mlp_enc, list) and isinstance(cnn_enc, list) and len(cnn_enc + mlp_enc) > 0):
+            raise ValueError(
+                "`algo.cnn_keys.encoder` and `algo.mlp_keys.encoder` must be non-empty lists of "
+                f"strings, got cnn={cnn_enc!r} and mlp={mlp_enc!r}"
+            )
+
+        # --- dict observation coercion (reference env.py:100-146)
+        obs_space = env.observation_space
+        if isinstance(obs_space, gym.spaces.Box) and len(obs_space.shape) < 2:
+            # vector-only observation
+            if len(cnn_enc) > 0:
+                if len(cnn_enc) > 1:
+                    warnings.warn(
+                        f"Multiple cnn keys specified but only one pixel observation is allowed in "
+                        f"{cfg.env.id}; keeping the first: {cnn_enc[0]}"
+                    )
+                state_key = mlp_enc[0] if len(mlp_enc) > 0 else None
+                env = _RenderObservation(env, pixel_key=cnn_enc[0], state_key=state_key)
+            else:
+                if len(mlp_enc) > 1:
+                    warnings.warn(
+                        f"Multiple mlp keys specified but only one observation is allowed in "
+                        f"{cfg.env.id}; keeping the first: {mlp_enc[0]}"
+                    )
+                env = _DictObservation(env, mlp_enc[0])
+        elif isinstance(obs_space, gym.spaces.Box) and 2 <= len(obs_space.shape) <= 3:
+            # pixel-only observation
+            if len(cnn_enc) > 1:
+                warnings.warn(
+                    f"Multiple cnn keys specified but only one pixel observation is allowed in "
+                    f"{cfg.env.id}; keeping the first: {cnn_enc[0]}"
+                )
+            elif len(cnn_enc) == 0:
+                raise ValueError(
+                    "You have selected a pixel observation but no cnn key has been specified. "
+                    "Set at least one cnn key: `algo.cnn_keys.encoder=[your_cnn_key]`"
+                )
+            env = _DictObservation(env, cnn_enc[0])
+
+        if len(set(env.observation_space.keys()).intersection(set(mlp_enc + cnn_enc))) == 0:
+            raise ValueError(
+                f"The user-specified keys {mlp_enc + cnn_enc} are not a subset of the environment "
+                f"observation keys {list(env.observation_space.keys())}; check your config."
+            )
+
+        env_cnn_keys = set(
+            k for k in env.observation_space.spaces.keys() if len(env.observation_space[k].shape) in (2, 3)
+        )
+        cnn_keys = env_cnn_keys.intersection(set(cnn_enc))
+
+        if cnn_keys:
+            env = _PixelPipeline(env, cnn_keys, cfg.env.screen_size, cfg.env.grayscale)
+
+        if cnn_keys and cfg.env.frame_stack > 1:
+            if cfg.env.frame_stack_dilation <= 0:
+                raise ValueError(
+                    f"The frame stack dilation argument must be greater than zero, got: {cfg.env.frame_stack_dilation}"
+                )
+            env = FrameStack(env, cfg.env.frame_stack, cnn_keys, cfg.env.frame_stack_dilation)
+
+        if cfg.env.actions_as_observation.num_stack > 0:
+            env = ActionsAsObservationWrapper(env, **cfg.env.actions_as_observation)
+
+        if cfg.env.reward_as_observation:
+            env = RewardAsObservationWrapper(env)
+
+        env.action_space.seed(seed)
+        env.observation_space.seed(seed)
+        if cfg.env.max_episode_steps and cfg.env.max_episode_steps > 0:
+            env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
+            if cfg.env.grayscale:
+                env = GrayscaleRenderWrapper(env)
+            try:
+                env = gym.wrappers.RecordVideo(
+                    env,
+                    os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
+                    disable_logger=True,
+                )
+            except Exception as e:  # video capture is best-effort
+                warnings.warn(f"Could not enable video capture: {e}")
+        return env
+
+    return thunk
+
+
+def get_dummy_env(id: str, **kwargs: Any) -> gym.Env:
+    """Build a fake env by id (reference env.py:240-255)."""
+    if "continuous" in id:
+        from sheeprl_tpu.envs.dummy import ContinuousDummyEnv
+
+        return ContinuousDummyEnv(**kwargs)
+    if "multidiscrete" in id:
+        from sheeprl_tpu.envs.dummy import MultiDiscreteDummyEnv
+
+        return MultiDiscreteDummyEnv(**kwargs)
+    if "discrete" in id:
+        from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+
+        return DiscreteDummyEnv(**kwargs)
+    raise ValueError(f"Unknown dummy env id: {id}")

@@ -1,0 +1,27 @@
+"""Optional-dependency gates (role of sheeprl/utils/imports.py:1-17)."""
+
+from __future__ import annotations
+
+import importlib.util
+
+
+def _available(module: str) -> bool:
+    try:
+        return importlib.util.find_spec(module) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_IS_MLFLOW_AVAILABLE = _available("mlflow")
+_IS_ATARI_AVAILABLE = _available("ale_py")
+_IS_BOX2D_AVAILABLE = _available("Box2D")
+_IS_MUJOCO_AVAILABLE = _available("mujoco")
+_IS_DMC_AVAILABLE = _available("dm_control")
+_IS_CRAFTER_AVAILABLE = _available("crafter")
+_IS_DIAMBRA_AVAILABLE = _available("diambra")
+_IS_MINEDOJO_AVAILABLE = _available("minedojo")
+_IS_MINERL_AVAILABLE = _available("minerl")
+_IS_ROBOSUITE_AVAILABLE = _available("robosuite")
+_IS_SUPER_MARIO_BROS_AVAILABLE = _available("gym_super_mario_bros")
+_IS_CV2_AVAILABLE = _available("cv2")
+_IS_TENSORBOARD_AVAILABLE = _available("tensorboardX") or _available("torch.utils.tensorboard")

@@ -1,0 +1,111 @@
+"""Run-dir management + TensorBoard logging (role of sheeprl/utils/logger.py:12-91).
+
+Rank-0 creates a versioned run directory ``logs/runs/<root_dir>/<run_name>/version_N``
+and shares it to other hosts via the host object channel (the reference broadcasts over
+a Gloo group, sheeprl/utils/logger.py:53-89).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+
+class TensorBoardLogger:
+    """Thin tensorboardX wrapper with the reference logger's name/root_dir/version
+    layout (sheeprl/configs/logger/tensorboard.yaml)."""
+
+    def __init__(
+        self,
+        root_dir: str = "logs/runs",
+        name: str = "run",
+        version: Optional[str] = None,
+        **_: Any,
+    ) -> None:
+        self.root_dir = root_dir
+        self.name = name
+        self._version = version
+        self._writer = None
+
+    @property
+    def version(self) -> str:
+        if self._version is None:
+            base = Path(self.root_dir) / self.name
+            existing = []
+            if base.is_dir():
+                for d in base.iterdir():
+                    if d.name.startswith("version_") and d.name[len("version_") :].isdigit():
+                        existing.append(int(d.name[len("version_") :]))
+            self._version = f"version_{max(existing) + 1 if existing else 0}"
+        return self._version
+
+    @property
+    def log_dir(self) -> str:
+        return str(Path(self.root_dir) / self.name / self.version)
+
+    @property
+    def writer(self):
+        if self._writer is None:
+            from tensorboardX import SummaryWriter
+
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._writer = SummaryWriter(logdir=self.log_dir)
+        return self._writer
+
+    def log_metrics(self, metrics: Dict[str, float], step: Optional[int] = None) -> None:
+        for k, v in metrics.items():
+            try:
+                self.writer.add_scalar(k, float(v), global_step=step)
+            except (TypeError, ValueError):
+                continue
+
+    def log_hyperparams(self, params: Dict[str, Any]) -> None:
+        try:
+            import json
+
+            self.writer.add_text("hparams", "```\n" + json.dumps(params, indent=2, default=str) + "\n```")
+        except Exception:
+            pass
+
+    def finalize(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+def get_logger(fabric, cfg, log_dir: Optional[str] = None) -> Optional[TensorBoardLogger]:
+    """Rank-0-only logger construction (sheeprl/utils/logger.py:12-36). When the run
+    dir has already been allocated (``log_dir``), the logger writes inside it instead
+    of allocating its own version directory."""
+    if fabric.global_rank != 0 or cfg.metric.log_level == 0:
+        return None
+    from sheeprl_tpu.config import instantiate
+
+    logger_cfg = dict(cfg.metric.logger)
+    if log_dir is not None and "TensorBoardLogger" in str(logger_cfg.get("_target_", "")):
+        p = Path(log_dir)
+        logger_cfg["root_dir"] = str(p.parent.parent)
+        logger_cfg["name"] = p.parent.name
+        logger_cfg["version"] = p.name
+    return instantiate(logger_cfg)
+
+
+def get_log_dir(fabric, root_dir: str, run_name: str, share: bool = True) -> str:
+    """Create (rank-0) and share the versioned log dir (sheeprl/utils/logger.py:40-91)."""
+    base = Path("logs") / "runs" / root_dir / run_name
+    if fabric.global_rank == 0:
+        existing = []
+        if base.is_dir():
+            for d in base.iterdir():
+                if d.name.startswith("version_") and d.name[len("version_") :].isdigit():
+                    existing.append(int(d.name[len("version_") :]))
+        log_dir = str(base / f"version_{max(existing) + 1 if existing else 0}")
+        os.makedirs(log_dir, exist_ok=True)
+    else:
+        log_dir = None
+    if share and fabric.world_size > 1:
+        from sheeprl_tpu.parallel import distributed
+
+        log_dir = distributed.host_broadcast_object(log_dir, src=0)
+    return log_dir

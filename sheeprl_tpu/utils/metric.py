@@ -1,0 +1,201 @@
+"""Metric aggregation without torchmetrics.
+
+Provides the same surface the reference gets from torchmetrics + MetricAggregator
+(sheeprl/utils/metric.py:17-195): named metrics with ``update/compute/reset``, a
+class-level disable switch, NaN dropping on compute, and an optional cross-host sync.
+State lives in plain Python floats on the host — metric updates must never force a
+device sync on the hot path, so callers pass in numpy/float values they already have.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+Number = Union[int, float, np.ndarray]
+
+
+def _to_float(value: Any) -> float:
+    """Best-effort scalar conversion; jax/numpy arrays become their mean."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    arr = np.asarray(value)
+    if arr.size == 0:
+        return math.nan
+    return float(arr.mean())
+
+
+class Metric:
+    """Minimal metric protocol: update(value) / compute() -> float / reset()."""
+
+    def __init__(self, sync_on_compute: bool = False, **_: Any) -> None:
+        self.sync_on_compute = sync_on_compute
+
+    def update(self, value: Any) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def compute(self) -> float:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def reset(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class MeanMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False, **kwargs: Any) -> None:
+        super().__init__(sync_on_compute=sync_on_compute, **kwargs)
+        self._total = 0.0
+        self._count = 0
+
+    def update(self, value: Any) -> None:
+        v = _to_float(value)
+        if math.isnan(v):
+            return
+        self._total += v
+        self._count += 1
+
+    def compute(self) -> float:
+        if self._count == 0:
+            return math.nan
+        total, count = self._total, self._count
+        if self.sync_on_compute:
+            from sheeprl_tpu.parallel import distributed
+
+            total = distributed.host_allsum(total)
+            count = int(distributed.host_allsum(count))
+        return total / count if count else math.nan
+
+    def reset(self) -> None:
+        self._total = 0.0
+        self._count = 0
+
+
+class SumMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False, **kwargs: Any) -> None:
+        super().__init__(sync_on_compute=sync_on_compute, **kwargs)
+        self._total = 0.0
+
+    def update(self, value: Any) -> None:
+        v = _to_float(value)
+        if not math.isnan(v):
+            self._total += v
+
+    def compute(self) -> float:
+        total = self._total
+        if self.sync_on_compute:
+            from sheeprl_tpu.parallel import distributed
+
+            total = distributed.host_allsum(total)
+        return total
+
+    def reset(self) -> None:
+        self._total = 0.0
+
+
+class MaxMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False, **kwargs: Any) -> None:
+        super().__init__(sync_on_compute=sync_on_compute, **kwargs)
+        self._max = -math.inf
+
+    def update(self, value: Any) -> None:
+        v = _to_float(value)
+        if not math.isnan(v):
+            self._max = max(self._max, v)
+
+    def compute(self) -> float:
+        return self._max if self._max != -math.inf else math.nan
+
+    def reset(self) -> None:
+        self._max = -math.inf
+
+
+class LastValueMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False, **kwargs: Any) -> None:
+        super().__init__(sync_on_compute=sync_on_compute, **kwargs)
+        self._value = math.nan
+
+    def update(self, value: Any) -> None:
+        self._value = _to_float(value)
+
+    def compute(self) -> float:
+        return self._value
+
+    def reset(self) -> None:
+        self._value = math.nan
+
+
+class MetricAggregator:
+    """Name → Metric dict with a class-level disable switch and NaN-dropping compute
+    (mirrors sheeprl/utils/metric.py:17-146)."""
+
+    disabled: bool = False
+
+    def __init__(self, metrics: Optional[Dict[str, Any]] = None, raise_on_missing: bool = False) -> None:
+        self.metrics: Dict[str, Metric] = {}
+        for name, metric in dict(metrics or {}).items():
+            if isinstance(metric, dict) and "_target_" in metric:
+                from sheeprl_tpu.config import instantiate
+
+                metric = instantiate(dict(metric))
+            self.metrics[name] = metric
+        self.raise_on_missing = raise_on_missing
+
+    def add(self, name: str, metric: Metric) -> None:
+        if name in self.metrics:
+            raise ValueError(f"metric {name} already present")
+        self.metrics[name] = metric
+
+    def pop(self, name: str) -> None:
+        if name not in self.metrics and self.raise_on_missing:
+            raise KeyError(name)
+        self.metrics.pop(name, None)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.metrics
+
+    def update(self, name: str, value: Any) -> None:
+        if self.disabled:
+            return
+        metric = self.metrics.get(name)
+        if metric is None:
+            if self.raise_on_missing:
+                raise KeyError(name)
+            return
+        metric.update(value)
+
+    def compute(self) -> Dict[str, float]:
+        if self.disabled:
+            return {}
+        out: Dict[str, float] = {}
+        for name, metric in self.metrics.items():
+            value = metric.compute()
+            if not (isinstance(value, float) and math.isnan(value)):
+                out[name] = value
+        return out
+
+    def reset(self) -> None:
+        for metric in self.metrics.values():
+            metric.reset()
+
+    def keys(self) -> Iterable[str]:
+        return self.metrics.keys()
+
+
+class RankIndependentMetricAggregator:
+    """Per-rank metrics gathered host-side at compute (sheeprl/utils/metric.py:149-195)."""
+
+    def __init__(self, metrics: Dict[str, Metric]) -> None:
+        self.aggregator = MetricAggregator(metrics)
+
+    def update(self, name: str, value: Any) -> None:
+        self.aggregator.update(name, value)
+
+    def compute(self) -> List[Dict[str, float]]:
+        from sheeprl_tpu.parallel import distributed
+
+        return distributed.host_allgather_object(self.aggregator.compute())
+
+    def reset(self) -> None:
+        self.aggregator.reset()

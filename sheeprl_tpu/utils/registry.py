@@ -1,0 +1,61 @@
+"""Algorithm / evaluation registries.
+
+Same contract as the reference registry (sheeprl/utils/registry.py:11-115): decorators
+record (module, entrypoint, decoupled) so the CLI can import and launch by name; the
+evaluation registry is validated against the algorithm registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+# algo name -> {"module": str, "entrypoint": str, "decoupled": bool}
+algorithm_registry: Dict[str, List[Dict[str, Any]]] = {}
+# algo name -> {"module": str, "entrypoint": str}
+evaluation_registry: Dict[str, List[Dict[str, Any]]] = {}
+
+
+def _register_algorithm(fn: Callable, decoupled: bool = False) -> Callable:
+    module = fn.__module__
+    algo_name = module.split(".")[-1]
+    entrypoint = fn.__name__
+    registrations = algorithm_registry.setdefault(algo_name, [])
+    if any(r["entrypoint"] == entrypoint and r["module"] == module for r in registrations):
+        raise ValueError(f"algorithm {algo_name} already registered from {module}.{entrypoint}")
+    registrations.append({"module": module, "entrypoint": entrypoint, "decoupled": decoupled})
+    return fn
+
+
+def _register_evaluation(fn: Callable, algorithms: Sequence[str]) -> Callable:
+    module = fn.__module__
+    entrypoint = fn.__name__
+    if isinstance(algorithms, str):
+        algorithms = [algorithms]
+    for algo in algorithms:
+        registrations = evaluation_registry.setdefault(algo, [])
+        registrations.append({"module": module, "entrypoint": entrypoint, "name": algo})
+    return fn
+
+
+def register_algorithm(decoupled: bool = False) -> Callable:
+    def wrap(fn: Callable) -> Callable:
+        return _register_algorithm(fn, decoupled=decoupled)
+
+    return wrap
+
+
+def register_evaluation(algorithms: Sequence[str]) -> Callable:
+    def wrap(fn: Callable) -> Callable:
+        return _register_evaluation(fn, algorithms=algorithms)
+
+    return wrap
+
+
+def get_algorithm(name: str) -> Optional[Dict[str, Any]]:
+    regs = algorithm_registry.get(name)
+    return regs[0] if regs else None
+
+
+def get_evaluation(name: str) -> Optional[Dict[str, Any]]:
+    regs = evaluation_registry.get(name)
+    return regs[0] if regs else None

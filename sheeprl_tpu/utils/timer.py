@@ -1,0 +1,63 @@
+"""Named-span timing — drives the ``Time/sps_*`` throughput metrics.
+
+Same contract as the reference's timer (sheeprl/utils/timer.py:16-84): a context
+manager/decorator with a class-level registry of named accumulating timers; reduced at
+log time into `sps_train` / `sps_env_interaction` (the BASELINE north-star metrics,
+logged e.g. at sheeprl/algos/ppo/ppo.py:393-408).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import ContextDecorator
+from typing import Any, ClassVar, Dict, Optional
+
+
+class timer(ContextDecorator):
+    disabled: ClassVar[bool] = False
+    timers: ClassVar[Dict[str, "timer"]] = {}
+
+    def __new__(cls, name: str, **kwargs: Any) -> "timer":
+        if name not in cls.timers:
+            inst = super().__new__(cls)
+            inst._init(name)
+            cls.timers[name] = inst
+        return cls.timers[name]
+
+    def _init(self, name: str) -> None:
+        self.name = name
+        self._total = 0.0
+        self._count = 0
+        self._start: Optional[float] = None
+
+    def __init__(self, name: str, **kwargs: Any) -> None:
+        # __new__ handles registry; nothing to do (kwargs accepted for reference parity)
+        pass
+
+    def __enter__(self) -> "timer":
+        if not timer.disabled:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        if not timer.disabled and self._start is not None:
+            self._total += time.perf_counter() - self._start
+            self._count += 1
+            self._start = None
+        return False
+
+    def compute(self) -> float:
+        return self._total
+
+    def reset(self) -> None:
+        self._total = 0.0
+        self._count = 0
+        self._start = None
+
+    @classmethod
+    def to_dict(cls, reset: bool = True) -> Dict[str, float]:
+        out = {name: t.compute() for name, t in cls.timers.items() if t._count > 0}
+        if reset:
+            for t in cls.timers.values():
+                t.reset()
+        return out

@@ -1,0 +1,40 @@
+"""Test harness configuration.
+
+Mirrors the reference's distributed-test trick (tests/conftest.py + LT_DEVICES,
+reference tests/test_algos/test_algos.py:48-53): tests run on the host CPU platform
+with 8 virtual XLA devices, so multi-chip mesh semantics (psum gradient reduction,
+data-axis sharding) execute on a true multi-device mesh without TPU hardware.
+"""
+
+import os
+
+# must happen before jax initializes any backend
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def chdir_tmp(tmp_path, monkeypatch):
+    """Isolate each test's logs/ and memmap dirs in a tmpdir."""
+    monkeypatch.chdir(tmp_path)
+    yield
+
+
+@pytest.fixture()
+def standard_args():
+    return [
+        "dry_run=True",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "metric.log_level=0",
+        "checkpoint.save_last=False",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+    ]

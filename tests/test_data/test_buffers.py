@@ -1,0 +1,247 @@
+"""Buffer-semantics tests mirroring the reference suite
+(tests/test_data/test_buffers.py and friends): wrap-around adds, oversize adds,
+sample_next_obs edge cases, sequential sampling, env-independent split, episode
+buffer episode handling, memmap round-trips."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+from sheeprl_tpu.utils.memmap import MemmapArray
+
+
+def _mk_data(t, n, start=0):
+    arange = np.arange(start, start + t * n).reshape(t, n, 1).astype(np.float32)
+    return {"observations": arange, "rewards": np.zeros((t, n, 1), np.float32)}
+
+
+class TestReplayBuffer:
+    def test_invalid_sizes(self):
+        with pytest.raises(ValueError):
+            ReplayBuffer(0)
+        with pytest.raises(ValueError):
+            ReplayBuffer(4, 0)
+
+    def test_add_and_wraparound(self):
+        rb = ReplayBuffer(5, 2)
+        rb.add(_mk_data(3, 2))
+        assert rb._pos == 3 and not rb.full
+        rb.add(_mk_data(3, 2, start=6))
+        assert rb._pos == 1 and rb.full
+        # oldest rows were overwritten at wrap
+        assert rb["observations"][0, 0, 0] == 10.0
+
+    def test_add_oversize(self):
+        rb = ReplayBuffer(4, 1)
+        rb.add(_mk_data(10, 1))
+        assert rb.full
+        # keeps the trailing rows
+        stored = rb["observations"][:, 0, 0]
+        assert set(stored.tolist()).issubset(set(range(10)))
+
+    def test_add_validate(self):
+        rb = ReplayBuffer(4, 2)
+        with pytest.raises(ValueError):
+            rb.add({"a": [1, 2]}, validate_args=True)
+        with pytest.raises(RuntimeError):
+            rb.add({"a": np.zeros((3,))}, validate_args=True)
+        with pytest.raises(RuntimeError):
+            rb.add({"a": np.zeros((3, 2, 1)), "b": np.zeros((2, 2, 1))}, validate_args=True)
+
+    def test_sample_shape(self):
+        rb = ReplayBuffer(8, 2)
+        rb.add(_mk_data(6, 2))
+        s = rb.sample(5, n_samples=3)
+        assert s["observations"].shape == (3, 5, 1)
+
+    def test_sample_empty_raises(self):
+        rb = ReplayBuffer(4, 1)
+        with pytest.raises(ValueError):
+            rb.sample(1)
+
+    def test_sample_next_obs_excludes_write_head(self):
+        rb = ReplayBuffer(4, 1)
+        rb.add(_mk_data(4, 1))  # full, pos == 0
+        s = rb.sample(64, sample_next_obs=True)
+        assert "next_observations" in s
+        # the transition (pos-1 -> pos) is invalid and must never be sampled
+        assert not np.any(s["observations"] == 3.0)
+
+    def test_sample_next_obs_single_sample_raises(self):
+        rb = ReplayBuffer(4, 1)
+        rb.add(_mk_data(1, 1))
+        with pytest.raises(RuntimeError):
+            rb.sample(1, sample_next_obs=True)
+
+    def test_getitem_setitem(self):
+        rb = ReplayBuffer(4, 2)
+        rb.add(_mk_data(2, 2))
+        with pytest.raises(TypeError):
+            rb[0]
+        new = np.ones((4, 2, 3), np.float32)
+        rb["extra"] = new
+        assert rb["extra"].shape == (4, 2, 3)
+        with pytest.raises(RuntimeError):
+            rb["bad"] = np.ones((2, 2))
+
+    def test_to_tensor_returns_jax(self):
+        import jax
+
+        rb = ReplayBuffer(4, 1)
+        rb.add(_mk_data(4, 1))
+        t = rb.to_tensor()
+        assert isinstance(t["observations"], jax.Array)
+
+    def test_memmap_roundtrip(self, tmp_path):
+        rb = ReplayBuffer(6, 2, memmap=True, memmap_dir=tmp_path / "buf")
+        rb.add(_mk_data(4, 2))
+        assert rb.is_memmap
+        s = rb.sample(3)
+        assert s["observations"].shape == (1, 3, 1)
+
+
+class TestSequentialReplayBuffer:
+    def test_sample_shape(self):
+        rb = SequentialReplayBuffer(16, 2)
+        rb.add(_mk_data(12, 2))
+        s = rb.sample(4, n_samples=2, sequence_length=5)
+        assert s["observations"].shape == (2, 5, 4, 1)
+
+    def test_sequences_are_contiguous_single_env(self):
+        rb = SequentialReplayBuffer(32, 1)
+        rb.add(_mk_data(20, 1))
+        s = rb.sample(6, sequence_length=4)
+        obs = s["observations"][0, :, :, 0]  # [seq, batch]
+        diffs = np.diff(obs, axis=0)
+        assert np.all(diffs == 1.0)
+
+    def test_sample_too_long_raises(self):
+        rb = SequentialReplayBuffer(8, 1)
+        rb.add(_mk_data(4, 1))
+        with pytest.raises(ValueError):
+            rb.sample(1, sequence_length=6)
+
+    def test_full_buffer_avoids_write_head(self):
+        rb = SequentialReplayBuffer(8, 1)
+        rb.add(_mk_data(8, 1))
+        rb.add(_mk_data(3, 1, start=8))  # pos=3, full
+        s = rb.sample(64, sequence_length=3)
+        obs = s["observations"][0, :, :, 0]
+        # no sequence may straddle the write head (rows 3.. are old data 3..7, 0..2 are 8,9,10)
+        starts = obs[0]
+        for st, col in zip(starts, obs.T):
+            assert np.all(np.diff(col) == 1.0)
+
+
+class TestEnvIndependent:
+    def test_add_and_sample(self):
+        rb = EnvIndependentReplayBuffer(8, 2, buffer_cls=SequentialReplayBuffer)
+        rb.add(_mk_data(6, 2))
+        s = rb.sample(4, n_samples=2, sequence_length=3)
+        assert s["observations"].shape == (2, 3, 4, 1)
+
+    def test_add_subset_indices(self):
+        rb = EnvIndependentReplayBuffer(8, 3)
+        data = _mk_data(4, 2)
+        rb.add(data, indices=[0, 2])
+        assert not rb.buffer[0].empty
+        assert rb.buffer[1].empty
+        assert not rb.buffer[2].empty
+
+    def test_ragged_positions(self):
+        rb = EnvIndependentReplayBuffer(8, 2)
+        rb.add(_mk_data(3, 1), indices=[0])
+        rb.add(_mk_data(5, 1), indices=[1])
+        assert rb.buffer[0]._pos == 3
+        assert rb.buffer[1]._pos == 5
+
+
+def _episode(length, n_envs=1, terminate=True):
+    data = {
+        "observations": np.arange(length).reshape(length, 1, 1).repeat(n_envs, 1).astype(np.float32),
+        "terminated": np.zeros((length, n_envs, 1), np.float32),
+        "truncated": np.zeros((length, n_envs, 1), np.float32),
+    }
+    if terminate:
+        data["terminated"][-1] = 1.0
+    return data
+
+
+class TestEpisodeBuffer:
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            EpisodeBuffer(0, 1)
+        with pytest.raises(ValueError):
+            EpisodeBuffer(4, 8)
+
+    def test_open_episode_accumulation(self):
+        eb = EpisodeBuffer(32, 2)
+        eb.add(_episode(4, terminate=False))
+        assert len(eb) == 0 and len(eb._open_episodes[0]) == 1
+        eb.add(_episode(4, terminate=True))
+        assert len(eb) == 8
+        assert len(eb._open_episodes[0]) == 0
+
+    def test_short_episode_rejected(self):
+        eb = EpisodeBuffer(32, 4)
+        with pytest.raises(RuntimeError):
+            eb.add(_episode(2, terminate=True))
+
+    def test_eviction(self):
+        eb = EpisodeBuffer(10, 2)
+        for _ in range(4):
+            eb.add(_episode(4, terminate=True))
+        assert len(eb) <= 10
+
+    def test_sample_shapes(self):
+        eb = EpisodeBuffer(64, 4)
+        for _ in range(3):
+            eb.add(_episode(8, terminate=True))
+        s = eb.sample(5, n_samples=2, sequence_length=4)
+        assert s["observations"].shape == (2, 4, 5, 1)
+
+    def test_prioritize_ends(self):
+        eb = EpisodeBuffer(64, 4, prioritize_ends=True)
+        eb.add(_episode(16, terminate=True))
+        s = eb.sample(10, sequence_length=4)
+        assert s["observations"].shape == (1, 4, 10, 1)
+
+    def test_sample_next_obs(self):
+        eb = EpisodeBuffer(64, 4)
+        eb.add(_episode(8, terminate=True))
+        s = eb.sample(3, sequence_length=4, sample_next_obs=True)
+        np.testing.assert_allclose(
+            s["next_observations"][..., 0], s["observations"][..., 0] + 1
+        )
+
+
+class TestMemmapArray:
+    def test_basic_io(self, tmp_path):
+        arr = MemmapArray(shape=(4, 3), dtype=np.float32, filename=tmp_path / "a.memmap")
+        arr[:] = np.ones((4, 3), np.float32)
+        assert np.asarray(arr).sum() == 12.0
+
+    def test_from_array(self, tmp_path):
+        src = np.arange(6).reshape(2, 3).astype(np.int64)
+        arr = MemmapArray.from_array(src, filename=tmp_path / "b.memmap")
+        np.testing.assert_array_equal(np.asarray(arr), src)
+
+    def test_pickle_drops_ownership(self, tmp_path):
+        import pickle
+
+        arr = MemmapArray(shape=(2, 2), dtype=np.float32, filename=tmp_path / "c.memmap")
+        arr[:] = 7.0
+        clone = pickle.loads(pickle.dumps(arr))
+        assert not clone.has_ownership
+        np.testing.assert_array_equal(np.asarray(clone), np.asarray(arr))
+
+    def test_ufunc(self, tmp_path):
+        arr = MemmapArray(shape=(3,), dtype=np.float32, filename=tmp_path / "d.memmap")
+        arr[:] = 2.0
+        out = arr * 3
+        np.testing.assert_allclose(out, [6.0, 6.0, 6.0])

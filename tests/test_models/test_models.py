@@ -1,0 +1,87 @@
+"""Shape/contract tests for the Flax building blocks (role of the reference's
+tests/test_models/test_{cnn,mlp}.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.models.models import (
+    CNN,
+    MLP,
+    DeCNN,
+    LayerNormGRUCell,
+    NatureCNN,
+    resolve_activation,
+)
+
+
+def test_mlp_shapes():
+    m = MLP(hidden_sizes=(32, 32), output_dim=5, activation="tanh", layer_norm=True)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((4, 7)))
+    out = m.apply(params, jnp.ones((4, 7)))
+    assert out.shape == (4, 5)
+
+
+def test_mlp_no_output_head():
+    m = MLP(hidden_sizes=(16,), output_dim=None)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((2, 3)))
+    out = m.apply(params, jnp.ones((2, 3)))
+    assert out.shape == (2, 16)
+
+
+def test_mlp_flatten():
+    m = MLP(hidden_sizes=(8,), output_dim=2, flatten_dim=1)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((2, 3, 4)))
+    out = m.apply(params, jnp.ones((2, 3, 4)))
+    assert out.shape == (2, 2)
+
+
+def test_cnn_channel_first_input():
+    m = CNN(channels=(8, 16), kernel_sizes=(3, 3), strides=(2, 2))
+    x = jnp.zeros((2, 3, 16, 16))  # NCHW as stored host-side
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x)
+    assert out.shape[0] == 2 and out.shape[-1] == 16  # NHWC inside
+
+
+def test_nature_cnn():
+    m = NatureCNN(features_dim=512, screen_size=64, in_channels=4)
+    x = jnp.zeros((3, 4, 64, 64))
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x)
+    assert out.shape == (3, 512)
+
+
+def test_decnn_outputs_channel_first():
+    m = DeCNN(channels=(16, 3), kernel_sizes=(4, 4), strides=(2, 2))
+    x = jnp.zeros((2, 4, 4, 32))  # NHWC latent
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x)
+    assert out.shape[1] == 3  # NCHW out
+
+
+def test_layer_norm_gru_cell_step_and_scan():
+    cell = LayerNormGRUCell(hidden_size=8)
+    x = jnp.ones((5, 4))
+    h = jnp.zeros((5, 8))
+    params = cell.init(jax.random.PRNGKey(0), h, x)
+    h1 = cell.apply(params, h, x)
+    assert h1.shape == (5, 8)
+    # usable as a lax.scan body
+    xs = jnp.ones((7, 5, 4))
+
+    def body(h, x):
+        h = cell.apply(params, h, x)
+        return h, h
+
+    hT, hs = jax.lax.scan(body, h, xs)
+    assert hs.shape == (7, 5, 8)
+    np.testing.assert_allclose(np.asarray(hs[0]), np.asarray(h1), rtol=1e-5)
+
+
+def test_resolve_activation_torch_names():
+    assert resolve_activation("torch.nn.Tanh")(jnp.asarray(0.5)) == pytest.approx(np.tanh(0.5))
+    assert resolve_activation("relu") is resolve_activation("torch.nn.ReLU")
+    with pytest.raises(ValueError):
+        resolve_activation("not_an_act")

@@ -1,0 +1,125 @@
+"""Golden-value tests for the math toolbox (symlog/twohot/GAE/lambda —
+the reference's tests/test_utils/test_two_hot_*.py plus GAE parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.utils import (
+    Ratio,
+    compute_lambda_values,
+    gae,
+    polynomial_decay,
+    symexp,
+    symlog,
+    two_hot_decoder,
+    two_hot_encoder,
+)
+
+
+def test_symlog_symexp_roundtrip():
+    x = jnp.asarray([-100.0, -1.0, 0.0, 0.5, 10.0, 1e4])
+    np.testing.assert_allclose(symexp(symlog(x)), x, rtol=1e-4)
+
+
+def test_symlog_values():
+    np.testing.assert_allclose(symlog(jnp.asarray([0.0])), [0.0])
+    np.testing.assert_allclose(symlog(jnp.asarray([np.e - 1])), [1.0], rtol=1e-6)
+    np.testing.assert_allclose(symlog(jnp.asarray([-(np.e - 1)])), [-1.0], rtol=1e-6)
+
+
+@pytest.mark.parametrize("value,support,expected_idx", [(0.0, 10, 10), (10.0, 10, 20), (-10.0, 10, 0)])
+def test_two_hot_encoder_exact_bucket(value, support, expected_idx):
+    enc = two_hot_encoder(jnp.asarray([value])[..., None], support_range=support)
+    enc = np.asarray(enc)[0]
+    assert enc[expected_idx] == pytest.approx(1.0)
+    assert enc.sum() == pytest.approx(1.0)
+
+
+def test_two_hot_encoder_between_buckets():
+    # 0.5 with unit bucket size → 0.5/0.5 split between buckets 10 (0) and 11 (1)
+    enc = np.asarray(two_hot_encoder(jnp.asarray([[0.5]]), support_range=10))[0]
+    assert enc[10] == pytest.approx(0.5)
+    assert enc[11] == pytest.approx(0.5)
+
+
+def test_two_hot_roundtrip():
+    vals = jnp.asarray([[-7.3], [0.0], [0.25], [5.9]])
+    enc = two_hot_encoder(vals, support_range=10)
+    dec = two_hot_decoder(enc, support_range=10)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(vals), atol=1e-5)
+
+
+def test_two_hot_encoder_clipping():
+    enc = np.asarray(two_hot_encoder(jnp.asarray([[1e6]]), support_range=10))[0]
+    assert enc[-1] == pytest.approx(1.0)
+
+
+def _reference_gae(rewards, values, dones, next_value, gamma, lam):
+    T = rewards.shape[0]
+    lastgaelam = 0.0
+    advantages = np.zeros_like(rewards)
+    nextvalues = next_value
+    not_dones = 1.0 - dones
+    nextnonterminal = not_dones[-1]
+    for t in reversed(range(T)):
+        if t < T - 1:
+            nextnonterminal = not_dones[t]
+            nextvalues = values[t + 1]
+        delta = rewards[t] + nextvalues * nextnonterminal * gamma - values[t]
+        advantages[t] = lastgaelam = delta + nextnonterminal * lastgaelam * gamma * lam
+    return advantages + values, advantages
+
+
+def test_gae_matches_reference_loop():
+    rng = np.random.default_rng(0)
+    T, B = 16, 3
+    rewards = rng.normal(size=(T, B, 1)).astype(np.float32)
+    values = rng.normal(size=(T, B, 1)).astype(np.float32)
+    dones = (rng.random(size=(T, B, 1)) < 0.15).astype(np.float32)
+    next_value = rng.normal(size=(B, 1)).astype(np.float32)
+    ref_ret, ref_adv = _reference_gae(rewards, values, dones, next_value, 0.99, 0.95)
+    ret, adv = gae(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(dones), jnp.asarray(next_value), T, 0.99, 0.95
+    )
+    np.testing.assert_allclose(np.asarray(adv), ref_adv, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret), ref_ret, rtol=1e-4, atol=1e-5)
+
+
+def _reference_lambda_values(rewards, values, continues, lmbda):
+    vals = list(values[1:]) + [values[-1]]
+    interm = rewards + continues * np.stack(vals) * (1 - lmbda)
+    lv = []
+    last = values[-1]
+    for t in reversed(range(len(rewards))):
+        last = interm[t] + continues[t] * lmbda * last
+        lv.append(last)
+    return np.stack(list(reversed(lv)))
+
+
+def test_lambda_values_match_reference_loop():
+    rng = np.random.default_rng(1)
+    T, B = 15, 4
+    rewards = rng.normal(size=(T, B, 1)).astype(np.float32)
+    values = rng.normal(size=(T, B, 1)).astype(np.float32)
+    continues = (rng.random(size=(T, B, 1)) < 0.9).astype(np.float32) * 0.997
+    ref = _reference_lambda_values(rewards, values, continues, 0.95)
+    out = compute_lambda_values(jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(continues), 0.95)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_polynomial_decay():
+    assert polynomial_decay(0, initial=1.0, final=0.0, max_decay_steps=10, power=1.0) == pytest.approx(1.0)
+    assert polynomial_decay(5, initial=1.0, final=0.0, max_decay_steps=10, power=1.0) == pytest.approx(0.5)
+    assert polynomial_decay(20, initial=1.0, final=0.0, max_decay_steps=10, power=1.0) == pytest.approx(0.0)
+
+
+def test_ratio_governor():
+    r = Ratio(ratio=0.5)
+    assert r(4) == 2  # first call: step * ratio
+    assert r(8) == 2
+    state = r.state_dict()
+    r2 = Ratio(1.0).load_state_dict(state)
+    assert r2(12) == 2
+    assert Ratio(0.0)(100) == 0
