@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Benchmark harness: one JSON line with the headline metric.
+
+Round-1 metric: PPO env-steps/sec on the reference's own benchmark conditions
+(sheeprl/configs/exp/ppo_benchmarks.yaml — 65536 total steps, 1 sync CartPole env,
+logging/checkpoints off). The reference's published wall-clock for this exact config
+is 81.27 s on 4 CPUs (README.md:99-106 / BASELINE.md) → 806.4 env-steps/sec.
+
+Select another workload with BENCH_ALGO (ppo is the default).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINES = {
+    # reference wall-clock seconds for the matching *_benchmarks exp (BASELINE.md)
+    "ppo": (65536, 81.27),
+    "a2c": (25600, 84.76),
+    "sac": (65536, 320.21),
+}
+
+
+def main() -> None:
+    algo = os.environ.get("BENCH_ALGO", "ppo")
+    total_steps, ref_seconds = BASELINES[algo]
+    baseline_sps = total_steps / ref_seconds
+
+    from sheeprl_tpu.cli import run
+
+    args = [f"exp={algo}_benchmarks"]
+    start = time.perf_counter()
+    run(args)
+    elapsed = time.perf_counter() - start
+
+    sps = total_steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"{algo}_env_steps_per_sec",
+                "value": round(sps, 2),
+                "unit": "env-steps/sec",
+                "vs_baseline": round(sps / baseline_sps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,7 +59,11 @@ def main(fabric, cfg: Dict[str, Any]):
                 vector_env_idx=i,
             )
             for i in range(total_num_envs)
-        ]
+        ],
+        # same-step autoreset restores the reference's gymnasium-0.x semantics: the
+        # final observation of a done episode arrives in infos["final_obs"] and the
+        # post-done row is a real reset transition, so truncation bootstrapping works
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
     )
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -121,6 +125,11 @@ def main(fabric, cfg: Dict[str, Any]):
 
     loss_reduction = cfg.algo.loss_reduction
 
+    # same latency design as PPO: act path on the host CPU backend, one fused jitted
+    # device program per iteration (GAE + full-rollout accumulated update)
+    cpu_device = jax.devices("cpu")[0]
+    act_on_cpu = fabric.device.platform != "cpu"
+
     @jax.jit
     def policy_step_fn(params, obs: Dict[str, jax.Array], step_key):
         norm_obs = {k: v.astype(jnp.float32) for k, v in obs.items()}
@@ -135,14 +144,9 @@ def main(fabric, cfg: Dict[str, Any]):
 
     @jax.jit
     def get_values(params, obs: Dict[str, jax.Array]):
+        obs = {k: v.astype(jnp.float32) for k, v in obs.items()}
         _, values = agent.apply({"params": params}, obs)
         return values
-
-    @jax.jit
-    def compute_gae(rewards, values, dones, next_values):
-        return gae(
-            rewards, values, dones, next_values, cfg.algo.rollout_steps, cfg.algo.gamma, cfg.algo.gae_lambda
-        )
 
     def loss_fn(params, batch):
         obs = {k: batch[k] for k in obs_keys}
@@ -155,7 +159,19 @@ def main(fabric, cfg: Dict[str, Any]):
         return pg + vl, (pg, vl)
 
     @jax.jit
-    def train_step(params, opt_state, batch):
+    def train_phase(params, opt_state, data, next_values):
+        returns, advantages = gae(
+            data["rewards"],
+            data["values"],
+            data["dones"],
+            next_values,
+            cfg.algo.rollout_steps,
+            cfg.algo.gamma,
+            cfg.algo.gae_lambda,
+        )
+        batch = {k: v.reshape(-1, *v.shape[2:]) for k, v in data.items()}
+        batch["returns"] = returns.reshape(-1, 1)
+        batch["advantages"] = advantages.reshape(-1, 1)
         grads, (pg, vl) = jax.grad(loss_fn, has_aux=True)(params, batch)
         updates, new_opt_state = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
@@ -164,6 +180,9 @@ def main(fabric, cfg: Dict[str, Any]):
     if world_size > 1:
         params = fabric.replicate_pytree(params)
         opt_state = fabric.replicate_pytree(opt_state)
+    act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
+    if act_on_cpu:
+        key = jax.device_put(key, cpu_device)
 
     step_data: Dict[str, np.ndarray] = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
@@ -175,9 +194,9 @@ def main(fabric, cfg: Dict[str, Any]):
             for _ in range(cfg.algo.rollout_steps):
                 policy_step += total_num_envs
 
-                obs_jax = {k: jnp.asarray(next_obs[k], dtype=jnp.float32) for k in obs_keys}
+                obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
                 key, step_key = jax.random.split(key)
-                out, real_actions = policy_step_fn(params, obs_jax, step_key)
+                out, real_actions = policy_step_fn(act_params, obs_host, step_key)
                 real_actions_np = np.asarray(real_actions)
                 if is_continuous:
                     env_actions = real_actions_np.reshape(envs.action_space.shape)
@@ -189,6 +208,20 @@ def main(fabric, cfg: Dict[str, Any]):
                 obs, rewards, terminated, truncated, info = envs.step(env_actions)
                 dones = np.logical_or(terminated, truncated).reshape(total_num_envs, 1).astype(np.float32)
                 rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, 1)
+
+                # truncation bootstrap (reference a2c.py:250-270): add gamma*V(final_obs)
+                if "final_obs" in info or "final_observation" in info:
+                    final_obs_arr = info.get("final_obs", info.get("final_observation"))
+                    truncated_envs = np.nonzero(truncated)[0]
+                    if len(truncated_envs) > 0:
+                        real_next_obs = {
+                            k: np.stack(
+                                [np.asarray(final_obs_arr[i][k], dtype=np.float32) for i in truncated_envs]
+                            )
+                            for k in obs_keys
+                        }
+                        vals = np.asarray(get_values(act_params, real_next_obs)).reshape(-1, 1)
+                        rewards[truncated_envs] += cfg.algo.gamma * vals
 
                 step_data["dones"] = dones[np.newaxis]
                 step_data["values"] = np.asarray(out["values"], dtype=np.float32)[np.newaxis]
@@ -203,29 +236,28 @@ def main(fabric, cfg: Dict[str, Any]):
                 for k in obs_keys:
                     step_data[k] = obs[k][np.newaxis]
 
-                if "episode" in info:
-                    mask = info.get("_episode", np.ones(total_num_envs, bool))
-                    rews = info["episode"]["r"][mask]
-                    lens = info["episode"]["l"][mask]
+                # under SAME_STEP autoreset the done-step infos arrive in final_info
+                ep_info = info.get("final_info", info)
+                if "episode" in ep_info:
+                    ep = ep_info["episode"]
+                    mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
+                    rews, lens = ep["r"][mask], ep["l"][mask]
                     if aggregator and not aggregator.disabled and len(rews) > 0:
                         aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
                         aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
-        obs_jax = {k: jnp.asarray(next_obs[k], dtype=jnp.float32) for k in obs_keys}
-        next_values = get_values(params, obs_jax)
+        obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
+        next_values = np.asarray(get_values(act_params, obs_host))
 
         with timer("Time/train_time"):
-            returns, advantages = compute_gae(
-                jnp.asarray(np.asarray(rb["rewards"])),
-                jnp.asarray(np.asarray(rb["values"])),
-                jnp.asarray(np.asarray(rb["dones"])),
-                next_values,
-            )
-            local_data = {k: np.asarray(rb[k]).reshape(-1, *rb[k].shape[2:]) for k in rb.buffer.keys()}
-            local_data["returns"] = np.asarray(returns).reshape(-1, 1)
-            local_data["advantages"] = np.asarray(advantages).reshape(-1, 1)
-            batch = fabric.shard_pytree(local_data) if world_size > 1 else local_data
-            params, opt_state, metrics = train_step(params, opt_state, batch)
+            data = {k: np.asarray(rb[k]) for k in rb.buffer.keys() if k not in ("returns", "advantages")}
+            if world_size > 1:
+                data = jax.device_put(data, fabric.sharding(None, "data"))
+            params, opt_state, metrics = train_phase(params, opt_state, data, next_values)
+            if act_on_cpu:
+                act_params = jax.device_put(params, cpu_device)
+            else:
+                act_params = params
             if aggregator and not aggregator.disabled:
                 aggregator.update("Loss/policy_loss", np.asarray(metrics["pg"]))
                 aggregator.update("Loss/value_loss", np.asarray(metrics["vl"]))

@@ -39,12 +39,14 @@ def value_loss(
     clip_vloss: bool,
     reduction: str = "mean",
 ) -> jax.Array:
-    if not clip_vloss:
-        return _reduce(0.5 * jnp.square(new_values - returns), reduction)
-    v_loss_unclipped = jnp.square(new_values - returns)
-    v_clipped = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
-    v_loss_clipped = jnp.square(v_clipped - returns)
-    return 0.5 * _reduce(jnp.maximum(v_loss_unclipped, v_loss_clipped), reduction)
+    """MSE on the (optionally clipped) value prediction — exact reference semantics
+    (sheeprl/algos/ppo/loss.py:44-58: no 0.5 factor, clipped path uses the clipped
+    prediction only)."""
+    if clip_vloss:
+        values_pred = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
+    else:
+        values_pred = new_values
+    return _reduce(jnp.square(values_pred - returns), reduction)
 
 
 def entropy_loss(entropy: jax.Array, reduction: str = "mean") -> jax.Array:

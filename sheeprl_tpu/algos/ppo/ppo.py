@@ -86,7 +86,11 @@ def main(fabric, cfg: Dict[str, Any]):
                 vector_env_idx=i,
             )
             for i in range(total_num_envs)
-        ]
+        ],
+        # same-step autoreset restores the reference's gymnasium-0.x semantics: the
+        # final observation of a done episode arrives in infos["final_obs"] and the
+        # post-done row is a real reset transition, so truncation bootstrapping works
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
     )
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -172,9 +176,9 @@ def main(fabric, cfg: Dict[str, Any]):
     vf_coef = float(cfg.algo.vf_coef)
     clip_vloss = bool(cfg.algo.clip_vloss)
     normalize_advantages = bool(cfg.algo.normalize_advantages)
-    global_bs = int(cfg.algo.per_rank_batch_size * world_size)
+    global_bs = min(int(cfg.algo.per_rank_batch_size * world_size), int(cfg.algo.rollout_steps * total_num_envs))
     num_rows = int(cfg.algo.rollout_steps * total_num_envs)
-    num_minibatches = max(1, num_rows // global_bs)
+    num_minibatches = -(-num_rows // global_bs)  # ceil: partial minibatches pad-wrap
 
     cpu_device = jax.devices("cpu")[0]
     act_on_cpu = fabric.device.platform != "cpu"
@@ -235,6 +239,11 @@ def main(fabric, cfg: Dict[str, Any]):
         def epoch_body(carry, epoch_key):
             params, opt_state = carry
             perm = jax.random.permutation(epoch_key, num_rows)
+            # pad (wrapping into the permutation) so every row is visited each epoch
+            # even when num_rows is not a multiple of the global batch
+            pad = num_minibatches * global_bs - num_rows
+            if pad > 0:
+                perm = jnp.concatenate([perm, perm[:pad]])
             mb_idx = perm[: num_minibatches * global_bs].reshape(num_minibatches, global_bs)
 
             def mb_body(carry, idx):
@@ -327,10 +336,12 @@ def main(fabric, cfg: Dict[str, Any]):
                 for k in obs_keys:
                     step_data[k] = obs[k][np.newaxis]
 
-                if "episode" in info:
-                    mask = info["_episode"] if "_episode" in info else np.ones(total_num_envs, bool)
-                    rews = info["episode"]["r"][mask]
-                    lens = info["episode"]["l"][mask]
+                # under SAME_STEP autoreset the done-step infos arrive in final_info
+                ep_info = info.get("final_info", info)
+                if "episode" in ep_info:
+                    ep = ep_info["episode"]
+                    mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
+                    rews, lens = ep["r"][mask], ep["l"][mask]
                     if aggregator and not aggregator.disabled and len(rews) > 0:
                         aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
                         aggregator.update("Game/ep_len_avg", float(np.mean(lens)))

@@ -114,6 +114,9 @@ def run_algorithm(cfg: dotdict) -> None:
         )
     if cfg.metric.log_level == 0 or cfg.metric.disable_timer:
         timer.disabled = True
+    from sheeprl_tpu.utils.metric import MetricAggregator
+
+    MetricAggregator.disabled = cfg.metric.log_level == 0
 
     fabric = instantiate(cfg.fabric)
     fabric.launch(main, cfg)

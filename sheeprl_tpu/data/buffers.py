@@ -167,15 +167,11 @@ class ReplayBuffer:
         if validate_args:
             _validate_add_data(data)
         data_len = _first(data).shape[0]
-        next_pos = (self._pos + data_len) % self._buffer_size
-        if next_pos <= self._pos or (data_len > self._buffer_size and not self._full):
-            idxes = np.concatenate(
-                [np.arange(self._pos, self._buffer_size), np.arange(0, next_pos)]
-            ).astype(np.intp)
-        else:
-            idxes = np.arange(self._pos, next_pos, dtype=np.intp)
         if data_len > self._buffer_size:
-            data = {k: v[-self._buffer_size - next_pos :] for k, v in data.items()}
+            data = {k: v[-self._buffer_size :] for k, v in data.items()}
+            data_len = self._buffer_size
+        next_pos = (self._pos + data_len) % self._buffer_size
+        idxes = (np.arange(self._pos, self._pos + data_len) % self._buffer_size).astype(np.intp)
         if self.empty:
             for k, v in data.items():
                 self._allocate(k, v)

@@ -117,11 +117,12 @@ def compute_lambda_values(
     continues: jax.Array,
     lmbda: float = 0.95,
 ) -> jax.Array:
-    """TD(lambda) returns over an imagined trajectory, matching the reference's
-    ``compute_lambda_values`` (sheeprl/algos/dreamer_v3/utils.py:67-78): inputs are
-    [T, B, 1] with `continues` already multiplied by gamma; output is [T, B, 1]."""
-    vals = jnp.concatenate([values[1:], values[-1:]], axis=0)
-    interm = rewards + continues * vals * (1 - lmbda)
+    """TD(lambda) returns over an imagined trajectory — exact recursion of the
+    reference's ``compute_lambda_values`` (sheeprl/algos/dreamer_v3/utils.py:67-78):
+    ``ret[t] = r[t] + c[t] * ((1-lambda) * v[t] + lambda * ret[t+1])`` with carry
+    initialized at ``v[T-1]``. Callers pass the inputs already shifted the way the
+    reference does (rewards[1:], values[1:], continues[1:] * gamma)."""
+    interm = rewards + continues * values * (1 - lmbda)
 
     def step(carry, inp):
         ret = carry

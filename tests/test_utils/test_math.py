@@ -88,14 +88,12 @@ def test_gae_matches_reference_loop():
 
 
 def _reference_lambda_values(rewards, values, continues, lmbda):
-    vals = list(values[1:]) + [values[-1]]
-    interm = rewards + continues * np.stack(vals) * (1 - lmbda)
-    lv = []
-    last = values[-1]
-    for t in reversed(range(len(rewards))):
-        last = interm[t] + continues[t] * lmbda * last
-        lv.append(last)
-    return np.stack(list(reversed(lv)))
+    # transcription of the reference loop (sheeprl/algos/dreamer_v3/utils.py:67-78)
+    vals = [values[-1]]
+    interm = rewards + continues * values * (1 - lmbda)
+    for t in reversed(range(len(continues))):
+        vals.append(interm[t] + continues[t] * lmbda * vals[-1])
+    return np.stack(list(reversed(vals))[:-1])
 
 
 def test_lambda_values_match_reference_loop():
