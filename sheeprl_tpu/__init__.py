@@ -21,6 +21,8 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.a2c.a2c",
     "sheeprl_tpu.algos.ppo.ppo",
     "sheeprl_tpu.algos.ppo.evaluate",
+    "sheeprl_tpu.algos.sac.sac",
+    "sheeprl_tpu.algos.sac.evaluate",
 ]
 
 import importlib  # noqa: E402

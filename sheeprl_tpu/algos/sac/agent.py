@@ -1,0 +1,147 @@
+"""SAC agent, Flax-native.
+
+Capability parity with the reference agent (sheeprl/algos/sac/agent.py:20-371):
+tanh-squashed Gaussian actor with action rescaling, twin (or n-way) Q critics,
+automatic entropy tuning via a learned log-alpha, EMA target critics.
+
+TPU-native structure: the critic ensemble is a single vmapped module with stacked
+params — one apply evaluates all n critics as batched matmuls on the MXU (the
+reference loops over n separate modules, agent.py:219-230). The agent/player split
+collapses into pure functions over one params pytree.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models.models import MLP
+
+LOG_STD_MAX = 2.0
+LOG_STD_MIN = -5.0
+
+
+class SACActor(nn.Module):
+    """MLP -> (mean, log_std) heads; actions are tanh-squashed and rescaled to the
+    env bounds (reference agent.py:57-145, Eq. 26 of arXiv:1812.05905)."""
+
+    action_dim: int
+    hidden_size: int = 256
+    action_low: Tuple[float, ...] = (-1.0,)
+    action_high: Tuple[float, ...] = (1.0,)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = MLP(hidden_sizes=(self.hidden_size, self.hidden_size), activation="relu", dtype=self.dtype)(obs)
+        mean = nn.Dense(self.action_dim, dtype=self.dtype)(x)
+        log_std = nn.Dense(self.action_dim, dtype=self.dtype)(x)
+        std = jnp.exp(jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+        return mean, std
+
+    @property
+    def action_scale(self) -> np.ndarray:
+        return (np.asarray(self.action_high) - np.asarray(self.action_low)) / 2.0
+
+    @property
+    def action_bias(self) -> np.ndarray:
+        return (np.asarray(self.action_high) + np.asarray(self.action_low)) / 2.0
+
+
+def squash_and_logprob(
+    mean: jax.Array, std: jax.Array, key: jax.Array, action_scale, action_bias
+) -> Tuple[jax.Array, jax.Array]:
+    """Reparameterized sample -> tanh squash -> rescale; log-prob with the tanh
+    change-of-variable correction (reference agent.py:110-145)."""
+    eps = jax.random.normal(key, mean.shape, dtype=mean.dtype)
+    x_t = mean + std * eps
+    y_t = jnp.tanh(x_t)
+    action = y_t * action_scale + action_bias
+    log_prob = -0.5 * (((x_t - mean) / std) ** 2 + 2 * jnp.log(std) + jnp.log(2 * jnp.pi))
+    log_prob = log_prob - jnp.log(action_scale * (1 - y_t**2) + 1e-6)
+    return action, log_prob.sum(-1, keepdims=True)
+
+
+def greedy_action(mean: jax.Array, action_scale, action_bias) -> jax.Array:
+    return jnp.tanh(mean) * action_scale + action_bias
+
+
+class SACCritic(nn.Module):
+    """Q(s, a) MLP (reference agent.py:20-54)."""
+
+    hidden_size: int = 256
+    num_critics: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=self.num_critics,
+            activation="relu",
+            dtype=self.dtype,
+        )(x)
+
+
+class CriticEnsemble(nn.Module):
+    """n independent critics with stacked params evaluated in one vmapped apply →
+    output [*batch, n] (replaces the reference's python loop over critic modules)."""
+
+    n: int
+    hidden_size: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        ensemble = nn.vmap(
+            SACCritic,
+            in_axes=None,
+            out_axes=-1,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            axis_size=self.n,
+        )
+        out = ensemble(hidden_size=self.hidden_size, num_critics=1, dtype=self.dtype)(obs, action)
+        return out.reshape(*out.shape[:-2], self.n)
+
+
+def build_agent(
+    fabric,
+    cfg,
+    observation_space,
+    action_space,
+    key: jax.Array,
+    state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACActor, CriticEnsemble, Dict[str, Any]]:
+    """Create modules + the params pytree {actor, critic, target_critic, log_alpha}
+    (role of reference build_agent, sheeprl/algos/sac/agent.py:318-371)."""
+    obs_dim = sum(prod(observation_space[k].shape) for k in cfg.algo.mlp_keys.encoder)
+    act_dim = int(prod(action_space.shape))
+    actor = SACActor(
+        action_dim=act_dim,
+        hidden_size=cfg.algo.actor.hidden_size,
+        action_low=tuple(np.asarray(action_space.low, dtype=np.float32).reshape(-1).tolist()),
+        action_high=tuple(np.asarray(action_space.high, dtype=np.float32).reshape(-1).tolist()),
+        dtype=fabric.compute_dtype,
+    )
+    critic = CriticEnsemble(n=cfg.algo.critic.n, hidden_size=cfg.algo.critic.hidden_size, dtype=fabric.compute_dtype)
+    k_actor, k_critic = jax.random.split(key)
+    dummy_obs = jnp.zeros((1, obs_dim), dtype=jnp.float32)
+    dummy_act = jnp.zeros((1, act_dim), dtype=jnp.float32)
+    actor_params = actor.init(k_actor, dummy_obs)["params"]
+    critic_params = critic.init(k_critic, dummy_obs, dummy_act)["params"]
+    params = {
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": jax.tree_util.tree_map(jnp.copy, critic_params),
+        "log_alpha": jnp.log(jnp.asarray([cfg.algo.alpha.alpha], dtype=jnp.float32)),
+    }
+    if state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, state)
+    return actor, critic, params

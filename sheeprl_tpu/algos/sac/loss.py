@@ -1,0 +1,22 @@
+"""SAC losses (reference sheeprl/algos/sac/loss.py:1-26)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def critic_loss(qf_values: jax.Array, next_qf_value: jax.Array, num_critics: int) -> jax.Array:
+    """Sum of per-critic MSEs against the shared soft target; qf_values is
+    ``[batch, n]``, next_qf_value ``[batch, 1]``."""
+    return jnp.sum(
+        jnp.stack([jnp.mean((qf_values[..., i : i + 1] - next_qf_value) ** 2) for i in range(num_critics)])
+    )
+
+
+def policy_loss(alpha: jax.Array, logprobs: jax.Array, min_qf_values: jax.Array) -> jax.Array:
+    return jnp.mean(alpha * logprobs - min_qf_values)
+
+
+def entropy_loss(log_alpha: jax.Array, logprobs: jax.Array, target_entropy: jax.Array) -> jax.Array:
+    return jnp.mean(-log_alpha * (logprobs + target_entropy))

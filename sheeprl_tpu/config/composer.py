@@ -272,7 +272,10 @@ class Composer:
             elif "@" in g:
                 src, _, pkg = g.partition("@")
                 sub = self._load_group_node(src.rstrip("/"), o)
-                deep_merge(merged, sub if pkg == "_global_" else {pkg: sub})
+                if pkg != "_global_":
+                    for part in reversed(pkg.split(".")):
+                        sub = {part: sub}
+                deep_merge(merged, sub)
             else:
                 overrides.append((g, o))
         deep_merge(merged, body)
@@ -321,7 +324,11 @@ class Composer:
             if "@" in key:
                 src, _, pkg = key.partition("@")
                 sub = self._load_group_node(src.rstrip("/"), val, _depth + 1)
-                deep_merge(node, sub if pkg == "_global_" else {pkg: sub})
+                if pkg != "_global_":
+                    # dotted packages nest (`/optim@actor.optimizer: adam`)
+                    for part in reversed(pkg.split(".")):
+                        sub = {part: sub}
+                deep_merge(node, sub)
             elif val is None:
                 deep_merge(node, self._load_group_node(group, key, _depth + 1))
             else:

@@ -159,3 +159,56 @@ def test_evaluation(standard_args):
 def test_unknown_algorithm_fails(standard_args):
     with pytest.raises(Exception):
         _run(standard_args + ["exp=ppo", "algo.name=not_an_algo"])
+
+
+def test_sac(standard_args, devices):
+    _run(
+        standard_args
+        + [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            f"fabric.devices={devices}",
+            "algo.per_rank_batch_size=4",
+        ]
+    )
+
+
+def test_sac_sample_next_obs(standard_args):
+    _run(
+        standard_args
+        + [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "dry_run=False",
+            "algo.total_steps=16",
+            "algo.learning_starts=4",
+            "algo.per_rank_batch_size=4",
+            "buffer.size=64",
+            "buffer.sample_next_obs=True",
+            "algo.run_test=False",
+            "checkpoint.every=1000",
+        ]
+    )
+
+
+def test_sac_resume_and_evaluation(standard_args):
+    import glob
+    import os
+
+    args = standard_args + [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "algo.per_rank_batch_size=4",
+        "checkpoint.save_last=True",
+    ]
+    _run(args)
+    ckpts = glob.glob("logs/runs/sac/continuous_dummy/**/*.ckpt", recursive=True)
+    assert len(ckpts) > 0
+    ckpt = os.path.abspath(sorted(ckpts)[-1])
+    _run(args + [f"checkpoint.resume_from={ckpt}"])
+    from sheeprl_tpu.cli import evaluation
+
+    evaluation([f"checkpoint_path={ckpt}", "fabric.accelerator=cpu", "env.capture_video=False"])
