@@ -23,6 +23,8 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.ppo.evaluate",
     "sheeprl_tpu.algos.sac.sac",
     "sheeprl_tpu.algos.sac.evaluate",
+    "sheeprl_tpu.algos.droq.droq",
+    "sheeprl_tpu.algos.droq.evaluate",
 ]
 
 import importlib  # noqa: E402

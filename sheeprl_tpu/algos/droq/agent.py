@@ -1,0 +1,112 @@
+"""DroQ agent, Flax-native.
+
+Capability parity with the reference agent (sheeprl/algos/droq/agent.py:20-278):
+the SAC tanh-Gaussian actor plus a critic ensemble whose members are two-layer MLPs
+with Dropout + LayerNorm after every hidden projection (arXiv:2110.02034, reference
+DROQCritic at agent.py:20-61).
+
+TPU-native structure mirrors the SAC agent: the ensemble is one vmapped module with
+stacked params — a single apply evaluates every critic as batched MXU matmuls, with
+per-member dropout RNG streams (the reference loops over n separate torch modules).
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import SACActor
+from sheeprl_tpu.models.models import MLP
+
+
+class DROQCritic(nn.Module):
+    """Q(s, a) MLP with Dropout + LayerNorm per hidden layer (reference
+    droq/agent.py:20-61: Dense -> Dropout -> LayerNorm -> ReLU)."""
+
+    hidden_size: int = 256
+    num_critics: int = 1
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, deterministic: bool = True) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=self.num_critics,
+            activation="relu",
+            layer_norm=True,
+            dropout=self.dropout,
+            dtype=self.dtype,
+        )(x, deterministic=deterministic)
+
+
+class DROQCriticEnsemble(nn.Module):
+    """n independent DroQ critics with stacked params, one vmapped apply →
+    [*batch, n]; dropout RNG is split per member so each critic sees its own mask."""
+
+    n: int
+    hidden_size: int = 256
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, deterministic: bool = True) -> jax.Array:
+        ensemble = nn.vmap(
+            DROQCritic,
+            in_axes=None,
+            out_axes=-1,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            axis_size=self.n,
+        )
+        out = ensemble(
+            hidden_size=self.hidden_size, num_critics=1, dropout=self.dropout, dtype=self.dtype
+        )(obs, action, deterministic)
+        return out.reshape(*out.shape[:-2], self.n)
+
+
+def build_agent(
+    fabric,
+    cfg,
+    observation_space,
+    action_space,
+    key: jax.Array,
+    state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACActor, DROQCriticEnsemble, Dict[str, Any]]:
+    """Create modules + the params pytree {actor, critic, target_critic, log_alpha}
+    (role of reference build_agent, sheeprl/algos/droq/agent.py:212-278)."""
+    obs_dim = sum(prod(observation_space[k].shape) for k in cfg.algo.mlp_keys.encoder)
+    act_dim = int(prod(action_space.shape))
+    actor = SACActor(
+        action_dim=act_dim,
+        hidden_size=cfg.algo.actor.hidden_size,
+        action_low=tuple(np.asarray(action_space.low, dtype=np.float32).reshape(-1).tolist()),
+        action_high=tuple(np.asarray(action_space.high, dtype=np.float32).reshape(-1).tolist()),
+        dtype=fabric.compute_dtype,
+    )
+    critic = DROQCriticEnsemble(
+        n=cfg.algo.critic.n,
+        hidden_size=cfg.algo.critic.hidden_size,
+        dropout=cfg.algo.critic.dropout,
+        dtype=fabric.compute_dtype,
+    )
+    k_actor, k_critic = jax.random.split(key)
+    dummy_obs = jnp.zeros((1, obs_dim), dtype=jnp.float32)
+    dummy_act = jnp.zeros((1, act_dim), dtype=jnp.float32)
+    actor_params = actor.init(k_actor, dummy_obs)["params"]
+    critic_params = critic.init(k_critic, dummy_obs, dummy_act)["params"]
+    params = {
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": jax.tree_util.tree_map(jnp.copy, critic_params),
+        "log_alpha": jnp.log(jnp.asarray([cfg.algo.alpha.alpha], dtype=jnp.float32)),
+    }
+    if state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, state)
+    return actor, critic, params

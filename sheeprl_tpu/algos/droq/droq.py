@@ -1,0 +1,406 @@
+"""DroQ, coupled training (capability parity with sheeprl/algos/droq/droq.py:30-436).
+
+DroQ = SAC with Dropout+LayerNorm critics driven at a high replay ratio
+(arXiv:2110.02034). Per train call the reference runs G critic minibatch updates
+(one per gradient step, each critic updated on its own MSE with target-EMA after
+every member update, droq.py:94-120) and then a single actor + alpha update on a
+separate batch (droq.py:122-137, with the Q mean — not min — in the policy loss).
+
+TPU-native structure (same stance as sac.py):
+- the replay batch for the critics is sampled as ``[G, B, ...]`` on the host,
+  uploaded once, and a ``lax.scan`` walks the G critic updates in ONE device
+  program; the actor/alpha updates run in the same program after the scan;
+- the per-member critic MSEs are computed on the vmapped ensemble in one pass —
+  summing them gives each member exactly its own gradient (params are disjoint),
+  so the reference's sequential per-member stepping collapses into one fused
+  optax update; the per-member EMA after each member's update is then identical
+  to one EMA after the fused update;
+- dropout stays active on online AND target critics during training (torch
+  modules run in train mode throughout the reference train()).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.droq.agent import build_agent
+from sheeprl_tpu.algos.sac.agent import squash_and_logprob
+from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    rank = fabric.global_rank
+    world_size = fabric.world_size
+
+    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        warnings.warn("DroQ algorithm cannot allow to use images as observations, the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
+    logger = get_logger(fabric, cfg, log_dir=log_dir)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict())
+    fabric.print(f"Log dir: {log_dir}")
+
+    total_num_envs = int(cfg.env.num_envs * world_size)
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * total_num_envs + i,
+                rank * total_num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(total_num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the DroQ agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in cfg.algo.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                "Only environments with vector-only observations are supported by the DroQ agent. "
+                f"The observation with key '{k}' has shape {observation_space[k].shape}. "
+                f"Provided environment: {cfg.env.id}"
+            )
+    if cfg.metric.log_level > 0:
+        fabric.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
+    mlp_keys = cfg.algo.mlp_keys.encoder
+
+    key = fabric.seed_everything(cfg.seed + rank)
+    key, agent_key = jax.random.split(key)
+    actor, critic, params = build_agent(
+        fabric, cfg, observation_space, action_space, agent_key, state["agent"] if state else None
+    )
+    act_dim = int(np.prod(action_space.shape))
+    target_entropy = -float(act_dim)
+    action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
+    action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
+
+    actor_tx = instantiate(cfg.algo.actor.optimizer)
+    critic_tx = instantiate(cfg.algo.critic.optimizer)
+    alpha_tx = instantiate(cfg.algo.alpha.optimizer)
+    opt_state = {
+        "actor": actor_tx.init(params["actor"]),
+        "critic": critic_tx.init(params["critic"]),
+        "alpha": alpha_tx.init(params["log_alpha"]),
+    }
+    if state is not None:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // total_num_envs if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        buffer_size,
+        total_num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        obs_keys=("observations",),
+    )
+    if state is not None and cfg.buffer.checkpoint and "rb" in state:
+        rb = state["rb"]
+
+    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(total_num_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state is not None:
+        ratio.load_state_dict(state["ratio"])
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    # ---------------- jitted programs ----------------
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    num_critics = int(cfg.algo.critic.n)
+    sample_next_obs = bool(cfg.buffer.sample_next_obs)
+
+    cpu_device = jax.devices("cpu")[0]
+    act_on_cpu = fabric.device.platform != "cpu"
+
+    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+    def act_fn(actor_params, obs: jax.Array, step_key):
+        mean, std = actor.apply({"params": actor_params}, obs)
+        actions, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+        return actions
+
+    def critic_loss_fn(critic_params, other, batch, step_key):
+        k_pi, k_tgt, k_online = jax.random.split(step_key, 3)
+        next_obs = batch["next_observations"]
+        mean, std = actor.apply({"params": other["actor"]}, next_obs)
+        next_actions, next_logprobs = squash_and_logprob(mean, std, k_pi, action_scale, action_bias)
+        # dropout stays on for the target ensemble too (reference modules are in
+        # train mode inside train(), droq.py:94-99)
+        target_q = critic.apply(
+            {"params": other["target_critic"]}, next_obs, next_actions, False, rngs={"dropout": k_tgt}
+        )
+        alpha = jnp.exp(other["log_alpha"])
+        min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
+        next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
+        qf_values = critic.apply(
+            {"params": critic_params}, batch["observations"], batch["actions"], False, rngs={"dropout": k_online}
+        )
+        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+
+    def actor_loss_fn(actor_params, other, batch, step_key):
+        k_pi, k_q = jax.random.split(step_key)
+        mean, std = actor.apply({"params": actor_params}, batch["observations"])
+        actions, logprobs = squash_and_logprob(mean, std, k_pi, action_scale, action_bias)
+        qf_values = critic.apply(
+            {"params": other["critic"]}, batch["observations"], actions, False, rngs={"dropout": k_q}
+        )
+        # DroQ uses the ensemble MEAN in the policy loss (reference droq.py:124)
+        mean_qf = jnp.mean(qf_values, axis=-1, keepdims=True)
+        alpha = jnp.exp(jax.lax.stop_gradient(other["log_alpha"]))
+        return policy_loss(alpha, logprobs, mean_qf), logprobs
+
+    def alpha_loss_fn(log_alpha, logprobs):
+        return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
+
+    @jax.jit
+    def train_phase(params, opt_state, critic_data, actor_data, train_key):
+        """G critic updates via lax.scan (EMA folded into each step), then a single
+        actor + alpha update — the whole reference train() (droq.py:30-137) as one
+        device program."""
+
+        def critic_step(carry, inp):
+            params, opt_state = carry
+            batch, k = inp
+            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k)
+            updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
+            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+            opt_state = {**opt_state, "critic": new_copt}
+            params = {
+                **params,
+                "target_critic": jax.tree_util.tree_map(
+                    lambda t, c: t * (1 - tau) + c * tau, params["target_critic"], params["critic"]
+                ),
+            }
+            return (params, opt_state), qf_loss
+
+        G = critic_data["rewards"].shape[0]
+        k_scan, k_actor = jax.random.split(train_key)
+        keys = jax.random.split(k_scan, G)
+        (params, opt_state), qf_losses = jax.lax.scan(critic_step, (params, opt_state), (critic_data, keys))
+
+        (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+            params["actor"], params, actor_data, k_actor
+        )
+        updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+        opt_state = {**opt_state, "actor": new_aopt}
+
+        al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
+        updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
+        params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
+        opt_state = {**opt_state, "alpha": new_alopt}
+
+        # log the per-member MSE (the reference logs each member's loss into a
+        # MeanMetric, droq.py:113-115), not the summed ensemble loss
+        return params, opt_state, jnp.stack([qf_losses.mean() / num_critics, a_loss, al_loss])
+
+    if world_size > 1:
+        params = fabric.replicate_pytree(params)
+        opt_state = fabric.replicate_pytree(opt_state)
+    act_params = jax.device_put(params["actor"], cpu_device) if act_on_cpu else params["actor"]
+    if act_on_cpu:
+        key = jax.device_put(key, cpu_device)
+
+    # ---------------- main loop ----------------
+    cumulative_per_rank_gradient_steps = 0
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                flat_obs = prepare_obs(fabric, obs, mlp_keys=mlp_keys, num_envs=total_num_envs)
+                key, step_key = jax.random.split(key)
+                actions = np.asarray(act_fn(act_params, flat_obs, step_key))
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, -1)
+
+        ep_info = infos.get("final_info", infos)
+        if "episode" in ep_info:
+            ep = ep_info["episode"]
+            mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
+            rews, lens = ep["r"][mask], ep["l"][mask]
+            if aggregator and not aggregator.disabled and len(rews) > 0:
+                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
+        final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
+        if final_obs_arr is not None:
+            for idx in range(total_num_envs):
+                if final_obs_arr[idx] is not None:
+                    for k in mlp_keys:
+                        real_next_obs[k][idx] = np.asarray(final_obs_arr[idx][k])
+        flat_real_next = np.concatenate(
+            [real_next_obs[k].reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
+        ).astype(np.float32)
+
+        step_data["terminated"] = np.asarray(terminated).reshape(1, total_num_envs, -1).astype(np.float32)
+        step_data["truncated"] = np.asarray(truncated).reshape(1, total_num_envs, -1).astype(np.float32)
+        step_data["actions"] = actions.reshape(1, total_num_envs, -1).astype(np.float32)
+        step_data["observations"] = np.concatenate(
+            [np.asarray(obs[k]).reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
+        ).astype(np.float32)[np.newaxis]
+        if not sample_next_obs:
+            step_data["next_observations"] = flat_real_next[np.newaxis]
+        step_data["rewards"] = rewards[np.newaxis]
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        obs = next_obs
+
+        # train (reference droq.py:339-360): Ratio decides G; critics see a [G, B]
+        # block, the actor a separate [B] batch
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    critic_sample = rb.sample(
+                        batch_size=cfg.algo.per_rank_batch_size * world_size,
+                        n_samples=per_rank_gradient_steps,
+                        sample_next_obs=sample_next_obs,
+                    )
+                    critic_data = {k: np.asarray(v, dtype=np.float32) for k, v in critic_sample.items()}
+                    actor_sample = rb.sample(
+                        batch_size=cfg.algo.per_rank_batch_size * world_size,
+                        n_samples=1,
+                        sample_next_obs=sample_next_obs,
+                    )
+                    actor_data = {k: np.asarray(v[0], dtype=np.float32) for k, v in actor_sample.items()}
+                    if world_size > 1:
+                        critic_data = jax.device_put(critic_data, fabric.sharding(None, "data"))
+                        actor_data = jax.device_put(actor_data, fabric.sharding("data"))
+                    key, train_key = jax.random.split(key)
+                    params, opt_state, mean_losses = train_phase(
+                        params, opt_state, critic_data, actor_data, np.asarray(train_key)
+                    )
+                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    if act_on_cpu:
+                        act_params = jax.device_put(params["actor"], cpu_device)
+                    else:
+                        act_params = params["actor"]
+                    if aggregator and not aggregator.disabled:
+                        losses_np = np.asarray(mean_losses)
+                        aggregator.update("Loss/value_loss", losses_np[0])
+                        aggregator.update("Loss/policy_loss", losses_np[1])
+                        aggregator.update("Loss/alpha_loss", losses_np[2])
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
+        ):
+            metrics_dict = aggregator.compute() if aggregator else {}
+            if logger is not None:
+                logger.log_metrics(metrics_dict, policy_step)
+                timers = timer.to_dict(reset=False)
+                if timers.get("Time/train_time", 0) > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                        policy_step,
+                    )
+                if timers.get("Time/env_interaction_time", 0) > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (policy_step - last_log)
+                            / max(timers["Time/env_interaction_time"], 1e-9)
+                        },
+                        policy_step,
+                    )
+            timer.to_dict(reset=True)
+            if aggregator:
+                aggregator.reset()
+            last_log = policy_step
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "opt_state": opt_state,
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(actor.apply, params["actor"], fabric, cfg, log_dir)
+    if logger is not None:
+        logger.finalize()

@@ -1,0 +1,7 @@
+"""DroQ helpers (reference: sheeprl/algos/droq/utils.py — reuses the SAC toolbox)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test
+
+__all__ = ["AGGREGATOR_KEYS", "MODELS_TO_REGISTER", "prepare_obs", "test"]

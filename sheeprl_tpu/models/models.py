@@ -55,24 +55,28 @@ def resolve_activation(act: Union[None, str, Callable]) -> Callable:
 
 
 class MLP(nn.Module):
-    """Per-layer [Dense → norm? → act?] stack with optional flatten of the input
-    (reference models.py:16-119)."""
+    """Per-layer [Dense → dropout? → norm? → act?] stack with optional flatten of the
+    input (reference models.py:16-119; layer ordering per its miniblock contract:
+    dropout before the normalization, both before the activation)."""
 
     hidden_sizes: Sequence[int] = ()
     output_dim: Optional[int] = None
     activation: Union[None, str, Callable] = "relu"
     layer_norm: bool = False
+    dropout: float = 0.0
     flatten_dim: Optional[int] = None
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
         act = resolve_activation(self.activation)
         if self.flatten_dim is not None:
             x = jnp.reshape(x, (*x.shape[: self.flatten_dim], -1))
         x = x.astype(self.dtype)
         for size in self.hidden_sizes:
             x = nn.Dense(size, dtype=self.dtype)(x)
+            if self.dropout > 0.0:
+                x = nn.Dropout(rate=self.dropout, deterministic=deterministic)(x)
             if self.layer_norm:
                 x = nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(x)
             x = act(x)

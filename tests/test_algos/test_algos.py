@@ -193,6 +193,19 @@ def test_sac_sample_next_obs(standard_args):
     )
 
 
+def test_droq(standard_args, devices):
+    _run(
+        standard_args
+        + [
+            "exp=droq",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            f"fabric.devices={devices}",
+            "algo.per_rank_batch_size=4",
+        ]
+    )
+
+
 def test_sac_resume_and_evaluation(standard_args):
     import glob
     import os
