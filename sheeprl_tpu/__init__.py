@@ -25,6 +25,8 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.sac.evaluate",
     "sheeprl_tpu.algos.droq.droq",
     "sheeprl_tpu.algos.droq.evaluate",
+    "sheeprl_tpu.algos.dreamer_v3.dreamer_v3",
+    "sheeprl_tpu.algos.dreamer_v3.evaluate",
 ]
 
 import importlib  # noqa: E402

@@ -1,0 +1,807 @@
+"""Dreamer-V3 agent, Flax/JAX-native.
+
+Capability parity with the reference agent (sheeprl/algos/dreamer_v3/agent.py:
+CNNEncoder:42, MLPEncoder:103, CNNDecoder:154, MLPDecoder:231, RecurrentModel:285,
+RSSM:344, PlayerDV3:596, Actor:694, build_agent:937) redesigned for the TPU:
+
+- the RSSM is a set of small Flax modules plus *pure scan functions*
+  (`dynamic_scan`, `imagination_scan`) so the whole sequence unroll is one
+  ``lax.scan`` inside a jitted program — the reference pays a Python loop with a
+  GRU-cell call per timestep (dreamer_v3.py:86-97);
+- images flow NHWC inside the conv stacks (MXU-friendly) while the framework-facing
+  arrays stay channel-first like the buffers;
+- Hafner initialization (reference utils.py:143-180) maps exactly onto
+  ``variance_scaling(1.0, "fan_avg", "truncated_normal")`` / ``(scale, "fan_avg",
+  "uniform")`` initializers;
+- the agent/player weight-tying dance (agent.py:1237-1260) disappears: one params
+  pytree serves the jitted `player_step` and the jitted train program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models.models import LayerNormGRUCell, resolve_activation
+from sheeprl_tpu.utils.utils import symlog
+
+# Hafner init: trunc-normal with variance 1/fan_avg and the 0.8796... correction —
+# identical math to reference init_weights (dreamer_v3/utils.py:143-168)
+hafner_init = nn.initializers.variance_scaling(1.0, "fan_avg", "truncated_normal")
+
+
+def uniform_init(scale: float) -> Callable:
+    """Reference uniform_init_weights (dreamer_v3/utils.py:170-180): U(-l, l) with
+    l = sqrt(3 * scale / fan_avg); scale 0 → zeros."""
+    if scale == 0.0:
+        return nn.initializers.zeros
+    return nn.initializers.variance_scaling(scale, "fan_avg", "uniform")
+
+
+class DenseStack(nn.Module):
+    """[Dense(no bias) → LayerNorm → act] × n — the Dreamer-V3 MLP block."""
+
+    units: int
+    n_layers: int
+    activation: Any = "silu"
+    eps: float = 1e-3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = resolve_activation(self.activation)
+        x = x.astype(self.dtype)
+        for _ in range(self.n_layers):
+            x = nn.Dense(self.units, use_bias=False, kernel_init=hafner_init, dtype=self.dtype)(x)
+            x = nn.LayerNorm(epsilon=self.eps, dtype=self.dtype)(x)
+            x = act(x)
+        return x
+
+
+class MLPHead(nn.Module):
+    """DenseStack + linear head — representation/transition/reward/continue/critic."""
+
+    units: int
+    n_layers: int
+    output_dim: int
+    activation: Any = "silu"
+    eps: float = 1e-3
+    head_init_scale: Optional[float] = None  # None → hafner trunc-normal
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = DenseStack(self.units, self.n_layers, self.activation, self.eps, self.dtype)(x)
+        init = hafner_init if self.head_init_scale is None else uniform_init(self.head_init_scale)
+        return nn.Dense(self.output_dim, kernel_init=init, dtype=self.dtype)(x)
+
+
+class CNNEncoder(nn.Module):
+    """4-stage stride-2 conv encoder, 64x64 → 4x4 (reference agent.py:42-100).
+    Inputs are channel-first [..., C, H, W]; convs run NHWC."""
+
+    keys: Sequence[str]
+    channels_multiplier: int
+    stages: int = 4
+    activation: Any = "silu"
+    eps: float = 1e-3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        act = resolve_activation(self.activation)
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-3)
+        lead = x.shape[:-3]
+        x = x.reshape(-1, *x.shape[-3:])
+        x = jnp.moveaxis(x, -3, -1).astype(self.dtype)  # NCHW -> NHWC
+        for i in range(self.stages):
+            x = nn.Conv(
+                (2**i) * self.channels_multiplier,
+                (4, 4),
+                strides=(2, 2),
+                padding=[(1, 1), (1, 1)],
+                use_bias=False,
+                kernel_init=hafner_init,
+                dtype=self.dtype,
+            )(x)
+            x = nn.LayerNorm(epsilon=self.eps, dtype=self.dtype)(x)
+            x = act(x)
+        return x.reshape(*lead, -1)
+
+
+class MLPEncoder(nn.Module):
+    """Vector encoder with optional symlog input squashing (reference agent.py:103-151)."""
+
+    keys: Sequence[str]
+    mlp_layers: int = 4
+    dense_units: int = 512
+    activation: Any = "silu"
+    eps: float = 1e-3
+    symlog_inputs: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate(
+            [symlog(obs[k]) if self.symlog_inputs else obs[k] for k in self.keys], axis=-1
+        )
+        return DenseStack(self.dense_units, self.mlp_layers, self.activation, self.eps, self.dtype)(x)
+
+
+class Encoder(nn.Module):
+    """Fused cnn+mlp encoder over the obs dict (reference MultiEncoder usage)."""
+
+    cnn_encoder: Optional[CNNEncoder]
+    mlp_encoder: Optional[MLPEncoder]
+
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        outs = []
+        if self.cnn_encoder is not None:
+            outs.append(self.cnn_encoder(obs))
+        if self.mlp_encoder is not None:
+            outs.append(self.mlp_encoder(obs))
+        return jnp.concatenate(outs, axis=-1)
+
+
+class CNNDecoder(nn.Module):
+    """Inverse of CNNEncoder: latent → 4x4 → stride-2 deconv stages → channel-first
+    images per key (reference agent.py:154-228)."""
+
+    keys: Sequence[str]
+    output_channels: Sequence[int]
+    channels_multiplier: int
+    image_size: Tuple[int, int]
+    stages: int = 4
+    activation: Any = "silu"
+    eps: float = 1e-3
+    hafner_heads: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        act = resolve_activation(self.activation)
+        spatial = self.image_size[0] // (2**self.stages)
+        top_channels = (2 ** (self.stages - 1)) * self.channels_multiplier
+        x = nn.Dense(
+            top_channels * spatial * spatial, kernel_init=hafner_init, dtype=self.dtype
+        )(latent)
+        lead = x.shape[:-1]
+        x = x.reshape(-1, spatial, spatial, top_channels)
+        for i in range(self.stages - 1):
+            x = nn.ConvTranspose(
+                (2 ** (self.stages - 2 - i)) * self.channels_multiplier,
+                (4, 4),
+                strides=(2, 2),
+                padding="SAME",
+                use_bias=False,
+                kernel_init=hafner_init,
+                dtype=self.dtype,
+            )(x)
+            x = nn.LayerNorm(epsilon=self.eps, dtype=self.dtype)(x)
+            x = act(x)
+        x = nn.ConvTranspose(
+            sum(self.output_channels),
+            (4, 4),
+            strides=(2, 2),
+            padding="SAME",
+            kernel_init=uniform_init(1.0) if self.hafner_heads else hafner_init,
+            dtype=self.dtype,
+        )(x)
+        x = jnp.moveaxis(x, -1, -3)  # NHWC -> NCHW
+        x = x.reshape(*lead, *x.shape[-3:])
+        splits = np.cumsum(self.output_channels)[:-1].tolist()
+        return {k: v for k, v in zip(self.keys, jnp.split(x, splits, axis=-3))}
+
+
+class MLPDecoder(nn.Module):
+    """Inverse of MLPEncoder: shared stack + one linear head per key
+    (reference agent.py:231-282)."""
+
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    mlp_layers: int = 4
+    dense_units: int = 512
+    activation: Any = "silu"
+    eps: float = 1e-3
+    hafner_heads: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        x = DenseStack(self.dense_units, self.mlp_layers, self.activation, self.eps, self.dtype)(latent)
+        init = uniform_init(1.0) if self.hafner_heads else hafner_init
+        return {
+            k: nn.Dense(dim, kernel_init=init, dtype=self.dtype)(x)
+            for k, dim in zip(self.keys, self.output_dims)
+        }
+
+
+class Decoder(nn.Module):
+    cnn_decoder: Optional[CNNDecoder]
+    mlp_decoder: Optional[MLPDecoder]
+
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_decoder is not None:
+            out.update(self.cnn_decoder(latent))
+        if self.mlp_decoder is not None:
+            out.update(self.mlp_decoder(latent))
+        return out
+
+
+class RecurrentModel(nn.Module):
+    """MLP input projection + layer-norm GRU cell (reference agent.py:285-341)."""
+
+    recurrent_state_size: int
+    dense_units: int
+    activation: Any = "silu"
+    eps: float = 1e-3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, h: jax.Array) -> jax.Array:
+        feat = DenseStack(self.dense_units, 1, self.activation, self.eps, self.dtype)(x)
+        return LayerNormGRUCell(
+            hidden_size=self.recurrent_state_size,
+            bias=False,
+            layer_norm=True,
+            layer_norm_eps=self.eps,
+            kernel_init=hafner_init,
+            dtype=self.dtype,
+        )(h, feat)
+
+
+class Actor(nn.Module):
+    """Dreamer-V3 policy head (reference agent.py:694-884): DenseStack backbone, one
+    logits head per discrete action dim (unimix-smoothed), or a single
+    mean/std head for continuous control. Returns the *raw head outputs*; sampling
+    and distribution math live in pure functions below so they can take PRNG keys."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    dense_units: int = 1024
+    mlp_layers: int = 5
+    activation: Any = "silu"
+    eps: float = 1e-3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, state: jax.Array) -> List[jax.Array]:
+        x = DenseStack(self.dense_units, self.mlp_layers, self.activation, self.eps, self.dtype)(state)
+        if self.is_continuous:
+            return [nn.Dense(int(np.sum(self.actions_dim)) * 2, kernel_init=uniform_init(1.0), dtype=self.dtype)(x)]
+        return [
+            nn.Dense(dim, kernel_init=uniform_init(1.0), dtype=self.dtype)(x)
+            for dim in self.actions_dim
+        ]
+
+
+# ---------------------------------------------------------------------------------
+# pure stochastic-state math
+# ---------------------------------------------------------------------------------
+def unimix_logits(logits: jax.Array, discrete: int, unimix: float) -> jax.Array:
+    """1% uniform mixing of categorical probs (reference RSSM._uniform_mix,
+    agent.py:447-459). Takes and returns flat [..., S*D] logits."""
+    logits = logits.reshape(*logits.shape[:-1], -1, discrete)
+    if unimix > 0.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        uniform = jnp.ones_like(probs) / discrete
+        probs = (1 - unimix) * probs + unimix * uniform
+        logits = jnp.log(probs)
+    return logits.reshape(*logits.shape[:-2], -1)
+
+
+def stochastic_state(
+    logits: jax.Array, discrete: int, key: Optional[jax.Array] = None, sample: bool = True
+) -> jax.Array:
+    """Straight-through sample (or mode) of the [..., S, D] categorical stack
+    (reference dreamer_v2/utils.py:44-61). Returns flat [..., S*D]."""
+    shaped = logits.reshape(*logits.shape[:-1], -1, discrete)
+    if sample:
+        idx = jax.random.categorical(key, shaped, axis=-1)
+        onehot = jax.nn.one_hot(idx, discrete, dtype=shaped.dtype)
+        probs = jax.nn.softmax(shaped, axis=-1)
+        out = jax.lax.stop_gradient(onehot) + probs - jax.lax.stop_gradient(probs)
+    else:
+        idx = jnp.argmax(shaped, axis=-1)
+        out = jax.nn.one_hot(idx, discrete, dtype=shaped.dtype)
+    return out.reshape(*out.shape[:-2], -1)
+
+
+def categorical_kl(post_logits: jax.Array, prior_logits: jax.Array, discrete: int) -> jax.Array:
+    """KL( Cat(post) || Cat(prior) ) summed over the stochastic-variable axis;
+    flat [..., S*D] logits in, [...] out."""
+    post = post_logits.reshape(*post_logits.shape[:-1], -1, discrete)
+    prior = prior_logits.reshape(*prior_logits.shape[:-1], -1, discrete)
+    post_lp = jax.nn.log_softmax(post, axis=-1)
+    prior_lp = jax.nn.log_softmax(prior, axis=-1)
+    kl = jnp.sum(jnp.exp(post_lp) * (post_lp - prior_lp), axis=-1)
+    return kl.sum(axis=-1)
+
+
+# ---------------------------------------------------------------------------------
+# actor distribution math (pure)
+# ---------------------------------------------------------------------------------
+def actor_sample(
+    agent: "DV3Agent",
+    pre_dist: List[jax.Array],
+    key: jax.Array,
+    greedy: bool = False,
+) -> jax.Array:
+    """Sample concatenated actions from the raw actor outputs (one-hot blocks for
+    discrete dims, clipped tanh-mean scaled-normal for continuous — reference
+    Actor.forward, agent.py:790-855)."""
+    cfg = agent.actor_cfg
+    if agent.is_continuous:
+        mean, std_raw = jnp.split(pre_dist[0], 2, axis=-1)
+        mean = jnp.tanh(mean)
+        std = (cfg["max_std"] - cfg["min_std"]) * jax.nn.sigmoid(std_raw + cfg["init_std"]) + cfg["min_std"]
+        if greedy:
+            actions = mean
+        else:
+            actions = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+        clip = cfg.get("action_clip", 1.0)
+        if clip and clip > 0:
+            limit = jnp.full_like(actions, clip)
+            scale = limit / jnp.maximum(limit, jnp.abs(actions))
+            actions = actions * jax.lax.stop_gradient(scale)
+        return actions
+    keys = jax.random.split(key, len(pre_dist))
+    outs = []
+    for i, logits in enumerate(pre_dist):
+        logits = unimix_logits(logits, logits.shape[-1], cfg.get("unimix", 0.01))
+        if greedy:
+            idx = jnp.argmax(logits, axis=-1)
+            outs.append(jax.nn.one_hot(idx, logits.shape[-1], dtype=logits.dtype))
+        else:
+            idx = jax.random.categorical(keys[i], logits, axis=-1)
+            onehot = jax.nn.one_hot(idx, logits.shape[-1], dtype=logits.dtype)
+            probs = jax.nn.softmax(logits, axis=-1)
+            outs.append(jax.lax.stop_gradient(onehot) + probs - jax.lax.stop_gradient(probs))
+    return jnp.concatenate(outs, axis=-1)
+
+
+def actor_logprob_entropy(
+    agent: "DV3Agent", pre_dist: List[jax.Array], actions: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """log-prob of concatenated ``actions`` under the actor heads + total entropy
+    (used by the imagination REINFORCE objective). Shapes [..., 1] / [...]."""
+    cfg = agent.actor_cfg
+    if agent.is_continuous:
+        mean, std_raw = jnp.split(pre_dist[0], 2, axis=-1)
+        mean = jnp.tanh(mean)
+        std = (cfg["max_std"] - cfg["min_std"]) * jax.nn.sigmoid(std_raw + cfg["init_std"]) + cfg["min_std"]
+        var = jnp.square(std)
+        lp = (-jnp.square(actions - mean) / (2 * var) - jnp.log(std) - 0.5 * jnp.log(2 * jnp.pi)).sum(
+            axis=-1, keepdims=True
+        )
+        ent = (0.5 + 0.5 * jnp.log(2 * jnp.pi) + jnp.log(std)).sum(axis=-1)
+        return lp, ent
+    splits = np.cumsum(agent.actions_dim)[:-1].tolist()
+    blocks = jnp.split(actions, splits, axis=-1)
+    lps, ents = [], []
+    for logits, act in zip(pre_dist, blocks):
+        logits = unimix_logits(logits, logits.shape[-1], cfg.get("unimix", 0.01))
+        lp_all = jax.nn.log_softmax(logits, axis=-1)
+        lps.append(jnp.sum(lp_all * act, axis=-1))
+        ents.append(-jnp.sum(jnp.exp(lp_all) * lp_all, axis=-1))
+    return jnp.stack(lps, axis=-1).sum(axis=-1, keepdims=True), jnp.stack(ents, axis=-1).sum(axis=-1)
+
+
+# ---------------------------------------------------------------------------------
+# agent container + scan programs
+# ---------------------------------------------------------------------------------
+@dataclass
+class DV3Agent:
+    """All Flax modules plus the pure-scan RSSM programs. ``params`` pytrees are
+    threaded explicitly; layout:
+
+    ``{"world_model": {"encoder", "recurrent_model", "representation_model",
+    "transition_model", "observation_model", "reward_model", "continue_model",
+    "initial_recurrent_state"}, "actor", "critic", "target_critic"}``
+    """
+
+    encoder: Encoder
+    recurrent_model: RecurrentModel
+    representation_model: MLPHead
+    transition_model: MLPHead
+    observation_model: Decoder
+    reward_model: MLPHead
+    continue_model: MLPHead
+    actor: Actor
+    critic: MLPHead
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    stochastic_size: int
+    discrete_size: int
+    recurrent_state_size: int
+    unimix: float
+    actor_cfg: Dict[str, Any] = field(default_factory=dict)
+    learnable_initial_recurrent_state: bool = True
+    decoupled_rssm: bool = False
+
+    @property
+    def stoch_state_size(self) -> int:
+        return self.stochastic_size * self.discrete_size
+
+    @property
+    def latent_state_size(self) -> int:
+        return self.stoch_state_size + self.recurrent_state_size
+
+    # -- rssm primitives -------------------------------------------------------------
+
+    def initial_state(self, wm_params: Dict, batch_shape: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
+        """tanh(learnable w) expanded + transition-mode posterior (reference
+        RSSM.get_initial_states, agent.py:406-409)."""
+        w = wm_params["initial_recurrent_state"]
+        if not self.learnable_initial_recurrent_state:
+            w = jax.lax.stop_gradient(w)
+        h0 = jnp.broadcast_to(jnp.tanh(w), (*batch_shape, self.recurrent_state_size))
+        prior_logits = self.transition_model.apply({"params": wm_params["transition_model"]}, h0)
+        prior_logits = unimix_logits(prior_logits, self.discrete_size, self.unimix)
+        z0 = stochastic_state(prior_logits, self.discrete_size, sample=False)
+        return h0, z0
+
+    def _representation(self, wm_params: Dict, h: jax.Array, embedded: jax.Array, key: jax.Array):
+        logits = self.representation_model.apply(
+            {"params": wm_params["representation_model"]}, jnp.concatenate([h, embedded], axis=-1)
+        )
+        logits = unimix_logits(logits, self.discrete_size, self.unimix)
+        return logits, stochastic_state(logits, self.discrete_size, key)
+
+    def _transition(self, wm_params: Dict, h: jax.Array, key: jax.Array):
+        logits = self.transition_model.apply({"params": wm_params["transition_model"]}, h)
+        logits = unimix_logits(logits, self.discrete_size, self.unimix)
+        return logits, stochastic_state(logits, self.discrete_size, key)
+
+    def _recurrent(self, wm_params: Dict, z: jax.Array, a: jax.Array, h: jax.Array) -> jax.Array:
+        return self.recurrent_model.apply(
+            {"params": wm_params["recurrent_model"]}, jnp.concatenate([z, a], axis=-1), h
+        )
+
+    def dynamic_scan(
+        self,
+        wm_params: Dict,
+        embedded: jax.Array,  # [T, B, E]
+        actions: jax.Array,  # [T, B, A]
+        is_first: jax.Array,  # [T, B, 1]
+        key: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Posterior/prior unroll over the sequence — ONE lax.scan replacing the
+        reference's per-timestep Python loop (dreamer_v3.py:86-97).
+
+        Returns (recurrent_states, posteriors, posterior_logits, prior_logits), all
+        time-major with flattened stochastic states.
+        """
+        T, B = embedded.shape[:2]
+        h0, z0 = self.initial_state(wm_params, (B,))
+        keys = jax.random.split(key, T)
+
+        def step(carry, inp):
+            h, z = carry
+            a, e, first, k = inp
+            a = (1 - first) * a
+            h = (1 - first) * h + first * h0
+            z = (1 - first) * z + first * z0
+            h = self._recurrent(wm_params, z, a, h)
+            prior_logits = self.transition_model.apply({"params": wm_params["transition_model"]}, h)
+            prior_logits = unimix_logits(prior_logits, self.discrete_size, self.unimix)
+            post_logits, z = self._representation(wm_params, h, e, k)
+            return (h, z), (h, z, post_logits, prior_logits)
+
+        init = (
+            jnp.zeros((B, self.recurrent_state_size), embedded.dtype),
+            jnp.zeros((B, self.stoch_state_size), embedded.dtype),
+        )
+        _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
+            step, init, (actions, embedded, is_first, keys)
+        )
+        return hs, zs, post_logits, prior_logits
+
+    def imagination_scan(
+        self,
+        wm_params: Dict,
+        actor_params: Dict,
+        z0: jax.Array,  # [N, S*D] flattened start posteriors (stop-gradient'ed)
+        h0: jax.Array,  # [N, H]
+        key: jax.Array,
+        horizon: int,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Latent imagination (reference behaviour_learning, dreamer_v3.py:104-158):
+        actor acts on stop-gradient latents, dynamics keep gradients flowing so the
+        continuous-control pathwise objective works. Returns
+        (latents [H+1, N, L], actions [H+1, N, A])."""
+        k0, kscan = jax.random.split(key)
+        latent0 = jnp.concatenate([z0, h0], axis=-1)
+        pre = self.actor.apply({"params": actor_params}, jax.lax.stop_gradient(latent0))
+        a0 = actor_sample(self, pre, k0)
+
+        def step(carry, k):
+            z, h, a = carry
+            h = self._recurrent(wm_params, z, a, h)
+            _, z = self._transition(wm_params, h, k)
+            latent = jnp.concatenate([z, h], axis=-1)
+            k_act = jax.random.fold_in(k, 1)
+            pre = self.actor.apply({"params": actor_params}, jax.lax.stop_gradient(latent))
+            a = actor_sample(self, pre, k_act)
+            return (z, h, a), (latent, a)
+
+        keys = jax.random.split(kscan, horizon)
+        _, (latents, actions) = jax.lax.scan(step, (z0, h0, a0), keys)
+        latents = jnp.concatenate([latent0[None], latents], axis=0)
+        actions = jnp.concatenate([a0[None], actions], axis=0)
+        return latents, actions
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    key: jax.Array,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DV3Agent, Dict[str, Any]]:
+    """Create the DV3Agent container + initialized params pytree (role of reference
+    build_agent, agent.py:937-1260, minus the Fabric/compile/weight-tying dance)."""
+    wm_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+    dtype = fabric.compute_dtype
+    if wm_cfg.get("decoupled_rssm", False):
+        raise NotImplementedError(
+            "decoupled_rssm is not implemented yet; set algo.world_model.decoupled_rssm=False"
+        )
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
+    mlp_dec_keys = tuple(cfg.algo.mlp_keys.decoder)
+    cnn_stages = int(np.log2(cfg.env.screen_size) - np.log2(4))
+    eps = 1e-3
+
+    cnn_encoder = (
+        CNNEncoder(
+            keys=cnn_keys,
+            channels_multiplier=wm_cfg.encoder.cnn_channels_multiplier,
+            stages=cnn_stages,
+            activation=cfg.algo.cnn_act,
+            eps=eps,
+            dtype=dtype,
+        )
+        if len(cnn_keys) > 0
+        else None
+    )
+    mlp_encoder = (
+        MLPEncoder(
+            keys=mlp_keys,
+            mlp_layers=wm_cfg.encoder.mlp_layers,
+            dense_units=wm_cfg.encoder.dense_units,
+            activation=cfg.algo.dense_act,
+            eps=eps,
+            dtype=dtype,
+        )
+        if len(mlp_keys) > 0
+        else None
+    )
+    encoder = Encoder(cnn_encoder, mlp_encoder)
+
+    stochastic_size = wm_cfg.stochastic_size
+    discrete_size = wm_cfg.discrete_size
+    stoch_state_size = stochastic_size * discrete_size
+    recurrent_state_size = wm_cfg.recurrent_model.recurrent_state_size
+    latent_state_size = stoch_state_size + recurrent_state_size
+
+    recurrent_model = RecurrentModel(
+        recurrent_state_size=recurrent_state_size,
+        dense_units=wm_cfg.recurrent_model.dense_units,
+        activation=cfg.algo.dense_act,
+        eps=eps,
+        dtype=dtype,
+    )
+    representation_model = MLPHead(
+        units=wm_cfg.representation_model.hidden_size,
+        n_layers=1,
+        output_dim=stoch_state_size,
+        activation=wm_cfg.representation_model.dense_act,
+        eps=eps,
+        head_init_scale=1.0 if cfg.algo.hafner_initialization else None,
+        dtype=dtype,
+    )
+    transition_model = MLPHead(
+        units=wm_cfg.transition_model.hidden_size,
+        n_layers=1,
+        output_dim=stoch_state_size,
+        activation=wm_cfg.transition_model.dense_act,
+        eps=eps,
+        head_init_scale=1.0 if cfg.algo.hafner_initialization else None,
+        dtype=dtype,
+    )
+    cnn_decoder = (
+        CNNDecoder(
+            keys=cnn_dec_keys,
+            output_channels=[int(np.prod(obs_space[k].shape[:-2])) for k in cnn_dec_keys],
+            channels_multiplier=wm_cfg.observation_model.cnn_channels_multiplier,
+            image_size=tuple(obs_space[cnn_dec_keys[0]].shape[-2:]),
+            stages=cnn_stages,
+            activation=cfg.algo.cnn_act,
+            eps=eps,
+            hafner_heads=cfg.algo.hafner_initialization,
+            dtype=dtype,
+        )
+        if len(cnn_dec_keys) > 0
+        else None
+    )
+    mlp_decoder = (
+        MLPDecoder(
+            keys=mlp_dec_keys,
+            output_dims=[obs_space[k].shape[0] for k in mlp_dec_keys],
+            mlp_layers=wm_cfg.observation_model.mlp_layers,
+            dense_units=wm_cfg.observation_model.dense_units,
+            activation=cfg.algo.dense_act,
+            eps=eps,
+            hafner_heads=cfg.algo.hafner_initialization,
+            dtype=dtype,
+        )
+        if len(mlp_dec_keys) > 0
+        else None
+    )
+    observation_model = Decoder(cnn_decoder, mlp_decoder)
+    reward_model = MLPHead(
+        units=wm_cfg.reward_model.dense_units,
+        n_layers=wm_cfg.reward_model.mlp_layers,
+        output_dim=wm_cfg.reward_model.bins,
+        activation=cfg.algo.dense_act,
+        eps=eps,
+        head_init_scale=0.0 if cfg.algo.hafner_initialization else None,
+        dtype=dtype,
+    )
+    continue_model = MLPHead(
+        units=wm_cfg.discount_model.dense_units,
+        n_layers=wm_cfg.discount_model.mlp_layers,
+        output_dim=1,
+        activation=cfg.algo.dense_act,
+        eps=eps,
+        head_init_scale=1.0 if cfg.algo.hafner_initialization else None,
+        dtype=dtype,
+    )
+    actor = Actor(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        dense_units=actor_cfg.dense_units,
+        mlp_layers=actor_cfg.mlp_layers,
+        activation=actor_cfg.dense_act,
+        eps=eps,
+        dtype=dtype,
+    )
+    critic = MLPHead(
+        units=critic_cfg.dense_units,
+        n_layers=critic_cfg.mlp_layers,
+        output_dim=critic_cfg.bins,
+        activation=critic_cfg.dense_act,
+        eps=eps,
+        head_init_scale=0.0 if cfg.algo.hafner_initialization else None,
+        dtype=dtype,
+    )
+
+    agent = DV3Agent(
+        encoder=encoder,
+        recurrent_model=recurrent_model,
+        representation_model=representation_model,
+        transition_model=transition_model,
+        observation_model=observation_model,
+        reward_model=reward_model,
+        continue_model=continue_model,
+        actor=actor,
+        critic=critic,
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        stochastic_size=stochastic_size,
+        discrete_size=discrete_size,
+        recurrent_state_size=recurrent_state_size,
+        unimix=cfg.algo.unimix,
+        actor_cfg={
+            "init_std": actor_cfg.init_std,
+            "min_std": actor_cfg.min_std,
+            "max_std": actor_cfg.get("max_std", 1.0),
+            "unimix": actor_cfg.get("unimix", cfg.algo.unimix),
+            "action_clip": actor_cfg.get("action_clip", 1.0),
+        },
+        learnable_initial_recurrent_state=wm_cfg.learnable_initial_recurrent_state,
+    )
+
+    # -- init params -------------------------------------------------------------
+    keys = jax.random.split(key, 10)
+    dummy_obs = {}
+    for k in cnn_keys:
+        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+    embed_dim_probe = encoder.init(keys[0], dummy_obs)
+    embedded = encoder.apply(embed_dim_probe, dummy_obs)
+    act_dim = int(np.sum(actions_dim))
+    h = jnp.zeros((1, recurrent_state_size), jnp.float32)
+    z = jnp.zeros((1, stoch_state_size), jnp.float32)
+    latent = jnp.zeros((1, latent_state_size), jnp.float32)
+
+    wm_params = {
+        "encoder": embed_dim_probe["params"],
+        "recurrent_model": recurrent_model.init(
+            keys[1], jnp.concatenate([z, jnp.zeros((1, act_dim), jnp.float32)], axis=-1), h
+        )["params"],
+        "representation_model": representation_model.init(
+            keys[2], jnp.concatenate([h, embedded], axis=-1)
+        )["params"],
+        "transition_model": transition_model.init(keys[3], h)["params"],
+        "observation_model": observation_model.init(keys[4], latent)["params"],
+        "reward_model": reward_model.init(keys[5], latent)["params"],
+        "continue_model": continue_model.init(keys[6], latent)["params"],
+        "initial_recurrent_state": jnp.zeros((recurrent_state_size,), jnp.float32),
+    }
+    actor_params = actor.init(keys[7], latent)["params"]
+    critic_params = critic.init(keys[8], latent)["params"]
+    params = {
+        "world_model": wm_params,
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": jax.tree_util.tree_map(lambda x: x, critic_params),
+    }
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    return agent, params
+
+
+class PlayerDV3:
+    """Stateful env-interaction wrapper (reference PlayerDV3, agent.py:596-694): holds
+    the per-env carry (previous action, recurrent + stochastic state) and steps all
+    envs through one jitted encoder→RSSM→actor program."""
+
+    def __init__(self, agent: DV3Agent, num_envs: int, cnn_keys: Sequence[str], mlp_keys: Sequence[str]):
+        self.agent = agent
+        self.num_envs = num_envs
+        self.cnn_keys = tuple(cnn_keys)
+        self.mlp_keys = tuple(mlp_keys)
+        self.actions: Optional[jax.Array] = None
+        self.recurrent_state: Optional[jax.Array] = None
+        self.stochastic_state: Optional[jax.Array] = None
+
+        agent_ref = self.agent
+
+        def _step(params, obs: Dict[str, jax.Array], a, h, z, key, greedy: bool):
+            wm = params["world_model"]
+            embedded = agent_ref.encoder.apply({"params": wm["encoder"]}, obs)
+            h = agent_ref._recurrent(wm, z, a, h)
+            k_repr, k_act = jax.random.split(key)
+            _, z = agent_ref._representation(wm, h, embedded, k_repr)
+            latent = jnp.concatenate([z, h], axis=-1)
+            pre = agent_ref.actor.apply({"params": params["actor"]}, latent)
+            actions = actor_sample(agent_ref, pre, k_act, greedy=greedy)
+            return actions, h, z
+
+        self._step = jax.jit(_step, static_argnames=("greedy",))
+
+    def init_states(self, params: Dict, reset_envs: Optional[Sequence[int]] = None) -> None:
+        act_dim = int(np.sum(self.agent.actions_dim))
+        if reset_envs is None or len(reset_envs) == 0:
+            h0, z0 = self.agent.initial_state(params["world_model"], (self.num_envs,))
+            self.actions = jnp.zeros((self.num_envs, act_dim), jnp.float32)
+            self.recurrent_state = h0
+            self.stochastic_state = z0
+        else:
+            idx = np.asarray(reset_envs)
+            h0, z0 = self.agent.initial_state(params["world_model"], (len(idx),))
+            self.actions = self.actions.at[idx].set(0.0)
+            self.recurrent_state = self.recurrent_state.at[idx].set(h0)
+            self.stochastic_state = self.stochastic_state.at[idx].set(z0)
+
+    def get_actions(self, params: Dict, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False) -> jax.Array:
+        actions, self.recurrent_state, self.stochastic_state = self._step(
+            params, obs, self.actions, self.recurrent_state, self.stochastic_state, key, greedy
+        )
+        self.actions = actions
+        return actions

@@ -1,0 +1,109 @@
+"""Dreamer-V3 support: metric whitelist, Moments return-normalizer, obs preparation
+and the greedy test rollout (reference sheeprl/algos/dreamer_v3/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
+
+
+def init_moments() -> Dict[str, jax.Array]:
+    return {"low": jnp.zeros(()), "high": jnp.zeros(())}
+
+
+def update_moments(
+    state: Dict[str, jax.Array],
+    x: jax.Array,
+    decay: float = 0.99,
+    maximum: float = 1.0,
+    percentile_low: float = 0.05,
+    percentile_high: float = 0.95,
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Percentile-EMA return normalizer (reference Moments, dreamer_v3/utils.py:40-64).
+    Under SPMD the full (global) batch is visible inside the program, so the quantiles
+    are already cross-replica — no explicit all_gather needed. Returns
+    (offset, invscale, new_state)."""
+    x = jax.lax.stop_gradient(x.astype(jnp.float32))
+    low = jnp.quantile(x, percentile_low)
+    high = jnp.quantile(x, percentile_high)
+    new_low = decay * state["low"] + (1 - decay) * low
+    new_high = decay * state["high"] + (1 - decay) * high
+    invscale = jnp.maximum(1.0 / maximum, new_high - new_low)
+    return new_low, invscale, {"low": new_low, "high": new_high}
+
+
+def prepare_obs(
+    fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1
+) -> Dict[str, jax.Array]:
+    """Host obs dict → device arrays: [N, C, H, W] in [-0.5, 0.5] for images,
+    [N, D] floats for vectors (reference utils.py:81-93, batch-first here)."""
+    out: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        v = np.asarray(obs[k], dtype=np.float32)
+        out[k] = jnp.asarray(v.reshape(num_envs, -1, *v.shape[-2:]) / 255.0 - 0.5)
+    for k in mlp_keys:
+        v = np.asarray(obs[k], dtype=np.float32)
+        out[k] = jnp.asarray(v.reshape(num_envs, -1))
+    return out
+
+
+def test(
+    player,
+    params,
+    fabric,
+    cfg: Dict[str, Any],
+    log_dir: str,
+    test_name: str = "",
+    greedy: bool = True,
+):
+    """Play one episode with the frozen params (reference utils.py:96-137)."""
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    player.num_envs = 1
+    player.init_states(params)
+    key = jax.random.PRNGKey(cfg.seed)
+    actions_dim = player.agent.actions_dim
+    while not done:
+        key, step_key = jax.random.split(key)
+        jobs = prepare_obs(
+            fabric, obs, cnn_keys=cfg.algo.cnn_keys.encoder, mlp_keys=cfg.algo.mlp_keys.encoder, num_envs=1
+        )
+        actions = np.asarray(player.get_actions(params, jobs, step_key, greedy=greedy))
+        if player.agent.is_continuous:
+            real_actions = actions[0]
+        else:
+            splits = np.cumsum(actions_dim)[:-1]
+            real_actions = np.stack([b.argmax(-1) for b in np.split(actions[0], splits, axis=-1)], axis=-1)
+        obs, reward, terminated, truncated, _ = env.step(real_actions.reshape(env.action_space.shape))
+        done = bool(terminated or truncated or cfg.dry_run)
+        cumulative_rew += float(np.asarray(reward))
+    fabric.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

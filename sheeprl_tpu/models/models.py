@@ -189,14 +189,19 @@ class LayerNormGRUCell(nn.Module):
     bias: bool = True
     batch_first: bool = False
     layer_norm: bool = True
+    layer_norm_eps: float = 1e-3
+    kernel_init: Optional[Callable] = None
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, hx: jax.Array, x: jax.Array) -> jax.Array:
         inp = jnp.concatenate([x, hx], axis=-1).astype(self.dtype)
-        gates = nn.Dense(3 * self.hidden_size, use_bias=self.bias, dtype=self.dtype)(inp)
+        dense_kwargs = {"use_bias": self.bias, "dtype": self.dtype}
+        if self.kernel_init is not None:
+            dense_kwargs["kernel_init"] = self.kernel_init
+        gates = nn.Dense(3 * self.hidden_size, **dense_kwargs)(inp)
         if self.layer_norm:
-            gates = nn.LayerNorm(dtype=self.dtype, epsilon=1e-3)(gates)
+            gates = nn.LayerNorm(dtype=self.dtype, epsilon=self.layer_norm_eps)(gates)
         reset, cand, update = jnp.split(gates, 3, axis=-1)
         reset = jax.nn.sigmoid(reset)
         cand = jnp.tanh(reset * cand)
