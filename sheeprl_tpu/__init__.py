@@ -27,6 +27,10 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.droq.evaluate",
     "sheeprl_tpu.algos.dreamer_v3.dreamer_v3",
     "sheeprl_tpu.algos.dreamer_v3.evaluate",
+    "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",
+    "sheeprl_tpu.algos.ppo_recurrent.evaluate",
+    "sheeprl_tpu.algos.sac_ae.sac_ae",
+    "sheeprl_tpu.algos.sac_ae.evaluate",
 ]
 
 import importlib  # noqa: E402

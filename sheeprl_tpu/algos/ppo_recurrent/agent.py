@@ -1,0 +1,226 @@
+"""Recurrent PPO agent, Flax/JAX-native.
+
+Capability parity with the reference (sheeprl/algos/ppo_recurrent/agent.py:
+RecurrentModel:18, RecurrentPPOAgent:86, RecurrentPPOPlayer:266): multi-key CNN+MLP
+encoder → optional pre-MLP → LSTM → optional post-MLP → actor heads + critic.
+
+The sequence unroll is a pure ``lax.scan`` over time with a mask-gated carry
+(replacing torch's pack_padded_sequence machinery); the same step function serves
+the per-env act path (T=1) and full-sequence training."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import CNNEncoder, MLPEncoder
+from sheeprl_tpu.models.models import MLP, MultiEncoder
+
+
+class RNNCore(nn.Module):
+    """Optional pre-MLP → LSTM cell → optional post-MLP, one timestep."""
+
+    lstm_hidden_size: int
+    pre_mlp: Dict[str, Any]
+    post_mlp: Dict[str, Any]
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry: Tuple[jax.Array, jax.Array], x: jax.Array):
+        if self.pre_mlp.get("apply", False):
+            x = MLP(
+                hidden_sizes=(self.pre_mlp["dense_units"],),
+                activation=self.pre_mlp["activation"],
+                layer_norm=self.pre_mlp["layer_norm"],
+                dtype=self.dtype,
+            )(x)
+        carry, out = nn.OptimizedLSTMCell(self.lstm_hidden_size, dtype=self.dtype)(carry, x)
+        if self.post_mlp.get("apply", False):
+            out = MLP(
+                hidden_sizes=(self.post_mlp["dense_units"],),
+                activation=self.post_mlp["activation"],
+                layer_norm=self.post_mlp["layer_norm"],
+                dtype=self.dtype,
+            )(out)
+        return carry, out
+
+
+class ActorHeads(nn.Module):
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    dense_units: int
+    mlp_layers: int
+    dense_act: Any
+    layer_norm: bool
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> List[jax.Array]:
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation=self.dense_act,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+        )(x)
+        if self.is_continuous:
+            return [nn.Dense(int(np.sum(self.actions_dim)) * 2, dtype=self.dtype)(x)]
+        return [nn.Dense(dim, dtype=self.dtype)(x) for dim in self.actions_dim]
+
+
+@dataclass
+class RecurrentPPOAgent:
+    """Module container + pure scan programs; params layout:
+    {"feature_extractor", "rnn", "actor", "critic"}."""
+
+    feature_extractor: MultiEncoder
+    rnn: RNNCore
+    actor: ActorHeads
+    critic: MLP
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    rnn_hidden_size: int
+
+    def initial_states(self, num_envs: int) -> Tuple[jax.Array, jax.Array]:
+        return (
+            jnp.zeros((num_envs, self.rnn_hidden_size), jnp.float32),
+            jnp.zeros((num_envs, self.rnn_hidden_size), jnp.float32),
+        )
+
+    def rnn_scan(
+        self,
+        params: Dict,
+        embedded: jax.Array,  # [T, B, F+A] (features ++ prev_actions)
+        hx: jax.Array,  # [B, H]
+        cx: jax.Array,  # [B, H]
+        mask: Optional[jax.Array] = None,  # [T, B, 1] — padded steps keep the carry
+    ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+        def step(carry, inp):
+            x, m = inp
+            new_carry, out = self.rnn.apply({"params": params["rnn"]}, carry, x)
+            if m is not None:
+                new_carry = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(m, n, o), new_carry, carry
+                )
+            return new_carry, out
+
+        if mask is None:
+            mask_seq = jnp.ones((*embedded.shape[:2], 1), bool)
+        else:
+            mask_seq = mask
+        (cx, hx), outs = jax.lax.scan(step, (cx, hx), (embedded, mask_seq))
+        return outs, (hx, cx)
+
+    def forward(
+        self,
+        params: Dict,
+        obs: Dict[str, jax.Array],  # [T, B, ...]
+        prev_actions: jax.Array,  # [T, B, A]
+        hx: jax.Array,
+        cx: jax.Array,
+        mask: Optional[jax.Array] = None,
+    ) -> Tuple[List[jax.Array], jax.Array, Tuple[jax.Array, jax.Array]]:
+        """Full forward over a (possibly padded) sequence: returns
+        (actor pre-dist outs, values, new (hx, cx))."""
+        feat = self.feature_extractor.apply({"params": params["feature_extractor"]}, obs)
+        rnn_out, states = self.rnn_scan(
+            params, jnp.concatenate([feat, prev_actions], axis=-1), hx, cx, mask
+        )
+        pre_dist = self.actor.apply({"params": params["actor"]}, rnn_out)
+        values = self.critic.apply({"params": params["critic"]}, rnn_out)
+        return pre_dist, values, states
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    key: jax.Array,
+) -> Tuple[RecurrentPPOAgent, Dict]:
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    enc_cfg = cfg.algo.encoder
+    rnn_cfg = cfg.algo.rnn
+    dtype = fabric.compute_dtype
+
+    cnn_encoder = (
+        CNNEncoder(
+            keys=cnn_keys,
+            features_dim=enc_cfg.cnn_features_dim,
+            screen_size=cfg.env.screen_size,
+            dtype=dtype,
+        )
+        if len(cnn_keys) > 0
+        else None
+    )
+    mlp_encoder = (
+        MLPEncoder(
+            keys=mlp_keys,
+            features_dim=enc_cfg.mlp_features_dim,
+            dense_units=enc_cfg.dense_units,
+            mlp_layers=enc_cfg.mlp_layers,
+            dense_act=enc_cfg.dense_act,
+            layer_norm=enc_cfg.layer_norm,
+            dtype=dtype,
+        )
+        if len(mlp_keys) > 0
+        else None
+    )
+    feature_extractor = MultiEncoder(cnn_encoder, mlp_encoder)
+    rnn = RNNCore(
+        lstm_hidden_size=rnn_cfg.lstm.hidden_size,
+        pre_mlp=dict(rnn_cfg.pre_rnn_mlp),
+        post_mlp=dict(rnn_cfg.post_rnn_mlp),
+        dtype=dtype,
+    )
+    actor = ActorHeads(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        dense_units=cfg.algo.actor.dense_units,
+        mlp_layers=cfg.algo.actor.mlp_layers,
+        dense_act=cfg.algo.actor.dense_act,
+        layer_norm=cfg.algo.actor.layer_norm,
+        dtype=dtype,
+    )
+    critic = MLP(
+        hidden_sizes=(cfg.algo.critic.dense_units,) * cfg.algo.critic.mlp_layers,
+        output_dim=1,
+        activation=cfg.algo.critic.dense_act,
+        layer_norm=cfg.algo.critic.layer_norm,
+        dtype=dtype,
+    )
+
+    agent = RecurrentPPOAgent(
+        feature_extractor=feature_extractor,
+        rnn=rnn,
+        actor=actor,
+        critic=critic,
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        rnn_hidden_size=rnn_cfg.lstm.hidden_size,
+    )
+
+    keys = jax.random.split(key, 4)
+    dummy_obs = {}
+    for k in cnn_keys:
+        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+    fe_vars = feature_extractor.init(keys[0], dummy_obs)
+    feat = feature_extractor.apply(fe_vars, dummy_obs)
+    act_dim = int(np.sum(actions_dim))
+    h = jnp.zeros((1, rnn_cfg.lstm.hidden_size), jnp.float32)
+    rnn_in = jnp.concatenate([feat, jnp.zeros((1, act_dim), jnp.float32)], axis=-1)
+    params = {
+        "feature_extractor": fe_vars["params"],
+        "rnn": rnn.init(keys[1], (h, h), rnn_in)["params"],
+        "actor": actor.init(keys[2], h)["params"],
+        "critic": critic.init(keys[3], h)["params"],
+    }
+    return agent, params

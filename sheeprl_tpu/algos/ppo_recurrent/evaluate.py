@@ -1,0 +1,37 @@
+"""Recurrent-PPO evaluation entrypoint (reference: sheeprl/algos/ppo_recurrent/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
+from sheeprl_tpu.algos.ppo_recurrent.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["ppo_recurrent"])
+def evaluate(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logdir = cfg.get("log_dir", "logs/evaluation")
+    env = make_env(cfg, cfg.seed, 0, logdir, "test")()
+    observation_space = env.observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    is_continuous = isinstance(env.action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(env.action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        env.action_space.shape
+        if is_continuous
+        else (env.action_space.nvec.tolist() if is_multidiscrete else [env.action_space.n])
+    )
+    env.close()
+    agent, params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, observation_space, jax.random.PRNGKey(cfg.seed)
+    )
+    if state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+    test(agent, params, fabric, cfg, logdir)

@@ -1,0 +1,479 @@
+"""Recurrent PPO, coupled training (capability parity with
+sheeprl/algos/ppo_recurrent/ppo_recurrent.py:33-524).
+
+TPU-native structure:
+- the act path is one jitted encoder→LSTM-step→actor program with an explicit
+  (hx, cx) carry per env, reset on done (reference keeps a stateful module and pays
+  per-step ``.cpu()`` syncs);
+- after the rollout, episodes are chopped into ``per_rank_sequence_length`` chunks and
+  padded host-side (numpy), then the whole optimization — update_epochs × sequence
+  minibatches, each a masked ``lax.scan`` LSTM unroll — runs as ONE jitted device
+  program (the reference packs/pads with torch.nn.utils.rnn per minibatch,
+  ppo_recurrent.py:407-447);
+- the padded sequence-count axis is bucketed to powers of two so XLA recompiles a
+  bounded number of program variants;
+- under dp the sequence axis is sharded over the mesh ``data`` axis.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from functools import partial
+from typing import Any, Dict, List
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import policy_output
+from sheeprl_tpu.algos.ppo.utils import normalize_obs
+from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
+from sheeprl_tpu.algos.ppo_recurrent.utils import test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
+    return jnp.sum(x * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    initial_ent_coef = float(cfg.algo.ent_coef)
+    initial_clip_coef = float(cfg.algo.clip_coef)
+
+    rank = fabric.global_rank
+    world_size = fabric.world_size
+
+    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    if cfg.algo.rollout_steps % cfg.algo.per_rank_sequence_length != 0:
+        raise ValueError(
+            f"rollout_steps ({cfg.algo.rollout_steps}) must be a multiple of "
+            f"per_rank_sequence_length ({cfg.algo.per_rank_sequence_length})"
+        )
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
+    logger = get_logger(fabric, cfg, log_dir=log_dir)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict())
+    fabric.print(f"Log dir: {log_dir}")
+
+    total_num_envs = int(cfg.env.num_envs * world_size)
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * total_num_envs + i,
+                rank * total_num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(total_num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder == []:
+        raise RuntimeError(
+            "You should specify at least one CNN or MLP key for the encoder: "
+            "`algo.cnn_keys.encoder=[rgb]` or `algo.mlp_keys.encoder=[state]`"
+        )
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+    cnn_keys = cfg.algo.cnn_keys.encoder
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+    act_dim = int(np.sum(actions_dim))
+
+    key = fabric.seed_everything(cfg.seed + rank)
+    key, agent_key = jax.random.split(key)
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
+    if state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+
+    # counters
+    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(total_num_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+
+    lr = cfg.algo.optimizer.lr
+    if cfg.algo.anneal_lr:
+        lr = optax.linear_schedule(
+            init_value=lr,
+            end_value=0.0,
+            transition_steps=total_iters * cfg.algo.update_epochs * max(1, cfg.algo.per_rank_num_batches),
+        )
+    tx = instantiate(cfg.algo.optimizer, lr=lr)
+    if cfg.algo.max_grad_norm > 0.0:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), tx)
+    opt_state = tx.init(params)
+    if state is not None and "optimizer" in state:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    rb = ReplayBuffer(
+        cfg.algo.rollout_steps,
+        total_num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        obs_keys=obs_keys,
+    )
+
+    # ---------------- jitted programs ----------------
+    loss_reduction = cfg.algo.loss_reduction
+    vf_coef = float(cfg.algo.vf_coef)
+    clip_vloss = bool(cfg.algo.clip_vloss)
+    normalize_advantages = bool(cfg.algo.normalize_advantages)
+    num_batches = max(1, int(cfg.algo.per_rank_num_batches))
+    sl = int(cfg.algo.per_rank_sequence_length)
+
+    cpu_device = jax.devices("cpu")[0]
+    act_on_cpu = fabric.device.platform != "cpu"
+
+    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+    def policy_step_fn(params, obs, prev_actions, hx, cx, step_key):
+        norm = normalize_obs(obs, cnn_keys, obs_keys)
+        norm = {k: v[None].astype(jnp.float32) for k, v in norm.items()}
+        pre_dist, values, (hx, cx) = agent.forward(params, norm, prev_actions[None], hx, cx)
+        out = policy_output(
+            [p[0] for p in pre_dist], values[0], step_key, actions_dim, is_continuous
+        )
+        if is_continuous:
+            real_actions = out["actions"]
+        else:
+            split = jnp.split(out["actions"], np.cumsum(actions_dim)[:-1].tolist(), axis=-1)
+            real_actions = jnp.stack([s.argmax(axis=-1) for s in split], axis=-1)
+        return out, real_actions, hx, cx
+
+    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+    def get_values(params, obs, prev_actions, hx, cx):
+        norm = normalize_obs(obs, cnn_keys, obs_keys)
+        norm = {k: v[None].astype(jnp.float32) for k, v in norm.items()}
+        _, values, _ = agent.forward(params, norm, prev_actions[None], hx, cx)
+        return values[0]
+
+    def loss_fn(params, batch, clip_coef, ent_coef):
+        mask = batch["mask"]  # [sl, B, 1]
+        norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
+        pre_dist, values, _ = agent.forward(
+            params,
+            norm_obs,
+            batch["prev_actions"],
+            batch["prev_hx"][0],
+            batch["prev_cx"][0],
+            mask=mask.astype(bool),
+        )
+        out = policy_output(
+            pre_dist, values, jax.random.PRNGKey(0), actions_dim, is_continuous, actions=batch["actions"]
+        )
+        advantages = batch["advantages"]
+        if normalize_advantages:
+            m = _masked_mean(advantages, mask)
+            var = _masked_mean(jnp.square(advantages - m), mask)
+            advantages = (advantages - m) / (jnp.sqrt(var) + 1e-8)
+        logratio = out["logprob"] - batch["logprobs"]
+        ratio = jnp.exp(logratio)
+        pg1 = -advantages * ratio
+        pg2 = -advantages * jnp.clip(ratio, 1 - clip_coef, 1 + clip_coef)
+        pg_loss = _masked_mean(jnp.maximum(pg1, pg2), mask)
+        if clip_vloss:
+            v_pred = batch["values"] + jnp.clip(out["values"] - batch["values"], -clip_coef, clip_coef)
+        else:
+            v_pred = out["values"]
+        v_loss = _masked_mean(jnp.square(v_pred - batch["returns"]), mask)
+        ent_loss = -_masked_mean(out["entropy"], mask)
+        loss = pg_loss + vf_coef * v_loss + ent_coef * ent_loss
+        return loss, (pg_loss, v_loss, ent_loss)
+
+    @jax.jit
+    def train_phase(params, opt_state, seqs, train_key, clip_coef, ent_coef):
+        """update_epochs × sequence-minibatches, fused. ``seqs`` is the padded
+        [sl, N, ...] block (N bucketed to a power of two)."""
+        N = seqs["mask"].shape[1]
+        bs = max(1, N // num_batches)
+        nmb = N // bs
+
+        def epoch_body(carry, epoch_key):
+            params, opt_state = carry
+            perm = jax.random.permutation(epoch_key, N)
+            mb_idx = perm[: nmb * bs].reshape(nmb, bs)
+
+            def mb_body(carry, idx):
+                params, opt_state = carry
+                batch = {k: jnp.take(v, idx, axis=1) for k, v in seqs.items()}
+                grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
+                    params, batch, clip_coef, ent_coef
+                )
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), jnp.stack([pg, vl, ent])
+
+            (params, opt_state), losses = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
+            return (params, opt_state), losses.mean(axis=0)
+
+        epoch_keys = jax.random.split(train_key, cfg.algo.update_epochs)
+        (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
+        return params, opt_state, losses.mean(axis=0)
+
+    if world_size > 1:
+        params = fabric.replicate_pytree(params)
+        opt_state = fabric.replicate_pytree(opt_state)
+    act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
+    if act_on_cpu:
+        key = jax.device_put(key, cpu_device)
+
+    # ---------------- main loop ----------------
+    ent_coef = initial_ent_coef
+    clip_coef = initial_clip_coef
+
+    step_data: Dict[str, np.ndarray] = {}
+    next_obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+    prev_actions = np.zeros((total_num_envs, act_dim), np.float32)
+    hx = np.zeros((total_num_envs, agent.rnn_hidden_size), np.float32)
+    cx = np.zeros((total_num_envs, agent.rnn_hidden_size), np.float32)
+
+    for iter_num in range(start_iter, total_iters + 1):
+        with timer("Time/env_interaction_time"):
+            for _ in range(cfg.algo.rollout_steps):
+                policy_step += total_num_envs
+
+                obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
+                key, step_key = jax.random.split(key)
+                prev_hx, prev_cx = hx, cx
+                out, real_actions, hx, cx = policy_step_fn(
+                    act_params, obs_host, jnp.asarray(prev_actions), jnp.asarray(prev_hx), jnp.asarray(prev_cx), step_key
+                )
+                real_actions_np = np.asarray(real_actions)
+                if is_continuous:
+                    env_actions = real_actions_np.reshape(envs.action_space.shape)
+                else:
+                    env_actions = real_actions_np.reshape(
+                        (total_num_envs, -1) if is_multidiscrete else (total_num_envs,)
+                    )
+
+                obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                dones = np.logical_or(terminated, truncated).reshape(total_num_envs, 1).astype(np.float32)
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, 1)
+
+                # truncation bootstrap with the *post-step* recurrent states
+                final_obs_arr = info.get("final_observation", info.get("final_obs"))
+                truncated_envs = np.nonzero(truncated)[0]
+                if final_obs_arr is not None and len(truncated_envs) > 0:
+                    real_next_obs = {
+                        k: np.stack(
+                            [np.asarray(final_obs_arr[i][k], dtype=np.float32) for i in truncated_envs]
+                        )
+                        for k in obs_keys
+                    }
+                    actions_np = np.asarray(out["actions"], np.float32)
+                    vals = np.asarray(
+                        get_values(
+                            act_params,
+                            real_next_obs,
+                            jnp.asarray(actions_np[truncated_envs]),
+                            jnp.asarray(np.asarray(hx)[truncated_envs]),
+                            jnp.asarray(np.asarray(cx)[truncated_envs]),
+                        )
+                    ).reshape(len(truncated_envs))
+                    rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(-1, 1)
+
+                step_data["dones"] = dones[np.newaxis]
+                step_data["values"] = np.asarray(out["values"], np.float32)[np.newaxis]
+                step_data["actions"] = np.asarray(out["actions"], np.float32)[np.newaxis]
+                step_data["logprobs"] = np.asarray(out["logprob"], np.float32)[np.newaxis]
+                step_data["rewards"] = rewards[np.newaxis]
+                step_data["prev_actions"] = prev_actions[np.newaxis]
+                step_data["prev_hx"] = np.asarray(prev_hx, np.float32)[np.newaxis]
+                step_data["prev_cx"] = np.asarray(prev_cx, np.float32)[np.newaxis]
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                prev_actions = (1 - dones) * np.asarray(out["actions"], np.float32)
+                next_obs = obs
+                for k in obs_keys:
+                    step_data[k] = np.asarray(obs[k])[np.newaxis]
+
+                # reset recurrent state on done (reference ppo_recurrent.py:368-371)
+                if cfg.algo.reset_recurrent_state_on_done:
+                    hx = (1 - dones) * np.asarray(hx)
+                    cx = (1 - dones) * np.asarray(cx)
+                else:
+                    hx, cx = np.asarray(hx), np.asarray(cx)
+
+                ep_info = info.get("final_info", info)
+                if "episode" in ep_info:
+                    ep = ep_info["episode"]
+                    mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
+                    rews, lens = ep["r"][mask], ep["l"][mask]
+                    if aggregator and not aggregator.disabled and len(rews) > 0:
+                        aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                        aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+
+        # bootstrap + GAE on host arrays
+        obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
+        next_values = np.asarray(
+            get_values(
+                act_params, obs_host, jnp.asarray(prev_actions), jnp.asarray(hx), jnp.asarray(cx)
+            )
+        )
+        local_data = {k: np.asarray(rb[k], dtype=np.float32) for k in rb.buffer.keys()}
+        returns, advantages = jax.device_get(
+            gae(
+                jnp.asarray(local_data["rewards"]),
+                jnp.asarray(local_data["values"]),
+                jnp.asarray(local_data["dones"]),
+                jnp.asarray(next_values),
+                cfg.algo.rollout_steps,
+                cfg.algo.gamma,
+                cfg.algo.gae_lambda,
+            )
+        )
+        local_data["returns"] = np.asarray(returns, np.float32)
+        local_data["advantages"] = np.asarray(advantages, np.float32)
+
+        # split into episodes → fixed-length sequences → padded [sl, N, ...] block
+        # (reference ppo_recurrent.py:405-445, numpy instead of torch pad_sequence)
+        sequences: Dict[str, List[np.ndarray]] = {k: [] for k in local_data}
+        lengths: List[int] = []
+        for env_id in range(total_num_envs):
+            ep_ends = local_data["dones"][:, env_id, 0].nonzero()[0].tolist()
+            ep_ends.append(cfg.algo.rollout_steps - 1)
+            start = 0
+            for stop in ep_ends:
+                if stop + 1 <= start:
+                    continue
+                for k in local_data:
+                    ep = local_data[k][start : stop + 1, env_id]
+                    for s0 in range(0, ep.shape[0], sl):
+                        sequences[k].append(ep[s0 : s0 + sl])
+                ep_len = stop + 1 - start
+                lengths.extend(
+                    [min(sl, ep_len - s0) for s0 in range(0, ep_len, sl)]
+                )
+                start = stop + 1
+        num_seq = len(lengths)
+        n_pad = _next_pow2(max(num_seq, num_batches))
+        seqs: Dict[str, np.ndarray] = {}
+        for k, chunks in sequences.items():
+            if k in ("dones", "rewards"):
+                continue  # folded into returns/advantages; not read by the loss
+            arr = np.zeros((sl, n_pad, *chunks[0].shape[1:]), np.float32)
+            for j, c in enumerate(chunks):
+                arr[: c.shape[0], j] = c
+            # only the sequence-start recurrent state seeds the unroll
+            seqs[k] = arr[:1] if k in ("prev_hx", "prev_cx") else arr
+        mask = np.zeros((sl, n_pad, 1), np.float32)
+        for j, ln in enumerate(lengths):
+            mask[:ln, j] = 1.0
+        seqs["mask"] = mask
+
+        with timer("Time/train_time"):
+            if world_size > 1:
+                seqs = jax.device_put(seqs, fabric.sharding(None, "data"))
+            key, train_key = jax.random.split(key)
+            params, opt_state, mean_losses = train_phase(
+                params, opt_state, seqs, np.asarray(train_key), clip_coef, ent_coef
+            )
+            if aggregator and not aggregator.disabled:
+                losses_np = np.asarray(mean_losses)
+                aggregator.update("Loss/policy_loss", losses_np[0])
+                aggregator.update("Loss/value_loss", losses_np[1])
+                aggregator.update("Loss/entropy_loss", losses_np[2])
+            act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
+        ):
+            metrics_dict = aggregator.compute() if aggregator else {}
+            if logger is not None:
+                logger.log_metrics(metrics_dict, policy_step)
+                timers = timer.to_dict(reset=False)
+                if timers.get("Time/train_time", 0) > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                        policy_step,
+                    )
+                if timers.get("Time/env_interaction_time", 0) > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (policy_step - last_log)
+                            / max(timers["Time/env_interaction_time"], 1e-9)
+                        },
+                        policy_step,
+                    )
+            timer.to_dict(reset=True)
+            if aggregator:
+                aggregator.reset()
+            last_log = policy_step
+
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(
+                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(
+                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "optimizer": opt_state,
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size if cfg.algo.get("per_rank_batch_size") else 0,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(agent, params, fabric, cfg, log_dir)
+    if logger is not None:
+        logger.finalize()

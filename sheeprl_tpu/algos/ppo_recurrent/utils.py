@@ -1,0 +1,58 @@
+"""Recurrent-PPO helpers (reference: sheeprl/algos/ppo_recurrent/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import policy_output
+from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, normalize_obs  # noqa: F401
+
+MODELS_TO_REGISTER = {"agent"}
+
+
+def test(agent, params, fabric, cfg: Dict[str, Any], log_dir: str) -> None:
+    """Greedy single-env rollout carrying the LSTM state across steps."""
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, None, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    key = jax.random.PRNGKey(cfg.seed)
+    act_dim = int(np.sum(agent.actions_dim))
+    prev_actions = jnp.zeros((1, act_dim), jnp.float32)
+    hx, cx = agent.initial_states(1)
+    cnn_keys = cfg.algo.cnn_keys.encoder
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+    while not done:
+        host = {}
+        for k in obs_keys:
+            v = np.asarray(obs[k], dtype=np.float32)
+            host[k] = v.reshape(1, -1, *v.shape[-2:]) if k in cnn_keys else v.reshape(1, -1)
+        norm = normalize_obs(host, cnn_keys, obs_keys)
+        norm = {k: jnp.asarray(v)[None] for k, v in norm.items()}
+        pre_dist, values, (hx, cx) = agent.forward(params, norm, prev_actions[None], hx, cx)
+        key, sub = jax.random.split(key)
+        out = policy_output(
+            [p[0] for p in pre_dist], values[0], sub, agent.actions_dim, agent.is_continuous, greedy=True
+        )
+        actions = np.asarray(out["actions"])
+        prev_actions = jnp.asarray(actions)
+        if agent.is_continuous:
+            real_actions = actions.reshape(env.action_space.shape)
+        else:
+            splits = np.cumsum(agent.actions_dim)[:-1]
+            real_actions = np.stack(
+                [b.argmax(-1) for b in np.split(actions[0], splits, axis=-1)], axis=-1
+            ).reshape(env.action_space.shape)
+        obs, reward, terminated, truncated, _ = env.step(real_actions)
+        done = bool(terminated or truncated or cfg.dry_run)
+        cumulative_rew += float(np.asarray(reward))
+    fabric.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

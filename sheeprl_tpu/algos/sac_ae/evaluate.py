@@ -1,0 +1,29 @@
+"""SAC-AE evaluation entrypoint (reference: sheeprl/algos/sac_ae/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.algos.sac_ae.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["sac_ae"])
+def evaluate(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logdir = cfg.get("log_dir", "logs/evaluation")
+    env = make_env(cfg, cfg.seed, 0, logdir, "test")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    env.close()
+    agent, params = build_agent(
+        fabric, cfg, observation_space, action_space, jax.random.PRNGKey(cfg.seed),
+        state["agent"] if state else None,
+    )
+    test(agent, params, fabric, cfg, logdir)

@@ -1,0 +1,455 @@
+"""SAC-AE, coupled training (capability parity with sheeprl/algos/sac_ae/sac_ae.py:
+35-502): pixel SAC with autoencoder reconstruction regularization.
+
+TPU-native structure (same shape as the SAC module): the act path is a small jitted
+sampler; each iteration's gradient steps run as ONE jitted program scanning the
+``[G, B, ...]`` replay block — critic → (gated) target EMA → (gated) actor+alpha →
+(gated) encoder/decoder reconstruction, with the update-frequency gates from the
+reference (critic.per_rank_target_network_update_freq, actor.per_rank_update_freq,
+decoder.per_rank_update_freq) applied per scanned step via ``lax.cond``-free masked
+updates on the cumulative step counter."""
+
+from __future__ import annotations
+
+import os
+import warnings
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.sac.agent import squash_and_logprob
+from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.algos.sac_ae.utils import prepare_obs, preprocess_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+def _masked_update(tx, grads, opt_state, group, apply_flag):
+    """Optimizer step that is a no-op (params and opt-state kept) when
+    ``apply_flag`` is 0 — the jit-able form of the reference's modulo-gated
+    update branches."""
+    updates, new_opt = tx.update(grads, opt_state, group)
+    new_params = optax.apply_updates(group, updates)
+    pick = lambda n, o: jnp.where(apply_flag, n, o)
+    return (
+        jax.tree_util.tree_map(pick, new_params, group),
+        jax.tree_util.tree_map(pick, new_opt, opt_state),
+    )
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    rank = fabric.global_rank
+    world_size = fabric.world_size
+
+    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
+    logger = get_logger(fabric, cfg, log_dir=log_dir)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict())
+    fabric.print(f"Log dir: {log_dir}")
+
+    total_num_envs = int(cfg.env.num_envs * world_size)
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * total_num_envs + i,
+                rank * total_num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(total_num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC-AE agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    if len(cnn_keys) + len(mlp_keys) == 0:
+        raise RuntimeError("You should specify at least one CNN or MLP key for the encoder")
+    obs_keys = cnn_keys + mlp_keys
+    if cfg.metric.log_level > 0:
+        fabric.print("Encoder CNN keys:", cnn_keys)
+        fabric.print("Encoder MLP keys:", mlp_keys)
+
+    key = fabric.seed_everything(cfg.seed + rank)
+    key, agent_key = jax.random.split(key)
+    agent, params = build_agent(
+        fabric, cfg, observation_space, action_space, agent_key, state["agent"] if state else None
+    )
+    act_dim = int(np.prod(action_space.shape))
+    target_entropy = -float(act_dim)
+
+    # five optimizers (reference sac_ae.py:211-248)
+    actor_tx = instantiate(cfg.algo.actor.optimizer)
+    critic_tx = instantiate(cfg.algo.critic.optimizer)
+    alpha_tx = instantiate(cfg.algo.alpha.optimizer)
+    encoder_tx = instantiate(cfg.algo.encoder.optimizer)
+    decoder_tx = instantiate(cfg.algo.decoder.optimizer)
+
+    def critic_group(p):
+        return {k: p[k] for k in ("conv", "mlp_enc", "critic_cnn_fc", "qfs") if k in p}
+
+    def actor_group(p):
+        return {k: p[k] for k in ("actor", "actor_cnn_fc") if k in p}
+
+    def encoder_group(p):
+        return {k: p[k] for k in ("conv", "mlp_enc", "critic_cnn_fc") if k in p}
+
+    opt_state = {
+        "critic": critic_tx.init(critic_group(params)),
+        "actor": actor_tx.init(actor_group(params)),
+        "alpha": alpha_tx.init(params["log_alpha"]),
+        "encoder": encoder_tx.init(encoder_group(params)),
+        "decoder": decoder_tx.init(params["decoder"]),
+    }
+    if state is not None:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // total_num_envs if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        buffer_size,
+        total_num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        obs_keys=tuple(obs_keys),
+    )
+    if state is not None and cfg.buffer.checkpoint and "rb" in state:
+        rb = state["rb"]
+
+    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(total_num_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state is not None:
+        ratio.load_state_dict(state["ratio"])
+
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    # ---------------- jitted programs ----------------
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    encoder_tau = float(cfg.algo.encoder.tau)
+    num_critics = int(cfg.algo.critic.n)
+    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+    actor_freq = int(cfg.algo.actor.per_rank_update_freq)
+    decoder_freq = int(cfg.algo.decoder.per_rank_update_freq)
+    l2_lambda = float(cfg.algo.decoder.l2_lambda)
+    cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
+    mlp_dec_keys = tuple(cfg.algo.mlp_keys.decoder)
+
+    def _flat_img(x):
+        # fold frame-stack dims into channels: [..., S, C, H, W] -> [..., S*C, H, W]
+        return x.reshape(*x.shape[:-4], -1, *x.shape[-2:]) if x.ndim >= 5 else x
+
+    def _norm(batch, prefix=""):
+        out = {}
+        for k in cnn_keys:
+            out[k] = _flat_img(batch[prefix + k]) / 255.0
+        for k in mlp_keys:
+            out[k] = batch[prefix + k]
+        return out
+
+    @jax.jit
+    def act_fn(params, obs: Dict[str, jax.Array], step_key):
+        feat = agent.features(params, obs, side="actor")
+        mean, std = agent.actor.apply({"params": params["actor"]}, feat)
+        actions, _ = squash_and_logprob(mean, std, step_key, agent.action_scale, agent.action_bias)
+        return actions
+
+    def critic_loss_fn(cg, params, batch, step_key):
+        p = {**params, **cg}
+        next_obs = _norm(batch, "next_")
+        obs = _norm(batch)
+        feat_next_actor = agent.features(params, next_obs, side="actor")
+        mean, std = agent.actor.apply({"params": params["actor"]}, feat_next_actor)
+        next_actions, next_logprobs = squash_and_logprob(
+            mean, std, step_key, agent.action_scale, agent.action_bias
+        )
+        target_feat = agent.features(params, next_obs, target=True)
+        target_q = agent.qfs.apply({"params": params["target"]["qfs"]}, target_feat, next_actions)
+        alpha = jnp.exp(params["log_alpha"])
+        min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
+        next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
+        feat = agent.features(p, obs)
+        qf_values = agent.qfs.apply({"params": cg["qfs"]}, feat, batch["actions"])
+        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+
+    def actor_loss_fn(ag, params, batch, step_key):
+        p = {**params, **ag}
+        obs = _norm(batch)
+        feat = agent.features(p, obs, side="actor", detach_encoder_features=True)
+        mean, std = agent.actor.apply({"params": ag["actor"]}, feat)
+        actions, logprobs = squash_and_logprob(mean, std, step_key, agent.action_scale, agent.action_bias)
+        feat_c = agent.features(params, obs, detach_encoder_features=True)
+        qf_values = agent.qfs.apply({"params": params["qfs"]}, feat_c, actions)
+        min_qf = jnp.min(qf_values, axis=-1, keepdims=True)
+        alpha = jnp.exp(jax.lax.stop_gradient(params["log_alpha"]))
+        return policy_loss(alpha, logprobs, min_qf), logprobs
+
+    def alpha_loss_fn(log_alpha, logprobs):
+        return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
+
+    def reconstruction_loss_fn(eg_dg, params, batch, step_key):
+        p = {**params, **{k: v for k, v in eg_dg.items() if k != "decoder"}}
+        obs = _norm(batch)
+        hidden = agent.features(p, obs)
+        recon = agent.reconstruct({**params, "decoder": eg_dg["decoder"]}, hidden)
+        l2 = 0.5 * jnp.sum(jnp.square(hidden), axis=-1).mean()
+        loss = l2_lambda * l2
+        for k in cnn_dec_keys:
+            target = preprocess_obs(_flat_img(batch[k]), step_key, bits=5)
+            loss = loss + jnp.mean(jnp.square(target - recon[k]))
+        for k in mlp_dec_keys:
+            loss = loss + jnp.mean(jnp.square(batch[k] - recon[k]))
+        return loss
+
+    @jax.jit
+    def train_phase(params, opt_state, data, cum_steps, train_key):
+        G = data["rewards"].shape[0]
+        keys = jax.random.split(jnp.asarray(train_key), G)
+
+        def step(carry, inp):
+            params, opt_state, cum = carry
+            batch, k = inp
+            k_critic, k_actor, k_rec = jax.random.split(k, 3)
+
+            # critic
+            cg = critic_group(params)
+            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(cg, params, batch, k_critic)
+            new_cg, new_copt = _masked_update(critic_tx, qf_grads, opt_state["critic"], cg, 1)
+            params = {**params, **new_cg}
+            opt_state = {**opt_state, "critic": new_copt}
+
+            # target EMA (critic tau + encoder tau), gated on cumulative steps
+            do_ema = (cum % target_freq) == 0
+            new_target = {}
+            for part, part_tau in (("qfs", tau), ("conv", encoder_tau), ("mlp_enc", encoder_tau), ("critic_cnn_fc", encoder_tau)):
+                if part in params["target"]:
+                    new_target[part] = jax.tree_util.tree_map(
+                        lambda t, c: jnp.where(do_ema, part_tau * c + (1 - part_tau) * t, t),
+                        params["target"][part],
+                        params[part],
+                    )
+            params = {**params, "target": new_target}
+
+            # actor + alpha, gated
+            do_actor = ((cum % actor_freq) == 0).astype(jnp.float32)
+            ag = actor_group(params)
+            (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+                ag, params, batch, k_actor
+            )
+            new_ag, new_aopt = _masked_update(actor_tx, a_grads, opt_state["actor"], ag, do_actor)
+            params = {**params, **new_ag}
+            opt_state = {**opt_state, "actor": new_aopt}
+
+            al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
+            new_la, new_alopt = _masked_update(
+                alpha_tx, al_grads, opt_state["alpha"], params["log_alpha"], do_actor
+            )
+            params = {**params, "log_alpha": new_la}
+            opt_state = {**opt_state, "alpha": new_alopt}
+
+            # encoder/decoder reconstruction, gated
+            do_dec = ((cum % decoder_freq) == 0).astype(jnp.float32)
+            eg = encoder_group(params)
+            eg_dg = {**eg, "decoder": params["decoder"]}
+            rec_loss, rec_grads = jax.value_and_grad(reconstruction_loss_fn)(
+                eg_dg, params, batch, k_rec
+            )
+            enc_grads = {k: v for k, v in rec_grads.items() if k != "decoder"}
+            new_eg, new_eopt = _masked_update(encoder_tx, enc_grads, opt_state["encoder"], eg, do_dec)
+            new_dg, new_dopt = _masked_update(
+                decoder_tx, rec_grads["decoder"], opt_state["decoder"], params["decoder"], do_dec
+            )
+            params = {**params, **new_eg, "decoder": new_dg}
+            opt_state = {**opt_state, "encoder": new_eopt, "decoder": new_dopt}
+
+            return (params, opt_state, cum + 1), jnp.stack([qf_loss, a_loss, al_loss, rec_loss])
+
+        (params, opt_state, _), losses = jax.lax.scan(step, (params, opt_state, cum_steps), (data, keys))
+        return params, opt_state, losses.mean(axis=0)
+
+    if world_size > 1:
+        params = fabric.replicate_pytree(params)
+        opt_state = fabric.replicate_pytree(opt_state)
+
+    # ---------------- main loop ----------------
+    cumulative_per_rank_gradient_steps = 0
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts and state is None:
+                actions = envs.action_space.sample()
+            else:
+                jobs = prepare_obs(
+                    fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=total_num_envs
+                )
+                key, step_key = jax.random.split(key)
+                actions = np.asarray(act_fn(params, jobs, step_key))
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                np.asarray(actions).reshape(envs.action_space.shape)
+            )
+            rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, -1)
+
+        ep_info = infos.get("final_info", infos)
+        if "episode" in ep_info:
+            ep = ep_info["episode"]
+            mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
+            rews, lens = ep["r"][mask], ep["l"][mask]
+            if aggregator and not aggregator.disabled and len(rews) > 0:
+                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+        final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
+        if final_obs_arr is not None:
+            for idx in range(total_num_envs):
+                if final_obs_arr[idx] is not None:
+                    for k in obs_keys:
+                        real_next_obs[k][idx] = np.asarray(final_obs_arr[idx][k])
+
+        for k in obs_keys:
+            step_data[k] = np.asarray(obs[k]).reshape(1, total_num_envs, *np.asarray(obs[k]).shape[1:])
+            step_data[f"next_{k}"] = real_next_obs[k][np.newaxis]
+        step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, total_num_envs, -1)
+        step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, total_num_envs, -1)
+        step_data["actions"] = np.asarray(actions, np.float32).reshape(1, total_num_envs, -1)
+        step_data["rewards"] = rewards[np.newaxis]
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        obs = next_obs
+
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio((policy_step - prefill_steps * policy_steps_per_iter) / world_size)
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    sample = rb.sample(
+                        batch_size=cfg.algo.per_rank_batch_size * world_size,
+                        n_samples=per_rank_gradient_steps,
+                    )
+                    data = {
+                        k: (
+                            np.asarray(v)
+                            if any(k.endswith(ck) for ck in cnn_keys)
+                            else np.asarray(v, dtype=np.float32)
+                        )
+                        for k, v in sample.items()
+                    }
+                    if world_size > 1:
+                        data = jax.device_put(data, fabric.sharding(None, "data"))
+                    key, train_key = jax.random.split(key)
+                    params, opt_state, mean_losses = train_phase(
+                        params,
+                        opt_state,
+                        data,
+                        jnp.asarray(cumulative_per_rank_gradient_steps),
+                        np.asarray(train_key),
+                    )
+                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    if aggregator and not aggregator.disabled:
+                        losses_np = np.asarray(mean_losses)
+                        aggregator.update("Loss/value_loss", losses_np[0])
+                        aggregator.update("Loss/policy_loss", losses_np[1])
+                        aggregator.update("Loss/alpha_loss", losses_np[2])
+                        aggregator.update("Loss/reconstruction_loss", losses_np[3])
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
+        ):
+            metrics_dict = aggregator.compute() if aggregator else {}
+            if logger is not None:
+                logger.log_metrics(metrics_dict, policy_step)
+                timers = timer.to_dict(reset=False)
+                if timers.get("Time/train_time", 0) > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                        policy_step,
+                    )
+                if timers.get("Time/env_interaction_time", 0) > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (policy_step - last_log)
+                            / max(timers["Time/env_interaction_time"], 1e-9)
+                        },
+                        policy_step,
+                    )
+            timer.to_dict(reset=True)
+            if aggregator:
+                aggregator.reset()
+            last_log = policy_step
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "opt_state": opt_state,
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(agent, params, fabric, cfg, log_dir)
+    if logger is not None:
+        logger.finalize()

@@ -1,0 +1,70 @@
+"""SAC-AE helpers (reference: sheeprl/algos/sac_ae/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+    "Loss/reconstruction_loss",
+}
+MODELS_TO_REGISTER = {"agent", "encoder", "decoder"}
+
+
+def preprocess_obs(obs: jax.Array, key: jax.Array, bits: int = 8) -> jax.Array:
+    """Bit-depth reduction + uniform dequantization noise, centered
+    (reference utils.py:68-76, arXiv:1807.03039)."""
+    bins = 2**bits
+    if bits < 8:
+        obs = jnp.floor(obs / 2 ** (8 - bits))
+    obs = obs / bins
+    obs = obs + jax.random.uniform(key, obs.shape, obs.dtype) / bins
+    return obs - 0.5
+
+
+def prepare_obs(
+    fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1
+) -> Dict[str, jax.Array]:
+    """Images → [N, C, H, W] in [0, 1]; vectors → [N, D] floats (reference
+    prepare_obs: images are divided by 255 only)."""
+    out: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        v = np.asarray(obs[k], dtype=np.float32)
+        out[k] = jnp.asarray(v.reshape(num_envs, -1, *v.shape[-2:]) / 255.0)
+    for k in mlp_keys:
+        v = np.asarray(obs[k], dtype=np.float32)
+        out[k] = jnp.asarray(v.reshape(num_envs, -1))
+    return out
+
+
+def test(agent, params, fabric, cfg: Dict[str, Any], log_dir: str) -> None:
+    """Greedy (tanh-mean) single-env rollout."""
+    from sheeprl_tpu.algos.sac.agent import greedy_action
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    while not done:
+        jobs = prepare_obs(
+            fabric, obs, cnn_keys=cfg.algo.cnn_keys.encoder, mlp_keys=cfg.algo.mlp_keys.encoder, num_envs=1
+        )
+        feat = agent.features(params, jobs, side="actor")
+        mean, _ = agent.actor.apply({"params": params["actor"]}, feat)
+        actions = np.asarray(greedy_action(mean, agent.action_scale, agent.action_bias))
+        obs, reward, terminated, truncated, _ = env.step(actions.reshape(env.action_space.shape))
+        done = bool(terminated or truncated or cfg.dry_run)
+        cumulative_rew += float(np.asarray(reward))
+    fabric.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

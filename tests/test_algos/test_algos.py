@@ -257,3 +257,43 @@ def test_dreamer_v3(standard_args, env_id):
 
 def test_dreamer_v3_devices2(standard_args):
     _run(standard_args + _DV3_TINY + ["fabric.devices=2"])
+
+
+_RPPO_TINY = [
+    "exp=ppo_recurrent",
+    "env=dummy",
+    "env.num_envs=2",
+    "algo.rollout_steps=8",
+    "algo.per_rank_sequence_length=4",
+    "algo.per_rank_num_batches=2",
+    "algo.update_epochs=2",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_ppo_recurrent(standard_args, env_id):
+    _run(standard_args + _RPPO_TINY + [f"env.id={env_id}", "algo.mlp_keys.encoder=[state]"])
+
+
+def test_ppo_recurrent_devices2(standard_args):
+    _run(standard_args + _RPPO_TINY + ["fabric.devices=2", "algo.mlp_keys.encoder=[state]"])
+
+
+def test_sac_ae(standard_args, devices):
+    _run(
+        standard_args
+        + [
+            "exp=sac_ae",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            f"fabric.devices={devices}",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.per_rank_batch_size=2",
+            "algo.hidden_size=16",
+            "algo.dense_units=8",
+            "algo.cnn_channels_multiplier=1",
+            "algo.encoder.features_dim=8",
+            "env.screen_size=64",
+        ]
+    )
