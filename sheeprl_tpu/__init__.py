@@ -31,6 +31,10 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.ppo_recurrent.evaluate",
     "sheeprl_tpu.algos.sac_ae.sac_ae",
     "sheeprl_tpu.algos.sac_ae.evaluate",
+    "sheeprl_tpu.algos.dreamer_v2.dreamer_v2",
+    "sheeprl_tpu.algos.dreamer_v2.evaluate",
+    "sheeprl_tpu.algos.dreamer_v1.dreamer_v1",
+    "sheeprl_tpu.algos.dreamer_v1.evaluate",
 ]
 
 import importlib  # noqa: E402

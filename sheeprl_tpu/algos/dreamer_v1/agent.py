@@ -1,0 +1,400 @@
+"""Dreamer-V1 agent, Flax/JAX-native.
+
+Capability parity with the reference (sheeprl/algos/dreamer_v1/agent.py:
+RecurrentModel:31, RSSM:64, PlayerDV1:219, build_agent:329): continuous-latent
+(Gaussian) RSSM — representation/transition emit (mean, raw-std) chunks, std is
+softplus + min_std — reusing the Dreamer-V2 encoder/decoder/actor modules (the
+reference does the same, dreamer_v1/agent.py:16-19)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    Actor,
+    CNNDecoder,
+    CNNEncoder,
+    Decoder,
+    DenseStack,
+    Encoder,
+    MLPDecoder,
+    MLPEncoder,
+    MLPHead,
+    RecurrentModel,
+    actor_logprob_entropy,  # noqa: F401 — shared policy math
+    actor_sample,
+    add_exploration_noise,
+)
+
+
+def gaussian_state(
+    mean_std: jax.Array, min_std: float, key: Optional[jax.Array] = None, sample: bool = True
+) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+    """(mean, std), state — reparameterized Normal sample (reference
+    dreamer_v1/utils.py:80-103)."""
+    mean, std_raw = jnp.split(mean_std, 2, axis=-1)
+    std = jax.nn.softplus(std_raw) + min_std
+    if sample:
+        state = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+    else:
+        state = mean
+    return (mean, std), state
+
+
+def normal_kl(mean_p, std_p, mean_q, std_q) -> jax.Array:
+    """KL( N(p) || N(q) ) summed over the last axis (Independent event dim)."""
+    kl = (
+        jnp.log(std_q / std_p)
+        + (jnp.square(std_p) + jnp.square(mean_p - mean_q)) / (2 * jnp.square(std_q))
+        - 0.5
+    )
+    return kl.sum(axis=-1)
+
+
+@dataclass
+class DV1Agent:
+    """Params layout matches DV2Agent, with Gaussian stochastic states."""
+
+    encoder: Encoder
+    recurrent_model: RecurrentModel
+    representation_model: MLPHead
+    transition_model: MLPHead
+    observation_model: Decoder
+    reward_model: MLPHead
+    continue_model: Optional[MLPHead]
+    actor: Actor
+    critic: MLPHead
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    stochastic_size: int
+    recurrent_state_size: int
+    min_std: float = 0.1
+    actor_cfg: Dict[str, Any] = field(default_factory=dict)
+
+    # kept for API symmetry with DV2/DV3 players
+    @property
+    def stoch_state_size(self) -> int:
+        return self.stochastic_size
+
+    @property
+    def discrete_size(self) -> int:
+        return 1
+
+    @property
+    def latent_state_size(self) -> int:
+        return self.stochastic_size + self.recurrent_state_size
+
+    def _representation(self, wm, h, embedded, key, sample=True):
+        out = self.representation_model.apply(
+            {"params": wm["representation_model"]}, jnp.concatenate([h, embedded], axis=-1)
+        )
+        return gaussian_state(out, self.min_std, key, sample)
+
+    def _transition(self, wm, h, key, sample=True):
+        out = self.transition_model.apply({"params": wm["transition_model"]}, h)
+        return gaussian_state(out, self.min_std, key, sample)
+
+    def _recurrent(self, wm, z, a, h):
+        return self.recurrent_model.apply(
+            {"params": wm["recurrent_model"]}, jnp.concatenate([z, a], axis=-1), h
+        )
+
+    def dynamic_scan(self, wm, embedded, actions, key):
+        """Posterior/prior unroll (reference RSSM.dynamic:97-134 — no is_first
+        masking in Dreamer-V1). Returns (hs, zs, post_mean, post_std, prior_mean,
+        prior_std), all time-major."""
+        T, B = embedded.shape[:2]
+        keys = jax.random.split(key, T)
+
+        def step(carry, inp):
+            h, z = carry
+            a, e, k = inp
+            h = self._recurrent(wm, z, a, h)
+            (prior_mean, prior_std), _ = self._transition(wm, h, jax.random.fold_in(k, 0))
+            (post_mean, post_std), z = self._representation(wm, h, e, k)
+            return (h, z), (h, z, post_mean, post_std, prior_mean, prior_std)
+
+        init = (
+            jnp.zeros((B, self.recurrent_state_size), embedded.dtype),
+            jnp.zeros((B, self.stochastic_size), embedded.dtype),
+        )
+        _, outs = jax.lax.scan(step, init, (actions, embedded, keys))
+        return outs
+
+    def imagination_scan(self, wm, actor_params, z0, h0, key, horizon):
+        """DV1 imagination (reference dreamer_v1.py:243-250): actor acts, dynamics
+        step; the trajectory collects the H *imagined* states only."""
+
+        def step(carry, k):
+            z, h, latent = carry
+            pre = self.actor.apply({"params": actor_params}, jax.lax.stop_gradient(latent))
+            a = actor_sample(self, pre, jax.random.fold_in(k, 1))
+            h = self._recurrent(wm, z, a, h)
+            _, z = self._transition(wm, h, k)
+            latent = jnp.concatenate([z, h], axis=-1)
+            return (z, h, latent), latent
+
+        latent0 = jnp.concatenate([z0, h0], axis=-1)
+        keys = jax.random.split(key, horizon)
+        _, latents = jax.lax.scan(step, (z0, h0, latent0), keys)
+        return latents
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    key: jax.Array,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DV1Agent, Dict[str, Any]]:
+    wm_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+    dtype = fabric.compute_dtype
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
+    mlp_dec_keys = tuple(cfg.algo.mlp_keys.decoder)
+    layer_norm = cfg.algo.get("layer_norm", False)
+
+    cnn_encoder = (
+        CNNEncoder(
+            keys=cnn_keys,
+            channels_multiplier=wm_cfg.encoder.cnn_channels_multiplier,
+            activation=cfg.algo.cnn_act,
+            layer_norm=layer_norm,
+            dtype=dtype,
+        )
+        if cnn_keys
+        else None
+    )
+    mlp_encoder = (
+        MLPEncoder(
+            keys=mlp_keys,
+            mlp_layers=wm_cfg.encoder.mlp_layers,
+            dense_units=wm_cfg.encoder.dense_units,
+            activation=cfg.algo.dense_act,
+            layer_norm=layer_norm,
+            dtype=dtype,
+        )
+        if mlp_keys
+        else None
+    )
+    encoder = Encoder(cnn_encoder, mlp_encoder)
+
+    stochastic_size = wm_cfg.stochastic_size
+    recurrent_state_size = wm_cfg.recurrent_model.recurrent_state_size
+    latent_state_size = stochastic_size + recurrent_state_size
+
+    recurrent_model = RecurrentModel(
+        recurrent_state_size=recurrent_state_size,
+        dense_units=wm_cfg.recurrent_model.dense_units,
+        activation=cfg.algo.dense_act,
+        layer_norm=False,
+        dtype=dtype,
+    )
+    representation_model = MLPHead(
+        units=wm_cfg.representation_model.hidden_size,
+        n_layers=1,
+        output_dim=stochastic_size * 2,
+        activation=wm_cfg.representation_model.dense_act,
+        layer_norm=layer_norm,
+        dtype=dtype,
+    )
+    transition_model = MLPHead(
+        units=wm_cfg.transition_model.hidden_size,
+        n_layers=1,
+        output_dim=stochastic_size * 2,
+        activation=wm_cfg.transition_model.dense_act,
+        layer_norm=layer_norm,
+        dtype=dtype,
+    )
+
+    dummy_obs = {}
+    for k in cnn_keys:
+        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+    keys = jax.random.split(key, 10)
+    enc_vars = encoder.init(keys[0], dummy_obs)
+    embedded = encoder.apply(enc_vars, dummy_obs)
+    cnn_encoder_output_dim = (
+        int(np.asarray(cnn_encoder.apply({"params": enc_vars["params"]["cnn_encoder"]}, dummy_obs)).shape[-1])
+        if cnn_encoder is not None
+        else 0
+    )
+
+    cnn_decoder = (
+        CNNDecoder(
+            keys=cnn_dec_keys,
+            output_channels=[int(np.prod(obs_space[k].shape[:-2])) for k in cnn_dec_keys],
+            channels_multiplier=wm_cfg.observation_model.cnn_channels_multiplier,
+            cnn_encoder_output_dim=cnn_encoder_output_dim,
+            activation=cfg.algo.cnn_act,
+            layer_norm=layer_norm,
+            dtype=dtype,
+        )
+        if cnn_dec_keys
+        else None
+    )
+    mlp_decoder = (
+        MLPDecoder(
+            keys=mlp_dec_keys,
+            output_dims=[obs_space[k].shape[0] for k in mlp_dec_keys],
+            mlp_layers=wm_cfg.observation_model.mlp_layers,
+            dense_units=wm_cfg.observation_model.dense_units,
+            activation=cfg.algo.dense_act,
+            layer_norm=layer_norm,
+            dtype=dtype,
+        )
+        if mlp_dec_keys
+        else None
+    )
+    observation_model = Decoder(cnn_decoder, mlp_decoder)
+    reward_model = MLPHead(
+        units=wm_cfg.reward_model.dense_units,
+        n_layers=wm_cfg.reward_model.mlp_layers,
+        output_dim=1,
+        activation=cfg.algo.dense_act,
+        layer_norm=layer_norm,
+        dtype=dtype,
+    )
+    continue_model = (
+        MLPHead(
+            units=wm_cfg.discount_model.dense_units,
+            n_layers=wm_cfg.discount_model.mlp_layers,
+            output_dim=1,
+            activation=cfg.algo.dense_act,
+            layer_norm=layer_norm,
+            dtype=dtype,
+        )
+        if wm_cfg.use_continues
+        else None
+    )
+    actor = Actor(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        dense_units=actor_cfg.dense_units,
+        mlp_layers=actor_cfg.mlp_layers,
+        activation=actor_cfg.dense_act,
+        layer_norm=layer_norm,
+        dtype=dtype,
+    )
+    critic = MLPHead(
+        units=critic_cfg.dense_units,
+        n_layers=critic_cfg.mlp_layers,
+        output_dim=1,
+        activation=critic_cfg.dense_act,
+        layer_norm=layer_norm,
+        dtype=dtype,
+    )
+
+    agent = DV1Agent(
+        encoder=encoder,
+        recurrent_model=recurrent_model,
+        representation_model=representation_model,
+        transition_model=transition_model,
+        observation_model=observation_model,
+        reward_model=reward_model,
+        continue_model=continue_model,
+        actor=actor,
+        critic=critic,
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        stochastic_size=stochastic_size,
+        recurrent_state_size=recurrent_state_size,
+        min_std=wm_cfg.min_std,
+        actor_cfg={
+            "init_std": actor_cfg.init_std,
+            "min_std": actor_cfg.min_std,
+            "expl_amount": actor_cfg.get("expl_amount", 0.0),
+            "expl_decay": actor_cfg.get("expl_decay", 0.0),
+            "expl_min": actor_cfg.get("expl_min", 0.0),
+        },
+    )
+
+    act_dim = int(np.sum(actions_dim))
+    h = jnp.zeros((1, recurrent_state_size), jnp.float32)
+    z = jnp.zeros((1, stochastic_size), jnp.float32)
+    latent = jnp.zeros((1, latent_state_size), jnp.float32)
+    wm_params = {
+        "encoder": enc_vars["params"],
+        "recurrent_model": recurrent_model.init(
+            keys[1], jnp.concatenate([z, jnp.zeros((1, act_dim), jnp.float32)], axis=-1), h
+        )["params"],
+        "representation_model": representation_model.init(
+            keys[2], jnp.concatenate([h, embedded], axis=-1)
+        )["params"],
+        "transition_model": transition_model.init(keys[3], h)["params"],
+        "observation_model": observation_model.init(keys[4], latent)["params"],
+        "reward_model": reward_model.init(keys[5], latent)["params"],
+    }
+    if continue_model is not None:
+        wm_params["continue_model"] = continue_model.init(keys[6], latent)["params"]
+    params = {
+        "world_model": wm_params,
+        "actor": actor.init(keys[7], latent)["params"],
+        "critic": critic.init(keys[8], latent)["params"],
+    }
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    return agent, params
+
+
+class PlayerDV1:
+    """Stateful env-interaction wrapper (reference PlayerDV1, agent.py:219-328)."""
+
+    def __init__(self, agent: DV1Agent, num_envs: int, cnn_keys: Sequence[str], mlp_keys: Sequence[str]):
+        self.agent = agent
+        self.num_envs = num_envs
+        self.cnn_keys = tuple(cnn_keys)
+        self.mlp_keys = tuple(mlp_keys)
+        self.actions: Optional[jax.Array] = None
+        self.recurrent_state: Optional[jax.Array] = None
+        self.stochastic_state: Optional[jax.Array] = None
+
+        agent_ref = self.agent
+
+        def _step(params, obs, a, h, z, key, greedy: bool, expl_amount):
+            wm = params["world_model"]
+            embedded = agent_ref.encoder.apply({"params": wm["encoder"]}, obs)
+            h = agent_ref._recurrent(wm, z, a, h)
+            k_repr, k_act, k_expl = jax.random.split(key, 3)
+            _, z = agent_ref._representation(wm, h, embedded, k_repr)
+            latent = jnp.concatenate([z, h], axis=-1)
+            pre = agent_ref.actor.apply({"params": params["actor"]}, latent)
+            actions = actor_sample(agent_ref, pre, k_act, greedy=greedy)
+            actions = add_exploration_noise(agent_ref, actions, k_expl, expl_amount)
+            return actions, h, z
+
+        self._step = jax.jit(_step, static_argnames=("greedy",))
+
+    def init_states(self, params: Dict = None, reset_envs: Optional[Sequence[int]] = None) -> None:
+        act_dim = int(np.sum(self.agent.actions_dim))
+        if reset_envs is None or len(reset_envs) == 0:
+            self.actions = jnp.zeros((self.num_envs, act_dim), jnp.float32)
+            self.recurrent_state = jnp.zeros((self.num_envs, self.agent.recurrent_state_size), jnp.float32)
+            self.stochastic_state = jnp.zeros((self.num_envs, self.agent.stochastic_size), jnp.float32)
+        else:
+            idx = np.asarray(reset_envs)
+            self.actions = self.actions.at[idx].set(0.0)
+            self.recurrent_state = self.recurrent_state.at[idx].set(0.0)
+            self.stochastic_state = self.stochastic_state.at[idx].set(0.0)
+
+    def get_actions(
+        self, params: Dict, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, expl_amount: float = 0.0
+    ) -> jax.Array:
+        actions, self.recurrent_state, self.stochastic_state = self._step(
+            params, obs, self.actions, self.recurrent_state, self.stochastic_state, key, greedy,
+            jnp.asarray(expl_amount, jnp.float32),
+        )
+        self.actions = actions
+        return actions

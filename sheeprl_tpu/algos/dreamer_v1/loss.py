@@ -1,0 +1,35 @@
+"""Dreamer-V1 losses (reference sheeprl/algos/dreamer_v1/loss.py:9-97)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v1.agent import normal_kl
+
+
+def reconstruction_loss(
+    observation_log_probs: Dict[str, jax.Array],
+    reward_log_prob: jax.Array,
+    posterior_mean: jax.Array,
+    posterior_std: jax.Array,
+    prior_mean: jax.Array,
+    prior_std: jax.Array,
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    continue_log_prob: Optional[jax.Array] = None,
+    continue_scale_factor: float = 10.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (loss, kl, state_loss, reward_loss, observation_loss, continue_loss)."""
+    observation_loss = -sum(lp.mean() for lp in observation_log_probs.values())
+    reward_loss = -reward_log_prob.mean()
+    kl = normal_kl(posterior_mean, posterior_std, prior_mean, prior_std).mean()
+    state_loss = jnp.maximum(kl, kl_free_nats)
+    if continue_log_prob is not None:
+        continue_loss = continue_scale_factor * -continue_log_prob.mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    loss = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    return loss, kl, state_loss, reward_loss, observation_loss, continue_loss

@@ -1,0 +1,52 @@
+"""Dreamer-V1 support (reference: sheeprl/algos/dreamer_v1/utils.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test  # noqa: F401 — shared
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    horizon: int,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """DV1 lambda-return recursion (reference dreamer_v1/utils.py:42-77): produces
+    ``horizon - 1`` targets; the final step bootstraps with the *full* last value
+    (not scaled by 1 - lambda)."""
+    # entries t = 0..H-2: t < H-2 uses values[t+1] * (1 - lambda), t == H-2 uses
+    # values[H-1] unscaled
+    next_values = jnp.concatenate([values[1:-1] * (1 - lmbda), values[-1:]], axis=0)
+    deltas = rewards[: horizon - 1] + next_values * continues[: horizon - 1]
+
+    def step(agg, inp):
+        delta_t, cont_t = inp
+        agg = delta_t + lmbda * cont_t * agg
+        return agg, agg
+
+    init = jnp.zeros_like(values[0])
+    _, lv_rev = jax.lax.scan(step, init, (deltas[::-1], continues[: horizon - 1][::-1]))
+    return lv_rev[::-1]

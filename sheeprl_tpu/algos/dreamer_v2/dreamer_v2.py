@@ -1,0 +1,549 @@
+"""Dreamer-V2, coupled training (capability parity with
+sheeprl/algos/dreamer_v2/dreamer_v2.py:96-792).
+
+Same TPU-native shape as the Dreamer-V3 module: one jitted program per iteration
+scanning the ``[G, T, B, ...]`` replay block — dynamic-learning lax.scan, world-model
+update (KL-balanced alpha loss), DV2-style imagination (zero first action, actor
+before each step), REINFORCE/dynamics-mixed actor update against the target critic,
+Normal(.,1) critic update, hard target-critic copy every
+``per_rank_target_network_update_freq`` gradient steps."""
+
+from __future__ import annotations
+
+import os
+import warnings
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    DV2Agent,
+    PlayerDV2,
+    actor_logprob_entropy,
+    build_agent,
+)
+from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v2.utils import (
+    bernoulli_logprob as _bernoulli_logprob,
+    compute_lambda_values,
+    normal1_logprob as _normal1_logprob,
+    prepare_obs,
+    test,
+)
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx):
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
+    mlp_dec_keys = tuple(cfg.algo.mlp_keys.decoder)
+    wm_cfg = cfg.algo.world_model
+    gamma = float(cfg.algo.gamma)
+    lmbda = float(cfg.algo.lmbda)
+    horizon = int(cfg.algo.horizon)
+    ent_coef = float(cfg.algo.actor.ent_coef)
+    objective_mix = float(cfg.algo.actor.objective_mix)
+    discrete_size = agent.discrete_size
+    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+    use_continues = bool(wm_cfg.use_continues)
+    act_dim = int(np.sum(agent.actions_dim))
+
+    def world_loss_fn(wm_params, batch, key):
+        batch_obs = {k: batch[k] / 255.0 - 0.5 for k in cnn_keys}
+        batch_obs.update({k: batch[k] for k in mlp_keys})
+        is_first = batch["is_first"].at[0].set(jnp.ones_like(batch["is_first"][0]))
+        # row t stores the action chosen *at* o_t; the dynamics consume the action
+        # that *led to* o_t (same shift as dreamer_v3.py, reference dv3:219-221)
+        actions = jnp.concatenate(
+            [jnp.zeros_like(batch["actions"][:1]), batch["actions"][:-1]], axis=0
+        )
+        embedded = agent.encoder.apply({"params": wm_params["encoder"]}, batch_obs)
+        hs, zs, post_logits, prior_logits = agent.dynamic_scan(
+            wm_params, embedded, actions, is_first, key
+        )
+        latents = jnp.concatenate([zs, hs], axis=-1)
+        recon = agent.observation_model.apply({"params": wm_params["observation_model"]}, latents)
+        obs_lps = {
+            k: _normal1_logprob(recon[k], batch_obs[k], len(recon[k].shape[2:]))
+            for k in cnn_dec_keys + mlp_dec_keys
+        }
+        reward_pred = agent.reward_model.apply({"params": wm_params["reward_model"]}, latents)
+        reward_lp = _normal1_logprob(reward_pred, batch["rewards"], 1)
+        cont_lp = None
+        if use_continues:
+            cont_logits = agent.continue_model.apply({"params": wm_params["continue_model"]}, latents)
+            cont_lp = _bernoulli_logprob(cont_logits, (1.0 - batch["terminated"]) * gamma, 1)
+        loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+            obs_lps,
+            reward_lp,
+            prior_logits,
+            post_logits,
+            discrete_size,
+            kl_balancing_alpha=wm_cfg.kl_balancing_alpha,
+            kl_free_nats=wm_cfg.kl_free_nats,
+            kl_free_avg=wm_cfg.kl_free_avg,
+            kl_regularizer=wm_cfg.kl_regularizer,
+            continue_log_prob=cont_lp,
+            discount_scale_factor=wm_cfg.discount_scale_factor,
+        )
+
+        def _cat_entropy(logits):
+            shaped = logits.reshape(*logits.shape[:-1], -1, discrete_size)
+            lp = jax.nn.log_softmax(shaped, axis=-1)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=(-2, -1)).mean()
+
+        metrics = {
+            "Loss/world_model_loss": loss,
+            "Loss/observation_loss": observation_loss,
+            "Loss/reward_loss": reward_loss,
+            "Loss/state_loss": state_loss,
+            "Loss/continue_loss": continue_loss,
+            "State/kl": kl,
+            "State/post_entropy": _cat_entropy(jax.lax.stop_gradient(post_logits)),
+            "State/prior_entropy": _cat_entropy(jax.lax.stop_gradient(prior_logits)),
+        }
+        return loss, (zs, hs, metrics)
+
+    def actor_loss_fn(actor_params, params, zs, hs, true_continue, key):
+        wm = params["world_model"]
+        z0 = jax.lax.stop_gradient(zs).reshape(-1, agent.stoch_state_size)
+        h0 = jax.lax.stop_gradient(hs).reshape(-1, agent.recurrent_state_size)
+        latents, actions = agent.imagination_scan(wm, actor_params, z0, h0, key, horizon, act_dim)
+        predicted_target_values = agent.critic.apply({"params": params["target_critic"]}, latents)
+        predicted_rewards = agent.reward_model.apply({"params": wm["reward_model"]}, latents)
+        if use_continues:
+            cont_logits = agent.continue_model.apply({"params": wm["continue_model"]}, latents)
+            continues = jax.nn.sigmoid(cont_logits)
+            continues = jnp.concatenate([true_continue[None] * gamma, continues[1:]], axis=0)
+        else:
+            continues = jnp.ones_like(jax.lax.stop_gradient(predicted_rewards)) * gamma
+        lambda_values = compute_lambda_values(
+            predicted_rewards[:-1],
+            predicted_target_values[:-1],
+            continues[:-1],
+            bootstrap=predicted_target_values[-1:],
+            lmbda=lmbda,
+        )
+        discount = jax.lax.stop_gradient(
+            jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], axis=0), axis=0)
+        )
+        pre = agent.actor.apply({"params": actor_params}, jax.lax.stop_gradient(latents[:-2]))
+        lp, ent = actor_logprob_entropy(agent, pre, jax.lax.stop_gradient(actions[1:-1]))
+        dynamics = lambda_values[1:]
+        advantage = jax.lax.stop_gradient(lambda_values[1:] - predicted_target_values[:-2])
+        reinforce = lp * advantage
+        objective = objective_mix * reinforce + (1 - objective_mix) * dynamics
+        entropy = ent_coef * ent[..., None]
+        policy_loss = -jnp.mean(discount[:-2] * (objective + entropy))
+        return policy_loss, (latents, lambda_values, discount)
+
+    def critic_loss_fn(critic_params, latents, lambda_values, discount):
+        pred = agent.critic.apply({"params": critic_params}, latents[:-1])
+        lp = _normal1_logprob(pred, jax.lax.stop_gradient(lambda_values), 1)
+        return -jnp.mean(discount[:-1, ..., 0] * lp)
+
+    @jax.jit
+    def train_phase(params, opt_state, data, cum_steps, train_key):
+        G = data["rewards"].shape[0]
+        keys = jax.random.split(jnp.asarray(train_key), G)
+
+        def step(carry, inp):
+            params, opt_state, cum = carry
+            batch, k = inp
+            k_world, k_img = jax.random.split(k)
+
+            # hard target-critic copy (reference dreamer_v2.py:736-740)
+            do_copy = (cum % target_freq) == 0
+            params = {
+                **params,
+                "target_critic": jax.tree_util.tree_map(
+                    lambda t, c: jnp.where(do_copy, c, t), params["target_critic"], params["critic"]
+                ),
+            }
+
+            (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
+                params["world_model"], batch, k_world
+            )
+            updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
+            params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
+            opt_state = {**opt_state, "world_model": new_wopt}
+
+            true_continue = (1 - batch["terminated"]).reshape(-1, 1)
+            (a_loss, (latents, lambda_values, discount)), a_grads = jax.value_and_grad(
+                actor_loss_fn, has_aux=True
+            )(params["actor"], params, zs, hs, true_continue, k_img)
+            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+            opt_state = {**opt_state, "actor": new_aopt}
+
+            latents_sg = jax.lax.stop_gradient(latents)
+            c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
+                params["critic"], latents_sg, lambda_values, discount
+            )
+            updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
+            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+            opt_state = {**opt_state, "critic": new_copt}
+
+            metrics = dict(w_metrics)
+            metrics["Loss/policy_loss"] = a_loss
+            metrics["Loss/value_loss"] = c_loss
+            metrics["Grads/world_model"] = optax.global_norm(w_grads)
+            metrics["Grads/actor"] = optax.global_norm(a_grads)
+            metrics["Grads/critic"] = optax.global_norm(c_grads)
+            return (params, opt_state, cum + 1), metrics
+
+        (params, opt_state, _), metrics = jax.lax.scan(
+            step, (params, opt_state, cum_steps), (data, keys)
+        )
+        return params, opt_state, jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+
+    return train_phase
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    rank = fabric.global_rank
+    world_size = fabric.world_size
+
+    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    cfg.env.frame_stack = 1
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
+    logger = get_logger(fabric, cfg, log_dir=log_dir)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict())
+    fabric.print(f"Log dir: {log_dir}")
+
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    num_envs = int(cfg.env.num_envs)
+    envs = vectorized_env(
+        [
+            partial(
+                RestartOnException,
+                make_env(
+                    cfg,
+                    cfg.seed + rank * num_envs + i,
+                    rank * num_envs,
+                    log_dir if rank == 0 else None,
+                    "train",
+                    vector_env_idx=i,
+                ),
+            )
+            for i in range(num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    if cfg.metric.log_level > 0:
+        fabric.print("Encoder CNN keys:", cnn_keys)
+        fabric.print("Encoder MLP keys:", mlp_keys)
+
+    key = fabric.seed_everything(cfg.seed + rank)
+    key, agent_key = jax.random.split(key)
+    agent, params = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        agent_key,
+        state["agent"] if state else None,
+    )
+    player = PlayerDV2(agent, num_envs, cnn_keys, mlp_keys)
+
+    def _tx(opt_cfg, clip):
+        base = instantiate(opt_cfg)
+        if clip is not None and clip > 0:
+            return optax.chain(optax.clip_by_global_norm(clip), base)
+        return base
+
+    world_tx = _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
+    actor_tx = _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_tx = _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    opt_state = {
+        "world_model": world_tx.init(params["world_model"]),
+        "actor": actor_tx.init(params["actor"]),
+        "critic": critic_tx.init(params["critic"]),
+    }
+    if state is not None:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // int(num_envs * world_size) if not cfg.dry_run else 8
+    buffer_type = cfg.buffer.get("type", "sequential").lower()
+    if buffer_type == "sequential":
+        rb = EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=num_envs,
+            obs_keys=tuple(obs_keys),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+            buffer_cls=SequentialReplayBuffer,
+        )
+    elif buffer_type == "episode":
+        rb = EpisodeBuffer(
+            buffer_size,
+            minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
+            n_envs=num_envs,
+            obs_keys=tuple(obs_keys),
+            prioritize_ends=cfg.buffer.prioritize_ends,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        )
+    else:
+        raise ValueError(
+            f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
+        )
+    if state is not None and cfg.buffer.checkpoint and "rb" in state:
+        rb = state["rb"]
+
+    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+
+    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
+    policy_step = state["iter_num"] * num_envs if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(num_envs * world_size)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state is not None:
+        ratio.load_state_dict(state["ratio"])
+
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    # exploration amount anneal (reference Actor._get_expl_amount)
+    expl_cfg = agent.actor_cfg
+
+    def expl_amount(step: int) -> float:
+        amount = expl_cfg["expl_amount"]
+        if expl_cfg["expl_decay"]:
+            amount = amount * (0.5 ** (step / expl_cfg["expl_decay"]))
+        return max(amount, expl_cfg["expl_min"])
+
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = np.asarray(obs[k])[np.newaxis]
+    step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    player.init_states(params)
+
+    cumulative_per_rank_gradient_steps = 0
+    train_step = 0
+    last_train = 0
+    act_dim = int(np.sum(actions_dim))
+
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts and state is None:
+                real_actions = actions = np.array(envs.action_space.sample())
+                if not is_continuous:
+                    per_dim = actions.reshape(num_envs, len(actions_dim)).T
+                    actions = np.concatenate(
+                        [np.eye(dim, dtype=np.float32)[act] for act, dim in zip(per_dim, actions_dim)],
+                        axis=-1,
+                    )
+            else:
+                jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+                key, step_key = jax.random.split(key)
+                actions = np.asarray(
+                    player.get_actions(params, jobs, step_key, expl_amount=expl_amount(policy_step))
+                )
+                if is_continuous:
+                    real_actions = actions
+                else:
+                    splits = np.cumsum(actions_dim)[:-1]
+                    real_actions = np.stack(
+                        [b.argmax(-1) for b in np.split(actions, splits, axis=-1)], axis=-1
+                    )
+
+            step_data["actions"] = actions.reshape((1, num_envs, -1)).astype(np.float32)
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+
+        step_data["is_first"] = np.zeros_like(step_data["terminated"])
+
+        ep_info = infos.get("final_info", infos)
+        if cfg.metric.log_level > 0 and "episode" in ep_info:
+            ep = ep_info["episode"]
+            mask = ep.get("_r", ep_info.get("_episode", np.ones(num_envs, bool)))
+            rews, lens = ep["r"][mask], ep["l"][mask]
+            if aggregator and not aggregator.disabled and len(rews) > 0:
+                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+        final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
+        if final_obs_arr is not None:
+            for idx in range(num_envs):
+                if final_obs_arr[idx] is not None:
+                    for k in obs_keys:
+                        real_next_obs[k][idx] = np.asarray(final_obs_arr[idx][k])
+
+        for k in obs_keys:
+            step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+        obs = next_obs
+
+        rewards = np.asarray(rewards, dtype=np.float32).reshape((1, num_envs, -1))
+        step_data["terminated"] = np.asarray(terminated, np.float32).reshape((1, num_envs, -1))
+        step_data["truncated"] = np.asarray(truncated, np.float32).reshape((1, num_envs, -1))
+        step_data["rewards"] = clip_rewards_fn(rewards)
+
+        dones_idxes = dones.nonzero()[0].tolist()
+        reset_envs = len(dones_idxes)
+        if reset_envs > 0:
+            reset_data = {}
+            for k in obs_keys:
+                reset_data[k] = (real_next_obs[k][dones_idxes])[np.newaxis]
+            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
+            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+            reset_data["actions"] = np.zeros((1, reset_envs, act_dim), np.float32)
+            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
+            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            step_data["rewards"][:, dones_idxes] = 0.0
+            step_data["terminated"][:, dones_idxes] = 0.0
+            step_data["truncated"][:, dones_idxes] = 0.0
+            step_data["is_first"][:, dones_idxes] = 1.0
+            player.init_states(params, dones_idxes)
+
+        if iter_num >= learning_starts:
+            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+            per_rank_gradient_steps = ratio(ratio_steps / world_size)
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    sample = rb.sample(
+                        cfg.algo.per_rank_batch_size * world_size,
+                        sequence_length=cfg.algo.per_rank_sequence_length,
+                        n_samples=per_rank_gradient_steps,
+                    )
+                    data = {
+                        k: np.asarray(v) if k in cnn_keys else np.asarray(v, dtype=np.float32)
+                        for k, v in sample.items()
+                    }
+                    if world_size > 1:
+                        data = jax.device_put(data, fabric.sharding(None, None, "data"))
+                    key, train_key = jax.random.split(key)
+                    params, opt_state, metrics = train_phase(
+                        params,
+                        opt_state,
+                        data,
+                        jnp.asarray(cumulative_per_rank_gradient_steps),
+                        np.asarray(train_key),
+                    )
+                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    train_step += world_size * per_rank_gradient_steps
+                    if aggregator and not aggregator.disabled:
+                        for mk, mv in metrics.items():
+                            aggregator.update(mk, float(np.asarray(mv)))
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
+        ):
+            metrics_dict = aggregator.compute() if aggregator else {}
+            if logger is not None:
+                logger.log_metrics(metrics_dict, policy_step)
+                timers = timer.to_dict(reset=False)
+                if timers.get("Time/train_time", 0) > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (train_step - last_train) / max(timers["Time/train_time"], 1e-9)},
+                        policy_step,
+                    )
+                if timers.get("Time/env_interaction_time", 0) > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (
+                                (policy_step - last_log) / world_size * cfg.env.action_repeat
+                            )
+                            / max(timers["Time/env_interaction_time"], 1e-9)
+                        },
+                        policy_step,
+                    )
+            timer.to_dict(reset=True)
+            if aggregator:
+                aggregator.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "opt_state": opt_state,
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(player, params, fabric, cfg, log_dir, greedy=False)
+    if logger is not None:
+        logger.finalize()

@@ -1,0 +1,51 @@
+"""Dreamer-V2 world-model loss (reference sheeprl/algos/dreamer_v2/loss.py:9-91):
+KL balancing with alpha (0.8 toward training the prior) and free nats applied to the
+(optionally averaged) KL."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def categorical_kl(post_logits: jax.Array, prior_logits: jax.Array, discrete: int) -> jax.Array:
+    post = post_logits.reshape(*post_logits.shape[:-1], -1, discrete)
+    prior = prior_logits.reshape(*prior_logits.shape[:-1], -1, discrete)
+    post_lp = jax.nn.log_softmax(post, axis=-1)
+    prior_lp = jax.nn.log_softmax(prior, axis=-1)
+    return jnp.sum(jnp.exp(post_lp) * (post_lp - prior_lp), axis=-1).sum(axis=-1)
+
+
+def reconstruction_loss(
+    observation_log_probs: Dict[str, jax.Array],
+    reward_log_prob: jax.Array,
+    priors_logits: jax.Array,
+    posteriors_logits: jax.Array,
+    discrete_size: int,
+    kl_balancing_alpha: float = 0.8,
+    kl_free_nats: float = 0.0,
+    kl_free_avg: bool = True,
+    kl_regularizer: float = 1.0,
+    continue_log_prob: Optional[jax.Array] = None,
+    discount_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (loss, kl, state_loss, reward_loss, observation_loss, continue_loss)."""
+    observation_loss = -sum(lp.mean() for lp in observation_log_probs.values())
+    reward_loss = -reward_log_prob.mean()
+    lhs = kl = categorical_kl(jax.lax.stop_gradient(posteriors_logits), priors_logits, discrete_size)
+    rhs = categorical_kl(posteriors_logits, jax.lax.stop_gradient(priors_logits), discrete_size)
+    if kl_free_avg:
+        loss_lhs = jnp.maximum(lhs.mean(), kl_free_nats)
+        loss_rhs = jnp.maximum(rhs.mean(), kl_free_nats)
+    else:
+        loss_lhs = jnp.maximum(lhs, kl_free_nats).mean()
+        loss_rhs = jnp.maximum(rhs, kl_free_nats).mean()
+    kl_loss = kl_balancing_alpha * loss_lhs + (1 - kl_balancing_alpha) * loss_rhs
+    if continue_log_prob is not None:
+        continue_loss = discount_scale_factor * -continue_log_prob.mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    loss = kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss
+    return loss, kl.mean(), kl_loss, reward_loss, observation_loss, continue_loss

@@ -240,8 +240,14 @@ def main(fabric, cfg: Dict[str, Any]):
                 grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
                     params, batch, clip_coef, ent_coef
                 )
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                # a minibatch drawn entirely from padding has exactly-zero gradients
+                # but would still advance Adam moments/schedule — skip it
+                has_real = jnp.sum(batch["mask"]) > 0
+                new_updates, new_opt = tx.update(grads, opt_state, params)
+                pick = lambda n, o: jnp.where(has_real, n, o)
+                new_params = optax.apply_updates(params, new_updates)
+                params = jax.tree_util.tree_map(pick, new_params, params)
+                opt_state = jax.tree_util.tree_map(pick, new_opt, opt_state)
                 return (params, opt_state), jnp.stack([pg, vl, ent])
 
             (params, opt_state), losses = jax.lax.scan(mb_body, (params, opt_state), mb_idx)

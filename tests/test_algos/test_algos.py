@@ -297,3 +297,84 @@ def test_sac_ae(standard_args, devices):
             "env.screen_size=64",
         ]
     )
+
+
+_DV2_TINY = [
+    "exp=dreamer_v2",
+    "env=dummy",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=1",
+    "algo.learning_starts=0",
+    "algo.replay_ratio=1",
+    "algo.per_rank_pretrain_steps=0",
+    "algo.horizon=8",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.cnn_keys.decoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.mlp_keys.decoder=[state]",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_dreamer_v2(standard_args, env_id):
+    _run(standard_args + _DV2_TINY + [f"env.id={env_id}"])
+
+
+def test_dreamer_v2_devices2(standard_args):
+    _run(standard_args + _DV2_TINY + ["fabric.devices=2"])
+
+
+def test_dreamer_v2_episode_buffer(standard_args):
+    _run(
+        standard_args
+        + _DV2_TINY
+        + [
+            "dry_run=False",
+            "buffer.type=episode",
+            "buffer.size=512",
+            "env.max_episode_steps=4",
+            "algo.run_test=False",
+            "algo.total_steps=32",
+            "algo.learning_starts=16",
+            "checkpoint.every=1000",
+        ]
+    )
+
+
+_DV1_TINY = [
+    "exp=dreamer_v1",
+    "env=dummy",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=1",
+    "algo.learning_starts=0",
+    "algo.replay_ratio=1",
+    "algo.horizon=8",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.cnn_keys.decoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.mlp_keys.decoder=[state]",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_dreamer_v1(standard_args, env_id):
+    _run(standard_args + _DV1_TINY + [f"env.id={env_id}"])
+
+
+def test_dreamer_v1_devices2(standard_args):
+    _run(standard_args + _DV1_TINY + ["fabric.devices=2"])
