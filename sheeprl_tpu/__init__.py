@@ -35,6 +35,15 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.dreamer_v2.evaluate",
     "sheeprl_tpu.algos.dreamer_v1.dreamer_v1",
     "sheeprl_tpu.algos.dreamer_v1.evaluate",
+    "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration",
+    "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_finetuning",
+    "sheeprl_tpu.algos.p2e_dv3.evaluate",
+    "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_exploration",
+    "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_finetuning",
+    "sheeprl_tpu.algos.p2e_dv2.evaluate",
+    "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_exploration",
+    "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_finetuning",
+    "sheeprl_tpu.algos.p2e_dv1.evaluate",
 ]
 
 import importlib  # noqa: E402

@@ -1,0 +1,71 @@
+"""Plan2Explore (Dreamer-V1 backbone) agent (reference sheeprl/algos/p2e_dv1/agent.py):
+DV1 world model + disagreement ensemble predicting the next *observation embedding*
++ exploration actor/critic (no target network)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v1.agent import DV1Agent
+from sheeprl_tpu.algos.dreamer_v1.agent import build_agent as build_dv1_agent
+from sheeprl_tpu.algos.p2e_dv3.agent import EnsembleHeads
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    key: jax.Array,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DV1Agent, EnsembleHeads, Dict[str, Any]]:
+    k_dv1, k_expl, k_ens, k_crit = jax.random.split(key, 4)
+    agent, dv1_params = build_dv1_agent(fabric, actions_dim, is_continuous, cfg, obs_space, k_dv1)
+
+    latent = jnp.zeros((1, agent.latent_state_size), jnp.float32)
+    actor_exploration_params = agent.actor.init(k_expl, latent)["params"]
+    critic_exploration_params = agent.critic.init(k_crit, latent)["params"]
+
+    # the embedding dim equals the encoder output: probe it
+    dummy_obs = {}
+    for k in tuple(cfg.algo.cnn_keys.encoder) + tuple(cfg.algo.mlp_keys.encoder):
+        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+    embedded = agent.encoder.apply({"params": dv1_params["world_model"]["encoder"]}, dummy_obs)
+    embedding_dim = int(embedded.shape[-1])
+
+    ens_cfg = cfg.algo.ensembles
+    ensembles = EnsembleHeads(
+        n=int(ens_cfg.n),
+        units=ens_cfg.dense_units,
+        n_layers=ens_cfg.mlp_layers,
+        output_dim=embedding_dim,
+        activation=ens_cfg.dense_act,
+        dtype=fabric.compute_dtype,
+    )
+    act_dim = int(np.sum(actions_dim))
+    ens_in = jnp.zeros((1, agent.latent_state_size + act_dim), jnp.float32)
+    ensembles_params = ensembles.init(k_ens, ens_in)["params"]
+
+    params = {
+        "world_model": dv1_params["world_model"],
+        "actor_task": dv1_params["actor"],
+        "critic_task": dv1_params["critic"],
+        "actor_exploration": actor_exploration_params,
+        "critic_exploration": critic_exploration_params,
+        "ensembles": ensembles_params,
+    }
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    return agent, ensembles, params
+
+
+def player_params(params: Dict[str, Any], actor_type: str) -> Dict[str, Any]:
+    return {
+        "world_model": params["world_model"],
+        "actor": params["actor_exploration"] if actor_type == "exploration" else params["actor_task"],
+    }

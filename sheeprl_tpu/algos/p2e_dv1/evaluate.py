@@ -1,0 +1,49 @@
+"""P2E-DV1 evaluation entrypoint (reference: sheeprl/algos/p2e_dv1/evaluate.py) —
+evaluates the task actor."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+
+from sheeprl_tpu.algos.dreamer_v1.agent import PlayerDV1
+from sheeprl_tpu.algos.p2e_dv1.agent import build_agent, player_params
+from sheeprl_tpu.algos.p2e_dv1.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["p2e_dv1_exploration", "p2e_dv1_finetuning"])
+def evaluate(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logdir = cfg.get("log_dir", "logs/evaluation")
+    env = make_env(cfg, cfg.seed, 0, logdir, "test")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    env.close()
+    agent_state = state["agent"] if state else None
+    if agent_state is not None and "actor_task" not in agent_state:
+        # finetuning checkpoints are saved in the plain dreamer layout
+        from sheeprl_tpu.algos.dreamer_v1.agent import build_agent as build_dv_agent
+
+        agent, params = build_dv_agent(
+            fabric, actions_dim, is_continuous, cfg, observation_space,
+            jax.random.PRNGKey(cfg.seed), agent_state,
+        )
+        player = PlayerDV1(agent, 1, cfg.algo.cnn_keys.encoder, cfg.algo.mlp_keys.encoder)
+        test(player, params, fabric, cfg, logdir, greedy=False)
+        return
+    agent, _, params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, observation_space,
+        jax.random.PRNGKey(cfg.seed), agent_state,
+    )
+    player = PlayerDV1(agent, 1, cfg.algo.cnn_keys.encoder, cfg.algo.mlp_keys.encoder)
+    test(player, player_params(params, "task"), fabric, cfg, logdir, greedy=False)

@@ -1,12 +1,8 @@
-"""Dreamer-V2, coupled training (capability parity with
-sheeprl/algos/dreamer_v2/dreamer_v2.py:96-792).
-
-Same TPU-native shape as the Dreamer-V3 module: one jitted program per iteration
-scanning the ``[G, T, B, ...]`` replay block — dynamic-learning lax.scan, world-model
-update (KL-balanced alpha loss), DV2-style imagination (zero first action, actor
-before each step), REINFORCE/dynamics-mixed actor update against the target critic,
-Normal(.,1) critic update, hard target-critic copy every
-``per_rank_target_network_update_freq`` gradient steps."""
+"""Plan2Explore on the Dreamer-V1 backbone — exploration phase (capability parity
+with sheeprl/algos/p2e_dv1/p2e_dv1_exploration.py:38-700): DV1 Gaussian-latent world
+model + ensembles predicting the next observation embedding; the exploration actor
+maximizes the ensemble-variance intrinsic reward with the DV1 dynamics-backprop
+objective; the task heads train alongside on the extrinsic reward."""
 
 from __future__ import annotations
 
@@ -21,22 +17,19 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.algos.dreamer_v2.agent import (
-    DV2Agent,
-    PlayerDV2,
-    actor_logprob_entropy,
-    build_agent,
-)
-from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v1.agent import DV1Agent, PlayerDV1
+from sheeprl_tpu.algos.dreamer_v1.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values
 from sheeprl_tpu.algos.dreamer_v2.utils import (
+    _HALF_LOG_2PI,
     bernoulli_logprob as _bernoulli_logprob,
-    compute_lambda_values,
     normal1_logprob as _normal1_logprob,
-    prepare_obs,
-    test,
 )
+from sheeprl_tpu.algos.p2e_dv1.agent import build_agent, player_params
+from sheeprl_tpu.algos.p2e_dv1.utils import prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv3.agent import EnsembleHeads
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -45,7 +38,8 @@ from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
-def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx):
+
+def make_train_phase(agent: DV1Agent, ensembles: EnsembleHeads, cfg, txs: Dict[str, Any]):
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
@@ -54,25 +48,18 @@ def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx):
     gamma = float(cfg.algo.gamma)
     lmbda = float(cfg.algo.lmbda)
     horizon = int(cfg.algo.horizon)
-    ent_coef = float(cfg.algo.actor.ent_coef)
-    objective_mix = float(cfg.algo.actor.objective_mix)
-    discrete_size = agent.discrete_size
-    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
     use_continues = bool(wm_cfg.use_continues)
-    act_dim = int(np.sum(agent.actions_dim))
+    intrinsic_mult = float(cfg.algo.intrinsic_reward_multiplier)
 
     def world_loss_fn(wm_params, batch, key):
         batch_obs = {k: batch[k] / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: batch[k] for k in mlp_keys})
-        is_first = batch["is_first"].at[0].set(jnp.ones_like(batch["is_first"][0]))
-        # row t stores the action chosen *at* o_t; the dynamics consume the action
-        # that *led to* o_t (same shift as dreamer_v3.py, reference dv3:219-221)
         actions = jnp.concatenate(
             [jnp.zeros_like(batch["actions"][:1]), batch["actions"][:-1]], axis=0
         )
         embedded = agent.encoder.apply({"params": wm_params["encoder"]}, batch_obs)
-        hs, zs, post_logits, prior_logits = agent.dynamic_scan(
-            wm_params, embedded, actions, is_first, key
+        hs, zs, post_mean, post_std, prior_mean, prior_std = agent.dynamic_scan(
+            wm_params, embedded, actions, key
         )
         latents = jnp.concatenate([zs, hs], axis=-1)
         recon = agent.observation_model.apply({"params": wm_params["observation_model"]}, latents)
@@ -89,21 +76,18 @@ def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx):
         loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
             obs_lps,
             reward_lp,
-            prior_logits,
-            post_logits,
-            discrete_size,
-            kl_balancing_alpha=wm_cfg.kl_balancing_alpha,
+            post_mean,
+            post_std,
+            prior_mean,
+            prior_std,
             kl_free_nats=wm_cfg.kl_free_nats,
-            kl_free_avg=wm_cfg.kl_free_avg,
             kl_regularizer=wm_cfg.kl_regularizer,
             continue_log_prob=cont_lp,
-            discount_scale_factor=wm_cfg.discount_scale_factor,
+            continue_scale_factor=wm_cfg.continue_scale_factor,
         )
 
-        def _cat_entropy(logits):
-            shaped = logits.reshape(*logits.shape[:-1], -1, discrete_size)
-            lp = jax.nn.log_softmax(shaped, axis=-1)
-            return -jnp.sum(jnp.exp(lp) * lp, axis=(-2, -1)).mean()
+        def _normal_entropy(std):
+            return (0.5 + _HALF_LOG_2PI + jnp.log(std)).sum(-1).mean()
 
         metrics = {
             "Loss/world_model_loss": loss,
@@ -112,114 +96,171 @@ def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx):
             "Loss/state_loss": state_loss,
             "Loss/continue_loss": continue_loss,
             "State/kl": kl,
-            "State/post_entropy": _cat_entropy(jax.lax.stop_gradient(post_logits)),
-            "State/prior_entropy": _cat_entropy(jax.lax.stop_gradient(prior_logits)),
+            "State/post_entropy": _normal_entropy(jax.lax.stop_gradient(post_std)),
+            "State/prior_entropy": _normal_entropy(jax.lax.stop_gradient(prior_std)),
         }
-        return loss, (zs, hs, metrics)
+        return loss, (zs, hs, embedded, metrics)
 
-    def actor_loss_fn(actor_params, params, zs, hs, true_continue, key):
+    def ensemble_loss_fn(ens_params, zs, hs, actions, embedded):
+        """Members predict the next obs embedding from (z, h, a) (reference
+        p2e_dv1_exploration.py:168-185)."""
+        inp = jax.lax.stop_gradient(jnp.concatenate([zs, hs, actions], axis=-1))
+        out = ensembles.apply({"params": ens_params}, inp)[:, :-1]
+        target = jax.lax.stop_gradient(embedded)[1:][None]
+        lp = _normal1_logprob(out, jnp.broadcast_to(target, out.shape), 1)
+        return -lp.mean(axis=tuple(range(1, lp.ndim))).sum()
+
+    def _behaviour(actor_params, params, zs, hs, reward_fn, critic_key, key):
+        """Shared DV1 dynamics-backprop behaviour learning."""
         wm = params["world_model"]
-        z0 = jax.lax.stop_gradient(zs).reshape(-1, agent.stoch_state_size)
+        z0 = jax.lax.stop_gradient(zs).reshape(-1, agent.stochastic_size)
         h0 = jax.lax.stop_gradient(hs).reshape(-1, agent.recurrent_state_size)
-        latents, actions = agent.imagination_scan(wm, actor_params, z0, h0, key, horizon, act_dim)
-        predicted_target_values = agent.critic.apply({"params": params["target_critic"]}, latents)
-        predicted_rewards = agent.reward_model.apply({"params": wm["reward_model"]}, latents)
+        latents, actions = agent_imagination_with_actions(wm, actor_params, z0, h0, key)
+        predicted_values = agent.critic.apply({"params": params[critic_key]}, latents)
+        reward = reward_fn(latents, actions, wm, params)
         if use_continues:
             cont_logits = agent.continue_model.apply({"params": wm["continue_model"]}, latents)
             continues = jax.nn.sigmoid(cont_logits)
-            continues = jnp.concatenate([true_continue[None] * gamma, continues[1:]], axis=0)
         else:
-            continues = jnp.ones_like(jax.lax.stop_gradient(predicted_rewards)) * gamma
-        lambda_values = compute_lambda_values(
-            predicted_rewards[:-1],
-            predicted_target_values[:-1],
-            continues[:-1],
-            bootstrap=predicted_target_values[-1:],
-            lmbda=lmbda,
-        )
+            continues = jnp.ones_like(jax.lax.stop_gradient(reward)) * gamma
+        lambda_values = compute_lambda_values(reward, predicted_values, continues, horizon, lmbda)
         discount = jax.lax.stop_gradient(
-            jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], axis=0), axis=0)
+            jnp.cumprod(
+                jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-2]], axis=0), axis=0
+            )
         )
-        pre = agent.actor.apply({"params": actor_params}, jax.lax.stop_gradient(latents[:-2]))
-        lp, ent = actor_logprob_entropy(agent, pre, jax.lax.stop_gradient(actions[1:-1]))
-        dynamics = lambda_values[1:]
-        advantage = jax.lax.stop_gradient(lambda_values[1:] - predicted_target_values[:-2])
-        reinforce = lp * advantage
-        objective = objective_mix * reinforce + (1 - objective_mix) * dynamics
-        entropy = ent_coef * ent[..., None]
-        policy_loss = -jnp.mean(discount[:-2] * (objective + entropy))
-        return policy_loss, (latents, lambda_values, discount)
+        policy_loss = -jnp.mean(discount * lambda_values)
+        return policy_loss, (latents, lambda_values, discount, reward)
+
+    def agent_imagination_with_actions(wm, actor_params, z0, h0, key):
+        """DV1 imagination that also returns the actions (the p2e intrinsic reward
+        consumes them; reference p2e_dv1_exploration.py:193-205)."""
+        from sheeprl_tpu.algos.dreamer_v2.agent import actor_sample
+
+        def step(carry, k):
+            z, h, latent = carry
+            pre = agent.actor.apply({"params": actor_params}, jax.lax.stop_gradient(latent))
+            a = actor_sample(agent, pre, jax.random.fold_in(k, 1))
+            h = agent._recurrent(wm, z, a, h)
+            _, z = agent._transition(wm, h, k)
+            latent = jnp.concatenate([z, h], axis=-1)
+            return (z, h, latent), (latent, a)
+
+        latent0 = jnp.concatenate([z0, h0], axis=-1)
+        keys = jax.random.split(key, horizon)
+        _, (latents, actions) = jax.lax.scan(step, (z0, h0, latent0), keys)
+        return latents, actions
+
+    def exploration_reward(latents, actions, wm, params):
+        ens_in = jax.lax.stop_gradient(jnp.concatenate([latents, actions], axis=-1))
+        ens_out = ensembles.apply({"params": params["ensembles"]}, ens_in)
+        return ens_out.var(axis=0).mean(axis=-1, keepdims=True) * intrinsic_mult
+
+    def task_reward(latents, actions, wm, params):
+        return agent.reward_model.apply({"params": wm["reward_model"]}, latents)
+
+    def actor_expl_loss_fn(actor_params, params, zs, hs, key):
+        return _behaviour(actor_params, params, zs, hs, exploration_reward, "critic_exploration", key)
+
+    def actor_task_loss_fn(actor_params, params, zs, hs, key):
+        return _behaviour(actor_params, params, zs, hs, task_reward, "critic_task", key)
 
     def critic_loss_fn(critic_params, latents, lambda_values, discount):
         pred = agent.critic.apply({"params": critic_params}, latents[:-1])
         lp = _normal1_logprob(pred, jax.lax.stop_gradient(lambda_values), 1)
-        return -jnp.mean(discount[:-1, ..., 0] * lp)
+        return -jnp.mean(discount[..., 0] * lp)
 
     @jax.jit
-    def train_phase(params, opt_state, data, cum_steps, train_key):
+    def train_phase(params, opt_state, data, train_key):
         G = data["rewards"].shape[0]
         keys = jax.random.split(jnp.asarray(train_key), G)
 
         def step(carry, inp):
-            params, opt_state, cum = carry
+            params, opt_state = carry
             batch, k = inp
-            k_world, k_img = jax.random.split(k)
+            k_world, k_expl, k_task = jax.random.split(k, 3)
 
-            # hard target-critic copy (reference dreamer_v2.py:736-740)
-            do_copy = (cum % target_freq) == 0
-            params = {
-                **params,
-                "target_critic": jax.tree_util.tree_map(
-                    lambda t, c: jnp.where(do_copy, c, t), params["target_critic"], params["critic"]
-                ),
-            }
-
-            (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
-                params["world_model"], batch, k_world
+            (w_loss, (zs, hs, embedded, w_metrics)), w_grads = jax.value_and_grad(
+                world_loss_fn, has_aux=True
+            )(params["world_model"], batch, k_world)
+            updates, new_wopt = txs["world_model"].update(
+                w_grads, opt_state["world_model"], params["world_model"]
             )
-            updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
             params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
             opt_state = {**opt_state, "world_model": new_wopt}
 
-            true_continue = (1 - batch["terminated"]).reshape(-1, 1)
-            (a_loss, (latents, lambda_values, discount)), a_grads = jax.value_and_grad(
-                actor_loss_fn, has_aux=True
-            )(params["actor"], params, zs, hs, true_continue, k_img)
-            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
-            opt_state = {**opt_state, "actor": new_aopt}
-
-            latents_sg = jax.lax.stop_gradient(latents)
-            c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
-                params["critic"], latents_sg, lambda_values, discount
+            e_loss, e_grads = jax.value_and_grad(ensemble_loss_fn)(
+                params["ensembles"], zs, hs, batch["actions"], embedded
             )
-            updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
-            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
-            opt_state = {**opt_state, "critic": new_copt}
+            updates, new_eopt = txs["ensembles"].update(e_grads, opt_state["ensembles"], params["ensembles"])
+            params = {**params, "ensembles": optax.apply_updates(params["ensembles"], updates)}
+            opt_state = {**opt_state, "ensembles": new_eopt}
 
             metrics = dict(w_metrics)
-            metrics["Loss/policy_loss"] = a_loss
-            metrics["Loss/value_loss"] = c_loss
-            metrics["Grads/world_model"] = optax.global_norm(w_grads)
-            metrics["Grads/actor"] = optax.global_norm(a_grads)
-            metrics["Grads/critic"] = optax.global_norm(c_grads)
-            return (params, opt_state, cum + 1), metrics
 
-        (params, opt_state, _), metrics = jax.lax.scan(
-            step, (params, opt_state, cum_steps), (data, keys)
-        )
+            (pe_loss, (latents_e, lambda_e, discount_e, intr_reward)), ae_grads = jax.value_and_grad(
+                actor_expl_loss_fn, has_aux=True
+            )(params["actor_exploration"], params, zs, hs, k_expl)
+            updates, new_aeopt = txs["actor_exploration"].update(
+                ae_grads, opt_state["actor_exploration"], params["actor_exploration"]
+            )
+            params = {**params, "actor_exploration": optax.apply_updates(params["actor_exploration"], updates)}
+            opt_state = {**opt_state, "actor_exploration": new_aeopt}
+
+            ce_loss, ce_grads = jax.value_and_grad(critic_loss_fn)(
+                params["critic_exploration"], jax.lax.stop_gradient(latents_e), lambda_e, discount_e
+            )
+            updates, new_ceopt = txs["critic_exploration"].update(
+                ce_grads, opt_state["critic_exploration"], params["critic_exploration"]
+            )
+            params = {**params, "critic_exploration": optax.apply_updates(params["critic_exploration"], updates)}
+            opt_state = {**opt_state, "critic_exploration": new_ceopt}
+
+            (pt_loss, (latents_t, lambda_t, discount_t, _)), at_grads = jax.value_and_grad(
+                actor_task_loss_fn, has_aux=True
+            )(params["actor_task"], params, zs, hs, k_task)
+            updates, new_atopt = txs["actor_task"].update(
+                at_grads, opt_state["actor_task"], params["actor_task"]
+            )
+            params = {**params, "actor_task": optax.apply_updates(params["actor_task"], updates)}
+            opt_state = {**opt_state, "actor_task": new_atopt}
+
+            ct_loss, ct_grads = jax.value_and_grad(critic_loss_fn)(
+                params["critic_task"], jax.lax.stop_gradient(latents_t), lambda_t, discount_t
+            )
+            updates, new_ctopt = txs["critic_task"].update(
+                ct_grads, opt_state["critic_task"], params["critic_task"]
+            )
+            params = {**params, "critic_task": optax.apply_updates(params["critic_task"], updates)}
+            opt_state = {**opt_state, "critic_task": new_ctopt}
+
+            metrics["Loss/ensemble_loss"] = e_loss
+            metrics["Loss/policy_loss_exploration"] = pe_loss
+            metrics["Loss/value_loss_exploration"] = ce_loss
+            metrics["Loss/policy_loss_task"] = pt_loss
+            metrics["Loss/value_loss_task"] = ct_loss
+            metrics["Rewards/intrinsic"] = intr_reward.mean()
+            metrics["Values_exploration/lambda_values"] = lambda_e.mean()
+            metrics["Grads/world_model"] = optax.global_norm(w_grads)
+            metrics["Grads/ensemble"] = optax.global_norm(e_grads)
+            metrics["Grads/actor_exploration"] = optax.global_norm(ae_grads)
+            metrics["Grads/critic_exploration"] = optax.global_norm(ce_grads)
+            metrics["Grads/actor_task"] = optax.global_norm(at_grads)
+            metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
+            return (params, opt_state), metrics
+
+        (params, opt_state), metrics = jax.lax.scan(step, (params, opt_state), (data, keys))
         return params, opt_state, jax.tree_util.tree_map(lambda m: m.mean(), metrics)
 
     return train_phase
 
 
 @register_algorithm()
-def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
+def main(fabric, cfg: Dict[str, Any]):
     rank = fabric.global_rank
     world_size = fabric.world_size
 
     state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
-
     cfg.env.frame_stack = 1
 
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
@@ -264,13 +305,10 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
     obs_keys = cnn_keys + mlp_keys
-    if cfg.metric.log_level > 0:
-        fabric.print("Encoder CNN keys:", cnn_keys)
-        fabric.print("Encoder MLP keys:", mlp_keys)
 
     key = fabric.seed_everything(cfg.seed + rank)
     key, agent_key = jax.random.split(key)
-    agent, params = build_agent(
+    agent, ensembles, params = build_agent(
         fabric,
         actions_dim,
         is_continuous,
@@ -279,7 +317,8 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
         agent_key,
         state["agent"] if state else None,
     )
-    player = PlayerDV2(agent, num_envs, cnn_keys, mlp_keys)
+    player = PlayerDV1(agent, num_envs, cnn_keys, mlp_keys)
+    actor_type = cfg.algo.player.actor_type
 
     def _tx(opt_cfg, clip):
         base = instantiate(opt_cfg)
@@ -287,13 +326,21 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             return optax.chain(optax.clip_by_global_norm(clip), base)
         return base
 
-    world_tx = _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
-    actor_tx = _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
-    critic_tx = _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    txs = {
+        "world_model": _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
+        "actor_task": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+        "critic_task": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+        "actor_exploration": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+        "critic_exploration": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+        "ensembles": _tx(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
+    }
     opt_state = {
-        "world_model": world_tx.init(params["world_model"]),
-        "actor": actor_tx.init(params["actor"]),
-        "critic": critic_tx.init(params["critic"]),
+        "world_model": txs["world_model"].init(params["world_model"]),
+        "actor_task": txs["actor_task"].init(params["actor_task"]),
+        "critic_task": txs["critic_task"].init(params["critic_task"]),
+        "actor_exploration": txs["actor_exploration"].init(params["actor_exploration"]),
+        "critic_exploration": txs["critic_exploration"].init(params["critic_exploration"]),
+        "ensembles": txs["ensembles"].init(params["ensembles"]),
     }
     if state is not None and "opt_state" in state:
         opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
@@ -306,34 +353,18 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
         aggregator = instantiate(cfg.metric.aggregator)
 
     buffer_size = cfg.buffer.size // int(num_envs * world_size) if not cfg.dry_run else 8
-    buffer_type = cfg.buffer.get("type", "sequential").lower()
-    if buffer_type == "sequential":
-        rb = EnvIndependentReplayBuffer(
-            buffer_size,
-            n_envs=num_envs,
-            obs_keys=tuple(obs_keys),
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-            buffer_cls=SequentialReplayBuffer,
-        )
-    elif buffer_type == "episode":
-        rb = EpisodeBuffer(
-            buffer_size,
-            minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
-            n_envs=num_envs,
-            obs_keys=tuple(obs_keys),
-            prioritize_ends=cfg.buffer.prioritize_ends,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        )
-    else:
-        raise ValueError(
-            f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
-        )
+    rb = EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs=num_envs,
+        obs_keys=tuple(obs_keys),
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        buffer_cls=SequentialReplayBuffer,
+    )
     if state is not None and cfg.buffer.checkpoint and "rb" in state:
         rb = state["rb"]
 
-    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+    train_phase = make_train_phase(agent, ensembles, cfg, txs)
 
     start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
     policy_step = state["iter_num"] * num_envs if state is not None else 0
@@ -358,7 +389,6 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             f"policy_steps_per_iter value ({policy_steps_per_iter})."
         )
 
-    # exploration amount anneal (reference Actor._get_expl_amount)
     expl_cfg = agent.actor_cfg
 
     def expl_amount(step: int) -> float:
@@ -375,7 +405,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    player.init_states(params)
+    player.init_states()
 
     cumulative_per_rank_gradient_steps = 0
     train_step = 0
@@ -399,14 +429,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                 key, step_key = jax.random.split(key)
                 actions = np.asarray(
                     player.get_actions(
-                        # p2e finetuning acts with the exploration actor during the
-                        # prefill, then switches to the (trained) task actor
-                        {**params, "actor": exploration_actor_params}
-                        if exploration_actor_params is not None and iter_num <= learning_starts
-                        else params,
-                        jobs,
-                        step_key,
-                        expl_amount=expl_amount(policy_step),
+                        player_params(params, actor_type), jobs, step_key, expl_amount=expl_amount(policy_step)
                     )
                 )
                 if is_continuous:
@@ -469,7 +492,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             step_data["terminated"][:, dones_idxes] = 0.0
             step_data["truncated"][:, dones_idxes] = 0.0
             step_data["is_first"][:, dones_idxes] = 1.0
-            player.init_states(params, dones_idxes)
+            player.init_states(reset_envs=dones_idxes)
 
         if iter_num >= learning_starts:
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
@@ -489,11 +512,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                         data = jax.device_put(data, fabric.sharding(None, None, "data"))
                     key, train_key = jax.random.split(key)
                     params, opt_state, metrics = train_phase(
-                        params,
-                        opt_state,
-                        data,
-                        jnp.asarray(cumulative_per_rank_gradient_steps),
-                        np.asarray(train_key),
+                        params, opt_state, data, np.asarray(train_key)
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
@@ -553,6 +572,6 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
-        test(player, params, fabric, cfg, log_dir, greedy=False)
+        test(player, player_params(params, actor_type), fabric, cfg, log_dir, greedy=False)
     if logger is not None:
         logger.finalize()

@@ -1,0 +1,65 @@
+"""Plan2Explore DV1 — finetuning phase (capability parity with
+sheeprl/algos/p2e_dv1/p2e_dv1_finetuning.py): resume the exploration checkpoint's
+world model and task heads, optionally inherit the exploration replay buffer, act
+with the exploration actor during the prefill, then train the task heads with the
+standard Dreamer-V1 program."""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v1 import dreamer_v1 as dv1
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
+    ckpt_path = pathlib.Path(cfg.checkpoint.exploration_ckpt_path)
+    resume = cfg.checkpoint.resume_from is not None
+    state = fabric.load(pathlib.Path(cfg.checkpoint.resume_from) if resume else ckpt_path)
+
+    for k in (
+        "gamma", "lmbda", "horizon", "dense_units", "mlp_layers", "dense_act", "cnn_act",
+        "world_model", "actor", "critic", "cnn_keys", "mlp_keys",
+    ):
+        if k in exploration_cfg.algo:
+            cfg.algo[k] = exploration_cfg.algo[k]
+    cfg.env.clip_rewards = exploration_cfg.env.clip_rewards
+    if cfg.buffer.get("load_from_exploration", False) and exploration_cfg.buffer.checkpoint:
+        cfg.env.num_envs = exploration_cfg.env.num_envs
+
+    agent_state = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+    dv1_state = dict(state)
+    exploration_actor_params = None
+    if "actor_task" in agent_state:
+        # p2e layout (exploration checkpoint) → remap to DV1 layout
+        dv1_state["agent"] = {
+            "world_model": agent_state["world_model"],
+            "actor": agent_state["actor_task"],
+            "critic": agent_state["critic_task"],
+        }
+        if cfg.algo.player.actor_type == "exploration":
+            exploration_actor_params = agent_state["actor_exploration"]
+    else:
+        # already DV1 layout: resuming an interrupted finetuning checkpoint
+        dv1_state["agent"] = agent_state
+    if not resume:
+        for k in ("iter_num", "last_log", "last_checkpoint"):
+            dv1_state[k] = 0
+        dv1_state["batch_size"] = cfg.algo.per_rank_batch_size * fabric.world_size
+        dv1_state.pop("opt_state", None)
+        dv1_state.pop("ratio", None)
+        if not cfg.buffer.get("load_from_exploration", False):
+            dv1_state.pop("rb", None)
+
+    _orig_load = fabric.load
+    fabric.load = lambda path: dv1_state
+    cfg.checkpoint.resume_from = cfg.checkpoint.resume_from or str(ckpt_path)
+    try:
+        dv1.main(fabric, cfg, exploration_actor_params=exploration_actor_params)
+    finally:
+        fabric.load = _orig_load

@@ -1,0 +1,65 @@
+"""Plan2Explore (Dreamer-V2 backbone) agent (reference sheeprl/algos/p2e_dv2/agent.py):
+DV2 world model + disagreement ensemble + exploration actor/critic (with target)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import DV2Agent
+from sheeprl_tpu.algos.dreamer_v2.agent import build_agent as build_dv2_agent
+from sheeprl_tpu.algos.p2e_dv3.agent import EnsembleHeads
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    key: jax.Array,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DV2Agent, EnsembleHeads, Dict[str, Any]]:
+    k_dv2, k_expl, k_ens, k_crit = jax.random.split(key, 4)
+    agent, dv2_params = build_dv2_agent(fabric, actions_dim, is_continuous, cfg, obs_space, k_dv2)
+
+    latent = jnp.zeros((1, agent.latent_state_size), jnp.float32)
+    actor_exploration_params = agent.actor.init(k_expl, latent)["params"]
+    critic_exploration_params = agent.critic.init(k_crit, latent)["params"]
+
+    ens_cfg = cfg.algo.ensembles
+    ensembles = EnsembleHeads(
+        n=int(ens_cfg.n),
+        units=ens_cfg.dense_units,
+        n_layers=ens_cfg.mlp_layers,
+        output_dim=agent.stoch_state_size,
+        activation=ens_cfg.dense_act,
+        dtype=fabric.compute_dtype,
+    )
+    act_dim = int(np.sum(actions_dim))
+    ens_in = jnp.zeros((1, agent.latent_state_size + act_dim), jnp.float32)
+    ensembles_params = ensembles.init(k_ens, ens_in)["params"]
+
+    params = {
+        "world_model": dv2_params["world_model"],
+        "actor_task": dv2_params["actor"],
+        "critic_task": dv2_params["critic"],
+        "target_critic_task": dv2_params["target_critic"],
+        "actor_exploration": actor_exploration_params,
+        "critic_exploration": critic_exploration_params,
+        "target_critic_exploration": jax.tree_util.tree_map(jnp.copy, critic_exploration_params),
+        "ensembles": ensembles_params,
+    }
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    return agent, ensembles, params
+
+
+def player_params(params: Dict[str, Any], actor_type: str) -> Dict[str, Any]:
+    return {
+        "world_model": params["world_model"],
+        "actor": params["actor_exploration"] if actor_type == "exploration" else params["actor_task"],
+    }

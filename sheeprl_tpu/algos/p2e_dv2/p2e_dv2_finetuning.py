@@ -1,0 +1,72 @@
+"""Plan2Explore DV2 — finetuning phase (capability parity with
+sheeprl/algos/p2e_dv2/p2e_dv2_finetuning.py): resume the exploration checkpoint's
+world model and task heads, optionally inherit the exploration replay buffer, act
+with the exploration actor during the prefill, then train the task heads with the
+standard Dreamer-V2 program."""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v2 import dreamer_v2 as dv2
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
+    ckpt_path = pathlib.Path(cfg.checkpoint.exploration_ckpt_path)
+    resume = cfg.checkpoint.resume_from is not None
+    state = fabric.load(pathlib.Path(cfg.checkpoint.resume_from) if resume else ckpt_path)
+
+    # models/env identity must match the exploration phase (reference
+    # p2e_dv2_finetuning.py:40-70)
+    for k in (
+        "gamma", "lmbda", "horizon", "layer_norm", "dense_units", "mlp_layers", "dense_act",
+        "cnn_act", "world_model", "actor", "critic", "cnn_keys", "mlp_keys",
+    ):
+        if k in exploration_cfg.algo:
+            cfg.algo[k] = exploration_cfg.algo[k]
+    cfg.env.clip_rewards = exploration_cfg.env.clip_rewards
+    if cfg.buffer.get("load_from_exploration", False) and exploration_cfg.buffer.checkpoint:
+        cfg.env.num_envs = exploration_cfg.env.num_envs
+
+    # remap the p2e pytree into the DV2 layout: the task heads get finetuned; the
+    # exploration actor only drives the prefill
+    agent_state = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+    dv2_state = dict(state)
+    exploration_actor_params = None
+    if "actor_task" in agent_state:
+        # p2e layout (exploration checkpoint) → remap to DV2 layout
+        dv2_state["agent"] = {
+            "world_model": agent_state["world_model"],
+            "actor": agent_state["actor_task"],
+            "critic": agent_state["critic_task"],
+            "target_critic": agent_state["target_critic_task"],
+        }
+        if cfg.algo.player.actor_type == "exploration":
+            exploration_actor_params = agent_state["actor_exploration"]
+    else:
+        # already DV2 layout: resuming an interrupted finetuning checkpoint
+        dv2_state["agent"] = agent_state
+    if not resume:
+        # fresh finetuning: counters restart; only the agent (and optionally the
+        # buffer) carry over — the guarded dv2.main skips the missing keys
+        for k in ("iter_num", "last_log", "last_checkpoint"):
+            dv2_state[k] = 0
+        dv2_state["batch_size"] = cfg.algo.per_rank_batch_size * fabric.world_size
+        dv2_state.pop("opt_state", None)
+        dv2_state.pop("ratio", None)
+        if not cfg.buffer.get("load_from_exploration", False):
+            dv2_state.pop("rb", None)
+
+    _orig_load = fabric.load
+    fabric.load = lambda path: dv2_state
+    cfg.checkpoint.resume_from = cfg.checkpoint.resume_from or str(ckpt_path)
+    try:
+        dv2.main(fabric, cfg, exploration_actor_params=exploration_actor_params)
+    finally:
+        fabric.load = _orig_load

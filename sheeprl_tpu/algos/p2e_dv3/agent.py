@@ -1,0 +1,110 @@
+"""Plan2Explore (Dreamer-V3 backbone) agent (reference sheeprl/algos/p2e_dv3/agent.py:
+build_agent:24-212): the full DV3 world model plus a disagreement ensemble, a second
+(exploration) actor and one critic per exploration reward stream.
+
+Params layout: {"world_model", "actor_task", "critic_task", "target_critic_task",
+"actor_exploration", "critics_exploration": {k: {"module", "target"}}, "ensembles"}.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import DV3Agent, MLPHead
+from sheeprl_tpu.algos.dreamer_v3.agent import build_agent as build_dv3_agent
+
+
+class EnsembleHeads(nn.Module):
+    """N independent next-state predictors with stacked params — one vmapped apply
+    evaluates all ensemble members (the reference loops over N modules,
+    p2e_dv3_exploration.py:208-220). Output [n, ..., out_dim]."""
+
+    n: int
+    units: int
+    n_layers: int
+    output_dim: int
+    activation: Any = "silu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        ensemble = nn.vmap(
+            MLPHead,
+            in_axes=None,
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            axis_size=self.n,
+        )
+        return ensemble(
+            units=self.units,
+            n_layers=self.n_layers,
+            output_dim=self.output_dim,
+            activation=self.activation,
+            dtype=self.dtype,
+        )(x)
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    key: jax.Array,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DV3Agent, EnsembleHeads, Dict[str, Any]]:
+    """DV3 agent + exploration heads + ensembles. The returned DV3Agent's ``actor``/
+    ``critic`` modules serve both the task and exploration parameter sets (identical
+    architectures, independent params)."""
+    k_dv3, k_expl, k_ens, k_crit = jax.random.split(key, 4)
+    agent, dv3_params = build_dv3_agent(fabric, actions_dim, is_continuous, cfg, obs_space, k_dv3)
+
+    latent = jnp.zeros((1, agent.latent_state_size), jnp.float32)
+    actor_exploration_params = agent.actor.init(k_expl, latent)["params"]
+    critics_exploration: Dict[str, Dict[str, Any]] = {}
+    for i, (name, c) in enumerate(dict(cfg.algo.critics_exploration).items()):
+        cp = agent.critic.init(jax.random.fold_in(k_crit, i), latent)["params"]
+        critics_exploration[name] = {
+            "module": cp,
+            "target": jax.tree_util.tree_map(jnp.copy, cp),
+        }
+
+    ens_cfg = cfg.algo.ensembles
+    ensembles = EnsembleHeads(
+        n=int(ens_cfg.n),
+        units=ens_cfg.dense_units,
+        n_layers=ens_cfg.mlp_layers,
+        output_dim=agent.stoch_state_size,
+        activation=ens_cfg.dense_act,
+        dtype=fabric.compute_dtype,
+    )
+    act_dim = int(np.sum(actions_dim))
+    ens_in = jnp.zeros((1, agent.latent_state_size + act_dim), jnp.float32)
+    ensembles_params = ensembles.init(k_ens, ens_in)["params"]
+
+    params = {
+        "world_model": dv3_params["world_model"],
+        "actor_task": dv3_params["actor"],
+        "critic_task": dv3_params["critic"],
+        "target_critic_task": dv3_params["target_critic"],
+        "actor_exploration": actor_exploration_params,
+        "critics_exploration": critics_exploration,
+        "ensembles": ensembles_params,
+    }
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    return agent, ensembles, params
+
+
+def player_params(params: Dict[str, Any], actor_type: str) -> Dict[str, Any]:
+    """View of the p2e params pytree in the layout PlayerDV3 expects."""
+    return {
+        "world_model": params["world_model"],
+        "actor": params["actor_exploration"] if actor_type == "exploration" else params["actor_task"],
+    }

@@ -1,21 +1,11 @@
-"""Dreamer-V3, coupled training (capability parity with
-sheeprl/algos/dreamer_v3/dreamer_v3.py:428-864).
+"""Plan2Explore on the Dreamer-V3 backbone — exploration phase (capability parity
+with sheeprl/algos/p2e_dv3/p2e_dv3_exploration.py:41-800).
 
-TPU-native structure:
-- the whole gradient step — dynamic-learning scan, world-model loss+update,
-  imagination scan, actor update, critic update, target-critic EMA, Moments — is ONE
-  jitted device program; each iteration's ``per_rank_gradient_steps`` steps run as a
-  ``lax.scan`` over the ``[G, T, B, ...]`` replay block (one host→device upload per
-  iteration). The reference instead pays a Python loop per gradient step with three
-  ``torch.compile`` regions inside (dreamer_v3.py:741-783);
-- sequence unrolls are ``lax.scan``s (agent.dynamic_scan / imagination_scan) — the
-  reference's per-timestep GRU python loops (dreamer_v3.py:86-97, 148-156);
-- under dp the batch axis is sharded over the mesh ``data`` axis: gradient psums and
-  the Moments quantiles (reference all_gathers, utils.py:57) come from XLA collectives
-  automatically;
-- the act path is a jitted encoder→RSSM-step→actor program with an explicit carry
-  (PlayerDV3), replacing the reference's stateful module + per-step ``.cpu()`` syncs.
-"""
+One jitted program per iteration scans the replay block through five updates:
+world model → disagreement ensembles (MSE to the next posterior) → exploration
+actor against a weighted mix of per-stream critics (intrinsic = ensemble variance,
+task = learned reward) → per-stream exploration critics → task actor/critic
+(standard DV3 behaviour learning). The player acts with the exploration actor."""
 
 from __future__ import annotations
 
@@ -30,14 +20,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.algos.dreamer_v3.agent import (
-    DV3Agent,
-    PlayerDV3,
-    actor_logprob_entropy,
-    build_agent,
-)
+from sheeprl_tpu.algos.dreamer_v3.agent import DV3Agent, PlayerDV3, actor_logprob_entropy
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import init_moments, prepare_obs, test, update_moments
+from sheeprl_tpu.algos.p2e_dv3.agent import EnsembleHeads, build_agent, player_params
+from sheeprl_tpu.algos.p2e_dv3.utils import init_moments, prepare_obs, test, update_moments
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs.wrappers import RestartOnException
@@ -56,9 +42,7 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, compute_lambda_values, save_configs
 
 
-def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
-    """Build the jitted multi-gradient-step train program. Returns
-    train_phase(params, opt_state, moments_state, data, cum_steps, key)."""
+def make_train_phase(agent: DV3Agent, ensembles: EnsembleHeads, cfg, txs: Dict[str, Any]):
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
@@ -71,6 +55,9 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
     discrete_size = agent.discrete_size
     tau = float(cfg.algo.critic.tau)
     target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+    intrinsic_mult = float(cfg.algo.intrinsic_reward_multiplier)
+    critic_cfgs = {k: dict(v) for k, v in dict(cfg.algo.critics_exploration).items()}
+    weights_sum = sum(c["weight"] for c in critic_cfgs.values())
     moments_kw = dict(
         decay=float(cfg.algo.actor.moments.decay),
         maximum=float(cfg.algo.actor.moments.max),
@@ -82,8 +69,6 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
         batch_obs = {k: batch[k] / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: batch[k] for k in mlp_keys})
         is_first = batch["is_first"].at[0].set(jnp.ones_like(batch["is_first"][0]))
-        # shift: a_t stored with o_t is the action *leaving* o_t; dynamics consume the
-        # action that *led to* o_t (reference dreamer_v3.py:219-221)
         actions = jnp.concatenate(
             [jnp.zeros_like(batch["actions"][:1]), batch["actions"][:-1]], axis=0
         )
@@ -105,7 +90,11 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
         )
         reward_logits = agent.reward_model.apply({"params": wm_params["reward_model"]}, latents)
         reward_lp = TwoHotEncodingDistribution(reward_logits, dims=1).log_prob(batch["rewards"])
-        cont_logits = agent.continue_model.apply({"params": wm_params["continue_model"]}, latents)
+        # p2e trains the continue head on detached latents (reference
+        # p2e_dv3_exploration.py:163)
+        cont_logits = agent.continue_model.apply(
+            {"params": wm_params["continue_model"]}, jax.lax.stop_gradient(latents)
+        )
         cont_lp = Independent(BernoulliSafeMode(logits=cont_logits), 1).log_prob(
             1.0 - batch["terminated"]
         )
@@ -140,32 +129,102 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
         }
         return loss, (zs, hs, metrics)
 
-    def actor_loss_fn(actor_params, params, zs, hs, true_continue, moments_state, key):
+    def ensemble_loss_fn(ens_params, zs, hs, actions):
+        """Each member predicts the next posterior from (z, h, a); MSE log-prob
+        (reference p2e_dv3_exploration.py:205-221)."""
+        inp = jax.lax.stop_gradient(jnp.concatenate([zs, hs, actions], axis=-1))
+        out = ensembles.apply({"params": ens_params}, inp)[:, :-1]  # [n, T-1, B, S*D]
+        target = jax.lax.stop_gradient(zs)[1:][None]
+        lp = MSEDistribution(out, dims=1).log_prob(jnp.broadcast_to(target, out.shape))
+        return -lp.mean(axis=tuple(range(1, lp.ndim))).sum()
+
+    def _continues_for(latents, wm, true_continue):
+        cont = Independent(
+            BernoulliSafeMode(logits=agent.continue_model.apply({"params": wm["continue_model"]}, latents)),
+            1,
+        ).mode
+        return jnp.concatenate([true_continue[None], cont[1:]], axis=0)
+
+    def exploration_actor_loss_fn(actor_params, params, zs, hs, true_continue, moments_expl, key):
+        wm = params["world_model"]
+        z0 = jax.lax.stop_gradient(zs).reshape(-1, agent.stoch_state_size)
+        h0 = jax.lax.stop_gradient(hs).reshape(-1, agent.recurrent_state_size)
+        latents, actions = agent.imagination_scan(wm, actor_params, z0, h0, key, horizon)
+        continues = _continues_for(latents, wm, true_continue)
+        discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, axis=0) / gamma)
+
+        # intrinsic disagreement reward: ensemble variance over the predicted next
+        # posterior (reference p2e_dv3_exploration.py:270-287)
+        ens_in = jax.lax.stop_gradient(jnp.concatenate([latents, actions], axis=-1))
+        ens_out = ensembles.apply({"params": params["ensembles"]}, ens_in)
+        intrinsic_reward = ens_out.var(axis=0).mean(axis=-1, keepdims=True) * intrinsic_mult
+
+        advantages = []
+        new_moments: Dict[str, Any] = {}
+        lambda_per_critic: Dict[str, jax.Array] = {}
+        metrics: Dict[str, jax.Array] = {}
+        for k, ccfg in critic_cfgs.items():
+            predicted_values = TwoHotEncodingDistribution(
+                agent.critic.apply({"params": params["critics_exploration"][k]["module"]}, latents), dims=1
+            ).mean
+            if ccfg["reward_type"] == "intrinsic":
+                reward = intrinsic_reward
+                metrics[f"Rewards/intrinsic_{k}"] = reward.mean()
+            else:
+                reward = TwoHotEncodingDistribution(
+                    agent.reward_model.apply({"params": wm["reward_model"]}, latents), dims=1
+                ).mean
+            lambda_values = compute_lambda_values(
+                reward[1:], predicted_values[1:], continues[1:] * gamma, lmbda
+            )
+            lambda_per_critic[k] = lambda_values
+            offset, invscale, new_moments[k] = update_moments(moments_expl[k], lambda_values, **moments_kw)
+            normed_lambda = (lambda_values - offset) / invscale
+            normed_baseline = (predicted_values[:-1] - offset) / invscale
+            advantages.append((normed_lambda - normed_baseline) * (ccfg["weight"] / weights_sum))
+            metrics[f"Values_exploration/predicted_values_{k}"] = predicted_values.mean()
+            metrics[f"Values_exploration/lambda_values_{k}"] = lambda_values.mean()
+        advantage = sum(advantages)
+
+        pre = agent.actor.apply({"params": actor_params}, jax.lax.stop_gradient(latents))
+        lp, ent = actor_logprob_entropy(agent, pre, jax.lax.stop_gradient(actions))
+        if agent.is_continuous:
+            objective = advantage
+        else:
+            objective = lp[:-1] * jax.lax.stop_gradient(advantage)
+        entropy = ent_coef * ent[..., None]
+        policy_loss = -jnp.mean(discount[:-1] * (objective + entropy[:-1]))
+        return policy_loss, (latents, lambda_per_critic, discount, new_moments, metrics)
+
+    def exploration_critic_loss_fn(critic_params, target_params, latents, lambda_values, discount):
+        qv = TwoHotEncodingDistribution(
+            agent.critic.apply({"params": critic_params}, latents[:-1]), dims=1
+        )
+        target_values = TwoHotEncodingDistribution(
+            agent.critic.apply({"params": target_params}, latents[:-1]), dims=1
+        ).mean
+        value_loss = -qv.log_prob(jax.lax.stop_gradient(lambda_values))
+        value_loss = value_loss - qv.log_prob(jax.lax.stop_gradient(target_values))
+        return jnp.mean(value_loss * discount[:-1].squeeze(-1))
+
+    def task_actor_loss_fn(actor_params, params, zs, hs, true_continue, moments_task, key):
         wm = params["world_model"]
         z0 = jax.lax.stop_gradient(zs).reshape(-1, agent.stoch_state_size)
         h0 = jax.lax.stop_gradient(hs).reshape(-1, agent.recurrent_state_size)
         latents, actions = agent.imagination_scan(wm, actor_params, z0, h0, key, horizon)
         predicted_values = TwoHotEncodingDistribution(
-            agent.critic.apply({"params": params["critic"]}, latents), dims=1
+            agent.critic.apply({"params": params["critic_task"]}, latents), dims=1
         ).mean
         predicted_rewards = TwoHotEncodingDistribution(
             agent.reward_model.apply({"params": wm["reward_model"]}, latents), dims=1
         ).mean
-        continues = Independent(
-            BernoulliSafeMode(logits=agent.continue_model.apply({"params": wm["continue_model"]}, latents)),
-            1,
-        ).mode
-        continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
+        continues = _continues_for(latents, wm, true_continue)
         lambda_values = compute_lambda_values(
             predicted_rewards[1:], predicted_values[1:], continues[1:] * gamma, lmbda
         )
         discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, axis=0) / gamma)
-
-        offset, invscale, new_moments = update_moments(moments_state, lambda_values, **moments_kw)
-        baseline = predicted_values[:-1]
-        normed_lambda = (lambda_values - offset) / invscale
-        normed_baseline = (baseline - offset) / invscale
-        advantage = normed_lambda - normed_baseline
+        offset, invscale, new_moments = update_moments(moments_task, lambda_values, **moments_kw)
+        advantage = (lambda_values - offset) / invscale - (predicted_values[:-1] - offset) / invscale
         pre = agent.actor.apply({"params": actor_params}, jax.lax.stop_gradient(latents))
         lp, ent = actor_logprob_entropy(agent, pre, jax.lax.stop_gradient(actions))
         if agent.is_continuous:
@@ -176,16 +235,6 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
         policy_loss = -jnp.mean(discount[:-1] * (objective + entropy[:-1]))
         return policy_loss, (latents, lambda_values, discount, new_moments)
 
-    def critic_loss_fn(critic_params, target_params, latents, lambda_values, discount):
-        qv_logits = agent.critic.apply({"params": critic_params}, latents[:-1])
-        qv = TwoHotEncodingDistribution(qv_logits, dims=1)
-        target_values = TwoHotEncodingDistribution(
-            agent.critic.apply({"params": target_params}, latents[:-1]), dims=1
-        ).mean
-        value_loss = -qv.log_prob(jax.lax.stop_gradient(lambda_values))
-        value_loss = value_loss - qv.log_prob(jax.lax.stop_gradient(target_values))
-        return jnp.mean(value_loss * discount[:-1].squeeze(-1))
-
     @jax.jit
     def train_phase(params, opt_state, moments_state, data, cum_steps, train_key):
         G = data["rewards"].shape[0]
@@ -194,50 +243,119 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
         def step(carry, inp):
             params, opt_state, moments_state, cum = carry
             batch, k = inp
-            k_world, k_img = jax.random.split(k)
+            k_world, k_expl, k_task = jax.random.split(k, 3)
 
-            # target-critic EMA before the step (reference dreamer_v3.py:756-761)
+            # target EMAs (task + per-stream exploration critics)
             do_ema = (cum % target_freq) == 0
             tau_eff = jnp.where(cum == 0, 1.0, tau)
+            ema = lambda t, c: jnp.where(do_ema, tau_eff * c + (1 - tau_eff) * t, t)
             params = {
                 **params,
-                "target_critic": jax.tree_util.tree_map(
-                    lambda t, c: jnp.where(do_ema, tau_eff * c + (1 - tau_eff) * t, t),
-                    params["target_critic"],
-                    params["critic"],
+                "target_critic_task": jax.tree_util.tree_map(
+                    ema, params["target_critic_task"], params["critic_task"]
                 ),
+                "critics_exploration": {
+                    ck: {
+                        "module": cv["module"],
+                        "target": jax.tree_util.tree_map(ema, cv["target"], cv["module"]),
+                    }
+                    for ck, cv in params["critics_exploration"].items()
+                },
             }
 
             (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
                 params["world_model"], batch, k_world
             )
-            updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
+            updates, new_wopt = txs["world_model"].update(
+                w_grads, opt_state["world_model"], params["world_model"]
+            )
             params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
             opt_state = {**opt_state, "world_model": new_wopt}
 
-            true_continue = (1 - batch["terminated"]).reshape(-1, 1)
-            (a_loss, (latents, lambda_values, discount, new_moments)), a_grads = jax.value_and_grad(
-                actor_loss_fn, has_aux=True
-            )(params["actor"], params, zs, hs, true_continue, moments_state, k_img)
-            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
-            opt_state = {**opt_state, "actor": new_aopt}
-            moments_state = new_moments
-
-            latents_sg = jax.lax.stop_gradient(latents)
-            c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
-                params["critic"], params["target_critic"], latents_sg, lambda_values, discount
+            # ensembles predict z_{t+1} from (z_t, h_t, a_t): the stored action at
+            # row t is the one *leaving* o_t, so no shift here
+            e_loss, e_grads = jax.value_and_grad(ensemble_loss_fn)(
+                params["ensembles"], zs, hs, batch["actions"]
             )
-            updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
-            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
-            opt_state = {**opt_state, "critic": new_copt}
+            updates, new_eopt = txs["ensembles"].update(e_grads, opt_state["ensembles"], params["ensembles"])
+            params = {**params, "ensembles": optax.apply_updates(params["ensembles"], updates)}
+            opt_state = {**opt_state, "ensembles": new_eopt}
 
+            true_continue = (1 - batch["terminated"]).reshape(-1, 1)
+            (pe_loss, (latents_e, lambda_per_critic, discount_e, new_me, e_metrics)), ae_grads = (
+                jax.value_and_grad(exploration_actor_loss_fn, has_aux=True)(
+                    params["actor_exploration"],
+                    params,
+                    zs,
+                    hs,
+                    true_continue,
+                    moments_state["exploration"],
+                    k_expl,
+                )
+            )
+            updates, new_aeopt = txs["actor_exploration"].update(
+                ae_grads, opt_state["actor_exploration"], params["actor_exploration"]
+            )
+            params = {**params, "actor_exploration": optax.apply_updates(params["actor_exploration"], updates)}
+            opt_state = {**opt_state, "actor_exploration": new_aeopt}
+            moments_state = {**moments_state, "exploration": new_me}
+
+            latents_e = jax.lax.stop_gradient(latents_e)
             metrics = dict(w_metrics)
-            metrics["Loss/policy_loss"] = a_loss
-            metrics["Loss/value_loss"] = c_loss
+            metrics.update(e_metrics)
+            new_ce = {}
+            for ck in critic_cfgs:
+                c_loss, c_grads = jax.value_and_grad(exploration_critic_loss_fn)(
+                    params["critics_exploration"][ck]["module"],
+                    params["critics_exploration"][ck]["target"],
+                    latents_e,
+                    lambda_per_critic[ck],
+                    discount_e,
+                )
+                updates, new_copt = txs[f"critic_exploration_{ck}"].update(
+                    c_grads, opt_state[f"critic_exploration_{ck}"], params["critics_exploration"][ck]["module"]
+                )
+                new_ce[ck] = {
+                    "module": optax.apply_updates(params["critics_exploration"][ck]["module"], updates),
+                    "target": params["critics_exploration"][ck]["target"],
+                }
+                opt_state = {**opt_state, f"critic_exploration_{ck}": new_copt}
+                metrics[f"Loss/value_loss_exploration_{ck}"] = c_loss
+                metrics[f"Grads/critic_exploration_{ck}"] = optax.global_norm(c_grads)
+            params = {**params, "critics_exploration": new_ce}
+
+            (pt_loss, (latents_t, lambda_t, discount_t, new_mt)), at_grads = jax.value_and_grad(
+                task_actor_loss_fn, has_aux=True
+            )(params["actor_task"], params, zs, hs, true_continue, moments_state["task"], k_task)
+            updates, new_atopt = txs["actor_task"].update(
+                at_grads, opt_state["actor_task"], params["actor_task"]
+            )
+            params = {**params, "actor_task": optax.apply_updates(params["actor_task"], updates)}
+            opt_state = {**opt_state, "actor_task": new_atopt}
+            moments_state = {**moments_state, "task": new_mt}
+
+            ct_loss, ct_grads = jax.value_and_grad(exploration_critic_loss_fn)(
+                params["critic_task"],
+                params["target_critic_task"],
+                jax.lax.stop_gradient(latents_t),
+                lambda_t,
+                discount_t,
+            )
+            updates, new_ctopt = txs["critic_task"].update(
+                ct_grads, opt_state["critic_task"], params["critic_task"]
+            )
+            params = {**params, "critic_task": optax.apply_updates(params["critic_task"], updates)}
+            opt_state = {**opt_state, "critic_task": new_ctopt}
+
+            metrics["Loss/ensemble_loss"] = e_loss
+            metrics["Loss/policy_loss_exploration"] = pe_loss
+            metrics["Loss/policy_loss_task"] = pt_loss
+            metrics["Loss/value_loss_task"] = ct_loss
             metrics["Grads/world_model"] = optax.global_norm(w_grads)
-            metrics["Grads/actor"] = optax.global_norm(a_grads)
-            metrics["Grads/critic"] = optax.global_norm(c_grads)
+            metrics["Grads/ensemble"] = optax.global_norm(e_grads)
+            metrics["Grads/actor_exploration"] = optax.global_norm(ae_grads)
+            metrics["Grads/actor_task"] = optax.global_norm(at_grads)
+            metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
             return (params, opt_state, moments_state, cum + 1), metrics
 
         (params, opt_state, moments_state, _), metrics = jax.lax.scan(
@@ -255,7 +373,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
     state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
 
-    # These arguments cannot be changed (reference dreamer_v3.py:437-440)
     cfg.env.frame_stack = -1
     if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
         raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
@@ -301,31 +418,11 @@ def main(fabric, cfg: Dict[str, Any]):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
-    if (
-        len(set(cnn_keys).intersection(set(cfg.algo.cnn_keys.decoder))) == 0
-        and len(set(mlp_keys).intersection(set(cfg.algo.mlp_keys.decoder))) == 0
-    ):
-        raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
-    if len(set(cfg.algo.cnn_keys.decoder) - set(cnn_keys)) > 0:
-        raise RuntimeError(
-            "The CNN keys of the decoder must be contained in the encoder ones. "
-            f"Those keys are decoded without being encoded: {list(set(cfg.algo.cnn_keys.decoder))}"
-        )
-    if len(set(cfg.algo.mlp_keys.decoder) - set(mlp_keys)) > 0:
-        raise RuntimeError(
-            "The MLP keys of the decoder must be contained in the encoder ones. "
-            f"Those keys are decoded without being encoded: {list(set(cfg.algo.mlp_keys.decoder))}"
-        )
-    if cfg.metric.log_level > 0:
-        fabric.print("Encoder CNN keys:", cnn_keys)
-        fabric.print("Encoder MLP keys:", mlp_keys)
-        fabric.print("Decoder CNN keys:", list(cfg.algo.cnn_keys.decoder))
-        fabric.print("Decoder MLP keys:", list(cfg.algo.mlp_keys.decoder))
     obs_keys = cnn_keys + mlp_keys
 
     key = fabric.seed_everything(cfg.seed + rank)
     key, agent_key = jax.random.split(key)
-    agent, params = build_agent(
+    agent, ensembles, params = build_agent(
         fabric,
         actions_dim,
         is_continuous,
@@ -335,26 +432,41 @@ def main(fabric, cfg: Dict[str, Any]):
         state["agent"] if state else None,
     )
     player = PlayerDV3(agent, num_envs, cnn_keys, mlp_keys)
+    actor_type = cfg.algo.player.actor_type
 
-    # three optimizers with per-group clipping (reference dreamer_v3.py:525-538)
     def _tx(opt_cfg, clip):
         base = instantiate(opt_cfg)
         if clip is not None and clip > 0:
             return optax.chain(optax.clip_by_global_norm(clip), base)
         return base
 
-    world_tx = _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
-    actor_tx = _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
-    critic_tx = _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
-    opt_state = {
-        "world_model": world_tx.init(params["world_model"]),
-        "actor": actor_tx.init(params["actor"]),
-        "critic": critic_tx.init(params["critic"]),
+    txs = {
+        "world_model": _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
+        "actor_task": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+        "critic_task": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+        "actor_exploration": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+        "ensembles": _tx(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
     }
-    if state is not None and "opt_state" in state:
+    for ck in dict(cfg.algo.critics_exploration):
+        txs[f"critic_exploration_{ck}"] = _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    opt_state = {
+        "world_model": txs["world_model"].init(params["world_model"]),
+        "actor_task": txs["actor_task"].init(params["actor_task"]),
+        "critic_task": txs["critic_task"].init(params["critic_task"]),
+        "actor_exploration": txs["actor_exploration"].init(params["actor_exploration"]),
+        "ensembles": txs["ensembles"].init(params["ensembles"]),
+    }
+    for ck in dict(cfg.algo.critics_exploration):
+        opt_state[f"critic_exploration_{ck}"] = txs[f"critic_exploration_{ck}"].init(
+            params["critics_exploration"][ck]["module"]
+        )
+    if state is not None:
         opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
-    moments_state = init_moments()
-    if state is not None and "moments" in state:
+    moments_state = {
+        "task": init_moments(),
+        "exploration": {ck: init_moments() for ck in dict(cfg.algo.critics_exploration)},
+    }
+    if state is not None:
         moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
 
     if fabric.is_global_zero:
@@ -362,7 +474,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     aggregator = None
     if not MetricAggregator.disabled:
-        aggregator = instantiate(cfg.metric.aggregator)
+        aggregator = instantiate(cfg.metric.aggregator, raise_on_missing=False)
 
     buffer_size = cfg.buffer.size // int(num_envs * world_size) if not cfg.dry_run else 8
     rb = EnvIndependentReplayBuffer(
@@ -376,9 +488,8 @@ def main(fabric, cfg: Dict[str, Any]):
     if state is not None and cfg.buffer.checkpoint and "rb" in state:
         rb = state["rb"]
 
-    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+    train_phase = make_train_phase(agent, ensembles, cfg, txs)
 
-    # counters (reference dreamer_v3.py:571-597)
     start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
     policy_step = state["iter_num"] * num_envs if state is not None else 0
     last_log = state["last_log"] if state is not None else 0
@@ -393,21 +504,15 @@ def main(fabric, cfg: Dict[str, Any]):
         prefill_steps += start_iter
 
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
-    if state is not None and "ratio" in state:
+    if state is not None:
         ratio.load_state_dict(state["ratio"])
 
-    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
-        warnings.warn(
-            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
-            f"policy_steps_per_iter value ({policy_steps_per_iter})."
-        )
     if cfg.checkpoint.every % policy_steps_per_iter != 0:
         warnings.warn(
             f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
             f"policy_steps_per_iter value ({policy_steps_per_iter})."
         )
 
-    # first observation
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
@@ -416,7 +521,7 @@ def main(fabric, cfg: Dict[str, Any]):
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    player.init_states(params)
+    player.init_states(player_params(params, actor_type))
 
     cumulative_per_rank_gradient_steps = 0
     train_step = 0
@@ -430,8 +535,6 @@ def main(fabric, cfg: Dict[str, Any]):
             if iter_num <= learning_starts and state is None:
                 real_actions = actions = np.array(envs.action_space.sample())
                 if not is_continuous:
-                    # [num_envs, n_dims] (or [num_envs] for a single Discrete) → one
-                    # one-hot block per action dim, env-major
                     per_dim = actions.reshape(num_envs, len(actions_dim)).T
                     actions = np.concatenate(
                         [np.eye(dim, dtype=np.float32)[act] for act, dim in zip(per_dim, actions_dim)],
@@ -440,7 +543,7 @@ def main(fabric, cfg: Dict[str, Any]):
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
                 key, step_key = jax.random.split(key)
-                actions = np.asarray(player.get_actions(params, jobs, step_key))
+                actions = np.asarray(player.get_actions(player_params(params, actor_type), jobs, step_key))
                 if is_continuous:
                     real_actions = actions
                 else:
@@ -458,21 +561,6 @@ def main(fabric, cfg: Dict[str, Any]):
             dones = np.logical_or(terminated, truncated).astype(np.uint8)
 
         step_data["is_first"] = np.zeros_like(step_data["terminated"])
-        if "restart_on_exception" in infos:
-            for i, agent_roe in enumerate(infos["restart_on_exception"]):
-                if agent_roe and not dones[i]:
-                    sub_rb = rb.buffer[i]
-                    last_inserted_idx = (sub_rb._pos - 1) % sub_rb.buffer_size
-                    sub_rb["terminated"][last_inserted_idx] = np.zeros_like(
-                        sub_rb["terminated"][last_inserted_idx]
-                    )
-                    sub_rb["truncated"][last_inserted_idx] = np.ones_like(
-                        sub_rb["truncated"][last_inserted_idx]
-                    )
-                    sub_rb["is_first"][last_inserted_idx] = np.zeros_like(
-                        sub_rb["is_first"][last_inserted_idx]
-                    )
-                    step_data["is_first"][:, i] = np.ones_like(step_data["is_first"][:, i])
 
         ep_info = infos.get("final_info", infos)
         if cfg.metric.log_level > 0 and "episode" in ep_info:
@@ -483,7 +571,6 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
                 aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
-        # real next obs of finished episodes (reference dreamer_v3.py:701-708)
         real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
         final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
         if final_obs_arr is not None:
@@ -513,14 +600,12 @@ def main(fabric, cfg: Dict[str, Any]):
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
-            # the reset rows restart the episode in the *live* step_data
             step_data["rewards"][:, dones_idxes] = 0.0
             step_data["terminated"][:, dones_idxes] = 0.0
             step_data["truncated"][:, dones_idxes] = 0.0
             step_data["is_first"][:, dones_idxes] = 1.0
-            player.init_states(params, dones_idxes)
+            player.init_states(player_params(params, actor_type), dones_idxes)
 
-        # train
         if iter_num >= learning_starts:
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
@@ -531,8 +616,6 @@ def main(fabric, cfg: Dict[str, Any]):
                         sequence_length=cfg.algo.per_rank_sequence_length,
                         n_samples=per_rank_gradient_steps,
                     )
-                    # image keys stay uint8 across the host→device boundary (4× less
-                    # transfer); the jitted program normalizes on device
                     data = {
                         k: np.asarray(v) if k in cnn_keys else np.asarray(v, dtype=np.float32)
                         for k, v in sample.items()
@@ -554,22 +637,12 @@ def main(fabric, cfg: Dict[str, Any]):
                         for mk, mv in metrics.items():
                             aggregator.update(mk, float(np.asarray(mv)))
 
-        # log
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
             metrics_dict = aggregator.compute() if aggregator else {}
             if logger is not None:
                 logger.log_metrics(metrics_dict, policy_step)
-                if policy_step > 0:
-                    logger.log_metrics(
-                        {
-                            "Params/replay_ratio": cumulative_per_rank_gradient_steps
-                            * world_size
-                            / max(policy_step, 1)
-                        },
-                        policy_step,
-                    )
                 timers = timer.to_dict(reset=False)
                 if timers.get("Time/train_time", 0) > 0:
                     logger.log_metrics(
@@ -592,7 +665,6 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        # checkpoint
         if (
             (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
             or cfg.dry_run
@@ -618,6 +690,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
-        test(player, params, fabric, cfg, log_dir, greedy=False)
+        test(player, player_params(params, actor_type), fabric, cfg, log_dir, greedy=False)
     if logger is not None:
         logger.finalize()

@@ -1,14 +1,13 @@
-"""Dreamer-V1, coupled training (capability parity with
-sheeprl/algos/dreamer_v1/dreamer_v1.py:96-750).
-
-Same TPU-native shape as the other Dreamer modules: one jitted program per iteration
-scanning the ``[G, T, B, ...]`` replay block — Gaussian-latent dynamic scan,
-world-model update (single KL with free nats), H-step imagination, dynamics-
-backprop actor update (-mean(discount * lambda)), Normal(.,1) critic update."""
+"""Plan2Explore DV3 — finetuning phase (capability parity with
+sheeprl/algos/p2e_dv3/p2e_dv3_finetuning.py:28-330): resume the exploration
+checkpoint's world model / task heads, optionally inherit the exploration replay
+buffer, act with the exploration actor until ``learning_starts`` then switch to the
+task actor, and train with the standard Dreamer-V3 program."""
 
 from __future__ import annotations
 
 import os
+import pathlib
 import warnings
 from functools import partial
 from typing import Any, Dict
@@ -19,17 +18,13 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.algos.dreamer_v1.agent import DV1Agent, PlayerDV1, build_agent
-from sheeprl_tpu.algos.dreamer_v1.loss import reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values, prepare_obs, test
-from sheeprl_tpu.algos.dreamer_v2.utils import (
-    _HALF_LOG_2PI,
-    bernoulli_logprob as _bernoulli_logprob,
-    normal1_logprob as _normal1_logprob,
-)
+from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_phase
+from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+from sheeprl_tpu.algos.p2e_dv3.agent import build_agent, player_params
+from sheeprl_tpu.algos.p2e_dv3.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -37,151 +32,29 @@ from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
-def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx):
-    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
-    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
-    cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
-    mlp_dec_keys = tuple(cfg.algo.mlp_keys.decoder)
-    wm_cfg = cfg.algo.world_model
-    gamma = float(cfg.algo.gamma)
-    lmbda = float(cfg.algo.lmbda)
-    horizon = int(cfg.algo.horizon)
-    use_continues = bool(wm_cfg.use_continues)
-
-    def world_loss_fn(wm_params, batch, key):
-        batch_obs = {k: batch[k] / 255.0 - 0.5 for k in cnn_keys}
-        batch_obs.update({k: batch[k] for k in mlp_keys})
-        # row t stores the action chosen *at* o_t; the dynamics consume the action
-        # that *led to* o_t (same shift as dreamer_v3.py, reference dv3:219-221)
-        actions = jnp.concatenate(
-            [jnp.zeros_like(batch["actions"][:1]), batch["actions"][:-1]], axis=0
-        )
-        embedded = agent.encoder.apply({"params": wm_params["encoder"]}, batch_obs)
-        hs, zs, post_mean, post_std, prior_mean, prior_std = agent.dynamic_scan(
-            wm_params, embedded, actions, key
-        )
-        latents = jnp.concatenate([zs, hs], axis=-1)
-        recon = agent.observation_model.apply({"params": wm_params["observation_model"]}, latents)
-        obs_lps = {
-            k: _normal1_logprob(recon[k], batch_obs[k], len(recon[k].shape[2:]))
-            for k in cnn_dec_keys + mlp_dec_keys
-        }
-        reward_pred = agent.reward_model.apply({"params": wm_params["reward_model"]}, latents)
-        reward_lp = _normal1_logprob(reward_pred, batch["rewards"], 1)
-        cont_lp = None
-        if use_continues:
-            cont_logits = agent.continue_model.apply({"params": wm_params["continue_model"]}, latents)
-            cont_lp = _bernoulli_logprob(cont_logits, (1.0 - batch["terminated"]) * gamma, 1)
-        loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
-            obs_lps,
-            reward_lp,
-            post_mean,
-            post_std,
-            prior_mean,
-            prior_std,
-            kl_free_nats=wm_cfg.kl_free_nats,
-            kl_regularizer=wm_cfg.kl_regularizer,
-            continue_log_prob=cont_lp,
-            continue_scale_factor=wm_cfg.continue_scale_factor,
-        )
-
-        def _normal_entropy(std):
-            return (0.5 + _HALF_LOG_2PI + jnp.log(std)).sum(-1).mean()
-
-        metrics = {
-            "Loss/world_model_loss": loss,
-            "Loss/observation_loss": observation_loss,
-            "Loss/reward_loss": reward_loss,
-            "Loss/state_loss": state_loss,
-            "Loss/continue_loss": continue_loss,
-            "State/kl": kl,
-            "State/post_entropy": _normal_entropy(jax.lax.stop_gradient(post_std)),
-            "State/prior_entropy": _normal_entropy(jax.lax.stop_gradient(prior_std)),
-        }
-        return loss, (zs, hs, metrics)
-
-    def actor_loss_fn(actor_params, params, zs, hs, key):
-        wm = params["world_model"]
-        z0 = jax.lax.stop_gradient(zs).reshape(-1, agent.stochastic_size)
-        h0 = jax.lax.stop_gradient(hs).reshape(-1, agent.recurrent_state_size)
-        latents = agent.imagination_scan(wm, actor_params, z0, h0, key, horizon)
-        predicted_values = agent.critic.apply({"params": params["critic"]}, latents)
-        predicted_rewards = agent.reward_model.apply({"params": wm["reward_model"]}, latents)
-        if use_continues:
-            cont_logits = agent.continue_model.apply({"params": wm["continue_model"]}, latents)
-            continues = jax.nn.sigmoid(cont_logits)
-        else:
-            continues = jnp.ones_like(jax.lax.stop_gradient(predicted_rewards)) * gamma
-        lambda_values = compute_lambda_values(
-            predicted_rewards, predicted_values, continues, horizon, lmbda
-        )
-        discount = jax.lax.stop_gradient(
-            jnp.cumprod(
-                jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-2]], axis=0), axis=0
-            )
-        )
-        policy_loss = -jnp.mean(discount * lambda_values)
-        return policy_loss, (latents, lambda_values, discount)
-
-    def critic_loss_fn(critic_params, latents, lambda_values, discount):
-        pred = agent.critic.apply({"params": critic_params}, latents[:-1])
-        lp = _normal1_logprob(pred, jax.lax.stop_gradient(lambda_values), 1)
-        return -jnp.mean(discount[..., 0] * lp)
-
-    @jax.jit
-    def train_phase(params, opt_state, data, train_key):
-        G = data["rewards"].shape[0]
-        keys = jax.random.split(jnp.asarray(train_key), G)
-
-        def step(carry, inp):
-            params, opt_state = carry
-            batch, k = inp
-            k_world, k_img = jax.random.split(k)
-
-            (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
-                params["world_model"], batch, k_world
-            )
-            updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
-            params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
-            opt_state = {**opt_state, "world_model": new_wopt}
-
-            (a_loss, (latents, lambda_values, discount)), a_grads = jax.value_and_grad(
-                actor_loss_fn, has_aux=True
-            )(params["actor"], params, zs, hs, k_img)
-            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
-            opt_state = {**opt_state, "actor": new_aopt}
-
-            latents_sg = jax.lax.stop_gradient(latents)
-            c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
-                params["critic"], latents_sg, lambda_values, discount
-            )
-            updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
-            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
-            opt_state = {**opt_state, "critic": new_copt}
-
-            metrics = dict(w_metrics)
-            metrics["Loss/policy_loss"] = a_loss
-            metrics["Loss/value_loss"] = c_loss
-            metrics["Grads/world_model"] = optax.global_norm(w_grads)
-            metrics["Grads/actor"] = optax.global_norm(a_grads)
-            metrics["Grads/critic"] = optax.global_norm(c_grads)
-            return (params, opt_state), metrics
-
-        (params, opt_state), metrics = jax.lax.scan(step, (params, opt_state), (data, keys))
-        return params, opt_state, jax.tree_util.tree_map(lambda m: m.mean(), metrics)
-
-    return train_phase
-
 
 @register_algorithm()
-def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
+def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     rank = fabric.global_rank
     world_size = fabric.world_size
 
-    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    ckpt_path = pathlib.Path(cfg.checkpoint.exploration_ckpt_path)
+    resume = cfg.checkpoint.resume_from is not None
+    state = fabric.load(pathlib.Path(cfg.checkpoint.resume_from) if resume else ckpt_path)
 
-    cfg.env.frame_stack = 1
+    # the models must match the exploration phase (reference
+    # p2e_dv3_finetuning.py:46-70)
+    for k in (
+        "gamma", "lmbda", "horizon", "dense_units", "mlp_layers", "dense_act", "cnn_act",
+        "unimix", "hafner_initialization", "world_model", "actor", "critic",
+        "cnn_keys", "mlp_keys",
+    ):
+        if k in exploration_cfg.algo:
+            cfg.algo[k] = exploration_cfg.algo[k]
+    cfg.env.clip_rewards = exploration_cfg.env.clip_rewards
+    if cfg.buffer.get("load_from_exploration", False) and exploration_cfg.buffer.checkpoint:
+        cfg.env.num_envs = exploration_cfg.env.num_envs
+    cfg.env.frame_stack = -1
 
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
     logger = get_logger(fabric, cfg, log_dir=log_dir)
@@ -194,16 +67,13 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
     num_envs = int(cfg.env.num_envs)
     envs = vectorized_env(
         [
-            partial(
-                RestartOnException,
-                make_env(
-                    cfg,
-                    cfg.seed + rank * num_envs + i,
-                    rank * num_envs,
-                    log_dir if rank == 0 else None,
-                    "train",
-                    vector_env_idx=i,
-                ),
+            make_env(
+                cfg,
+                cfg.seed + rank * num_envs + i,
+                rank * num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
             )
             for i in range(num_envs)
         ],
@@ -211,7 +81,6 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
     )
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
-
     is_continuous = isinstance(action_space, gym.spaces.Box)
     is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
     actions_dim = tuple(
@@ -228,16 +97,19 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
 
     key = fabric.seed_everything(cfg.seed + rank)
     key, agent_key = jax.random.split(key)
-    agent, params = build_agent(
-        fabric,
-        actions_dim,
-        is_continuous,
-        cfg,
-        observation_space,
-        agent_key,
-        state["agent"] if state else None,
+    agent, _, p2e_params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, observation_space, agent_key, state["agent"]
     )
-    player = PlayerDV1(agent, num_envs, cnn_keys, mlp_keys)
+    # DV3-layout view of the p2e pytree: the task heads are trained
+    params = {
+        "world_model": p2e_params["world_model"],
+        "actor": p2e_params["actor_task"],
+        "critic": p2e_params["critic_task"],
+        "target_critic": p2e_params["target_critic_task"],
+    }
+    actor_exploration_params = p2e_params["actor_exploration"]
+    player = PlayerDV3(agent, num_envs, cnn_keys, mlp_keys)
+    actor_type = cfg.algo.player.actor_type
 
     def _tx(opt_cfg, clip):
         base = instantiate(opt_cfg)
@@ -253,8 +125,11 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
         "actor": actor_tx.init(params["actor"]),
         "critic": critic_tx.init(params["critic"]),
     }
-    if state is not None and "opt_state" in state:
+    if resume and "opt_state" in state:
         opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+    moments_state = init_moments()
+    if resume and "moments" in state:
+        moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
 
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
@@ -272,26 +147,28 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         buffer_cls=SequentialReplayBuffer,
     )
-    if state is not None and cfg.buffer.checkpoint and "rb" in state:
+    if "rb" in state and (
+        cfg.buffer.get("load_from_exploration", False) or (resume and cfg.buffer.checkpoint)
+    ):
         rb = state["rb"]
 
     train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
 
-    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
-    policy_step = state["iter_num"] * num_envs if state is not None else 0
-    last_log = state["last_log"] if state is not None else 0
-    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    start_iter = (state["iter_num"] // world_size) + 1 if resume else 1
+    policy_step = state["iter_num"] * num_envs if resume else 0
+    last_log = state["last_log"] if resume else 0
+    last_checkpoint = state["last_checkpoint"] if resume else 0
     policy_steps_per_iter = int(num_envs * world_size)
     total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
     learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
     prefill_steps = learning_starts - int(learning_starts > 0)
-    if state is not None:
+    if resume:
         cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
         learning_starts += start_iter
         prefill_steps += start_iter
 
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
-    if state is not None and "ratio" in state:
+    if resume:
         ratio.load_state_dict(state["ratio"])
 
     if cfg.checkpoint.every % policy_steps_per_iter != 0:
@@ -300,13 +177,13 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             f"policy_steps_per_iter value ({policy_steps_per_iter})."
         )
 
-    expl_cfg = agent.actor_cfg
-
-    def expl_amount(step: int) -> float:
-        amount = expl_cfg["expl_amount"]
-        if expl_cfg["expl_decay"]:
-            amount = amount * (0.5 ** (step / expl_cfg["expl_decay"]))
-        return max(amount, expl_cfg["expl_min"])
+    def _act_params():
+        p2e_view = {
+            "world_model": params["world_model"],
+            "actor_task": params["actor"],
+            "actor_exploration": actor_exploration_params,
+        }
+        return player_params(p2e_view, actor_type)
 
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -316,7 +193,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    player.init_states(params)
+    player.init_states(_act_params())
 
     cumulative_per_rank_gradient_steps = 0
     train_step = 0
@@ -327,36 +204,16 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time"):
-            if iter_num <= learning_starts and state is None:
-                real_actions = actions = np.array(envs.action_space.sample())
-                if not is_continuous:
-                    per_dim = actions.reshape(num_envs, len(actions_dim)).T
-                    actions = np.concatenate(
-                        [np.eye(dim, dtype=np.float32)[act] for act, dim in zip(per_dim, actions_dim)],
-                        axis=-1,
-                    )
+            jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+            key, step_key = jax.random.split(key)
+            actions = np.asarray(player.get_actions(_act_params(), jobs, step_key))
+            if is_continuous:
+                real_actions = actions
             else:
-                jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
-                key, step_key = jax.random.split(key)
-                actions = np.asarray(
-                    player.get_actions(
-                        # p2e finetuning acts with the exploration actor during the
-                        # prefill, then switches to the (trained) task actor
-                        {**params, "actor": exploration_actor_params}
-                        if exploration_actor_params is not None and iter_num <= learning_starts
-                        else params,
-                        jobs,
-                        step_key,
-                        expl_amount=expl_amount(policy_step),
-                    )
+                splits = np.cumsum(actions_dim)[:-1]
+                real_actions = np.stack(
+                    [b.argmax(-1) for b in np.split(actions, splits, axis=-1)], axis=-1
                 )
-                if is_continuous:
-                    real_actions = actions
-                else:
-                    splits = np.cumsum(actions_dim)[:-1]
-                    real_actions = np.stack(
-                        [b.argmax(-1) for b in np.split(actions, splits, axis=-1)], axis=-1
-                    )
 
             step_data["actions"] = actions.reshape((1, num_envs, -1)).astype(np.float32)
             rb.add(step_data, validate_args=cfg.buffer.validate_args)
@@ -410,9 +267,13 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             step_data["terminated"][:, dones_idxes] = 0.0
             step_data["truncated"][:, dones_idxes] = 0.0
             step_data["is_first"][:, dones_idxes] = 1.0
-            player.init_states(params, dones_idxes)
+            player.init_states(_act_params(), dones_idxes)
 
         if iter_num >= learning_starts:
+            # after the prefill the player switches to the task actor (reference
+            # p2e_dv3_finetuning.py:350-352)
+            if actor_type != "task":
+                actor_type = "task"
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
@@ -429,8 +290,13 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                     if world_size > 1:
                         data = jax.device_put(data, fabric.sharding(None, None, "data"))
                     key, train_key = jax.random.split(key)
-                    params, opt_state, metrics = train_phase(
-                        params, opt_state, data, np.asarray(train_key)
+                    params, opt_state, moments_state, metrics = train_phase(
+                        params,
+                        opt_state,
+                        moments_state,
+                        data,
+                        jnp.asarray(cumulative_per_rank_gradient_steps),
+                        np.asarray(train_key),
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
@@ -472,9 +338,17 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             or (iter_num == total_iters and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step
+            full_agent = {
+                **p2e_params,
+                "world_model": params["world_model"],
+                "actor_task": params["actor"],
+                "critic_task": params["critic"],
+                "target_critic_task": params["target_critic"],
+            }
             ckpt_state = {
-                "agent": params,
+                "agent": full_agent,
                 "opt_state": opt_state,
+                "moments": moments_state,
                 "ratio": ratio.state_dict(),
                 "iter_num": iter_num * world_size,
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,
@@ -490,6 +364,6 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
-        test(player, params, fabric, cfg, log_dir, greedy=False)
+        test(player, _act_params(), fabric, cfg, log_dir, greedy=False)
     if logger is not None:
         logger.finalize()

@@ -1,0 +1,55 @@
+"""P2E-DV3 support (reference: sheeprl/algos/p2e_dv3/utils.py)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401 — shared with DV3
+    init_moments,
+    prepare_obs,
+    test,
+    update_moments,
+)
+
+AGGREGATOR_KEYS = {
+    # dreamer-native keys: the finetuning phase delegates to the dreamer train
+    # program, which emits the unsuffixed names
+    "Loss/policy_loss",
+    "Loss/value_loss",
+    "Grads/actor",
+    "Grads/critic",
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Loss/policy_loss_exploration",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/ensemble_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor_task",
+    "Grads/critic_task",
+    "Grads/actor_exploration",
+    "Grads/ensemble",
+    # per-exploration-critic metrics are dynamically suffixed with the critic key
+    "Loss/value_loss_exploration",
+    "Grads/critic_exploration",
+    "Rewards/intrinsic",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+}
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "critics_exploration",
+    "actor_task",
+    "critic_task",
+    "target_critic_task",
+    "moments_task",
+    "moments_exploration",
+}

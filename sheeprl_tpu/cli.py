@@ -109,8 +109,14 @@ def run_algorithm(cfg: dotdict) -> None:
     if utils_mod is not None and hasattr(utils_mod, "AGGREGATOR_KEYS") and cfg.metric.log_level > 0:
         keys = set(utils_mod.AGGREGATOR_KEYS)
         metrics = cfg.metric.aggregator.metrics
+        # prefix matches keep per-stream suffixed metrics (e.g. the p2e exploration
+        # critics' Loss/value_loss_exploration_<critic>)
         cfg.metric.aggregator.metrics = dotdict(
-            {k: v for k, v in metrics.items() if k in keys}
+            {
+                k: v
+                for k, v in metrics.items()
+                if k in keys or any(k.startswith(p + "_") for p in keys)
+            }
         )
     if cfg.metric.log_level == 0 or cfg.metric.disable_timer:
         timer.disabled = True
@@ -118,8 +124,46 @@ def run_algorithm(cfg: dotdict) -> None:
 
     MetricAggregator.disabled = cfg.metric.log_level == 0
 
+    kwargs: Dict[str, Any] = {}
+    if "finetuning" in cfg.algo.name and "p2e" in entry["module"]:
+        # inherit env/config identity from the exploration run (reference
+        # cli.py:116-147)
+        import yaml
+
+        ckpt_path = Path(cfg.checkpoint.exploration_ckpt_path)
+        expl_cfg_path = ckpt_path.parent.parent / "config.yaml"
+        if not expl_cfg_path.is_file():
+            expl_cfg_path = ckpt_path.parent / "config.yaml"
+        if not expl_cfg_path.is_file():
+            raise ValueError(
+                f"cannot finetune from {ckpt_path}: no config.yaml found next to the "
+                "exploration checkpoint"
+            )
+        with open(expl_cfg_path) as f:
+            exploration_cfg = dotdict(yaml.safe_load(f))
+        if exploration_cfg.env.id != cfg.env.id:
+            raise ValueError(
+                "This experiment is run with a different environment from the one of "
+                f"the exploration you want to finetune. Got '{cfg.env.id}', but the "
+                f"environment used during exploration was {exploration_cfg.env.id}."
+            )
+        for k in (
+            "frame_stack",
+            "screen_size",
+            "action_repeat",
+            "grayscale",
+            "clip_rewards",
+            "frame_stack_dilation",
+            "max_episode_steps",
+            "reward_as_observation",
+        ):
+            cfg.env[k] = exploration_cfg.env[k]
+        if cfg.buffer.get("load_from_exploration", False):
+            cfg.fabric.devices = exploration_cfg.fabric.devices
+        kwargs["exploration_cfg"] = exploration_cfg
+
     fabric = instantiate(cfg.fabric)
-    fabric.launch(main, cfg)
+    fabric.launch(main, cfg, **kwargs)
 
 
 def run(args: Optional[Sequence[str]] = None) -> None:

@@ -378,3 +378,49 @@ def test_dreamer_v1(standard_args, env_id):
 
 def test_dreamer_v1_devices2(standard_args):
     _run(standard_args + _DV1_TINY + ["fabric.devices=2"])
+
+
+def _p2e_tiny(version):
+    args = [
+        "env=dummy",
+        "env.num_envs=2",
+        "algo.per_rank_batch_size=1",
+        "algo.per_rank_sequence_length=1",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.per_rank_pretrain_steps=0",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.stochastic_size=4",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.ensembles.n=3",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+    ]
+    if version in (2, 3):
+        args.append("algo.world_model.discrete_size=4")
+    return args
+
+
+@pytest.mark.parametrize("version", [1, 2, 3])
+def test_p2e_exploration_then_finetuning(standard_args, version):
+    import glob
+    import os
+
+    _run(
+        standard_args
+        + [f"exp=p2e_dv{version}_exploration", f"root_dir=p2e{version}", "run_name=expl", "checkpoint.save_last=True"]
+        + _p2e_tiny(version)
+    )
+    ckpts = glob.glob(f"logs/runs/p2e{version}/expl/**/*.ckpt", recursive=True)
+    assert len(ckpts) > 0
+    ckpt = os.path.abspath(sorted(ckpts)[-1])
+    _run(
+        standard_args
+        + [f"exp=p2e_dv{version}_finetuning", f"checkpoint.exploration_ckpt_path={ckpt}"]
+        + _p2e_tiny(version)
+    )
