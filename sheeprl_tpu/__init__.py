@@ -20,8 +20,10 @@ from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE  # noqa: E402
 _ALGO_MODULES = [
     "sheeprl_tpu.algos.a2c.a2c",
     "sheeprl_tpu.algos.ppo.ppo",
+    "sheeprl_tpu.algos.ppo.ppo_decoupled",
     "sheeprl_tpu.algos.ppo.evaluate",
     "sheeprl_tpu.algos.sac.sac",
+    "sheeprl_tpu.algos.sac.sac_decoupled",
     "sheeprl_tpu.algos.sac.evaluate",
     "sheeprl_tpu.algos.droq.droq",
     "sheeprl_tpu.algos.droq.evaluate",

@@ -128,7 +128,8 @@ class DV1Agent:
 
     def imagination_scan(self, wm, actor_params, z0, h0, key, horizon):
         """DV1 imagination (reference dreamer_v1.py:243-250): actor acts, dynamics
-        step; the trajectory collects the H *imagined* states only."""
+        step; the trajectory collects the H *imagined* states (and the actions that
+        produced them — the p2e intrinsic reward consumes those)."""
 
         def step(carry, k):
             z, h, latent = carry
@@ -137,12 +138,12 @@ class DV1Agent:
             h = self._recurrent(wm, z, a, h)
             _, z = self._transition(wm, h, k)
             latent = jnp.concatenate([z, h], axis=-1)
-            return (z, h, latent), latent
+            return (z, h, latent), (latent, a)
 
         latent0 = jnp.concatenate([z0, h0], axis=-1)
         keys = jax.random.split(key, horizon)
-        _, latents = jax.lax.scan(step, (z0, h0, latent0), keys)
-        return latents
+        _, (latents, actions) = jax.lax.scan(step, (z0, h0, latent0), keys)
+        return latents, actions
 
 
 def build_agent(

@@ -104,7 +104,7 @@ def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx):
         wm = params["world_model"]
         z0 = jax.lax.stop_gradient(zs).reshape(-1, agent.stochastic_size)
         h0 = jax.lax.stop_gradient(hs).reshape(-1, agent.recurrent_state_size)
-        latents = agent.imagination_scan(wm, actor_params, z0, h0, key, horizon)
+        latents, _ = agent.imagination_scan(wm, actor_params, z0, h0, key, horizon)
         predicted_values = agent.critic.apply({"params": params["critic"]}, latents)
         predicted_rewards = agent.reward_model.apply({"params": wm["reward_model"]}, latents)
         if use_continues:
@@ -272,7 +272,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         buffer_cls=SequentialReplayBuffer,
     )
-    if state is not None and cfg.buffer.checkpoint and "rb" in state:
+    if state is not None and "rb" in state:
         rb = state["rb"]
 
     train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)

@@ -330,7 +330,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
         raise ValueError(
             f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
         )
-    if state is not None and cfg.buffer.checkpoint and "rb" in state:
+    if state is not None and "rb" in state:
         rb = state["rb"]
 
     train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)

@@ -135,7 +135,7 @@ def main(fabric, cfg: Dict[str, Any]):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         obs_keys=("observations",),
     )
-    if state is not None and cfg.buffer.checkpoint and "rb" in state:
+    if state is not None and "rb" in state:
         rb = state["rb"]
 
     start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1

@@ -115,7 +115,7 @@ def make_train_phase(agent: DV1Agent, ensembles: EnsembleHeads, cfg, txs: Dict[s
         wm = params["world_model"]
         z0 = jax.lax.stop_gradient(zs).reshape(-1, agent.stochastic_size)
         h0 = jax.lax.stop_gradient(hs).reshape(-1, agent.recurrent_state_size)
-        latents, actions = agent_imagination_with_actions(wm, actor_params, z0, h0, key)
+        latents, actions = agent.imagination_scan(wm, actor_params, z0, h0, key, horizon)
         predicted_values = agent.critic.apply({"params": params[critic_key]}, latents)
         reward = reward_fn(latents, actions, wm, params)
         if use_continues:
@@ -131,25 +131,6 @@ def make_train_phase(agent: DV1Agent, ensembles: EnsembleHeads, cfg, txs: Dict[s
         )
         policy_loss = -jnp.mean(discount * lambda_values)
         return policy_loss, (latents, lambda_values, discount, reward)
-
-    def agent_imagination_with_actions(wm, actor_params, z0, h0, key):
-        """DV1 imagination that also returns the actions (the p2e intrinsic reward
-        consumes them; reference p2e_dv1_exploration.py:193-205)."""
-        from sheeprl_tpu.algos.dreamer_v2.agent import actor_sample
-
-        def step(carry, k):
-            z, h, latent = carry
-            pre = agent.actor.apply({"params": actor_params}, jax.lax.stop_gradient(latent))
-            a = actor_sample(agent, pre, jax.random.fold_in(k, 1))
-            h = agent._recurrent(wm, z, a, h)
-            _, z = agent._transition(wm, h, k)
-            latent = jnp.concatenate([z, h], axis=-1)
-            return (z, h, latent), (latent, a)
-
-        latent0 = jnp.concatenate([z0, h0], axis=-1)
-        keys = jax.random.split(key, horizon)
-        _, (latents, actions) = jax.lax.scan(step, (z0, h0, latent0), keys)
-        return latents, actions
 
     def exploration_reward(latents, actions, wm, params):
         ens_in = jax.lax.stop_gradient(jnp.concatenate([latents, actions], axis=-1))
@@ -361,7 +342,7 @@ def main(fabric, cfg: Dict[str, Any]):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         buffer_cls=SequentialReplayBuffer,
     )
-    if state is not None and cfg.buffer.checkpoint and "rb" in state:
+    if state is not None and "rb" in state:
         rb = state["rb"]
 
     train_phase = make_train_phase(agent, ensembles, cfg, txs)

@@ -398,7 +398,7 @@ def main(fabric, cfg: Dict[str, Any]):
         )
     else:
         raise ValueError(f"Unrecognized buffer type: {buffer_type}")
-    if state is not None and cfg.buffer.checkpoint and "rb" in state:
+    if state is not None and "rb" in state:
         rb = state["rb"]
 
     train_phase = make_train_phase(agent, ensembles, cfg, txs)

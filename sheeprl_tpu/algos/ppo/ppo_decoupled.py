@@ -1,0 +1,439 @@
+"""PPO, decoupled (actor–learner MPMD) training — capability parity with
+sheeprl/algos/ppo/ppo_decoupled.py:33-670.
+
+TPU-native topology: the reference splits torch ranks into a rank-0 player and a
+trainer DDP group, moving data as pickled-object scatters and weights as a flattened
+parameter broadcast. Here the split is **device-role based inside one controller
+process**: the player runs on the host CPU backend (envs are host-side anyway) in
+the main thread; the learner owns the accelerator mesh and runs in its own thread.
+The two planes become explicit channels with the reference's blocking semantics:
+
+- data plane  — a depth-1 queue of host rollout blocks (the reference's
+  ``scatter_object_list`` of pickled chunks, ppo_decoupled.py:294-299); under dp the
+  learner shards the block over the mesh ``data`` axis (the trainer-group DDP);
+- weight plane — a depth-1 queue carrying the updated params pytree (the
+  reference's flattened-parameter broadcast, ppo_decoupled.py:302-305): the player
+  BLOCKS on it before the next rollout, preserving the synchronous alternation.
+
+On a multi-host pod the same roles map to env-hosts + a learner slice with the
+host object channel (parallel/distributed.py) as the data plane."""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from functools import partial
+from typing import Any, Dict, Optional
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import build_agent, policy_output
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
+
+
+def _trainer_loop(
+    fabric,
+    cfg,
+    agent,
+    params,
+    data_q: "queue.Queue",
+    params_q: "queue.Queue",
+    error: Dict[str, Any],
+):
+    """Learner role (reference trainer(), ppo_decoupled.py:368-620): consume rollout
+    blocks, run the fused epochs×minibatches program on the mesh, publish params."""
+    try:
+        world_size = fabric.world_size
+        total_num_envs = int(cfg.env.num_envs * world_size)
+        loss_reduction = cfg.algo.loss_reduction
+        vf_coef = float(cfg.algo.vf_coef)
+        clip_vloss = bool(cfg.algo.clip_vloss)
+        normalize_advantages = bool(cfg.algo.normalize_advantages)
+        global_bs = min(
+            int(cfg.algo.per_rank_batch_size * world_size),
+            int(cfg.algo.rollout_steps * total_num_envs),
+        )
+        num_rows = int(cfg.algo.rollout_steps * total_num_envs)
+        num_minibatches = -(-num_rows // global_bs)
+        is_continuous = agent.is_continuous
+        actions_dim = agent.actions_dim
+        cnn_keys = list(cfg.algo.cnn_keys.encoder)
+        obs_keys = cnn_keys + list(cfg.algo.mlp_keys.encoder)
+
+        policy_steps_per_iter = int(total_num_envs * cfg.algo.rollout_steps)
+        total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+        from sheeprl_tpu.algos.ppo.ppo import _build_optimizer
+
+        tx = _build_optimizer(cfg, total_iters)
+        opt_state = tx.init(params)
+
+        def loss_fn(params, batch, clip_coef, ent_coef):
+            norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
+            actor_outs, new_values = agent.apply({"params": params}, norm_obs)
+            out = policy_output(
+                actor_outs, new_values, jax.random.PRNGKey(0), actions_dim, is_continuous,
+                actions=batch["actions"],
+            )
+            advantages = batch["advantages"]
+            if normalize_advantages:
+                advantages = normalize_tensor(advantages)
+            pg_loss = policy_loss(out["logprob"], batch["logprobs"], advantages, clip_coef, loss_reduction)
+            v_loss = value_loss(
+                out["values"], batch["values"], batch["returns"], clip_coef, clip_vloss, loss_reduction
+            )
+            ent_loss = entropy_loss(out["entropy"], loss_reduction)
+            return pg_loss + vf_coef * v_loss + ent_coef * ent_loss, (pg_loss, v_loss, ent_loss)
+
+        @jax.jit
+        def train_phase(params, opt_state, flat, train_key, clip_coef, ent_coef):
+            def epoch_body(carry, epoch_key):
+                params, opt_state = carry
+                perm = jax.random.permutation(epoch_key, num_rows)
+                pad = num_minibatches * global_bs - num_rows
+                if pad > 0:
+                    perm = jnp.concatenate([perm, perm[:pad]])
+                mb_idx = perm[: num_minibatches * global_bs].reshape(num_minibatches, global_bs)
+
+                def mb_body(carry, idx):
+                    params, opt_state = carry
+                    batch = {k: jnp.take(v, idx, axis=0) for k, v in flat.items()}
+                    grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
+                        params, batch, clip_coef, ent_coef
+                    )
+                    updates, opt_state = tx.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state), jnp.stack([pg, vl, ent])
+
+                (params, opt_state), losses = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
+                return (params, opt_state), losses.mean(axis=0)
+
+            epoch_keys = jax.random.split(train_key, cfg.algo.update_epochs)
+            (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
+            return params, opt_state, losses.mean(axis=0)
+
+        if world_size > 1:
+            params = fabric.replicate_pytree(params)
+            opt_state = fabric.replicate_pytree(opt_state)
+
+        key = jax.random.PRNGKey(cfg.seed + 1)
+        while True:
+            msg = data_q.get()
+            if msg is None:  # sentinel (reference :344: scatter of -1)
+                params_q.put(None)
+                return
+            flat, clip_coef, ent_coef, want_opt_state = msg
+            if world_size > 1:
+                flat = jax.device_put(flat, fabric.data_sharding)
+            key, train_key = jax.random.split(key)
+            params, opt_state, mean_losses = train_phase(
+                params, opt_state, flat, np.asarray(train_key), clip_coef, ent_coef
+            )
+            # weight plane: the player needs the full agent each round (it predicts
+            # values during the rollout); opt_state only crosses when a checkpoint
+            # is due
+            params_q.put(
+                (
+                    jax.tree_util.tree_map(np.asarray, params),
+                    jax.tree_util.tree_map(np.asarray, opt_state) if want_opt_state else None,
+                    np.asarray(mean_losses),
+                )
+            )
+    except BaseException as e:  # surface learner crashes to the player
+        error["exc"] = e
+        params_q.put(None)
+
+
+@register_algorithm(decoupled=True)
+def main(fabric, cfg: Dict[str, Any]):
+    initial_ent_coef = float(cfg.algo.ent_coef)
+    initial_clip_coef = float(cfg.algo.clip_coef)
+
+    rank = fabric.global_rank
+    world_size = fabric.world_size
+
+    if cfg.checkpoint.resume_from:
+        raise ValueError(
+            "The decoupled PPO implementation does not support resuming from a checkpoint; "
+            "use the coupled `ppo` algorithm to resume"
+        )
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
+    logger = get_logger(fabric, cfg, log_dir=log_dir)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict())
+    fabric.print(f"Log dir: {log_dir}")
+
+    total_num_envs = int(cfg.env.num_envs * world_size)
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * total_num_envs + i,
+                rank * total_num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(total_num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+    cnn_keys = cfg.algo.cnn_keys.encoder
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+
+    key = fabric.seed_everything(cfg.seed + rank)
+    key, agent_key = jax.random.split(key)
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    rb = ReplayBuffer(
+        cfg.algo.rollout_steps,
+        total_num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        obs_keys=obs_keys,
+    )
+
+    policy_steps_per_iter = int(total_num_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    last_log = 0
+    last_checkpoint = 0
+    policy_step = 0
+
+    # ---------------- channels + learner thread ----------------
+    data_q: "queue.Queue" = queue.Queue(maxsize=1)
+    params_q: "queue.Queue" = queue.Queue(maxsize=1)
+    error: Dict[str, Any] = {}
+    trainer = threading.Thread(
+        target=_trainer_loop,
+        args=(fabric, cfg, agent, params, data_q, params_q, error),
+        daemon=True,
+        name="ppo-learner",
+    )
+    trainer.start()
+
+    cpu_device = jax.devices("cpu")[0]
+    act_on_cpu = fabric.device.platform != "cpu"
+
+    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+    def policy_step_fn(params, obs: Dict[str, jax.Array], step_key):
+        norm_obs = normalize_obs(obs, cnn_keys, obs_keys)
+        norm_obs = {k: v.astype(jnp.float32) for k, v in norm_obs.items()}
+        actor_outs, values = agent.apply({"params": params}, norm_obs)
+        out = policy_output(actor_outs, values, step_key, actions_dim, is_continuous)
+        if is_continuous:
+            real_actions = out["actions"]
+        else:
+            split = jnp.split(out["actions"], np.cumsum(actions_dim)[:-1].tolist(), axis=-1)
+            real_actions = jnp.stack([s.argmax(axis=-1) for s in split], axis=-1)
+        return out, real_actions
+
+    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+    def get_values(params, obs: Dict[str, jax.Array]):
+        norm_obs = normalize_obs(obs, cnn_keys, obs_keys)
+        norm_obs = {k: v.astype(jnp.float32) for k, v in norm_obs.items()}
+        _, values = agent.apply({"params": params}, norm_obs)
+        return values
+
+    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+    def gae_fn(data, next_values):
+        returns, advantages = gae(
+            data["rewards"],
+            data["values"],
+            data["dones"],
+            next_values,
+            cfg.algo.rollout_steps,
+            cfg.algo.gamma,
+            cfg.algo.gae_lambda,
+        )
+        flat = {k: v.reshape(-1, *v.shape[2:]) for k, v in data.items()}
+        flat["returns"] = returns.reshape(-1, 1)
+        flat["advantages"] = advantages.reshape(-1, 1)
+        return flat
+
+    act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
+    if act_on_cpu:
+        key = jax.device_put(key, cpu_device)
+
+    ent_coef = initial_ent_coef
+    clip_coef = initial_clip_coef
+    opt_state_host: Optional[Any] = None
+    params_host = jax.tree_util.tree_map(np.asarray, params)
+
+    step_data: Dict[str, np.ndarray] = {}
+    next_obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = next_obs[k][np.newaxis]
+
+    for iter_num in range(1, total_iters + 1):
+        with timer("Time/env_interaction_time"):
+            for _ in range(cfg.algo.rollout_steps):
+                policy_step += total_num_envs
+                obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
+                key, step_key = jax.random.split(key)
+                out, real_actions = policy_step_fn(act_params, obs_host, step_key)
+                real_actions_np = np.asarray(real_actions)
+                if is_continuous:
+                    env_actions = real_actions_np.reshape(envs.action_space.shape)
+                else:
+                    env_actions = real_actions_np.reshape(
+                        (total_num_envs, -1) if is_multidiscrete else (total_num_envs,)
+                    )
+
+                obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                dones = np.logical_or(terminated, truncated).reshape(total_num_envs, 1).astype(np.float32)
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, 1)
+
+                final_obs_arr = info.get("final_observation", info.get("final_obs"))
+                truncated_envs = np.nonzero(truncated)[0]
+                if final_obs_arr is not None and len(truncated_envs) > 0:
+                    real_next_obs = {
+                        k: np.stack(
+                            [np.asarray(final_obs_arr[i][k], dtype=np.float32) for i in truncated_envs]
+                        )
+                        for k in obs_keys
+                    }
+                    vals = np.asarray(get_values(act_params, real_next_obs)).reshape(len(truncated_envs))
+                    rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(-1, 1)
+
+                step_data["dones"] = dones[np.newaxis]
+                step_data["values"] = np.asarray(out["values"], np.float32)[np.newaxis]
+                step_data["actions"] = np.asarray(out["actions"], np.float32)[np.newaxis]
+                step_data["logprobs"] = np.asarray(out["logprob"], np.float32)[np.newaxis]
+                step_data["rewards"] = rewards[np.newaxis]
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                next_obs = obs
+                for k in obs_keys:
+                    step_data[k] = obs[k][np.newaxis]
+
+                ep_info = info.get("final_info", info)
+                if "episode" in ep_info:
+                    ep = ep_info["episode"]
+                    mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
+                    rews, lens = ep["r"][mask], ep["l"][mask]
+                    if aggregator and not aggregator.disabled and len(rews) > 0:
+                        aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                        aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+
+        # GAE on the player (reference ppo_decoupled.py:277-289), then ship the block
+        obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
+        next_values = np.asarray(get_values(act_params, obs_host))
+        data = {k: np.asarray(rb[k]) for k in rb.buffer.keys()}
+        flat = jax.tree_util.tree_map(np.asarray, gae_fn(data, next_values))
+
+        with timer("Time/train_time"):
+            data_q.put((flat, clip_coef, ent_coef))
+            # weight plane: BLOCK until the learner finishes (reference :302)
+            msg = params_q.get()
+            if msg is None:
+                if "exc" in error:
+                    raise error["exc"]
+                break
+            params_host, opt_state_host, mean_losses = msg
+            act_params = (
+                jax.device_put(params_host, cpu_device) if act_on_cpu else params_host
+            )
+            if aggregator and not aggregator.disabled:
+                aggregator.update("Loss/policy_loss", float(mean_losses[0]))
+                aggregator.update("Loss/value_loss", float(mean_losses[1]))
+                aggregator.update("Loss/entropy_loss", float(mean_losses[2]))
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
+        ):
+            metrics_dict = aggregator.compute() if aggregator else {}
+            if logger is not None:
+                logger.log_metrics(metrics_dict, policy_step)
+                timers = timer.to_dict(reset=False)
+                if timers.get("Time/train_time", 0) > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                        policy_step,
+                    )
+                if timers.get("Time/env_interaction_time", 0) > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (policy_step - last_log)
+                            / max(timers["Time/env_interaction_time"], 1e-9)
+                        },
+                        policy_step,
+                    )
+            timer.to_dict(reset=True)
+            if aggregator:
+                aggregator.reset()
+            last_log = policy_step
+
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(
+                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(
+                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params_host,
+                "optimizer": opt_state_host,
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            fabric.call(
+                "on_checkpoint_player",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+            )
+
+    # sentinel → learner exits (reference :344)
+    data_q.put(None)
+    trainer.join(timeout=60)
+    if "exc" in error:
+        raise error["exc"]
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(agent.apply, jax.tree_util.tree_map(jnp.asarray, act_params), fabric, cfg, log_dir)
+    if logger is not None:
+        logger.finalize()

@@ -1,25 +1,21 @@
-"""SAC, coupled training (capability parity with sheeprl/algos/sac/sac.py:85-427).
+"""SAC, decoupled (actor–learner MPMD) training — capability parity with
+sheeprl/algos/sac/sac_decoupled.py:33-588.
 
-TPU-native structure:
-- the act path is a tiny jitted sampler pinned to the host CPU backend (envs are
-  host-side; the reference pays a per-step ``.cpu().numpy()`` sync, sac.py:259-262);
-- each iteration's ``per_rank_gradient_steps`` critic/actor/alpha updates run as ONE
-  jitted device program: the replay batch is sampled as ``[G, B, ...]`` on the host,
-  uploaded once, and a ``lax.scan`` walks the G gradient steps (the replay-ratio
-  governor ``Ratio`` stays host-side, reference sac.py:301-309);
-- under dp the batch axis is sharded over the mesh ``data`` axis and XLA inserts the
-  gradient psum (replacing DDP allreduce + the explicit log-alpha all_reduce at
-  reference sac.py:74);
-- target-critic EMA is a pure pytree lerp inside the same program (reference
-  qfs_target_ema, agent.py:262-268).
-"""
+Same TPU-native topology as the decoupled PPO module: the player owns the envs and
+the replay buffer on the host (CPU backend act path, reference player():33-353); the
+learner owns the accelerator mesh in its own thread and runs the fused G-step SAC
+program (reference trainer():356-545). The data plane ships sampled replay blocks
+(the reference's pickled scatter, sac_decoupled.py:243-257); the weight plane
+returns the actor params, blocking the player like the reference's flattened-actor
+broadcast (sac_decoupled.py:266-272)."""
 
 from __future__ import annotations
 
 import os
+import queue
+import threading
 import warnings
-from functools import partial
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import gymnasium as gym
 import jax
@@ -40,12 +36,124 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
-@register_algorithm()
+def _trainer_loop(fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error):
+    try:
+        world_size = fabric.world_size
+        gamma = float(cfg.algo.gamma)
+        tau = float(cfg.algo.tau)
+        num_critics = int(cfg.algo.critic.n)
+        policy_steps_per_iter = int(cfg.env.num_envs * world_size)
+        target_period = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
+        action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
+        action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
+
+        actor_tx = instantiate(cfg.algo.actor.optimizer)
+        critic_tx = instantiate(cfg.algo.critic.optimizer)
+        alpha_tx = instantiate(cfg.algo.alpha.optimizer)
+        opt_state = {
+            "actor": actor_tx.init(params["actor"]),
+            "critic": critic_tx.init(params["critic"]),
+            "alpha": alpha_tx.init(params["log_alpha"]),
+        }
+
+        def critic_loss_fn(critic_params, other, batch, step_key):
+            next_obs = batch["next_observations"]
+            mean, std = actor.apply({"params": other["actor"]}, next_obs)
+            next_actions, next_logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+            target_q = critic.apply({"params": other["target_critic"]}, next_obs, next_actions)
+            alpha = jnp.exp(other["log_alpha"])
+            min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
+            next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
+            qf_values = critic.apply({"params": critic_params}, batch["observations"], batch["actions"])
+            return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+
+        def actor_loss_fn(actor_params, other, batch, step_key):
+            mean, std = actor.apply({"params": actor_params}, batch["observations"])
+            actions, logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+            qf_values = critic.apply({"params": other["critic"]}, batch["observations"], actions)
+            min_qf = jnp.min(qf_values, axis=-1, keepdims=True)
+            alpha = jnp.exp(jax.lax.stop_gradient(other["log_alpha"]))
+            return policy_loss(alpha, logprobs, min_qf), logprobs
+
+        def alpha_loss_fn(log_alpha, logprobs):
+            return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
+
+        @jax.jit
+        def train_phase(params, opt_state, data, iter_num, train_key):
+            do_ema = (iter_num % target_period) == 0
+
+            def step(carry, inp):
+                params, opt_state = carry
+                batch, k = inp
+                k_critic, k_actor = jax.random.split(k)
+                qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k_critic)
+                updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
+                params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+                opt_state = {**opt_state, "critic": new_copt}
+                params = {
+                    **params,
+                    "target_critic": jax.tree_util.tree_map(
+                        lambda t, c: jnp.where(do_ema, t * (1 - tau) + c * tau, t),
+                        params["target_critic"],
+                        params["critic"],
+                    ),
+                }
+                (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+                    params["actor"], params, batch, k_actor
+                )
+                updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+                params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+                opt_state = {**opt_state, "actor": new_aopt}
+                al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
+                updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
+                params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
+                opt_state = {**opt_state, "alpha": new_alopt}
+                return (params, opt_state), jnp.stack([qf_loss, a_loss, al_loss])
+
+            G = data["rewards"].shape[0]
+            keys = jax.random.split(train_key, G)
+            (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (data, keys))
+            return params, opt_state, losses.mean(axis=0)
+
+        if world_size > 1:
+            params = fabric.replicate_pytree(params)
+            opt_state = fabric.replicate_pytree(opt_state)
+
+        key = jax.random.PRNGKey(cfg.seed + 1)
+        while True:
+            msg = data_q.get()
+            if msg is None:
+                params_q.put(None)
+                return
+            data, iter_num = msg
+            if world_size > 1:
+                data = jax.device_put(data, fabric.sharding(None, "data"))
+            key, train_key = jax.random.split(key)
+            params, opt_state, mean_losses = train_phase(
+                params, opt_state, data, jnp.asarray(iter_num), np.asarray(train_key)
+            )
+            params_q.put(
+                (
+                    jax.tree_util.tree_map(np.asarray, params),
+                    jax.tree_util.tree_map(np.asarray, opt_state),
+                    np.asarray(mean_losses),
+                )
+            )
+    except BaseException as e:
+        error["exc"] = e
+        params_q.put(None)
+
+
+@register_algorithm(decoupled=True)
 def main(fabric, cfg: Dict[str, Any]):
     rank = fabric.global_rank
     world_size = fabric.world_size
 
-    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    if cfg.checkpoint.resume_from:
+        raise ValueError(
+            "The decoupled SAC implementation does not support resuming from a checkpoint; "
+            "use the coupled `sac` algorithm to resume"
+        )
 
     if len(cfg.algo.cnn_keys.encoder) > 0:
         warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
@@ -78,42 +186,15 @@ def main(fabric, cfg: Dict[str, Any]):
     observation_space = envs.single_observation_space
     if not isinstance(action_space, gym.spaces.Box):
         raise ValueError("Only continuous action space is supported for the SAC agent")
-    if not isinstance(observation_space, gym.spaces.Dict):
-        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
-    if len(cfg.algo.mlp_keys.encoder) == 0:
-        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
-    for k in cfg.algo.mlp_keys.encoder:
-        if len(observation_space[k].shape) > 1:
-            raise ValueError(
-                "Only environments with vector-only observations are supported by the SAC agent. "
-                f"The observation with key '{k}' has shape {observation_space[k].shape}. "
-                f"Provided environment: {cfg.env.id}"
-            )
-    if cfg.metric.log_level > 0:
-        fabric.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
     mlp_keys = cfg.algo.mlp_keys.encoder
 
     key = fabric.seed_everything(cfg.seed + rank)
     key, agent_key = jax.random.split(key)
-    actor, critic, params = build_agent(
-        fabric, cfg, observation_space, action_space, agent_key, state["agent"] if state else None
-    )
+    actor, critic, params = build_agent(fabric, cfg, observation_space, action_space, agent_key, None)
     act_dim = int(np.prod(action_space.shape))
     target_entropy = -float(act_dim)
     action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
     action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
-
-    # three optimizers, one per parameter group (reference sac.py:151-173)
-    actor_tx = instantiate(cfg.algo.actor.optimizer)
-    critic_tx = instantiate(cfg.algo.critic.optimizer)
-    alpha_tx = instantiate(cfg.algo.alpha.optimizer)
-    opt_state = {
-        "actor": actor_tx.init(params["actor"]),
-        "critic": critic_tx.init(params["critic"]),
-        "alpha": alpha_tx.init(params["log_alpha"]),
-    }
-    if state is not None:
-        opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
 
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
@@ -130,47 +211,29 @@ def main(fabric, cfg: Dict[str, Any]):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         obs_keys=("observations",),
     )
-    if state is not None and "rb" in state:
-        rb = state["rb"]
 
-    # counters (reference sac.py:200-226)
-    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
-    policy_step = state["iter_num"] * cfg.env.num_envs if state is not None else 0
-    last_log = state["last_log"] if state is not None else 0
-    last_checkpoint = state["last_checkpoint"] if state is not None else 0
     policy_steps_per_iter = int(total_num_envs)
     total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
     learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
     prefill_steps = learning_starts - int(learning_starts > 0)
-    if state is not None:
-        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
-        learning_starts += start_iter
-        prefill_steps += start_iter
-
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
-    if state is not None:
-        ratio.load_state_dict(state["ratio"])
-
-    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
-        warnings.warn(
-            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
-            f"policy_steps_per_iter value ({policy_steps_per_iter})."
-        )
-    if cfg.checkpoint.every % policy_steps_per_iter != 0:
-        warnings.warn(
-            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
-            f"policy_steps_per_iter value ({policy_steps_per_iter})."
-        )
-
-    # ---------------- jitted programs ----------------
-    gamma = float(cfg.algo.gamma)
-    tau = float(cfg.algo.tau)
-    num_critics = int(cfg.algo.critic.n)
-    target_period = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
     sample_next_obs = bool(cfg.buffer.sample_next_obs)
+
+    data_q: "queue.Queue" = queue.Queue(maxsize=1)
+    params_q: "queue.Queue" = queue.Queue(maxsize=1)
+    error: Dict[str, Any] = {}
+    trainer = threading.Thread(
+        target=_trainer_loop,
+        args=(fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error),
+        daemon=True,
+        name="sac-learner",
+    )
+    trainer.start()
 
     cpu_device = jax.devices("cpu")[0]
     act_on_cpu = fabric.device.platform != "cpu"
+
+    from functools import partial
 
     @partial(jax.jit, backend="cpu" if act_on_cpu else None)
     def act_fn(actor_params, obs: jax.Array, step_key):
@@ -178,85 +241,20 @@ def main(fabric, cfg: Dict[str, Any]):
         actions, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
         return actions
 
-    def critic_loss_fn(critic_params, other, batch, step_key):
-        next_obs = batch["next_observations"]
-        mean, std = actor.apply({"params": other["actor"]}, next_obs)
-        next_actions, next_logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
-        target_q = critic.apply({"params": other["target_critic"]}, next_obs, next_actions)
-        alpha = jnp.exp(other["log_alpha"])
-        min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
-        next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
-        qf_values = critic.apply({"params": critic_params}, batch["observations"], batch["actions"])
-        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
-
-    def actor_loss_fn(actor_params, other, batch, step_key):
-        mean, std = actor.apply({"params": actor_params}, batch["observations"])
-        actions, logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
-        qf_values = critic.apply({"params": other["critic"]}, batch["observations"], actions)
-        min_qf = jnp.min(qf_values, axis=-1, keepdims=True)
-        alpha = jnp.exp(jax.lax.stop_gradient(other["log_alpha"]))
-        return policy_loss(alpha, logprobs, min_qf), logprobs
-
-    def alpha_loss_fn(log_alpha, logprobs):
-        return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
-
-    @jax.jit
-    def train_phase(params, opt_state, data, iter_num, train_key):
-        """scan over the [G, B, ...] gradient-step axis: critic -> EMA -> actor -> alpha
-        (one fused device program per iteration; reference train(), sac.py:32-81)."""
-        # reference gates EMA on the iteration counter (sac.py:57-59 with update=iter_num)
-        do_ema = (iter_num % target_period) == 0
-
-        def step(carry, inp):
-            params, opt_state = carry
-            batch, k = inp
-            k_critic, k_actor = jax.random.split(k)
-
-            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k_critic)
-            updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
-            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
-            opt_state = {**opt_state, "critic": new_copt}
-            params = {
-                **params,
-                "target_critic": jax.tree_util.tree_map(
-                    lambda t, c: jnp.where(do_ema, t * (1 - tau) + c * tau, t),
-                    params["target_critic"],
-                    params["critic"],
-                ),
-            }
-
-            (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
-                params["actor"], params, batch, k_actor
-            )
-            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
-            opt_state = {**opt_state, "actor": new_aopt}
-
-            al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
-            updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
-            params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
-            opt_state = {**opt_state, "alpha": new_alopt}
-
-            return (params, opt_state), jnp.stack([qf_loss, a_loss, al_loss])
-
-        G = data["rewards"].shape[0]
-        keys = jax.random.split(train_key, G)
-        (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (data, keys))
-        return params, opt_state, losses.mean(axis=0)
-
-    if world_size > 1:
-        params = fabric.replicate_pytree(params)
-        opt_state = fabric.replicate_pytree(opt_state)
     act_params = jax.device_put(params["actor"], cpu_device) if act_on_cpu else params["actor"]
+    params_host = jax.tree_util.tree_map(np.asarray, params)
+    opt_state_host: Optional[Any] = None
     if act_on_cpu:
         key = jax.device_put(key, cpu_device)
 
-    # ---------------- main loop ----------------
+    policy_step = 0
+    last_log = 0
+    last_checkpoint = 0
     cumulative_per_rank_gradient_steps = 0
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
 
-    for iter_num in range(start_iter, total_iters + 1):
+    for iter_num in range(1, total_iters + 1):
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time"):
@@ -267,7 +265,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 key, step_key = jax.random.split(key)
                 actions = np.asarray(act_fn(act_params, flat_obs, step_key))
             next_obs, rewards, terminated, truncated, infos = envs.step(
-                actions.reshape(envs.action_space.shape)
+                np.asarray(actions).reshape(envs.action_space.shape)
             )
             rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, -1)
 
@@ -280,7 +278,6 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
                 aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
-        # real next obs for done envs (reference sac.py:281-289)
         real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
         final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
         if final_obs_arr is not None:
@@ -294,7 +291,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
         step_data["terminated"] = np.asarray(terminated).reshape(1, total_num_envs, -1).astype(np.float32)
         step_data["truncated"] = np.asarray(truncated).reshape(1, total_num_envs, -1).astype(np.float32)
-        step_data["actions"] = actions.reshape(1, total_num_envs, -1).astype(np.float32)
+        step_data["actions"] = np.asarray(actions).reshape(1, total_num_envs, -1).astype(np.float32)
         step_data["observations"] = np.concatenate(
             [np.asarray(obs[k]).reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
         ).astype(np.float32)[np.newaxis]
@@ -305,7 +302,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
         obs = next_obs
 
-        # train (reference sac.py:299-324): Ratio decides G; one upload, one program
         if iter_num >= learning_starts:
             per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
             if per_rank_gradient_steps > 0:
@@ -316,22 +312,25 @@ def main(fabric, cfg: Dict[str, Any]):
                         sample_next_obs=sample_next_obs,
                     )
                     data = {k: np.asarray(v, dtype=np.float32) for k, v in sample.items()}
-                    if world_size > 1:
-                        data = jax.device_put(data, fabric.sharding(None, "data"))
-                    key, train_key = jax.random.split(key)
-                    params, opt_state, mean_losses = train_phase(
-                        params, opt_state, data, jnp.asarray(iter_num), np.asarray(train_key)
+                    # data plane: ship the replay block to the learner (reference
+                    # scatter, sac_decoupled.py:243-257) and BLOCK on the weight plane
+                    data_q.put((data, iter_num))
+                    msg = params_q.get()
+                    if msg is None:
+                        if "exc" in error:
+                            raise error["exc"]
+                        break
+                    params_host, opt_state_host, mean_losses = msg
+                    act_params = (
+                        jax.device_put(params_host["actor"], cpu_device)
+                        if act_on_cpu
+                        else params_host["actor"]
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                    if act_on_cpu:
-                        act_params = jax.device_put(params["actor"], cpu_device)
-                    else:
-                        act_params = params["actor"]
                     if aggregator and not aggregator.disabled:
-                        losses_np = np.asarray(mean_losses)
-                        aggregator.update("Loss/value_loss", losses_np[0])
-                        aggregator.update("Loss/policy_loss", losses_np[1])
-                        aggregator.update("Loss/alpha_loss", losses_np[2])
+                        aggregator.update("Loss/value_loss", float(mean_losses[0]))
+                        aggregator.update("Loss/policy_loss", float(mean_losses[1]))
+                        aggregator.update("Loss/alpha_loss", float(mean_losses[2]))
 
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
@@ -365,8 +364,8 @@ def main(fabric, cfg: Dict[str, Any]):
         ):
             last_checkpoint = policy_step
             ckpt_state = {
-                "agent": params,
-                "opt_state": opt_state,
+                "agent": params_host,
+                "opt_state": opt_state_host,
                 "ratio": ratio.state_dict(),
                 "iter_num": iter_num * world_size,
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,
@@ -374,14 +373,19 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_checkpoint": last_checkpoint,
             }
             fabric.call(
-                "on_checkpoint_coupled",
+                "on_checkpoint_player",
                 ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    data_q.put(None)
+    trainer.join(timeout=60)
+    if "exc" in error:
+        raise error["exc"]
+
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
-        test(actor.apply, params["actor"], fabric, cfg, log_dir)
+        test(actor.apply, jax.tree_util.tree_map(jnp.asarray, params_host["actor"]), fabric, cfg, log_dir)
     if logger is not None:
         logger.finalize()

@@ -424,3 +424,32 @@ def test_p2e_exploration_then_finetuning(standard_args, version):
         + [f"exp=p2e_dv{version}_finetuning", f"checkpoint.exploration_ckpt_path={ckpt}"]
         + _p2e_tiny(version)
     )
+
+
+def test_ppo_decoupled(standard_args, devices):
+    _run(
+        standard_args
+        + [
+            "exp=ppo_decoupled",
+            "env=dummy",
+            f"fabric.devices={devices}",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+        ]
+    )
+
+
+def test_sac_decoupled(standard_args, devices):
+    _run(
+        standard_args
+        + [
+            "exp=sac_decoupled",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            f"fabric.devices={devices}",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.per_rank_batch_size=4",
+        ]
+    )
