@@ -46,6 +46,8 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_exploration",
     "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_finetuning",
     "sheeprl_tpu.algos.p2e_dv1.evaluate",
+    "sheeprl_tpu.algos.offline_dreamer.offline_dreamer",
+    "sheeprl_tpu.algos.offline_dreamer.evaluate",
 ]
 
 import importlib  # noqa: E402

@@ -368,13 +368,14 @@ class PlayerDV1:
             wm = params["world_model"]
             embedded = agent_ref.encoder.apply({"params": wm["encoder"]}, obs)
             h = agent_ref._recurrent(wm, z, a, h)
-            k_repr, k_act, k_expl = jax.random.split(key, 3)
+            # chain key advanced in-program (saves ~0.5 ms/step of host dispatch)
+            key, k_repr, k_act, k_expl = jax.random.split(key, 4)
             _, z = agent_ref._representation(wm, h, embedded, k_repr)
             latent = jnp.concatenate([z, h], axis=-1)
             pre = agent_ref.actor.apply({"params": params["actor"]}, latent)
             actions = actor_sample(agent_ref, pre, k_act, greedy=greedy)
             actions = add_exploration_noise(agent_ref, actions, k_expl, expl_amount)
-            return actions, h, z
+            return actions, h, z, key
 
         self._step = jax.jit(_step, static_argnames=("greedy",))
 
@@ -392,10 +393,11 @@ class PlayerDV1:
 
     def get_actions(
         self, params: Dict, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, expl_amount: float = 0.0
-    ) -> jax.Array:
-        actions, self.recurrent_state, self.stochastic_state = self._step(
+    ):
+        """Returns ``(actions, key)`` — the advanced PRNG chain key."""
+        actions, self.recurrent_state, self.stochastic_state, key = self._step(
             params, obs, self.actions, self.recurrent_state, self.stochastic_state, key, greedy,
             jnp.asarray(expl_amount, jnp.float32),
         )
         self.actions = actions
-        return actions
+        return actions, key

@@ -396,19 +396,17 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                     )
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
-                key, step_key = jax.random.split(key)
-                actions = np.asarray(
-                    player.get_actions(
-                        # p2e finetuning acts with the exploration actor during the
-                        # prefill, then switches to the (trained) task actor
-                        {**params, "actor": exploration_actor_params}
-                        if exploration_actor_params is not None and iter_num <= learning_starts
-                        else params,
-                        jobs,
-                        step_key,
-                        expl_amount=expl_amount(policy_step),
-                    )
+                actions, key = player.get_actions(
+                    # p2e finetuning acts with the exploration actor during the
+                    # prefill, then switches to the (trained) task actor
+                    {**params, "actor": exploration_actor_params}
+                    if exploration_actor_params is not None and iter_num <= learning_starts
+                    else params,
+                    jobs,
+                    key,
+                    expl_amount=expl_amount(policy_step),
                 )
+                actions = np.asarray(actions)
                 if is_continuous:
                     real_actions = actions
                 else:

@@ -91,11 +91,11 @@ def test(player, params, fabric, cfg: Dict[str, Any], log_dir: str, test_name: s
     key = jax.random.PRNGKey(cfg.seed)
     actions_dim = player.agent.actions_dim
     while not done:
-        key, step_key = jax.random.split(key)
         jobs = prepare_obs(
             fabric, obs, cnn_keys=cfg.algo.cnn_keys.encoder, mlp_keys=cfg.algo.mlp_keys.encoder, num_envs=1
         )
-        actions = np.asarray(player.get_actions(params, jobs, step_key, greedy=greedy))
+        actions, key = player.get_actions(params, jobs, key, greedy=greedy)
+        actions = np.asarray(actions)
         if player.agent.is_continuous:
             real_actions = actions[0]
         else:

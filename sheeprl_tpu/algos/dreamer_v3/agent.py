@@ -773,15 +773,17 @@ class PlayerDV3:
         agent_ref = self.agent
 
         def _step(params, obs: Dict[str, jax.Array], a, h, z, key, greedy: bool):
+            # the PRNG chain advances inside the jitted program: an un-jitted
+            # per-step jax.random.split costs ~0.5 ms of host dispatch
+            key, k_repr, k_act = jax.random.split(key, 3)
             wm = params["world_model"]
             embedded = agent_ref.encoder.apply({"params": wm["encoder"]}, obs)
             h = agent_ref._recurrent(wm, z, a, h)
-            k_repr, k_act = jax.random.split(key)
             _, z = agent_ref._representation(wm, h, embedded, k_repr)
             latent = jnp.concatenate([z, h], axis=-1)
             pre = agent_ref.actor.apply({"params": params["actor"]}, latent)
             actions = actor_sample(agent_ref, pre, k_act, greedy=greedy)
-            return actions, h, z
+            return actions, h, z, key
 
         self._step = jax.jit(_step, static_argnames=("greedy",))
 
@@ -799,9 +801,10 @@ class PlayerDV3:
             self.recurrent_state = self.recurrent_state.at[idx].set(h0)
             self.stochastic_state = self.stochastic_state.at[idx].set(z0)
 
-    def get_actions(self, params: Dict, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False) -> jax.Array:
-        actions, self.recurrent_state, self.stochastic_state = self._step(
+    def get_actions(self, params: Dict, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False):
+        """Returns ``(actions, key)`` — the advanced PRNG chain key."""
+        actions, self.recurrent_state, self.stochastic_state, key = self._step(
             params, obs, self.actions, self.recurrent_state, self.stochastic_state, key, greedy
         )
         self.actions = actions
-        return actions
+        return actions, key

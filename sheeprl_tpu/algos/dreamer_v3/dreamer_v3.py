@@ -56,9 +56,13 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, compute_lambda_values, save_configs
 
 
-def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
+def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx, world_latent_hook=None):
     """Build the jitted multi-gradient-step train program. Returns
-    train_phase(params, opt_state, moments_state, data, cum_steps, key)."""
+    train_phase(params, opt_state, moments_state, data, cum_steps, key).
+
+    ``world_latent_hook(wm_params, latents, key) -> (head_latents, extra_loss,
+    extra_metrics)`` lets forks transform the latent the world-model heads consume and
+    add loss terms (offline_dreamer's CEM bottleneck); None keeps plain DV3."""
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
@@ -79,6 +83,7 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
     )
 
     def world_loss_fn(wm_params, batch, key):
+        key, hook_key = jax.random.split(jnp.asarray(key))
         batch_obs = {k: batch[k] / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: batch[k] for k in mlp_keys})
         is_first = batch["is_first"].at[0].set(jnp.ones_like(batch["is_first"][0]))
@@ -92,6 +97,9 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
             wm_params, embedded, actions, is_first, key
         )
         latents = jnp.concatenate([zs, hs], axis=-1)
+        extra_loss, extra_metrics = 0.0, {}
+        if world_latent_hook is not None:
+            latents, extra_loss, extra_metrics = world_latent_hook(wm_params, latents, hook_key)
         recon = agent.observation_model.apply({"params": wm_params["observation_model"]}, latents)
         obs_lps = {
             k: MSEDistribution(recon[k], dims=len(recon[k].shape[2:])).log_prob(batch_obs[k])
@@ -109,7 +117,7 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
         cont_lp = Independent(BernoulliSafeMode(logits=cont_logits), 1).log_prob(
             1.0 - batch["terminated"]
         )
-        loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+        rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
             obs_lps,
             reward_lp,
             prior_logits,
@@ -128,6 +136,7 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
             lp = jax.nn.log_softmax(shaped, axis=-1)
             return -jnp.sum(jnp.exp(lp) * lp, axis=(-2, -1)).mean()
 
+        loss = rec_loss + extra_loss
         metrics = {
             "Loss/world_model_loss": loss,
             "Loss/observation_loss": observation_loss,
@@ -138,6 +147,7 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
             "State/post_entropy": _cat_entropy(jax.lax.stop_gradient(post_logits)),
             "State/prior_entropy": _cat_entropy(jax.lax.stop_gradient(prior_logits)),
         }
+        metrics.update(extra_metrics)
         return loss, (zs, hs, metrics)
 
     def actor_loss_fn(actor_params, params, zs, hs, true_continue, moments_state, key):
@@ -248,8 +258,22 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx):
     return train_phase
 
 
-@register_algorithm()
-def main(fabric, cfg: Dict[str, Any]):
+def run_dreamer(
+    fabric,
+    cfg: Dict[str, Any],
+    *,
+    build_agent_fn=None,
+    player_cls=None,
+    make_train_phase_fn=None,
+    test_fn=None,
+):
+    """The full Dreamer-V3 training loop, with the agent/player/train-phase factories
+    injectable so forks with the same loop shape (offline_dreamer's CBWM, reference
+    offline_dreamer.py:446-866) reuse it instead of copying ~400 lines."""
+    build_agent_fn = build_agent_fn or build_agent
+    player_cls = player_cls or PlayerDV3
+    make_train_phase_fn = make_train_phase_fn or make_train_phase
+    test_fn = test_fn or test
     rank = fabric.global_rank
     world_size = fabric.world_size
 
@@ -325,7 +349,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     key = fabric.seed_everything(cfg.seed + rank)
     key, agent_key = jax.random.split(key)
-    agent, params = build_agent(
+    agent, params = build_agent_fn(
         fabric,
         actions_dim,
         is_continuous,
@@ -334,7 +358,7 @@ def main(fabric, cfg: Dict[str, Any]):
         agent_key,
         state["agent"] if state else None,
     )
-    player = PlayerDV3(agent, num_envs, cnn_keys, mlp_keys)
+    player = player_cls(agent, num_envs, cnn_keys, mlp_keys)
 
     # three optimizers with per-group clipping (reference dreamer_v3.py:525-538)
     def _tx(opt_cfg, clip):
@@ -376,7 +400,7 @@ def main(fabric, cfg: Dict[str, Any]):
     if state is not None and "rb" in state:
         rb = state["rb"]
 
-    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+    train_phase = make_train_phase_fn(agent, cfg, world_tx, actor_tx, critic_tx)
 
     # counters (reference dreamer_v3.py:571-597)
     start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
@@ -439,8 +463,8 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
-                key, step_key = jax.random.split(key)
-                actions = np.asarray(player.get_actions(params, jobs, step_key))
+                actions, key = player.get_actions(params, jobs, key)
+                actions = np.asarray(actions)
                 if is_continuous:
                     real_actions = actions
                 else:
@@ -618,6 +642,11 @@ def main(fabric, cfg: Dict[str, Any]):
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
-        test(player, params, fabric, cfg, log_dir, greedy=False)
+        test_fn(player, params, fabric, cfg, log_dir, greedy=False)
     if logger is not None:
         logger.finalize()
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    return run_dreamer(fabric, cfg)

@@ -1,0 +1,43 @@
+"""Offline Dreamer evaluation entrypoint (reference:
+sheeprl/algos/offline_dreamer/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+
+from sheeprl_tpu.algos.offline_dreamer.agent import PlayerODV3, build_agent
+from sheeprl_tpu.algos.offline_dreamer.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["offline_dreamer"])
+def evaluate(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logdir = cfg.get("log_dir", "logs/evaluation")
+    env = make_env(cfg, cfg.seed, 0, logdir, "test")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    env.close()
+    agent, params = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        jax.random.PRNGKey(cfg.seed),
+        state["agent"] if state else None,
+    )
+    player = PlayerODV3(agent, 1, cfg.algo.cnn_keys.encoder, cfg.algo.mlp_keys.encoder)
+    test(player, params, fabric, cfg, logdir, greedy=False)

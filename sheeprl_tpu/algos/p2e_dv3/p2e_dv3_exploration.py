@@ -542,8 +542,8 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
-                key, step_key = jax.random.split(key)
-                actions = np.asarray(player.get_actions(player_params(params, actor_type), jobs, step_key))
+                actions, key = player.get_actions(player_params(params, actor_type), jobs, key)
+                actions = np.asarray(actions)
                 if is_continuous:
                     real_actions = actions
                 else:

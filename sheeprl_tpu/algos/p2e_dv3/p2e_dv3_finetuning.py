@@ -205,8 +205,8 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
 
         with timer("Time/env_interaction_time"):
             jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
-            key, step_key = jax.random.split(key)
-            actions = np.asarray(player.get_actions(_act_params(), jobs, step_key))
+            actions, key = player.get_actions(_act_params(), jobs, key)
+            actions = np.asarray(actions)
             if is_continuous:
                 real_actions = actions
             else:

@@ -173,10 +173,13 @@ def main(fabric, cfg: Dict[str, Any]):
     act_on_cpu = fabric.device.platform != "cpu"
 
     @partial(jax.jit, backend="cpu" if act_on_cpu else None)
-    def act_fn(actor_params, obs: jax.Array, step_key):
+    def act_fn(actor_params, obs: jax.Array, key):
+        # PRNG chain advances inside the jitted program (un-jitted per-step
+        # jax.random.split costs ~0.5 ms of host dispatch)
+        key, step_key = jax.random.split(key)
         mean, std = actor.apply({"params": actor_params}, obs)
         actions, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
-        return actions
+        return actions, key
 
     def critic_loss_fn(critic_params, other, batch, step_key):
         next_obs = batch["next_observations"]
@@ -264,8 +267,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 actions = envs.action_space.sample()
             else:
                 flat_obs = prepare_obs(fabric, obs, mlp_keys=mlp_keys, num_envs=total_num_envs)
-                key, step_key = jax.random.split(key)
-                actions = np.asarray(act_fn(act_params, flat_obs, step_key))
+                actions, key = act_fn(act_params, flat_obs, key)
+                actions = np.asarray(actions)
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )

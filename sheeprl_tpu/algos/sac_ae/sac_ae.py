@@ -194,11 +194,14 @@ def main(fabric, cfg: Dict[str, Any]):
         return out
 
     @jax.jit
-    def act_fn(params, obs: Dict[str, jax.Array], step_key):
+    def act_fn(params, obs: Dict[str, jax.Array], key):
+        # PRNG chain advances inside the jitted program (un-jitted per-step
+        # jax.random.split costs ~0.5 ms of host dispatch)
+        key, step_key = jax.random.split(key)
         feat = agent.features(params, obs, side="actor")
         mean, std = agent.actor.apply({"params": params["actor"]}, feat)
         actions, _ = squash_and_logprob(mean, std, step_key, agent.action_scale, agent.action_bias)
-        return actions
+        return actions, key
 
     def critic_loss_fn(cg, params, batch, step_key):
         p = {**params, **cg}
@@ -332,8 +335,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 jobs = prepare_obs(
                     fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=total_num_envs
                 )
-                key, step_key = jax.random.split(key)
-                actions = np.asarray(act_fn(params, jobs, step_key))
+                actions, key = act_fn(params, jobs, key)
+                actions = np.asarray(actions)
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 np.asarray(actions).reshape(envs.action_space.shape)
             )

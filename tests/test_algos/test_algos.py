@@ -259,6 +259,46 @@ def test_dreamer_v3_devices2(standard_args):
     _run(standard_args + _DV3_TINY + ["fabric.devices=2"])
 
 
+_ODV3_TINY = [
+    "exp=offline_dreamer",
+    "env=dummy",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=1",
+    "algo.learning_starts=0",
+    "algo.replay_ratio=1",
+    "algo.horizon=8",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.cbm_model.n_concepts=3",
+    "algo.world_model.cbm_model.concept_bins=[2,2,2]",
+    "algo.world_model.cbm_model.emb_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.cnn_keys.decoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.mlp_keys.decoder=[state]",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_offline_dreamer(standard_args, env_id):
+    _run(standard_args + _ODV3_TINY + [f"env.id={env_id}"])
+
+
+def test_offline_dreamer_devices2(standard_args):
+    _run(standard_args + _ODV3_TINY + ["fabric.devices=2"])
+
+
+def test_offline_dreamer_no_cbm(standard_args):
+    """use_cbm=False degenerates to plain Dreamer-V3."""
+    _run(standard_args + _ODV3_TINY + ["algo.world_model.cbm_model.use_cbm=False"])
+
+
 _RPPO_TINY = [
     "exp=ppo_recurrent",
     "env=dummy",
