@@ -402,6 +402,23 @@ def run_dreamer(
 
     train_phase = make_train_phase_fn(agent, cfg, world_tx, actor_tx, critic_tx)
 
+    # Act/train device split: with the fabric on an accelerator the per-step player
+    # program runs on the host CPU backend (per-dispatch latency to a TPU dwarfs the
+    # one-frame forward; the reference pays per-step .cpu() syncs instead,
+    # dreamer_v3.py:630-664) while the fused multi-gradient-step train program runs
+    # on the accelerator. Only the player-visible params cross back per train call.
+    cpu_device = jax.devices("cpu")[0]
+    act_on_cpu = fabric.device.platform != "cpu"
+
+    def _act_view(p):
+        if not act_on_cpu:
+            return p
+        return jax.device_put({"world_model": p["world_model"], "actor": p["actor"]}, cpu_device)
+
+    act_params = _act_view(params)
+    if act_on_cpu:
+        key = jax.device_put(key, cpu_device)
+
     # counters (reference dreamer_v3.py:571-597)
     start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
     policy_step = state["iter_num"] * num_envs if state is not None else 0
@@ -440,7 +457,7 @@ def run_dreamer(
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    player.init_states(params)
+    player.init_states(act_params)
 
     cumulative_per_rank_gradient_steps = 0
     train_step = 0
@@ -463,7 +480,7 @@ def run_dreamer(
                     )
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
-                actions, key = player.get_actions(params, jobs, key)
+                actions, key = player.get_actions(act_params, jobs, key)
                 actions = np.asarray(actions)
                 if is_continuous:
                     real_actions = actions
@@ -542,7 +559,7 @@ def run_dreamer(
             step_data["terminated"][:, dones_idxes] = 0.0
             step_data["truncated"][:, dones_idxes] = 0.0
             step_data["is_first"][:, dones_idxes] = 1.0
-            player.init_states(params, dones_idxes)
+            player.init_states(act_params, dones_idxes)
 
         # train
         if iter_num >= learning_starts:
@@ -574,6 +591,7 @@ def run_dreamer(
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
+                    act_params = _act_view(params)
                     if aggregator and not aggregator.disabled:
                         for mk, mv in metrics.items():
                             aggregator.update(mk, float(np.asarray(mv)))

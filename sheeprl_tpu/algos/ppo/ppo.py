@@ -35,7 +35,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import epoch_permutation, gae, normalize_tensor, polynomial_decay, save_configs
 
 
 def _build_optimizer(cfg, total_iters: int) -> optax.GradientTransformation:
@@ -179,6 +179,7 @@ def main(fabric, cfg: Dict[str, Any]):
     global_bs = min(int(cfg.algo.per_rank_batch_size * world_size), int(cfg.algo.rollout_steps * total_num_envs))
     num_rows = int(cfg.algo.rollout_steps * total_num_envs)
     num_minibatches = -(-num_rows // global_bs)  # ceil: partial minibatches pad-wrap
+    share_data = bool(cfg.buffer.share_data)
 
     cpu_device = jax.devices("cpu")[0]
     act_on_cpu = fabric.device.platform != "cpu"
@@ -242,7 +243,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
         def epoch_body(carry, epoch_key):
             params, opt_state = carry
-            perm = jax.random.permutation(epoch_key, num_rows)
+            perm = epoch_permutation(epoch_key, num_rows, world_size, share_data)
             # pad (wrapping into the permutation) so every row is visited each epoch
             # even when num_rows is not a multiple of the global batch
             pad = num_minibatches * global_bs - num_rows

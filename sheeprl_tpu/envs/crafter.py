@@ -1,0 +1,57 @@
+"""Crafter adapter (capability parity with reference sheeprl/envs/crafter.py:17-66;
+crafter is optional — the module import is gated).
+
+Crafter is the BASELINE north-star XL workload: 64x64 rgb obs, 17 discrete actions,
+gym-0.x step API converted to terminated/truncated via the ``discount`` info field.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_CRAFTER_AVAILABLE
+
+if not _IS_CRAFTER_AVAILABLE:
+    raise ModuleNotFoundError("crafter is not installed: pip install crafter")
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import crafter
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class CrafterWrapper(gym.Env):
+    def __init__(self, id: str, screen_size: Union[int, Tuple[int, int]], seed: Optional[int] = None) -> None:
+        if id not in ("crafter_reward", "crafter_nonreward"):
+            raise ValueError(f"id must be crafter_reward or crafter_nonreward, got {id!r}")
+        size = (screen_size, screen_size) if isinstance(screen_size, int) else tuple(screen_size)
+        self._env = crafter.Env(size=size, seed=seed, reward=(id == "crafter_reward"))
+        inner = self._env.observation_space
+        self.observation_space = spaces.Dict(
+            {"rgb": spaces.Box(inner.low, inner.high, inner.shape, inner.dtype)}
+        )
+        self.action_space = spaces.Discrete(self._env.action_space.n)
+        self.reward_range = self._env.reward_range or (-np.inf, np.inf)
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+        self.render_mode = "rgb_array"
+        self.metadata = {"render_fps": 30}
+
+    def step(self, action: Any):
+        obs, reward, done, info = self._env.step(action)
+        # crafter signals a true terminal with discount==0; otherwise the episode hit
+        # its internal time limit (reference crafter.py:52-53)
+        terminated = done and info["discount"] == 0
+        truncated = done and info["discount"] != 0
+        return {"rgb": obs}, reward, terminated, truncated, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        self._env._seed = seed
+        obs = self._env.reset()
+        return {"rgb": obs}, {}
+
+    def render(self):
+        return self._env.render()
+
+    def close(self) -> None:
+        return

@@ -1,0 +1,132 @@
+"""DIAMBRA Arena adapter (capability parity with reference
+sheeprl/envs/diambra.py:22-145; the diambra SDK is optional).
+
+Normalizes the arena's Dict observation (Discrete/MultiDiscrete entries become int32
+Boxes so the whole dict flows through the pixel/vector pipeline) and forces the
+settings the framework owns (frame shape, single player, flatten).
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from sheeprl_tpu.utils.imports import _IS_DIAMBRA_AVAILABLE
+
+if not _IS_DIAMBRA_AVAILABLE:
+    raise ModuleNotFoundError("diambra is not installed: pip install diambra diambra-arena")
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import diambra
+import diambra.arena
+import gymnasium as gym
+import numpy as np
+from diambra.arena import EnvironmentSettings, WrappersSettings
+
+
+class DiambraWrapper(gym.Env):
+    def __init__(
+        self,
+        id: str,
+        action_space: str = "DISCRETE",
+        screen_size: Union[int, Tuple[int, int]] = 64,
+        grayscale: bool = False,
+        repeat_action: int = 1,
+        rank: int = 0,
+        diambra_settings: Optional[Dict[str, Any]] = None,
+        diambra_wrappers: Optional[Dict[str, Any]] = None,
+        render_mode: str = "rgb_array",
+        log_level: int = 0,
+        increase_performance: bool = True,
+    ) -> None:
+        if isinstance(screen_size, int):
+            screen_size = (screen_size,) * 2
+        if action_space not in ("DISCRETE", "MULTI_DISCRETE"):
+            raise ValueError(
+                "The valid values for the `action_space` attribute are "
+                f"'DISCRETE' or 'MULTI_DISCRETE', got {action_space}"
+            )
+        diambra_settings = dict(diambra_settings or {})
+        diambra_wrappers = dict(diambra_wrappers or {})
+        for owned in ("frame_shape", "n_players"):
+            if diambra_settings.pop(owned, None) is not None:
+                warnings.warn(f"The DIAMBRA {owned} setting is disabled")
+        role = diambra_settings.pop("role", None)
+        if role is not None and role not in ("P1", "P2"):
+            raise ValueError(f"The valid values for the `role` attribute are 'P1' or 'P2' or None, got {role}")
+        self._action_type = action_space.lower()
+
+        settings = EnvironmentSettings(
+            **{
+                **diambra_settings,
+                "game_id": id,
+                "action_space": getattr(diambra.arena.SpaceTypes, action_space, diambra.arena.SpaceTypes.DISCRETE),
+                "n_players": 1,
+                "role": getattr(diambra.arena.Roles, role, diambra.arena.Roles.P1) if role is not None else None,
+                "render_mode": render_mode,
+            }
+        )
+        if repeat_action > 1:
+            if "step_ratio" not in settings or settings["step_ratio"] > 1:
+                warnings.warn(
+                    f"step_ratio parameter modified to 1 because the sticky action is active ({repeat_action})"
+                )
+            settings["step_ratio"] = 1
+        for owned in ("frame_shape", "stack_frames", "dilation", "flatten"):
+            if diambra_wrappers.pop(owned, None) is not None:
+                warnings.warn(f"The DIAMBRA {owned} wrapper is disabled")
+        wrappers = WrappersSettings(
+            **{**diambra_wrappers, "flatten": True, "repeat_action": repeat_action}
+        )
+        if increase_performance:
+            settings.frame_shape = screen_size + (int(grayscale),)
+        else:
+            wrappers.frame_shape = screen_size + (int(grayscale),)
+        self._env = diambra.arena.make(
+            id, settings, wrappers, rank=rank, render_mode=render_mode, log_level=log_level
+        )
+
+        self.action_space = self._env.action_space
+        obs: Dict[str, gym.spaces.Space] = {}
+        for k, space in self._env.observation_space.spaces.items():
+            if isinstance(space, gym.spaces.Box):
+                obs[k] = space
+            elif isinstance(space, gym.spaces.Discrete):
+                obs[k] = gym.spaces.Box(0, space.n - 1, (1,), np.int32)
+            elif isinstance(space, gym.spaces.MultiDiscrete):
+                obs[k] = gym.spaces.Box(
+                    np.zeros_like(space.nvec), space.nvec - 1, (len(space.nvec),), np.int32
+                )
+            else:
+                raise RuntimeError(f"Invalid observation space, got: {type(space)}")
+        self.observation_space = gym.spaces.Dict(obs)
+        self.render_mode = render_mode
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            k: np.asarray(v).reshape(self.observation_space[k].shape) for k, v in obs.items()
+        }
+
+    def step(self, action):
+        if self._action_type == "discrete" and isinstance(action, np.ndarray):
+            action = action.squeeze().item()
+        obs, reward, terminated, truncated, infos = self._env.step(action)
+        infos["env_domain"] = "DIAMBRA"
+        return (
+            self._convert_obs(obs),
+            reward,
+            terminated or infos.get("env_done", False),
+            truncated,
+            infos,
+        )
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs, infos = self._env.reset(seed=seed, options=options)
+        infos["env_domain"] = "DIAMBRA"
+        return self._convert_obs(obs), infos
+
+    def render(self, **kwargs):
+        return self._env.render()
+
+    def close(self) -> None:
+        self._env.close()

@@ -1,0 +1,156 @@
+"""DeepMind Control Suite adapter (capability parity with reference
+sheeprl/envs/dmc.py:49-244; dm_control is optional — the module import is gated).
+
+Exposes every dm_control task as a gymnasium env with a Dict observation holding an
+``rgb`` render and/or a flattened ``state`` vector, a [-1, 1]-normalized continuous
+action space, and dm_env discount-based terminated/truncated semantics.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_DMC_AVAILABLE
+
+if not _IS_DMC_AVAILABLE:
+    raise ModuleNotFoundError("dm_control is not installed: pip install dm_control")
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+from dm_control import suite
+from dm_env import specs
+from gymnasium import spaces
+
+
+def _spec_to_box(spec_list, dtype) -> spaces.Box:
+    lows, highs = [], []
+    for s in spec_list:
+        dim = int(np.prod(s.shape))
+        if isinstance(s, specs.BoundedArray):
+            lows.append(np.broadcast_to(s.minimum, (dim,)).astype(np.float64))
+            highs.append(np.broadcast_to(s.maximum, (dim,)).astype(np.float64))
+        elif isinstance(s, specs.Array):
+            lows.append(np.full(dim, -np.inf))
+            highs.append(np.full(dim, np.inf))
+        else:
+            raise ValueError(f"Unrecognized spec: {type(s)}")
+    return spaces.Box(
+        np.concatenate(lows).astype(dtype), np.concatenate(highs).astype(dtype), dtype=dtype
+    )
+
+
+def _flatten(obs: Dict[Any, Any]) -> np.ndarray:
+    return np.concatenate(
+        [np.atleast_1d(np.asarray(v)).ravel() for v in obs.values()], axis=0
+    )
+
+
+class DMCWrapper(gym.Env):
+    """dm_control task as a gymnasium env.
+
+    Observation: Dict with ``rgb`` (from_pixels) and/or ``state`` (from_vectors).
+    A dm_env episode ends with discount==0 → terminated; discount==1 at the final
+    step → truncated (time limit), matching reference dmc.py:228-229.
+    """
+
+    def __init__(
+        self,
+        domain_name: str,
+        task_name: str,
+        from_pixels: bool = False,
+        from_vectors: bool = True,
+        height: int = 84,
+        width: int = 84,
+        camera_id: int = 0,
+        task_kwargs: Optional[Dict[Any, Any]] = None,
+        environment_kwargs: Optional[Dict[Any, Any]] = None,
+        channels_first: bool = True,
+        visualize_reward: bool = False,
+        seed: Optional[int] = None,
+    ):
+        if not (from_vectors or from_pixels):
+            raise ValueError(
+                "'from_vectors' and 'from_pixels' must not be both False: "
+                f"got {from_vectors} and {from_pixels} respectively."
+            )
+        self._from_pixels = from_pixels
+        self._from_vectors = from_vectors
+        self._height = height
+        self._width = width
+        self._camera_id = camera_id
+        self._channels_first = channels_first
+
+        task_kwargs = dict(task_kwargs or {})
+        task_kwargs.pop("random", None)  # seeding goes through reset()
+        self._env = suite.load(
+            domain_name=domain_name,
+            task_name=task_name,
+            task_kwargs=task_kwargs,
+            visualize_reward=visualize_reward,
+            environment_kwargs=environment_kwargs,
+        )
+
+        self._true_action_space = _spec_to_box([self._env.action_spec()], np.float32)
+        self.action_space = spaces.Box(
+            -1.0, 1.0, shape=self._true_action_space.shape, dtype=np.float32
+        )
+        reward_space = _spec_to_box([self._env.reward_spec()], np.float32)
+        self.reward_range = (float(reward_space.low.item()), float(reward_space.high.item()))
+
+        obs_space: Dict[str, spaces.Space] = {}
+        if from_pixels:
+            shape = (3, height, width) if channels_first else (height, width, 3)
+            obs_space["rgb"] = spaces.Box(0, 255, shape=shape, dtype=np.uint8)
+        if from_vectors:
+            obs_space["state"] = _spec_to_box(self._env.observation_spec().values(), np.float64)
+        self.observation_space = spaces.Dict(obs_space)
+        self.state_space = _spec_to_box(self._env.observation_spec().values(), np.float64)
+        self.current_state: Optional[np.ndarray] = None
+        self.render_mode = "rgb_array"
+        self.metadata = {}
+        self._seed_spaces(seed)
+
+    def _seed_spaces(self, seed: Optional[int]) -> None:
+        self.action_space.seed(seed)
+        self._true_action_space.seed(seed)
+        self.observation_space.seed(seed)
+
+    def _obs(self, time_step) -> Dict[str, np.ndarray]:
+        obs = {}
+        if self._from_pixels:
+            rgb = self.render()
+            obs["rgb"] = rgb.transpose(2, 0, 1).copy() if self._channels_first else rgb
+        if self._from_vectors:
+            obs["state"] = _flatten(time_step.observation)
+        return obs
+
+    def _denormalize(self, action: np.ndarray) -> np.ndarray:
+        low, high = self._true_action_space.low, self._true_action_space.high
+        action = (np.asarray(action, np.float64) + 1.0) / 2.0  # [-1,1] → [0,1]
+        return (action * (high - low) + low).astype(np.float32)
+
+    def step(self, action):
+        time_step = self._env.step(self._denormalize(action))
+        self.current_state = _flatten(time_step.observation)
+        info = {
+            "discount": time_step.discount,
+            "internal_state": self._env.physics.get_state().copy(),
+        }
+        terminated = bool(not time_step.first() and time_step.last() and time_step.discount == 0)
+        truncated = bool(time_step.last() and time_step.discount == 1)
+        return self._obs(time_step), time_step.reward or 0.0, terminated, truncated, info
+
+    def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        # dm_control draws task randomness from a numpy RandomState owned by the task
+        self._env.task._random = np.random.RandomState(seed)
+        time_step = self._env.reset()
+        self.current_state = _flatten(time_step.observation)
+        return self._obs(time_step), {}
+
+    def render(self, camera_id: Optional[int] = None) -> np.ndarray:
+        return self._env.physics.render(
+            height=self._height, width=self._width, camera_id=camera_id or self._camera_id
+        )
+
+    def close(self) -> None:
+        self._env.close()

@@ -1,0 +1,265 @@
+"""MineDojo adapter (capability parity with reference sheeprl/envs/minedojo.py:56-307;
+minedojo is optional).
+
+Re-expresses MineDojo's 8-slot functional action space as a 3-head MultiDiscrete
+(movement-camera-functional macro, craft target, equip/place/destroy target),
+flattens the inventory/equipment into per-item vectors, and exposes the action masks
+the Dreamer-V3 MinedojoActor consumes (``mask_action_type`` / ``mask_equip_place`` /
+``mask_destroy`` / ``mask_craft_smelt``).
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINEDOJO_AVAILABLE
+
+if not _IS_MINEDOJO_AVAILABLE:
+    raise ModuleNotFoundError("minedojo is not installed: pip install minedojo")
+
+import copy
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import minedojo
+import minedojo.tasks
+import numpy as np
+from minedojo.sim import ALL_CRAFT_SMELT_ITEMS, ALL_ITEMS
+
+N_ALL_ITEMS = len(ALL_ITEMS)
+ITEM_ID_TO_NAME = dict(enumerate(ALL_ITEMS))
+ITEM_NAME_TO_ID = {name: i for i, name in enumerate(ALL_ITEMS)}
+
+# 19 macro actions over MineDojo's raw 8-slot action vector
+# (slot 0 fwd/back, 1 left/right, 2 jump/sneak/sprint, 3 pitch, 4 yaw,
+#  5 functional, 6 craft arg, 7 inventory arg); 12 is the camera no-op bin.
+_MACROS = [
+    [0, 0, 0, 12, 12, 0, 0, 0],  # no-op
+    [1, 0, 0, 12, 12, 0, 0, 0],  # forward
+    [2, 0, 0, 12, 12, 0, 0, 0],  # back
+    [0, 1, 0, 12, 12, 0, 0, 0],  # left
+    [0, 2, 0, 12, 12, 0, 0, 0],  # right
+    [1, 0, 1, 12, 12, 0, 0, 0],  # jump + forward
+    [1, 0, 2, 12, 12, 0, 0, 0],  # sneak + forward
+    [1, 0, 3, 12, 12, 0, 0, 0],  # sprint + forward
+    [0, 0, 0, 11, 12, 0, 0, 0],  # pitch -15
+    [0, 0, 0, 13, 12, 0, 0, 0],  # pitch +15
+    [0, 0, 0, 12, 11, 0, 0, 0],  # yaw -15
+    [0, 0, 0, 12, 13, 0, 0, 0],  # yaw +15
+    [0, 0, 0, 12, 12, 1, 0, 0],  # use
+    [0, 0, 0, 12, 12, 2, 0, 0],  # drop
+    [0, 0, 0, 12, 12, 3, 0, 0],  # attack
+    [0, 0, 0, 12, 12, 4, 0, 0],  # craft
+    [0, 0, 0, 12, 12, 5, 0, 0],  # equip
+    [0, 0, 0, 12, 12, 6, 0, 0],  # place
+    [0, 0, 0, 12, 12, 7, 0, 0],  # destroy
+]
+ACTION_MAP = {i: np.asarray(m) for i, m in enumerate(_MACROS)}
+
+
+def _item_key(name: str) -> str:
+    return "_".join(name.split(" "))
+
+
+class MineDojoWrapper(gym.Env):
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
+        **kwargs: Any,
+    ):
+        self._pitch_limits = pitch_limits
+        self._pos = kwargs.get("start_position", None)
+        self._break_speed_multiplier = kwargs.pop("break_speed_multiplier", 100)
+        # a >1 break-speed already shortens digging; sticky attack would overshoot
+        self._sticky_attack = 0 if self._break_speed_multiplier > 1 else (sticky_attack or 0)
+        self._sticky_jump = sticky_jump or 0
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        if self._pos is not None and not (pitch_limits[0] <= self._pos["pitch"] <= pitch_limits[1]):
+            raise ValueError(
+                f"The initial position must respect the pitch limits {pitch_limits}, given {self._pos['pitch']}"
+            )
+
+        # minedojo.make mutates the global task-spec table; snapshot + restore so
+        # repeated construction stays deterministic (reference minedojo.py:43,115)
+        task_specs = copy.deepcopy(minedojo.tasks.ALL_TASKS_SPECS)
+        self._env = minedojo.make(
+            task_id=id,
+            image_size=(height, width),
+            world_seed=seed,
+            fast_reset=True,
+            break_speed_multiplier=self._break_speed_multiplier,
+            **kwargs,
+        )
+        minedojo.tasks.ALL_TASKS_SPECS = copy.deepcopy(task_specs)
+
+        self._inventory: Dict[str, list] = {}
+        self._inventory_names: Optional[np.ndarray] = None
+        self._inventory_max = np.zeros(N_ALL_ITEMS)
+        self.action_space = gym.spaces.MultiDiscrete(
+            np.array([len(ACTION_MAP), len(ALL_CRAFT_SMELT_ITEMS), N_ALL_ITEMS])
+        )
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(0, 255, self._env.observation_space["rgb"].shape, np.uint8),
+                "inventory": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
+                "inventory_max": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
+                "inventory_delta": gym.spaces.Box(-np.inf, np.inf, (N_ALL_ITEMS,), np.float32),
+                "equipment": gym.spaces.Box(0.0, 1.0, (N_ALL_ITEMS,), np.int32),
+                "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+                "mask_action_type": gym.spaces.Box(0, 1, (len(ACTION_MAP),), bool),
+                "mask_equip_place": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                "mask_destroy": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                "mask_craft_smelt": gym.spaces.Box(0, 1, (len(ALL_CRAFT_SMELT_ITEMS),), bool),
+            }
+        )
+        self.render_mode = "rgb_array"
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
+        counts = np.zeros(N_ALL_ITEMS)
+        self._inventory = {}
+        self._inventory_names = np.array([_item_key(n) for n in inventory["name"].tolist()])
+        for slot, (name, quantity) in enumerate(zip(inventory["name"], inventory["quantity"])):
+            item = _item_key(name)
+            self._inventory.setdefault(item, []).append(slot)
+            counts[ITEM_NAME_TO_ID[item]] += 1 if item == "air" else quantity
+        self._inventory_max = np.maximum(counts, self._inventory_max)
+        return counts
+
+    def _convert_inventory_delta(self, delta: Dict[str, Any]) -> np.ndarray:
+        out = np.zeros(N_ALL_ITEMS)
+        for names_key, qty_key, sign in (
+            ("inc_name_by_craft", "inc_quantity_by_craft", 1),
+            ("dec_name_by_craft", "dec_quantity_by_craft", -1),
+            ("inc_name_by_other", "inc_quantity_by_other", 1),
+            ("dec_name_by_other", "dec_quantity_by_other", -1),
+        ):
+            for name, qty in zip(delta[names_key], delta[qty_key]):
+                out[ITEM_NAME_TO_ID[_item_key(name)]] += sign * qty
+        return out
+
+    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
+        out = np.zeros(N_ALL_ITEMS, dtype=np.int32)
+        out[ITEM_NAME_TO_ID[_item_key(equipment["name"][0])]] = 1
+        return out
+
+    def _convert_masks(self, masks: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        equip_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
+        destroy_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
+        for item, can_equip, can_destroy in zip(self._inventory_names, masks["equip"], masks["destroy"]):
+            idx = ITEM_NAME_TO_ID[item]
+            equip_mask[idx] = can_equip
+            destroy_mask[idx] = can_destroy
+        # functional-action availability: equip/place need an equipable item, destroy
+        # a destroyable one; the 12 movement/camera macros are always legal
+        masks["action_type"][5:7] *= bool(np.any(equip_mask))
+        masks["action_type"][7] *= bool(np.any(destroy_mask))
+        return {
+            "mask_action_type": np.concatenate((np.ones(12, dtype=bool), masks["action_type"][1:])),
+            "mask_equip_place": equip_mask,
+            "mask_destroy": destroy_mask,
+            "mask_craft_smelt": masks["craft_smelt"],
+        }
+
+    def _convert_action(self, action: np.ndarray) -> np.ndarray:
+        out = ACTION_MAP[int(action[0])].copy()
+        if self._sticky_attack:
+            if out[5] == 3:
+                self._sticky_attack_counter = self._sticky_attack - 1
+            elif self._sticky_attack_counter > 0 and out[5] == 0:
+                out[5] = 3
+                self._sticky_attack_counter -= 1
+            else:
+                self._sticky_attack_counter = 0
+        if self._sticky_jump:
+            if out[2] == 1:
+                self._sticky_jump_counter = self._sticky_jump - 1
+            elif self._sticky_jump_counter > 0 and out[0] == 0:
+                out[2] = 1
+                # keep moving while the sticky jump plays out
+                if out[0] == out[1] == 0:
+                    out[0] = 1
+                self._sticky_jump_counter -= 1
+            elif out[2] != 1:
+                self._sticky_jump_counter = 0
+        out[6] = int(action[1]) if out[5] == 4 else 0
+        if out[5] in (5, 6, 7):
+            out[7] = self._inventory[ITEM_ID_TO_NAME[int(action[2])]][0]
+        else:
+            out[7] = 0
+        return out
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            "rgb": obs["rgb"].copy(),
+            "inventory": self._convert_inventory(obs["inventory"]),
+            "inventory_max": self._inventory_max,
+            "inventory_delta": self._convert_inventory_delta(obs["delta_inv"]),
+            "equipment": self._convert_equipment(obs["equipment"]),
+            "life_stats": np.concatenate(
+                (obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["oxygen"])
+            ),
+            **self._convert_masks(obs["masks"]),
+        }
+
+    def _update_pos(self, obs: Dict[str, Any]) -> None:
+        loc = obs["location_stats"]
+        self._pos = {
+            "x": float(loc["pos"][0]),
+            "y": float(loc["pos"][1]),
+            "z": float(loc["pos"][2]),
+            "pitch": float(loc["pitch"].item()),
+            "yaw": float(loc["yaw"].item()),
+        }
+
+    def _life_info(self, obs: Dict[str, Any]) -> Dict[str, float]:
+        return {
+            "life": float(obs["life_stats"]["life"].item()),
+            "oxygen": float(obs["life_stats"]["oxygen"].item()),
+            "food": float(obs["life_stats"]["food"].item()),
+        }
+
+    def step(self, action: np.ndarray):
+        raw_action = action
+        action = self._convert_action(action)
+        # clamp the camera so the pitch never leaves the limits
+        next_pitch = self._pos["pitch"] + (action[3] - 12) * 15
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            action[3] = 12
+        obs, reward, done, info = self._env.step(action)
+        is_timelimit = info.get("TimeLimit.truncated", False)
+        self._update_pos(obs)
+        info.update(
+            {
+                "life_stats": self._life_info(obs),
+                "location_stats": copy.deepcopy(self._pos),
+                "action": raw_action.tolist(),
+                "biomeid": float(obs["location_stats"]["biome_id"].item()),
+            }
+        )
+        return self._convert_obs(obs), reward, done and not is_timelimit, done and is_timelimit, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs = self._env.reset()
+        self._update_pos(obs)
+        self._sticky_jump_counter = 0
+        self._sticky_attack_counter = 0
+        self._inventory_max = np.zeros(N_ALL_ITEMS)
+        return self._convert_obs(obs), {
+            "life_stats": self._life_info(obs),
+            "location_stats": copy.deepcopy(self._pos),
+            "biomeid": float(obs["location_stats"]["biome_id"].item()),
+        }
+
+    def render(self):
+        prev = self._env.unwrapped._prev_obs
+        return None if prev is None else prev["rgb"]
+
+    def close(self) -> None:
+        self._env.close()

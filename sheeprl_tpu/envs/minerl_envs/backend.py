@@ -1,0 +1,67 @@
+"""Base env-spec for the custom MineRL tasks (capability parity with reference
+sheeprl/envs/minerl_envs/backend.py:19-61; minerl==0.4.4 is optional).
+
+Provides the simple-embodiment observation/action surface (POV camera, location and
+life stats, 8 keyboard actions + camera) plus a Malmo break-speed multiplier so the
+obtain tasks are tractable without sticky attack.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError("minerl is not installed: pip install minerl==0.4.4")
+
+from abc import ABC
+from typing import List
+
+from minerl.herobraine.env_spec import EnvSpec
+from minerl.herobraine.hero import handler, handlers
+from minerl.herobraine.hero.handlers.translation import TranslationHandler
+from minerl.herobraine.hero.mc import INVERSE_KEYMAP
+
+SIMPLE_KEYBOARD_ACTION = ["forward", "back", "left", "right", "jump", "sneak", "sprint", "attack"]
+
+
+class BreakSpeedMultiplier(handler.Handler):
+    """Malmo agent-start handler scaling block-breaking speed (the diamond_env
+    trick; reference backend.py:53-61)."""
+
+    def __init__(self, multiplier: float = 1.0):
+        self.multiplier = multiplier
+
+    def to_string(self):
+        return f"break_speed({self.multiplier})"
+
+    def xml_template(self):
+        return "<BreakSpeedMultiplier>{{multiplier}}</BreakSpeedMultiplier>"
+
+
+class CustomSimpleEmbodimentEnvSpec(EnvSpec, ABC):
+    """Shared base of the custom navigate/obtain specs."""
+
+    def __init__(self, name, *args, resolution=(64, 64), break_speed: int = 100, **kwargs):
+        self.resolution = resolution
+        self.break_speed = break_speed
+        super().__init__(name, *args, **kwargs)
+
+    def create_agent_start(self) -> List[handler.Handler]:
+        return [BreakSpeedMultiplier(self.break_speed)]
+
+    def create_observables(self) -> List[TranslationHandler]:
+        return [
+            handlers.POVObservation(self.resolution),
+            handlers.ObservationFromCurrentLocation(),
+            handlers.ObservationFromLifeStats(),
+        ]
+
+    def create_actionables(self) -> List[TranslationHandler]:
+        return [
+            handlers.KeybasedCommandAction(k, v)
+            for k, v in INVERSE_KEYMAP.items()
+            if k in SIMPLE_KEYBOARD_ACTION
+        ] + [handlers.CameraAction()]
+
+    def create_monitors(self) -> List[TranslationHandler]:
+        return []

@@ -1,0 +1,99 @@
+"""Custom MineRL Navigate task spec (capability parity with reference
+sheeprl/envs/minerl_envs/navigate.py:18-139): reach a diamond block ~64 m away
+guided by a compass; optional dense distance shaping and extreme-hills variant.
+The Malmo time limit is disabled — truncation is owned by the framework's
+TimeLimit wrapper so terminated/truncated stay distinguishable.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError("minerl is not installed: pip install minerl==0.4.4")
+
+from typing import List
+
+import minerl.herobraine.hero.handlers as handlers
+from minerl.herobraine.hero.handler import Handler
+
+from sheeprl_tpu.envs.minerl_envs.backend import CustomSimpleEmbodimentEnvSpec
+
+
+class CustomNavigate(CustomSimpleEmbodimentEnvSpec):
+    def __init__(self, dense: bool, extreme: bool, *args, **kwargs):
+        suffix = ("Extreme" if extreme else "") + ("Dense" if dense else "")
+        self.dense, self.extreme = dense, extreme
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(f"CustomMineRLNavigate{suffix}-v0", *args, max_episode_steps=None, **kwargs)
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == ("navigateextreme" if self.extreme else "navigate")
+
+    def create_observables(self) -> List[Handler]:
+        return super().create_observables() + [
+            handlers.CompassObservation(angle=True, distance=False),
+            handlers.FlatInventoryObservation(["dirt"]),
+        ]
+
+    def create_actionables(self) -> List[Handler]:
+        return super().create_actionables() + [
+            handlers.PlaceBlock(["none", "dirt"], _other="none", _default="none")
+        ]
+
+    def create_rewardables(self) -> List[Handler]:
+        rewards: List[Handler] = [
+            handlers.RewardForTouchingBlockType(
+                [{"type": "diamond_block", "behaviour": "onceOnly", "reward": 100.0}]
+            )
+        ]
+        if self.dense:
+            rewards.append(handlers.RewardForDistanceTraveledToCompassTarget(reward_per_block=1.0))
+        return rewards
+
+    def create_agent_start(self) -> List[Handler]:
+        return super().create_agent_start() + [
+            handlers.SimpleInventoryAgentStart([dict(type="compass", quantity="1")])
+        ]
+
+    def create_agent_handlers(self) -> List[Handler]:
+        return [handlers.AgentQuitFromTouchingBlockType(["diamond_block"])]
+
+    def create_server_world_generators(self) -> List[Handler]:
+        if self.extreme:
+            return [handlers.BiomeGenerator(biome=3, force_reset=True)]
+        return [handlers.DefaultWorldGenerator(force_reset=True)]
+
+    def create_server_quit_producers(self) -> List[Handler]:
+        return [handlers.ServerQuitWhenAnyAgentFinishes()]
+
+    def create_server_decorators(self) -> List[Handler]:
+        return [
+            handlers.NavigationDecorator(
+                max_randomized_radius=64,
+                min_randomized_radius=64,
+                block="diamond_block",
+                placement="surface",
+                max_radius=8,
+                min_radius=0,
+                max_randomized_distance=8,
+                min_randomized_distance=0,
+                randomize_compass_location=True,
+            )
+        ]
+
+    def create_server_initial_conditions(self) -> List[Handler]:
+        return [
+            handlers.TimeInitialCondition(allow_passage_of_time=False, start_time=6000),
+            handlers.WeatherInitialCondition("clear"),
+            handlers.SpawningInitialCondition("false"),
+        ]
+
+    def get_docstring(self) -> str:
+        return (
+            "Navigate to a diamond block ~64 m from spawn using a compass observation; "
+            "+100 on reaching it" + (", plus per-tick distance shaping" if self.dense else "")
+        )
+
+    def determine_success_from_rewards(self, rewards: list) -> bool:
+        return sum(rewards) >= (160.0 if self.dense else 100.0)

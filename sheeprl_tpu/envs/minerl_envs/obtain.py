@@ -1,0 +1,162 @@
+"""Custom MineRL Obtain task specs (capability parity with reference
+sheeprl/envs/minerl_envs/obtain.py:23-326): the ObtainDiamond / ObtainIronPickaxe
+item-hierarchy tasks with GUI-free craft/smelt actions and milestone rewards.
+The Malmo time limit is disabled — truncation is owned by the framework's
+TimeLimit wrapper so terminated/truncated stay distinguishable.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError("minerl is not installed: pip install minerl==0.4.4")
+
+from typing import Dict, List, Union
+
+from minerl.herobraine.hero import handlers
+from minerl.herobraine.hero.handler import Handler
+
+from sheeprl_tpu.envs.minerl_envs.backend import CustomSimpleEmbodimentEnvSpec
+
+_NONE = "none"
+_OTHER = "other"
+
+_INVENTORY_ITEMS = [
+    "dirt", "coal", "torch", "log", "planks", "stick", "crafting_table",
+    "wooden_axe", "wooden_pickaxe", "stone", "cobblestone", "furnace",
+    "stone_axe", "stone_pickaxe", "iron_ore", "iron_ingot", "iron_axe", "iron_pickaxe",
+]
+_EQUIP_ITEMS = [
+    "air", "wooden_axe", "wooden_pickaxe", "stone_axe", "stone_pickaxe",
+    "iron_axe", "iron_pickaxe",
+]
+# milestone rewards along the diamond item hierarchy (reference obtain.py:183-196)
+_MILESTONES = [
+    ("log", 1), ("planks", 2), ("stick", 4), ("crafting_table", 4),
+    ("wooden_pickaxe", 8), ("cobblestone", 16), ("furnace", 32),
+    ("stone_pickaxe", 32), ("iron_ore", 64), ("iron_ingot", 128),
+    ("iron_pickaxe", 256),
+]
+
+
+def _camel(word: str) -> str:
+    return "".join(part.capitalize() for part in word.split("_"))
+
+
+class CustomObtain(CustomSimpleEmbodimentEnvSpec):
+    def __init__(
+        self,
+        target_item: str,
+        dense: bool,
+        reward_schedule: List[Dict[str, Union[str, int, float]]],
+        *args,
+        max_episode_steps=None,
+        **kwargs,
+    ):
+        self.target_item = target_item
+        self.dense = dense
+        self.reward_schedule = reward_schedule
+        name = f"CustomMineRLObtain{_camel(target_item)}{'Dense' if dense else ''}-v0"
+        super().__init__(*args, name=name, max_episode_steps=max_episode_steps, **kwargs)
+
+    def create_observables(self) -> List[Handler]:
+        return super().create_observables() + [
+            handlers.FlatInventoryObservation(_INVENTORY_ITEMS),
+            handlers.EquippedItemObservation(
+                items=_EQUIP_ITEMS + [_OTHER], _default="air", _other=_OTHER
+            ),
+        ]
+
+    def create_actionables(self) -> List[Handler]:
+        return super().create_actionables() + [
+            handlers.PlaceBlock(
+                [_NONE, "dirt", "stone", "cobblestone", "crafting_table", "furnace", "torch"],
+                _other=_NONE,
+                _default=_NONE,
+            ),
+            handlers.EquipAction([_NONE] + _EQUIP_ITEMS, _other=_NONE, _default=_NONE),
+            handlers.CraftAction(
+                [_NONE, "torch", "stick", "planks", "crafting_table"], _other=_NONE, _default=_NONE
+            ),
+            handlers.CraftNearbyAction(
+                [_NONE] + [i for i in _EQUIP_ITEMS if i != "air"] + ["furnace"],
+                _other=_NONE,
+                _default=_NONE,
+            ),
+            handlers.SmeltItemNearby([_NONE, "iron_ingot", "coal"], _other=_NONE, _default=_NONE),
+        ]
+
+    def create_rewardables(self) -> List[Handler]:
+        reward_handler = (
+            handlers.RewardForCollectingItems if self.dense else handlers.RewardForCollectingItemsOnce
+        )
+        return [reward_handler(self.reward_schedule or {self.target_item: 1})]
+
+    def create_agent_handlers(self) -> List[Handler]:
+        return [handlers.AgentQuitFromPossessingItem([dict(type="diamond", amount=1)])]
+
+    def create_server_world_generators(self) -> List[Handler]:
+        return [handlers.DefaultWorldGenerator(force_reset=True)]
+
+    def create_server_quit_producers(self) -> List[Handler]:
+        return [handlers.ServerQuitWhenAnyAgentFinishes()]
+
+    def create_server_decorators(self) -> List[Handler]:
+        return []
+
+    def create_server_initial_conditions(self) -> List[Handler]:
+        return [
+            handlers.TimeInitialCondition(start_time=6000, allow_passage_of_time=True),
+            handlers.SpawningInitialCondition(allow_spawning=True),
+        ]
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == f"o_{self.target_item}"
+
+    def get_docstring(self) -> str:
+        return f"Obtain {self.target_item} through the item hierarchy; milestone rewards."
+
+    def determine_success_from_rewards(self, rewards: list) -> bool:
+        reward_values = [s["reward"] for s in self.reward_schedule]
+        max_missing = round(len(self.reward_schedule) * 0.1)
+        return len(set(rewards).intersection(reward_values)) >= len(reward_values) - max_missing
+
+
+def _schedule(extra: List[Dict] = ()) -> List[Dict]:
+    return [dict(type=t, amount=1, reward=r) for t, r in _MILESTONES] + list(extra)
+
+
+class CustomObtainDiamond(CustomObtain):
+    def __init__(self, dense: bool, *args, **kwargs):
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(
+            *args,
+            target_item="diamond",
+            dense=dense,
+            reward_schedule=_schedule([dict(type="diamond", amount=1, reward=1024)]),
+            max_episode_steps=None,
+            **kwargs,
+        )
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == "o_dia"
+
+
+class CustomObtainIronPickaxe(CustomObtain):
+    def __init__(self, dense: bool, *args, **kwargs):
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(
+            *args,
+            target_item="iron_pickaxe",
+            dense=dense,
+            reward_schedule=_schedule(),
+            max_episode_steps=None,
+            **kwargs,
+        )
+
+    def create_agent_handlers(self) -> List[Handler]:
+        return [handlers.AgentQuitFromCraftingItem([dict(type="iron_pickaxe", amount=1)])]
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == "o_iron"

@@ -1,0 +1,131 @@
+"""Robosuite / LIBERO adapter (capability parity with reference
+sheeprl/envs/robosuite.py:17-301; robosuite and libero are optional).
+
+Exposes a robosuite manipulation task (or a LIBERO bddl task) as a gymnasium env
+with a Dict observation: ``rgb`` (agentview camera) and/or ``state`` (robot
+proprioception), and a [-1, 1]-normalized continuous action space.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_ROBOSUITE_AVAILABLE
+
+if not _IS_ROBOSUITE_AVAILABLE:
+    raise ModuleNotFoundError("robosuite is not installed: pip install robosuite")
+
+import os
+from typing import Any, Dict, Optional
+
+import gymnasium as gym
+import numpy as np
+import robosuite as suite
+from gymnasium import spaces
+
+
+class RobosuiteWrapper(gym.Env):
+    def __init__(
+        self,
+        env_name: str,
+        env_config: str,
+        robot: str,
+        bddl_file: Optional[str] = None,
+        controller: Any = "OSC_POSE",
+        hard_reset: bool = False,
+        horizon: int = 500,
+        reward_scale: float = 1.0,
+        reward_shaping: bool = True,
+        ignore_done: bool = True,
+        has_renderer: bool = False,
+        has_offscreen_renderer: bool = False,
+        use_camera_obs: bool = False,
+        control_freq: int = 20,
+        channels_first: bool = True,
+    ):
+        make_args = dict(
+            env_configuration=env_config,
+            robots=[robot],
+            controller_configs=suite.controllers.load_controller_config(default_controller=controller),
+            hard_reset=hard_reset,
+            horizon=horizon,
+            reward_scale=reward_scale,
+            reward_shaping=reward_shaping,
+            ignore_done=ignore_done,
+            has_renderer=has_renderer,
+            has_offscreen_renderer=has_offscreen_renderer,
+            use_camera_obs=use_camera_obs,
+            control_freq=control_freq,
+        )
+        if bddl_file:
+            # LIBERO task described by a bddl file (reference robosuite.py:103-109)
+            import libero.libero.envs.bddl_utils as BDDLUtils
+            from libero.libero.envs import TASK_MAPPING
+
+            if not os.path.exists(bddl_file):
+                raise FileNotFoundError(bddl_file)
+            problem_info = BDDLUtils.get_problem_info(bddl_file)
+            self._env = TASK_MAPPING[problem_info["problem_name"]](
+                bddl_file_name=bddl_file, **make_args
+            )
+        else:
+            self._env = suite.make(env_name=env_name, **make_args)
+
+        first_obs = self._env.reset()
+        obs_spec = self._env.observation_spec()
+        self._channels_first = channels_first
+        self._from_pixels = bool(self._env.use_camera_obs)
+        self._from_vectors = "robot0_proprio-state" in obs_spec
+        self.name = f"{robot}_{type(self._env).__name__}"
+
+        obs_space: Dict[str, spaces.Space] = {}
+        if self._from_pixels:
+            h, w = first_obs["agentview_image"].shape[:2]
+            shape = (3, h, w) if channels_first else (h, w, 3)
+            obs_space["rgb"] = spaces.Box(0, 255, shape=shape, dtype=np.uint8)
+        for idx in range(len(self._env.robots)):
+            key = "state" if idx == 0 else f"state{idx}"
+            spec = obs_spec[f"robot{idx}_proprio-state"]
+            obs_space[key] = spaces.Box(-np.inf, np.inf, shape=spec.shape, dtype=np.float64)
+        self.observation_space = spaces.Dict(obs_space)
+        self.state_space = obs_space.get("state")
+
+        a_low, a_high = self._env.action_spec
+        self._true_action_space = spaces.Box(a_low, a_high, dtype=np.float32)
+        self.action_space = spaces.Box(-1.0, 1.0, shape=self._true_action_space.shape, dtype=np.float32)
+        self.reward_range = (0, self._env.reward_scale)
+        self.render_mode = "rgb_array"
+        self.current_state = first_obs
+
+    def _denormalize(self, action: np.ndarray) -> np.ndarray:
+        low, high = self._true_action_space.low, self._true_action_space.high
+        action = (np.asarray(action, np.float64) + 1.0) / 2.0
+        return (action * (high - low) + low).astype(np.float32)
+
+    def _obs(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        obs = {}
+        if self._from_pixels:
+            rgb = raw["agentview_image"]
+            obs["rgb"] = rgb.transpose(2, 0, 1).copy() if self._channels_first else rgb
+        if self._from_vectors:
+            for idx in range(len(self._env.robots)):
+                key = "state" if idx == 0 else f"state{idx}"
+                obs[key] = raw[f"robot{idx}_proprio-state"]
+        return obs
+
+    def step(self, action):
+        raw, reward, done, info = self._env.step(self._denormalize(action))
+        self.current_state = raw
+        info["internal_state"] = raw
+        # robosuite's flat `done` covers both the horizon and task success; without a
+        # success flag it is reported as truncation (the horizon is the common case)
+        return self._obs(raw), reward, False, bool(done), info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        raw = self._env.reset()
+        self.current_state = raw
+        return self._obs(raw), {}
+
+    def render(self):
+        return self._env._get_observations()["agentview_image"]
+
+    def close(self) -> None:
+        self._env.close()

@@ -1,0 +1,65 @@
+"""Super Mario Bros adapter (capability parity with reference
+sheeprl/envs/super_mario_bros.py:22-74; gym-super-mario-bros is optional).
+
+Wraps the nes-py env in a joypad action set and converts the gym-0.x done flag to
+terminated/truncated using the in-game timer.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_SUPER_MARIO_BROS_AVAILABLE
+
+if not _IS_SUPER_MARIO_BROS_AVAILABLE:
+    raise ModuleNotFoundError(
+        "gym-super-mario-bros is not installed: pip install gym-super-mario-bros==7.4.0"
+    )
+
+from typing import Any, Dict, Optional
+
+import gym_super_mario_bros as gsmb
+import gymnasium as gym
+import numpy as np
+from gym_super_mario_bros.actions import COMPLEX_MOVEMENT, RIGHT_ONLY, SIMPLE_MOVEMENT
+from nes_py.wrappers import JoypadSpace
+
+ACTION_SPACE_MAP = {"simple": SIMPLE_MOVEMENT, "right_only": RIGHT_ONLY, "complex": COMPLEX_MOVEMENT}
+
+
+class _JoypadSeedableReset(JoypadSpace):
+    """nes-py's JoypadSpace drops reset kwargs; forward them (reference
+    super_mario_bros.py:22-24)."""
+
+    def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        return self.env.reset(seed=seed, options=options)
+
+
+class SuperMarioBrosWrapper(gym.Env):
+    def __init__(self, id: str, action_space: str = "simple", render_mode: str = "rgb_array"):
+        env = gsmb.make(id)
+        self._env = _JoypadSeedableReset(env, ACTION_SPACE_MAP[action_space])
+        self.render_mode = render_mode
+        inner = env.observation_space
+        self.observation_space = gym.spaces.Dict(
+            {"rgb": gym.spaces.Box(inner.low, inner.high, inner.shape, inner.dtype)}
+        )
+        self.action_space = gym.spaces.Discrete(self._env.action_space.n)
+
+    def step(self, action):
+        if isinstance(action, np.ndarray):
+            action = action.squeeze().item()
+        obs, reward, done, info = self._env.step(action)
+        is_timelimit = info.get("time", False)
+        return {"rgb": obs.copy()}, reward, done and not is_timelimit, done and is_timelimit, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs = self._env.reset(seed=seed, options=options)
+        return {"rgb": obs.copy()}, {}
+
+    def render(self):
+        frame = self._env.render(mode=self.render_mode)
+        if self.render_mode == "rgb_array" and frame is not None:
+            return frame.copy()
+        return None
+
+    def close(self) -> None:
+        self._env.close()

@@ -166,8 +166,25 @@ class Fabric:
 
     def all_gather(self, tree: Any) -> Any:
         """Host-visible gather of per-device data (reference fabric.all_gather,
-        used for buffer.share_data at sheeprl/algos/ppo/ppo.py:362-369)."""
-        return jax.tree_util.tree_map(np.asarray, tree)
+        used for buffer.share_data at sheeprl/algos/ppo/ppo.py:362-369 and Moments
+        quantiles at dreamer_v3/utils.py:57).
+
+        For a fully-addressable array (single-host, any mesh sharding) this
+        materializes the complete logical value on the host. On a multi-host mesh the
+        local process only holds its shards — materializing would silently return
+        wrong data — so it raises and points at the host object channel instead.
+        """
+
+        def gather(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                raise RuntimeError(
+                    "all_gather of a non-addressable (multi-host) array: use "
+                    "jax.experimental.multihost_utils.process_allgather or the host "
+                    "object channel (sheeprl_tpu.parallel.distributed.host_allgather_object)"
+                )
+            return np.asarray(x)
+
+        return jax.tree_util.tree_map(gather, tree)
 
     # -- callbacks / io ------------------------------------------------------------
 

@@ -137,6 +137,33 @@ def compute_lambda_values(
 # ---------------------------------------------------------------------------------
 # misc numerics
 # ---------------------------------------------------------------------------------
+def epoch_permutation(
+    key: jax.Array, num_rows: int, world_size: int, share_data: bool
+) -> jax.Array:
+    """Row-visit order for one optimization epoch over a ``data``-axis-sharded rollout.
+
+    The TPU-native reading of the reference's ``buffer.share_data`` switch
+    (sheeprl/algos/ppo/ppo.py:40-50,362-369): with ``share_data`` each rank optimizes a
+    shard of the *globally shuffled* rollout (reference: ``fabric.all_gather`` +
+    ``DistributedSampler``) — here a global permutation whose gathers XLA turns into
+    ICI collectives; without it every device samples only its own rows (reference:
+    ``RandomSampler`` on local data) — here a per-shard permutation, so minibatch
+    gathers stay device-local and no collective is emitted for the data plane.
+
+    Rows are assumed contiguous per device shard (``device_put`` with a leading-axis
+    ``P("data")`` sharding). The returned order interleaves shards so every global
+    minibatch takes an equal slice of each device's rows.
+    """
+    if share_data or world_size == 1 or num_rows % world_size != 0:
+        return jax.random.permutation(key, num_rows)
+    rows_per_shard = num_rows // world_size
+    keys = jax.random.split(key, world_size)
+    local = jnp.stack(
+        [jax.random.permutation(k, rows_per_shard) for k in keys]
+    ) + jnp.arange(world_size)[:, None] * rows_per_shard
+    return local.T.reshape(-1)
+
+
 def normalize_tensor(x: jax.Array, eps: float = 1e-8, mask: Optional[jax.Array] = None) -> jax.Array:
     if mask is None:
         return (x - x.mean()) / (x.std() + eps)

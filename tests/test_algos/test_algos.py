@@ -34,6 +34,23 @@ def test_ppo(standard_args, devices):
     )
 
 
+def test_ppo_share_data_devices2(standard_args):
+    """buffer.share_data=True at devices=2: global reshuffle across device shards."""
+    _run(
+        standard_args
+        + [
+            "exp=ppo",
+            "env=dummy",
+            "fabric.devices=2",
+            "buffer.share_data=True",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=2",
+        ]
+    )
+
+
 def test_ppo_pixel(standard_args, devices):
     _run(
         standard_args

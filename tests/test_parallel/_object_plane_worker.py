@@ -1,0 +1,62 @@
+"""Worker for the 2-process object-plane test: each process initializes
+jax.distributed over CPU and round-trips the host object channel (the TPU-native
+replacement of the reference's Gloo pickled-object collectives, SURVEY §5.8)."""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, num_processes, process_id, out_path = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator, num_processes=num_processes, process_id=process_id)
+
+    from sheeprl_tpu.parallel import distributed
+
+    assert distributed.process_count() == num_processes
+    assert distributed.process_index() == process_id
+
+    # object broadcast: a non-trivial pytree, only rank-0's survives
+    obj = {"rank": process_id, "nested": [1, 2, {"x": "y"}]} if process_id == 0 else None
+    bcast = distributed.host_broadcast_object(obj, src=0)
+
+    # object allgather: every rank contributes a distinct payload (different sizes)
+    gathered = distributed.host_allgather_object({"rank": process_id, "pad": "z" * (10 * (process_id + 1))})
+
+    # scalar allsum
+    total = distributed.host_allsum(float(process_id + 1))
+
+    # log-dir share: rank-0 creates the versioned dir, others receive the same path
+    class _F:
+        global_rank = process_id
+        world_size = num_processes
+
+    from sheeprl_tpu.utils.logger import get_log_dir
+
+    log_dir = get_log_dir(_F(), "object_plane", "run", share=True)
+
+    distributed.barrier("object-plane-test")
+
+    with open(out_path, "w") as f:
+        json.dump(
+            {
+                "bcast": bcast,
+                "gathered_ranks": [g["rank"] for g in gathered],
+                "total": total,
+                "log_dir": log_dir,
+            },
+            f,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""buffer.share_data semantics (reference sheeprl/algos/ppo/ppo.py:40-50,362-369):
+with share_data each device optimizes a shard of the globally shuffled rollout;
+without it every device's minibatch rows stay inside its own data shard."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.utils import epoch_permutation
+
+
+def test_epoch_permutation_local_stays_in_shard():
+    num_rows, world = 64, 8
+    rows_per = num_rows // world
+    perm = np.asarray(epoch_permutation(jax.random.PRNGKey(0), num_rows, world, share_data=False))
+    assert sorted(perm.tolist()) == list(range(num_rows))
+    # interleaved layout: position i belongs to shard i % world
+    by_pos = perm.reshape(rows_per, world)
+    for shard in range(world):
+        vals = by_pos[:, shard]
+        assert np.all((vals >= shard * rows_per) & (vals < (shard + 1) * rows_per))
+
+
+def test_epoch_permutation_shared_mixes_shards():
+    num_rows, world = 64, 8
+    perm = np.asarray(epoch_permutation(jax.random.PRNGKey(0), num_rows, world, share_data=True))
+    assert sorted(perm.tolist()) == list(range(num_rows))
+    rows_per = num_rows // world
+    by_pos = perm.reshape(rows_per, world)
+    # a global permutation almost surely crosses shard boundaries at some position
+    crossings = sum(
+        not np.all((by_pos[:, s] >= s * rows_per) & (by_pos[:, s] < (s + 1) * rows_per))
+        for s in range(world)
+    )
+    assert crossings > 0
+
+
+def test_epoch_permutation_single_device_is_global():
+    perm = np.asarray(epoch_permutation(jax.random.PRNGKey(1), 32, 1, share_data=False))
+    assert sorted(perm.tolist()) == list(range(32))
+
+
+def test_all_gather_materializes_sharded_array():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    fabric = Fabric(devices=2, accelerator="cpu")
+    fabric._setup()
+    x = jnp.arange(8.0).reshape(2, 4)
+    sharded = jax.device_put(x, NamedSharding(fabric.mesh, P("data")))
+    out = fabric.all_gather({"x": sharded})
+    np.testing.assert_array_equal(out["x"], np.asarray(x))
