@@ -67,14 +67,16 @@ def compute_lambda_values(
 
 def prepare_obs(
     fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1
-) -> Dict[str, jax.Array]:
-    out: Dict[str, jax.Array] = {}
+) -> Dict[str, np.ndarray]:
+    # host arrays: the act program's placement follows the player params (see the
+    # dreamer_v3 prepare_obs note on avoiding a per-frame accelerator round-trip)
+    out: Dict[str, np.ndarray] = {}
     for k in cnn_keys:
         v = np.asarray(obs[k], dtype=np.float32)
-        out[k] = jnp.asarray(v.reshape(num_envs, -1, *v.shape[-2:]) / 255.0 - 0.5)
+        out[k] = v.reshape(num_envs, -1, *v.shape[-2:]) / 255.0 - 0.5
     for k in mlp_keys:
         v = np.asarray(obs[k], dtype=np.float32)
-        out[k] = jnp.asarray(v.reshape(num_envs, -1))
+        out[k] = v.reshape(num_envs, -1)
     return out
 
 

@@ -716,43 +716,51 @@ def build_agent(
     )
 
     # -- init params -------------------------------------------------------------
-    keys = jax.random.split(key, 10)
-    dummy_obs = {}
-    for k in cnn_keys:
-        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
-    for k in mlp_keys:
-        dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
-    embed_dim_probe = encoder.init(keys[0], dummy_obs)
-    embedded = encoder.apply(embed_dim_probe, dummy_obs)
+    # The whole init is ONE jitted program: eager flax `.init` calls dispatch hundreds
+    # of tiny ops, each paying a device round-trip (multi-second setup on a remote
+    # TPU); a single traced program pays one compile + one execution.
     act_dim = int(np.sum(actions_dim))
-    h = jnp.zeros((1, recurrent_state_size), jnp.float32)
-    z = jnp.zeros((1, stoch_state_size), jnp.float32)
-    latent = jnp.zeros((1, latent_state_size), jnp.float32)
 
-    wm_params = {
-        "encoder": embed_dim_probe["params"],
-        "recurrent_model": recurrent_model.init(
-            keys[1], jnp.concatenate([z, jnp.zeros((1, act_dim), jnp.float32)], axis=-1), h
-        )["params"],
-        "representation_model": representation_model.init(
-            keys[2], jnp.concatenate([h, embedded], axis=-1)
-        )["params"],
-        "transition_model": transition_model.init(keys[3], h)["params"],
-        "observation_model": observation_model.init(keys[4], latent)["params"],
-        "reward_model": reward_model.init(keys[5], latent)["params"],
-        "continue_model": continue_model.init(keys[6], latent)["params"],
-        "initial_recurrent_state": jnp.zeros((recurrent_state_size,), jnp.float32),
-    }
-    actor_params = actor.init(keys[7], latent)["params"]
-    critic_params = critic.init(keys[8], latent)["params"]
-    params = {
-        "world_model": wm_params,
-        "actor": actor_params,
-        "critic": critic_params,
-        "target_critic": jax.tree_util.tree_map(lambda x: x, critic_params),
-    }
+    def _init_all(key):
+        keys = jax.random.split(key, 10)
+        dummy_obs = {}
+        for k in cnn_keys:
+            dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+        for k in mlp_keys:
+            dummy_obs[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+        embed_dim_probe = encoder.init(keys[0], dummy_obs)
+        embedded = encoder.apply(embed_dim_probe, dummy_obs)
+        h = jnp.zeros((1, recurrent_state_size), jnp.float32)
+        z = jnp.zeros((1, stoch_state_size), jnp.float32)
+        latent = jnp.zeros((1, latent_state_size), jnp.float32)
+
+        wm_params = {
+            "encoder": embed_dim_probe["params"],
+            "recurrent_model": recurrent_model.init(
+                keys[1], jnp.concatenate([z, jnp.zeros((1, act_dim), jnp.float32)], axis=-1), h
+            )["params"],
+            "representation_model": representation_model.init(
+                keys[2], jnp.concatenate([h, embedded], axis=-1)
+            )["params"],
+            "transition_model": transition_model.init(keys[3], h)["params"],
+            "observation_model": observation_model.init(keys[4], latent)["params"],
+            "reward_model": reward_model.init(keys[5], latent)["params"],
+            "continue_model": continue_model.init(keys[6], latent)["params"],
+            "initial_recurrent_state": jnp.zeros((recurrent_state_size,), jnp.float32),
+        }
+        actor_params = actor.init(keys[7], latent)["params"]
+        critic_params = critic.init(keys[8], latent)["params"]
+        return {
+            "world_model": wm_params,
+            "actor": actor_params,
+            "critic": critic_params,
+            "target_critic": jax.tree_util.tree_map(lambda x: x, critic_params),
+        }
+
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    else:
+        params = jax.jit(_init_all)(key)
     return agent, params
 
 
@@ -787,19 +795,34 @@ class PlayerDV3:
 
         self._step = jax.jit(_step, static_argnames=("greedy",))
 
+        def _full_init(params, n):
+            h0, z0 = agent_ref.initial_state(params["world_model"], (n,))
+            act_dim = int(np.sum(agent_ref.actions_dim))
+            return jnp.zeros((n, act_dim), jnp.float32), h0, z0
+
+        self._full_init = jax.jit(_full_init, static_argnames=("n",))
+
+        def _masked_reset(params, a, h, z, mask):
+            # one fixed-shape program per num_envs: resets are a `where` over a host
+            # mask, not per-index eager scatters (each of which pays a dispatch and,
+            # for every new index pattern, a fresh compile)
+            h0, z0 = agent_ref.initial_state(params["world_model"], (a.shape[0],))
+            m = mask[:, None]
+            return a * (1.0 - m), jnp.where(m > 0, h0, h), jnp.where(m > 0, z0, z)
+
+        self._masked_reset = jax.jit(_masked_reset)
+
     def init_states(self, params: Dict, reset_envs: Optional[Sequence[int]] = None) -> None:
-        act_dim = int(np.sum(self.agent.actions_dim))
-        if reset_envs is None or len(reset_envs) == 0:
-            h0, z0 = self.agent.initial_state(params["world_model"], (self.num_envs,))
-            self.actions = jnp.zeros((self.num_envs, act_dim), jnp.float32)
-            self.recurrent_state = h0
-            self.stochastic_state = z0
+        if reset_envs is None or len(reset_envs) == 0 or self.actions is None:
+            self.actions, self.recurrent_state, self.stochastic_state = self._full_init(
+                params, self.num_envs
+            )
         else:
-            idx = np.asarray(reset_envs)
-            h0, z0 = self.agent.initial_state(params["world_model"], (len(idx),))
-            self.actions = self.actions.at[idx].set(0.0)
-            self.recurrent_state = self.recurrent_state.at[idx].set(h0)
-            self.stochastic_state = self.stochastic_state.at[idx].set(z0)
+            mask = np.zeros((self.num_envs,), np.float32)
+            mask[np.asarray(reset_envs)] = 1.0
+            self.actions, self.recurrent_state, self.stochastic_state = self._masked_reset(
+                params, self.actions, self.recurrent_state, self.stochastic_state, mask
+            )
 
     def get_actions(self, params: Dict, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False):
         """Returns ``(actions, key)`` — the advanced PRNG chain key."""

@@ -53,7 +53,13 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, compute_lambda_values, save_configs
+from sheeprl_tpu.utils.utils import (
+    Ratio,
+    compute_lambda_values,
+    packed_device_get,
+    packed_device_put,
+    save_configs,
+)
 
 
 def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx, world_latent_hook=None):
@@ -413,7 +419,8 @@ def run_dreamer(
     def _act_view(p):
         if not act_on_cpu:
             return p
-        return jax.device_put({"world_model": p["world_model"], "actor": p["actor"]}, cpu_device)
+        # one packed transfer instead of one RTT per param leaf
+        return packed_device_put({"world_model": p["world_model"], "actor": p["actor"]}, cpu_device)
 
     act_params = _act_view(params)
     if act_on_cpu:
@@ -464,7 +471,21 @@ def run_dreamer(
     last_train = 0
     act_dim = int(np.sum(actions_dim))
 
+    # Optional steady-state measurement window for bench.py: record wall time over the
+    # policy steps after SHEEPRL_BENCH_STEADY_START (set past warmup+compile), so the
+    # reported throughput is the post-compile regime (see bench.py docstring).
+    import time as _time
+
+    bench_file = os.environ.get("SHEEPRL_BENCH_STEADY_FILE")
+    bench_start_step = int(os.environ.get("SHEEPRL_BENCH_STEADY_START", "0"))
+    bench_t0 = None
+    bench_step0 = 0
+
     for iter_num in range(start_iter, total_iters + 1):
+        if bench_file and bench_t0 is None and policy_step >= bench_start_step:
+            jax.block_until_ready(params)
+            bench_t0 = _time.perf_counter()
+            bench_step0 = policy_step
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time"):
@@ -593,8 +614,8 @@ def run_dreamer(
                     train_step += world_size * per_rank_gradient_steps
                     act_params = _act_view(params)
                     if aggregator and not aggregator.disabled:
-                        for mk, mv in metrics.items():
-                            aggregator.update(mk, float(np.asarray(mv)))
+                        for mk, mv in packed_device_get(metrics).items():
+                            aggregator.update(mk, float(mv))
 
         # log
         if cfg.metric.log_level > 0 and (
@@ -656,6 +677,16 @@ def run_dreamer(
                 ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    if bench_file and bench_t0 is not None:
+        import json
+
+        jax.block_until_ready(params)
+        with open(bench_file, "w") as f:
+            json.dump(
+                {"steps": policy_step - bench_step0, "seconds": _time.perf_counter() - bench_t0},
+                f,
             )
 
     envs.close()

@@ -54,19 +54,9 @@ def update_moments(
     return new_low, invscale, {"low": new_low, "high": new_high}
 
 
-def prepare_obs(
-    fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1
-) -> Dict[str, jax.Array]:
-    """Host obs dict → device arrays: [N, C, H, W] in [-0.5, 0.5] for images,
-    [N, D] floats for vectors (reference utils.py:81-93, batch-first here)."""
-    out: Dict[str, jax.Array] = {}
-    for k in cnn_keys:
-        v = np.asarray(obs[k], dtype=np.float32)
-        out[k] = jnp.asarray(v.reshape(num_envs, -1, *v.shape[-2:]) / 255.0 - 0.5)
-    for k in mlp_keys:
-        v = np.asarray(obs[k], dtype=np.float32)
-        out[k] = jnp.asarray(v.reshape(num_envs, -1))
-    return out
+# same [-0.5, 0.5] image normalization as DV2 (reference dreamer_v3/utils.py:81-93);
+# shared so the host-array device-placement rationale lives in one place
+from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs  # noqa: F401, E402
 
 
 def test(

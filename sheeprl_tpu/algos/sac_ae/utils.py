@@ -32,16 +32,17 @@ def preprocess_obs(obs: jax.Array, key: jax.Array, bits: int = 8) -> jax.Array:
 
 def prepare_obs(
     fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1
-) -> Dict[str, jax.Array]:
+) -> Dict[str, np.ndarray]:
     """Images → [N, C, H, W] in [0, 1]; vectors → [N, D] floats (reference
-    prepare_obs: images are divided by 255 only)."""
-    out: Dict[str, jax.Array] = {}
+    prepare_obs: images are divided by 255 only). Host arrays — see the dreamer_v3
+    prepare_obs note on device placement."""
+    out: Dict[str, np.ndarray] = {}
     for k in cnn_keys:
         v = np.asarray(obs[k], dtype=np.float32)
-        out[k] = jnp.asarray(v.reshape(num_envs, -1, *v.shape[-2:]) / 255.0)
+        out[k] = v.reshape(num_envs, -1, *v.shape[-2:]) / 255.0
     for k in mlp_keys:
         v = np.asarray(obs[k], dtype=np.float32)
-        out[k] = jnp.asarray(v.reshape(num_envs, -1))
+        out[k] = v.reshape(num_envs, -1)
     return out
 
 
