@@ -1,0 +1,12 @@
+"""A2C evaluation entrypoint (reference: sheeprl/algos/a2c/evaluate.py:1-60).
+
+A2C shares PPO's agent surface (vector-MLP actor-critic; the reference's A2CAgent is
+its own torch module, a2c/agent.py:48), so evaluation reuses PPO's ``evaluate`` body
+and only adds the registry binding."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.ppo.evaluate import evaluate as _ppo_evaluate
+from sheeprl_tpu.utils.registry import register_evaluation
+
+evaluate = register_evaluation(algorithms=["a2c"])(_ppo_evaluate)

@@ -35,7 +35,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, foreach_gradient_step, save_configs
 
 def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx):
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
@@ -129,47 +129,41 @@ def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx):
         return -jnp.mean(discount[..., 0] * lp)
 
     @jax.jit
+    def train_step(params, opt_state, batch, k):
+        k_world, k_img = jax.random.split(jnp.asarray(k))
+
+        (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
+            params["world_model"], batch, k_world
+        )
+        updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
+        params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
+        opt_state = {**opt_state, "world_model": new_wopt}
+
+        (a_loss, (latents, lambda_values, discount)), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(params["actor"], params, zs, hs, k_img)
+        updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+        opt_state = {**opt_state, "actor": new_aopt}
+
+        latents_sg = jax.lax.stop_gradient(latents)
+        c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
+            params["critic"], latents_sg, lambda_values, discount
+        )
+        updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
+        params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+        opt_state = {**opt_state, "critic": new_copt}
+
+        metrics = dict(w_metrics)
+        metrics["Loss/policy_loss"] = a_loss
+        metrics["Loss/value_loss"] = c_loss
+        metrics["Grads/world_model"] = optax.global_norm(w_grads)
+        metrics["Grads/actor"] = optax.global_norm(a_grads)
+        metrics["Grads/critic"] = optax.global_norm(c_grads)
+        return params, opt_state, metrics
+
     def train_phase(params, opt_state, data, train_key):
-        G = data["rewards"].shape[0]
-        keys = jax.random.split(jnp.asarray(train_key), G)
-
-        def step(carry, inp):
-            params, opt_state = carry
-            batch, k = inp
-            k_world, k_img = jax.random.split(k)
-
-            (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
-                params["world_model"], batch, k_world
-            )
-            updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
-            params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
-            opt_state = {**opt_state, "world_model": new_wopt}
-
-            (a_loss, (latents, lambda_values, discount)), a_grads = jax.value_and_grad(
-                actor_loss_fn, has_aux=True
-            )(params["actor"], params, zs, hs, k_img)
-            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
-            opt_state = {**opt_state, "actor": new_aopt}
-
-            latents_sg = jax.lax.stop_gradient(latents)
-            c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
-                params["critic"], latents_sg, lambda_values, discount
-            )
-            updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
-            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
-            opt_state = {**opt_state, "critic": new_copt}
-
-            metrics = dict(w_metrics)
-            metrics["Loss/policy_loss"] = a_loss
-            metrics["Loss/value_loss"] = c_loss
-            metrics["Grads/world_model"] = optax.global_norm(w_grads)
-            metrics["Grads/actor"] = optax.global_norm(a_grads)
-            metrics["Grads/critic"] = optax.global_norm(c_grads)
-            return (params, opt_state), metrics
-
-        (params, opt_state), metrics = jax.lax.scan(step, (params, opt_state), (data, keys))
-        return params, opt_state, jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+        return foreach_gradient_step(train_step, (params, opt_state), data, train_key)
 
     return train_phase
 

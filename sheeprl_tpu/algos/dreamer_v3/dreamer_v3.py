@@ -56,6 +56,7 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import (
     Ratio,
     compute_lambda_values,
+    foreach_gradient_step,
     packed_device_get,
     packed_device_put,
     save_configs,
@@ -202,64 +203,65 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx, world_
         value_loss = value_loss - qv.log_prob(jax.lax.stop_gradient(target_values))
         return jnp.mean(value_loss * discount[:-1].squeeze(-1))
 
+    # ONE compiled program per single gradient step, driven by a host loop over the
+    # [G, ...] replay block. Two reasons this beats an outer ``lax.scan`` over G:
+    # (a) measured 3.6x faster steady-state on XLA CPU — the scan-carried
+    # params/opt-state force layout copies and block fusion across the while-loop
+    # body; (b) every distinct ``per_rank_gradient_steps`` value the Ratio governor
+    # produces would recompile the whole scanned program (~45 s each); the
+    # single-step program compiles once for any G.
     @jax.jit
-    def train_phase(params, opt_state, moments_state, data, cum_steps, train_key):
-        G = data["rewards"].shape[0]
-        keys = jax.random.split(jnp.asarray(train_key), G)
+    def train_step(params, opt_state, moments_state, batch, cum, k):
+        k_world, k_img = jax.random.split(jnp.asarray(k))
 
-        def step(carry, inp):
-            params, opt_state, moments_state, cum = carry
-            batch, k = inp
-            k_world, k_img = jax.random.split(k)
+        # target-critic EMA before the step (reference dreamer_v3.py:756-761)
+        do_ema = (cum % target_freq) == 0
+        tau_eff = jnp.where(cum == 0, 1.0, tau)
+        params = {
+            **params,
+            "target_critic": jax.tree_util.tree_map(
+                lambda t, c: jnp.where(do_ema, tau_eff * c + (1 - tau_eff) * t, t),
+                params["target_critic"],
+                params["critic"],
+            ),
+        }
 
-            # target-critic EMA before the step (reference dreamer_v3.py:756-761)
-            do_ema = (cum % target_freq) == 0
-            tau_eff = jnp.where(cum == 0, 1.0, tau)
-            params = {
-                **params,
-                "target_critic": jax.tree_util.tree_map(
-                    lambda t, c: jnp.where(do_ema, tau_eff * c + (1 - tau_eff) * t, t),
-                    params["target_critic"],
-                    params["critic"],
-                ),
-            }
-
-            (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
-                params["world_model"], batch, k_world
-            )
-            updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
-            params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
-            opt_state = {**opt_state, "world_model": new_wopt}
-
-            true_continue = (1 - batch["terminated"]).reshape(-1, 1)
-            (a_loss, (latents, lambda_values, discount, new_moments)), a_grads = jax.value_and_grad(
-                actor_loss_fn, has_aux=True
-            )(params["actor"], params, zs, hs, true_continue, moments_state, k_img)
-            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
-            opt_state = {**opt_state, "actor": new_aopt}
-            moments_state = new_moments
-
-            latents_sg = jax.lax.stop_gradient(latents)
-            c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
-                params["critic"], params["target_critic"], latents_sg, lambda_values, discount
-            )
-            updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
-            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
-            opt_state = {**opt_state, "critic": new_copt}
-
-            metrics = dict(w_metrics)
-            metrics["Loss/policy_loss"] = a_loss
-            metrics["Loss/value_loss"] = c_loss
-            metrics["Grads/world_model"] = optax.global_norm(w_grads)
-            metrics["Grads/actor"] = optax.global_norm(a_grads)
-            metrics["Grads/critic"] = optax.global_norm(c_grads)
-            return (params, opt_state, moments_state, cum + 1), metrics
-
-        (params, opt_state, moments_state, _), metrics = jax.lax.scan(
-            step, (params, opt_state, moments_state, cum_steps), (data, keys)
+        (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
+            params["world_model"], batch, k_world
         )
-        return params, opt_state, moments_state, jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+        updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
+        params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
+        opt_state = {**opt_state, "world_model": new_wopt}
+
+        true_continue = (1 - batch["terminated"]).reshape(-1, 1)
+        (a_loss, (latents, lambda_values, discount, new_moments)), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(params["actor"], params, zs, hs, true_continue, moments_state, k_img)
+        updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+        opt_state = {**opt_state, "actor": new_aopt}
+        moments_state = new_moments
+
+        latents_sg = jax.lax.stop_gradient(latents)
+        c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
+            params["critic"], params["target_critic"], latents_sg, lambda_values, discount
+        )
+        updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
+        params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+        opt_state = {**opt_state, "critic": new_copt}
+
+        metrics = dict(w_metrics)
+        metrics["Loss/policy_loss"] = a_loss
+        metrics["Loss/value_loss"] = c_loss
+        metrics["Grads/world_model"] = optax.global_norm(w_grads)
+        metrics["Grads/actor"] = optax.global_norm(a_grads)
+        metrics["Grads/critic"] = optax.global_norm(c_grads)
+        return params, opt_state, moments_state, metrics
+
+    def train_phase(params, opt_state, moments_state, data, cum_steps, train_key):
+        return foreach_gradient_step(
+            train_step, (params, opt_state, moments_state), data, train_key, cum_steps
+        )
 
     return train_phase
 

@@ -40,7 +40,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, foreach_gradient_step, save_configs
 
 
 def make_train_phase(agent: DV2Agent, ensembles: EnsembleHeads, cfg, txs: Dict[str, Any]):
@@ -181,99 +181,93 @@ def make_train_phase(agent: DV2Agent, ensembles: EnsembleHeads, cfg, txs: Dict[s
         return -jnp.mean(discount[:-1, ..., 0] * lp)
 
     @jax.jit
+    def train_step(params, opt_state, batch, cum, k):
+        k_world, k_expl, k_task = jax.random.split(jnp.asarray(k), 3)
+
+        do_copy = (cum % target_freq) == 0
+        hard = lambda t, c: jnp.where(do_copy, c, t)
+        params = {
+            **params,
+            "target_critic_task": jax.tree_util.tree_map(
+                hard, params["target_critic_task"], params["critic_task"]
+            ),
+            "target_critic_exploration": jax.tree_util.tree_map(
+                hard, params["target_critic_exploration"], params["critic_exploration"]
+            ),
+        }
+
+        (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
+            params["world_model"], batch, k_world
+        )
+        updates, new_wopt = txs["world_model"].update(
+            w_grads, opt_state["world_model"], params["world_model"]
+        )
+        params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
+        opt_state = {**opt_state, "world_model": new_wopt}
+
+        e_loss, e_grads = jax.value_and_grad(ensemble_loss_fn)(
+            params["ensembles"], zs, hs, batch["actions"]
+        )
+        updates, new_eopt = txs["ensembles"].update(e_grads, opt_state["ensembles"], params["ensembles"])
+        params = {**params, "ensembles": optax.apply_updates(params["ensembles"], updates)}
+        opt_state = {**opt_state, "ensembles": new_eopt}
+
+        true_continue = (1 - batch["terminated"]).reshape(-1, 1)
+        metrics = dict(w_metrics)
+
+        (pe_loss, (latents_e, lambda_e, discount_e, intr_reward)), ae_grads = jax.value_and_grad(
+            actor_expl_loss_fn, has_aux=True
+        )(params["actor_exploration"], params, zs, hs, true_continue, k_expl)
+        updates, new_aeopt = txs["actor_exploration"].update(
+            ae_grads, opt_state["actor_exploration"], params["actor_exploration"]
+        )
+        params = {**params, "actor_exploration": optax.apply_updates(params["actor_exploration"], updates)}
+        opt_state = {**opt_state, "actor_exploration": new_aeopt}
+
+        ce_loss, ce_grads = jax.value_and_grad(critic_loss_fn)(
+            params["critic_exploration"], jax.lax.stop_gradient(latents_e), lambda_e, discount_e
+        )
+        updates, new_ceopt = txs["critic_exploration"].update(
+            ce_grads, opt_state["critic_exploration"], params["critic_exploration"]
+        )
+        params = {**params, "critic_exploration": optax.apply_updates(params["critic_exploration"], updates)}
+        opt_state = {**opt_state, "critic_exploration": new_ceopt}
+
+        (pt_loss, (latents_t, lambda_t, discount_t, _)), at_grads = jax.value_and_grad(
+            actor_task_loss_fn, has_aux=True
+        )(params["actor_task"], params, zs, hs, true_continue, k_task)
+        updates, new_atopt = txs["actor_task"].update(
+            at_grads, opt_state["actor_task"], params["actor_task"]
+        )
+        params = {**params, "actor_task": optax.apply_updates(params["actor_task"], updates)}
+        opt_state = {**opt_state, "actor_task": new_atopt}
+
+        ct_loss, ct_grads = jax.value_and_grad(critic_loss_fn)(
+            params["critic_task"], jax.lax.stop_gradient(latents_t), lambda_t, discount_t
+        )
+        updates, new_ctopt = txs["critic_task"].update(
+            ct_grads, opt_state["critic_task"], params["critic_task"]
+        )
+        params = {**params, "critic_task": optax.apply_updates(params["critic_task"], updates)}
+        opt_state = {**opt_state, "critic_task": new_ctopt}
+
+        metrics["Loss/ensemble_loss"] = e_loss
+        metrics["Loss/policy_loss_exploration"] = pe_loss
+        metrics["Loss/value_loss_exploration"] = ce_loss
+        metrics["Loss/policy_loss_task"] = pt_loss
+        metrics["Loss/value_loss_task"] = ct_loss
+        metrics["Rewards/intrinsic"] = intr_reward.mean()
+        metrics["Values_exploration/lambda_values"] = lambda_e.mean()
+        metrics["Grads/world_model"] = optax.global_norm(w_grads)
+        metrics["Grads/ensemble"] = optax.global_norm(e_grads)
+        metrics["Grads/actor_exploration"] = optax.global_norm(ae_grads)
+        metrics["Grads/critic_exploration"] = optax.global_norm(ce_grads)
+        metrics["Grads/actor_task"] = optax.global_norm(at_grads)
+        metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
+        return params, opt_state, metrics
+
     def train_phase(params, opt_state, data, cum_steps, train_key):
-        G = data["rewards"].shape[0]
-        keys = jax.random.split(jnp.asarray(train_key), G)
-
-        def step(carry, inp):
-            params, opt_state, cum = carry
-            batch, k = inp
-            k_world, k_expl, k_task = jax.random.split(k, 3)
-
-            do_copy = (cum % target_freq) == 0
-            hard = lambda t, c: jnp.where(do_copy, c, t)
-            params = {
-                **params,
-                "target_critic_task": jax.tree_util.tree_map(
-                    hard, params["target_critic_task"], params["critic_task"]
-                ),
-                "target_critic_exploration": jax.tree_util.tree_map(
-                    hard, params["target_critic_exploration"], params["critic_exploration"]
-                ),
-            }
-
-            (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
-                params["world_model"], batch, k_world
-            )
-            updates, new_wopt = txs["world_model"].update(
-                w_grads, opt_state["world_model"], params["world_model"]
-            )
-            params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
-            opt_state = {**opt_state, "world_model": new_wopt}
-
-            e_loss, e_grads = jax.value_and_grad(ensemble_loss_fn)(
-                params["ensembles"], zs, hs, batch["actions"]
-            )
-            updates, new_eopt = txs["ensembles"].update(e_grads, opt_state["ensembles"], params["ensembles"])
-            params = {**params, "ensembles": optax.apply_updates(params["ensembles"], updates)}
-            opt_state = {**opt_state, "ensembles": new_eopt}
-
-            true_continue = (1 - batch["terminated"]).reshape(-1, 1)
-            metrics = dict(w_metrics)
-
-            (pe_loss, (latents_e, lambda_e, discount_e, intr_reward)), ae_grads = jax.value_and_grad(
-                actor_expl_loss_fn, has_aux=True
-            )(params["actor_exploration"], params, zs, hs, true_continue, k_expl)
-            updates, new_aeopt = txs["actor_exploration"].update(
-                ae_grads, opt_state["actor_exploration"], params["actor_exploration"]
-            )
-            params = {**params, "actor_exploration": optax.apply_updates(params["actor_exploration"], updates)}
-            opt_state = {**opt_state, "actor_exploration": new_aeopt}
-
-            ce_loss, ce_grads = jax.value_and_grad(critic_loss_fn)(
-                params["critic_exploration"], jax.lax.stop_gradient(latents_e), lambda_e, discount_e
-            )
-            updates, new_ceopt = txs["critic_exploration"].update(
-                ce_grads, opt_state["critic_exploration"], params["critic_exploration"]
-            )
-            params = {**params, "critic_exploration": optax.apply_updates(params["critic_exploration"], updates)}
-            opt_state = {**opt_state, "critic_exploration": new_ceopt}
-
-            (pt_loss, (latents_t, lambda_t, discount_t, _)), at_grads = jax.value_and_grad(
-                actor_task_loss_fn, has_aux=True
-            )(params["actor_task"], params, zs, hs, true_continue, k_task)
-            updates, new_atopt = txs["actor_task"].update(
-                at_grads, opt_state["actor_task"], params["actor_task"]
-            )
-            params = {**params, "actor_task": optax.apply_updates(params["actor_task"], updates)}
-            opt_state = {**opt_state, "actor_task": new_atopt}
-
-            ct_loss, ct_grads = jax.value_and_grad(critic_loss_fn)(
-                params["critic_task"], jax.lax.stop_gradient(latents_t), lambda_t, discount_t
-            )
-            updates, new_ctopt = txs["critic_task"].update(
-                ct_grads, opt_state["critic_task"], params["critic_task"]
-            )
-            params = {**params, "critic_task": optax.apply_updates(params["critic_task"], updates)}
-            opt_state = {**opt_state, "critic_task": new_ctopt}
-
-            metrics["Loss/ensemble_loss"] = e_loss
-            metrics["Loss/policy_loss_exploration"] = pe_loss
-            metrics["Loss/value_loss_exploration"] = ce_loss
-            metrics["Loss/policy_loss_task"] = pt_loss
-            metrics["Loss/value_loss_task"] = ct_loss
-            metrics["Rewards/intrinsic"] = intr_reward.mean()
-            metrics["Values_exploration/lambda_values"] = lambda_e.mean()
-            metrics["Grads/world_model"] = optax.global_norm(w_grads)
-            metrics["Grads/ensemble"] = optax.global_norm(e_grads)
-            metrics["Grads/actor_exploration"] = optax.global_norm(ae_grads)
-            metrics["Grads/critic_exploration"] = optax.global_norm(ce_grads)
-            metrics["Grads/actor_task"] = optax.global_norm(at_grads)
-            metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
-            return (params, opt_state, cum + 1), metrics
-
-        (params, opt_state, _), metrics = jax.lax.scan(step, (params, opt_state, cum_steps), (data, keys))
-        return params, opt_state, jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+        return foreach_gradient_step(train_step, (params, opt_state), data, train_key, cum_steps)
 
     return train_phase
 

@@ -39,7 +39,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, compute_lambda_values, save_configs
+from sheeprl_tpu.utils.utils import Ratio, compute_lambda_values, foreach_gradient_step, save_configs
 
 
 def make_train_phase(agent: DV3Agent, ensembles: EnsembleHeads, cfg, txs: Dict[str, Any]):
@@ -236,132 +236,126 @@ def make_train_phase(agent: DV3Agent, ensembles: EnsembleHeads, cfg, txs: Dict[s
         return policy_loss, (latents, lambda_values, discount, new_moments)
 
     @jax.jit
-    def train_phase(params, opt_state, moments_state, data, cum_steps, train_key):
-        G = data["rewards"].shape[0]
-        keys = jax.random.split(jnp.asarray(train_key), G)
+    def train_step(params, opt_state, moments_state, batch, cum, k):
+        k_world, k_expl, k_task = jax.random.split(jnp.asarray(k), 3)
 
-        def step(carry, inp):
-            params, opt_state, moments_state, cum = carry
-            batch, k = inp
-            k_world, k_expl, k_task = jax.random.split(k, 3)
-
-            # target EMAs (task + per-stream exploration critics)
-            do_ema = (cum % target_freq) == 0
-            tau_eff = jnp.where(cum == 0, 1.0, tau)
-            ema = lambda t, c: jnp.where(do_ema, tau_eff * c + (1 - tau_eff) * t, t)
-            params = {
-                **params,
-                "target_critic_task": jax.tree_util.tree_map(
-                    ema, params["target_critic_task"], params["critic_task"]
-                ),
-                "critics_exploration": {
-                    ck: {
-                        "module": cv["module"],
-                        "target": jax.tree_util.tree_map(ema, cv["target"], cv["module"]),
-                    }
-                    for ck, cv in params["critics_exploration"].items()
-                },
-            }
-
-            (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
-                params["world_model"], batch, k_world
-            )
-            updates, new_wopt = txs["world_model"].update(
-                w_grads, opt_state["world_model"], params["world_model"]
-            )
-            params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
-            opt_state = {**opt_state, "world_model": new_wopt}
-
-            # ensembles predict z_{t+1} from (z_t, h_t, a_t): the stored action at
-            # row t is the one *leaving* o_t, so no shift here
-            e_loss, e_grads = jax.value_and_grad(ensemble_loss_fn)(
-                params["ensembles"], zs, hs, batch["actions"]
-            )
-            updates, new_eopt = txs["ensembles"].update(e_grads, opt_state["ensembles"], params["ensembles"])
-            params = {**params, "ensembles": optax.apply_updates(params["ensembles"], updates)}
-            opt_state = {**opt_state, "ensembles": new_eopt}
-
-            true_continue = (1 - batch["terminated"]).reshape(-1, 1)
-            (pe_loss, (latents_e, lambda_per_critic, discount_e, new_me, e_metrics)), ae_grads = (
-                jax.value_and_grad(exploration_actor_loss_fn, has_aux=True)(
-                    params["actor_exploration"],
-                    params,
-                    zs,
-                    hs,
-                    true_continue,
-                    moments_state["exploration"],
-                    k_expl,
-                )
-            )
-            updates, new_aeopt = txs["actor_exploration"].update(
-                ae_grads, opt_state["actor_exploration"], params["actor_exploration"]
-            )
-            params = {**params, "actor_exploration": optax.apply_updates(params["actor_exploration"], updates)}
-            opt_state = {**opt_state, "actor_exploration": new_aeopt}
-            moments_state = {**moments_state, "exploration": new_me}
-
-            latents_e = jax.lax.stop_gradient(latents_e)
-            metrics = dict(w_metrics)
-            metrics.update(e_metrics)
-            new_ce = {}
-            for ck in critic_cfgs:
-                c_loss, c_grads = jax.value_and_grad(exploration_critic_loss_fn)(
-                    params["critics_exploration"][ck]["module"],
-                    params["critics_exploration"][ck]["target"],
-                    latents_e,
-                    lambda_per_critic[ck],
-                    discount_e,
-                )
-                updates, new_copt = txs[f"critic_exploration_{ck}"].update(
-                    c_grads, opt_state[f"critic_exploration_{ck}"], params["critics_exploration"][ck]["module"]
-                )
-                new_ce[ck] = {
-                    "module": optax.apply_updates(params["critics_exploration"][ck]["module"], updates),
-                    "target": params["critics_exploration"][ck]["target"],
+        # target EMAs (task + per-stream exploration critics)
+        do_ema = (cum % target_freq) == 0
+        tau_eff = jnp.where(cum == 0, 1.0, tau)
+        ema = lambda t, c: jnp.where(do_ema, tau_eff * c + (1 - tau_eff) * t, t)
+        params = {
+            **params,
+            "target_critic_task": jax.tree_util.tree_map(
+                ema, params["target_critic_task"], params["critic_task"]
+            ),
+            "critics_exploration": {
+                ck: {
+                    "module": cv["module"],
+                    "target": jax.tree_util.tree_map(ema, cv["target"], cv["module"]),
                 }
-                opt_state = {**opt_state, f"critic_exploration_{ck}": new_copt}
-                metrics[f"Loss/value_loss_exploration_{ck}"] = c_loss
-                metrics[f"Grads/critic_exploration_{ck}"] = optax.global_norm(c_grads)
-            params = {**params, "critics_exploration": new_ce}
+                for ck, cv in params["critics_exploration"].items()
+            },
+        }
 
-            (pt_loss, (latents_t, lambda_t, discount_t, new_mt)), at_grads = jax.value_and_grad(
-                task_actor_loss_fn, has_aux=True
-            )(params["actor_task"], params, zs, hs, true_continue, moments_state["task"], k_task)
-            updates, new_atopt = txs["actor_task"].update(
-                at_grads, opt_state["actor_task"], params["actor_task"]
-            )
-            params = {**params, "actor_task": optax.apply_updates(params["actor_task"], updates)}
-            opt_state = {**opt_state, "actor_task": new_atopt}
-            moments_state = {**moments_state, "task": new_mt}
-
-            ct_loss, ct_grads = jax.value_and_grad(exploration_critic_loss_fn)(
-                params["critic_task"],
-                params["target_critic_task"],
-                jax.lax.stop_gradient(latents_t),
-                lambda_t,
-                discount_t,
-            )
-            updates, new_ctopt = txs["critic_task"].update(
-                ct_grads, opt_state["critic_task"], params["critic_task"]
-            )
-            params = {**params, "critic_task": optax.apply_updates(params["critic_task"], updates)}
-            opt_state = {**opt_state, "critic_task": new_ctopt}
-
-            metrics["Loss/ensemble_loss"] = e_loss
-            metrics["Loss/policy_loss_exploration"] = pe_loss
-            metrics["Loss/policy_loss_task"] = pt_loss
-            metrics["Loss/value_loss_task"] = ct_loss
-            metrics["Grads/world_model"] = optax.global_norm(w_grads)
-            metrics["Grads/ensemble"] = optax.global_norm(e_grads)
-            metrics["Grads/actor_exploration"] = optax.global_norm(ae_grads)
-            metrics["Grads/actor_task"] = optax.global_norm(at_grads)
-            metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
-            return (params, opt_state, moments_state, cum + 1), metrics
-
-        (params, opt_state, moments_state, _), metrics = jax.lax.scan(
-            step, (params, opt_state, moments_state, cum_steps), (data, keys)
+        (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
+            params["world_model"], batch, k_world
         )
-        return params, opt_state, moments_state, jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+        updates, new_wopt = txs["world_model"].update(
+            w_grads, opt_state["world_model"], params["world_model"]
+        )
+        params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
+        opt_state = {**opt_state, "world_model": new_wopt}
+
+        # ensembles predict z_{t+1} from (z_t, h_t, a_t): the stored action at
+        # row t is the one *leaving* o_t, so no shift here
+        e_loss, e_grads = jax.value_and_grad(ensemble_loss_fn)(
+            params["ensembles"], zs, hs, batch["actions"]
+        )
+        updates, new_eopt = txs["ensembles"].update(e_grads, opt_state["ensembles"], params["ensembles"])
+        params = {**params, "ensembles": optax.apply_updates(params["ensembles"], updates)}
+        opt_state = {**opt_state, "ensembles": new_eopt}
+
+        true_continue = (1 - batch["terminated"]).reshape(-1, 1)
+        (pe_loss, (latents_e, lambda_per_critic, discount_e, new_me, e_metrics)), ae_grads = (
+            jax.value_and_grad(exploration_actor_loss_fn, has_aux=True)(
+                params["actor_exploration"],
+                params,
+                zs,
+                hs,
+                true_continue,
+                moments_state["exploration"],
+                k_expl,
+            )
+        )
+        updates, new_aeopt = txs["actor_exploration"].update(
+            ae_grads, opt_state["actor_exploration"], params["actor_exploration"]
+        )
+        params = {**params, "actor_exploration": optax.apply_updates(params["actor_exploration"], updates)}
+        opt_state = {**opt_state, "actor_exploration": new_aeopt}
+        moments_state = {**moments_state, "exploration": new_me}
+
+        latents_e = jax.lax.stop_gradient(latents_e)
+        metrics = dict(w_metrics)
+        metrics.update(e_metrics)
+        new_ce = {}
+        for ck in critic_cfgs:
+            c_loss, c_grads = jax.value_and_grad(exploration_critic_loss_fn)(
+                params["critics_exploration"][ck]["module"],
+                params["critics_exploration"][ck]["target"],
+                latents_e,
+                lambda_per_critic[ck],
+                discount_e,
+            )
+            updates, new_copt = txs[f"critic_exploration_{ck}"].update(
+                c_grads, opt_state[f"critic_exploration_{ck}"], params["critics_exploration"][ck]["module"]
+            )
+            new_ce[ck] = {
+                "module": optax.apply_updates(params["critics_exploration"][ck]["module"], updates),
+                "target": params["critics_exploration"][ck]["target"],
+            }
+            opt_state = {**opt_state, f"critic_exploration_{ck}": new_copt}
+            metrics[f"Loss/value_loss_exploration_{ck}"] = c_loss
+            metrics[f"Grads/critic_exploration_{ck}"] = optax.global_norm(c_grads)
+        params = {**params, "critics_exploration": new_ce}
+
+        (pt_loss, (latents_t, lambda_t, discount_t, new_mt)), at_grads = jax.value_and_grad(
+            task_actor_loss_fn, has_aux=True
+        )(params["actor_task"], params, zs, hs, true_continue, moments_state["task"], k_task)
+        updates, new_atopt = txs["actor_task"].update(
+            at_grads, opt_state["actor_task"], params["actor_task"]
+        )
+        params = {**params, "actor_task": optax.apply_updates(params["actor_task"], updates)}
+        opt_state = {**opt_state, "actor_task": new_atopt}
+        moments_state = {**moments_state, "task": new_mt}
+
+        ct_loss, ct_grads = jax.value_and_grad(exploration_critic_loss_fn)(
+            params["critic_task"],
+            params["target_critic_task"],
+            jax.lax.stop_gradient(latents_t),
+            lambda_t,
+            discount_t,
+        )
+        updates, new_ctopt = txs["critic_task"].update(
+            ct_grads, opt_state["critic_task"], params["critic_task"]
+        )
+        params = {**params, "critic_task": optax.apply_updates(params["critic_task"], updates)}
+        opt_state = {**opt_state, "critic_task": new_ctopt}
+
+        metrics["Loss/ensemble_loss"] = e_loss
+        metrics["Loss/policy_loss_exploration"] = pe_loss
+        metrics["Loss/policy_loss_task"] = pt_loss
+        metrics["Loss/value_loss_task"] = ct_loss
+        metrics["Grads/world_model"] = optax.global_norm(w_grads)
+        metrics["Grads/ensemble"] = optax.global_norm(e_grads)
+        metrics["Grads/actor_exploration"] = optax.global_norm(ae_grads)
+        metrics["Grads/actor_task"] = optax.global_norm(at_grads)
+        metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
+        return params, opt_state, moments_state, metrics
+
+    def train_phase(params, opt_state, moments_state, data, cum_steps, train_key):
+        return foreach_gradient_step(
+            train_step, (params, opt_state, moments_state), data, train_key, cum_steps
+        )
 
     return train_phase
 

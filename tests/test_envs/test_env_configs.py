@@ -6,7 +6,7 @@ absent — only instantiation requires the SDK."""
 
 from __future__ import annotations
 
-import importlib
+import importlib.util
 
 import pytest
 
@@ -41,8 +41,10 @@ def test_env_group_composes_with_dreamer_v3(env_group):
     target = cfg.env.wrapper["_target_"]
     module_name, _, attr = target.rpartition(".")
     # the adapter module itself imports lazily (SDK gate), but the module path must
-    # exist in the package — a typo'd _target_ should fail here, not at runtime
+    # exist in the package — a typo'd _target_ module should fail here, not at runtime
     assert module_name == "gymnasium" or module_name.startswith(("sheeprl_tpu.", "gymnasium."))
+    spec = importlib.util.find_spec(module_name)
+    assert spec is not None, f"wrapper _target_ points at a nonexistent module: {target}"
 
 
 def test_env_group_minecraft_knobs_inherited():
