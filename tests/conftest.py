@@ -16,7 +16,41 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache (same knob cli._setup_xla_env applies for real runs):
+# the fused Dreamer train programs take 30-60 s to compile; with the cache, repeat
+# suite runs skip every compile that already happened. Keyed by program, so shape
+# changes in a test invalidate only that test's entries.
+_cache_dir = os.environ.get("SHEEPRL_JAX_CACHE", os.path.expanduser("~/.cache/sheeprl_tpu/jax"))
+if _cache_dir not in ("0", ""):
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+import signal  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _per_test_timeout(request):
+    """Per-test wall-clock budget (reference tests/conftest.py:73-78 uses
+    pytest-timeout markers; that plugin is not in this image, so SIGALRM plays the
+    same role). Override per test with @pytest.mark.timeout(seconds)."""
+    marker = request.node.get_closest_marker("timeout")
+    seconds = int(marker.args[0]) if marker and marker.args else 300
+
+    def _raise(signum, frame):
+        raise TimeoutError(f"test exceeded its {seconds}s budget")
+
+    old = signal.signal(signal.SIGALRM, _raise)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture(autouse=True)
