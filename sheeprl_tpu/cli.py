@@ -79,6 +79,15 @@ def check_configs(cfg: dotdict) -> None:
         raise ValueError("single_device strategy requires fabric.devices=1")
 
 
+def _apply_distribution_cfg(cfg: dotdict) -> None:
+    """Global distribution argument-validation switch (reference cli.py:71 sets the
+    torch-distributions default from configs/distribution/default.yaml)."""
+    from sheeprl_tpu.utils.distribution import set_validate_args
+
+    dist_cfg = cfg.get("distribution") or {}
+    set_validate_args(bool(dist_cfg.get("validate_args", False)))
+
+
 def _setup_xla_env(cfg: dotdict) -> None:
     """Apply the XLA/runtime knobs (replacing torch/cuDNN knobs, reference cli.py:186-196)."""
     import jax
@@ -189,6 +198,7 @@ def run(args: Optional[Sequence[str]] = None) -> None:
         cfg = resume_from_checkpoint(cfg)
     check_configs(cfg)
     _setup_xla_env(cfg)
+    _apply_distribution_cfg(cfg)
     if cfg.metric.log_level > 0:
         print_config(cfg)
     run_algorithm(cfg)
@@ -256,6 +266,7 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
         base["fabric"]["accelerator"] = kv["fabric.accelerator"]
     cfg = dotdict(base)
     check_configs_evaluation(cfg)
+    _apply_distribution_cfg(cfg)
     eval_algorithm(cfg)
 
 

@@ -395,7 +395,14 @@ def resolve_interpolations(cfg: Dict[str, Any]) -> Dict[str, Any]:
         if ref.startswith("oc.env:") or ref.startswith("env:"):
             body = ref.split(":", 1)[1]
             var, _, default = body.partition(",")
-            return os.environ.get(var.strip(), default.strip())
+            var = var.strip()
+            if var in os.environ:
+                return os.environ[var]
+            # YAML-style scalars in the DEFAULT position keep their type; a set env
+            # var always passes through as a raw string (OmegaConf parity:
+            # ${oc.env:VAR,null} -> None only when VAR is unset)
+            default = default.strip()
+            return {"null": None, "None": None, "true": True, "false": False}.get(default, default)
         try:
             return resolve_value(get_by_path(cfg, ref), depth + 1)
         except KeyError:

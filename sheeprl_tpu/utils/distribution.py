@@ -19,6 +19,44 @@ from sheeprl_tpu.utils.utils import symexp, symlog
 
 _HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
 
+# Module-level argument-validation switch, set from ``cfg.distribution.validate_args``
+# at CLI startup (role of the reference's global torch-distribution toggle,
+# sheeprl/cli.py:71 + configs/distribution/default.yaml). Under jit only *static*
+# properties (shapes, dtypes, broadcastability) can be validated — value-dependent
+# checks would need checkify — and shape bugs are exactly what the toggle catches.
+_VALIDATE_ARGS = False
+
+
+def set_validate_args(enabled: bool) -> None:
+    global _VALIDATE_ARGS
+    _VALIDATE_ARGS = bool(enabled)
+
+
+def validate_args_enabled() -> bool:
+    return _VALIDATE_ARGS
+
+
+def _check_broadcastable(name: str, value: jax.Array, *params: jax.Array) -> None:
+    if not _VALIDATE_ARGS:
+        return
+    batch_shape = jnp.broadcast_shapes(*(jnp.shape(p) for p in params))
+    try:
+        jnp.broadcast_shapes(jnp.shape(value), batch_shape)
+    except ValueError as err:
+        raise ValueError(
+            f"{name}.log_prob: value shape {tuple(jnp.shape(value))} is not broadcastable "
+            f"against the distribution's batch shape {tuple(batch_shape)}"
+        ) from err
+
+
+def _check_last_dim(name: str, value: jax.Array, size: int) -> None:
+    if not _VALIDATE_ARGS:
+        return
+    if value.shape[-1] != size:
+        raise ValueError(
+            f"{name}.log_prob: value's event dimension is {value.shape[-1]}, expected {size}"
+        )
+
 
 def _sum_rightmost(x: jax.Array, ndims: int) -> jax.Array:
     if ndims == 0:
@@ -71,6 +109,7 @@ class Normal(Distribution):
         return self.sample(key)
 
     def log_prob(self, value: jax.Array) -> jax.Array:
+        _check_broadcastable("Normal", value, self.loc, self.scale)
         var = jnp.square(self.scale)
         return -jnp.square(value - self.loc) / (2 * var) - jnp.log(self.scale) - _HALF_LOG_2PI
 
@@ -170,6 +209,7 @@ class OneHotCategorical(Distribution):
         return jax.nn.one_hot(idx, self._cat.num_categories, dtype=self.logits.dtype)
 
     def log_prob(self, value: jax.Array) -> jax.Array:
+        _check_last_dim("OneHotCategorical", value, self.logits.shape[-1])
         return jnp.sum(self.logits * value, axis=-1)
 
     def entropy(self) -> jax.Array:

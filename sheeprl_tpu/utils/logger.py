@@ -109,3 +109,79 @@ def get_log_dir(fabric, root_dir: str, run_name: str, share: bool = True) -> str
 
         log_dir = distributed.host_broadcast_object(log_dir, src=0)
     return log_dir
+
+
+class MLFlowLogger:
+    """MLflow metric/param logger (role of the reference's lightning MLFlowLogger
+    option, sheeprl/utils/logger.py:12-36 + configs/logger/mlflow.yaml). Optional
+    dependency: constructing it without mlflow installed raises the import-gate
+    error; the default TensorBoard path never imports mlflow."""
+
+    def __init__(
+        self,
+        experiment_name: str = "sheeprl",
+        tracking_uri: Optional[str] = None,
+        run_name: Optional[str] = None,
+        run_id: Optional[str] = None,
+        tags: Optional[Dict[str, Any]] = None,
+        **_: Any,
+    ) -> None:
+        from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+        if not _IS_MLFLOW_AVAILABLE:
+            raise ModuleNotFoundError("mlflow is not installed: pip install mlflow")
+        import mlflow
+
+        self._mlflow = mlflow
+        self.tracking_uri = tracking_uri or os.environ.get("MLFLOW_TRACKING_URI")
+        if self.tracking_uri:
+            mlflow.set_tracking_uri(self.tracking_uri)
+        from sheeprl_tpu.utils.mlflow import get_or_create_experiment
+
+        experiment_id = get_or_create_experiment(experiment_name)
+        self._run = mlflow.start_run(
+            run_id=run_id, experiment_id=experiment_id, run_name=run_name, tags=tags
+        )
+
+    @property
+    def run_id(self) -> str:
+        return self._run.info.run_id
+
+    @property
+    def log_dir(self) -> Optional[str]:
+        return None
+
+    def log_metrics(self, metrics: Dict[str, float], step: Optional[int] = None) -> None:
+        clean = {}
+        for k, v in metrics.items():
+            try:
+                clean[k] = float(v)
+            except (TypeError, ValueError):
+                continue
+        if clean:
+            self._mlflow.log_metrics(clean, step=step)
+
+    def log_hyperparams(self, params: Dict[str, Any]) -> None:
+        try:
+            flat = {}
+
+            def _walk(prefix, node):
+                if isinstance(node, dict):
+                    for k, v in node.items():
+                        _walk(f"{prefix}.{k}" if prefix else str(k), v)
+                else:
+                    flat[prefix] = node
+
+            _walk("", params)
+            # mlflow caps params per batch; log in chunks
+            items = list(flat.items())
+            for i in range(0, len(items), 90):
+                self._mlflow.log_params({k: str(v)[:250] for k, v in items[i : i + 90]})
+        except Exception:
+            pass
+
+    def finalize(self) -> None:
+        try:
+            self._mlflow.end_run()
+        except Exception:
+            pass

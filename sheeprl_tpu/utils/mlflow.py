@@ -1,0 +1,225 @@
+"""MLflow model-registry integration (role of sheeprl/utils/mlflow.py:35-427).
+
+TPU-native twist: there are no torch ``nn.Module``s to pickle — a "model" here is a
+named parameter pytree (the same subtrees the checkpoints store, e.g. Dreamer's
+``world_model`` / ``actor`` / ``critic``). Each registered model version is an MLflow
+run artifact holding the flax-serialized pytree plus a small JSON manifest, and the
+registry CRUD (versions, stage transitions, deletion, best-model selection, download)
+matches the reference ``MlflowModelManager`` surface.
+
+Every entrypoint import-gates on mlflow (optional dependency, reference
+utils/imports.py) — importing this module without mlflow raises a clear error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from datetime import datetime
+from typing import Any, Dict, Mapping, Optional
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+if not _IS_MLFLOW_AVAILABLE:
+    raise ModuleNotFoundError("mlflow is not installed: pip install mlflow")
+
+import mlflow  # noqa: E402
+
+MODEL_ARTIFACT_NAME = "params.msgpack"
+
+
+def get_or_create_experiment(experiment_name: str) -> str:
+    """Shared get-or-create for MLflow experiments (used by both the logger and the
+    registration flow so deleted-experiment edge-case fixes live in one place)."""
+    experiment = mlflow.get_experiment_by_name(experiment_name)
+    if experiment is None:
+        return mlflow.create_experiment(experiment_name)
+    return experiment.experiment_id
+
+
+def _serialize_params(params: Any) -> bytes:
+    from flax import serialization
+
+    return serialization.to_bytes(params)
+
+
+def log_params_as_model(name: str, params: Any, extra_manifest: Optional[Dict[str, Any]] = None):
+    """Log one named parameter pytree as an artifact directory of the ACTIVE run and
+    return its ``runs:/`` model URI (the role of mlflow.pytorch.log_model in the
+    reference's per-algo ``log_models``)."""
+    import jax
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir = os.path.join(tmp, name)
+        os.makedirs(model_dir, exist_ok=True)
+        with open(os.path.join(model_dir, MODEL_ARTIFACT_NAME), "wb") as f:
+            f.write(_serialize_params(params))
+        manifest = {
+            "name": name,
+            "format": "flax.serialization.to_bytes",
+            "n_leaves": len(jax.tree_util.tree_leaves(params)),
+            **(extra_manifest or {}),
+        }
+        with open(os.path.join(model_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2, default=str)
+        mlflow.log_artifacts(model_dir, artifact_path=name)
+    run = mlflow.active_run()
+    return f"runs:/{run.info.run_id}/{name}"
+
+
+class MlflowModelManager:
+    """Registry CRUD over MlflowClient (reference MlflowModelManager,
+    sheeprl/utils/mlflow.py:75-327: register/get_latest_version/transition/delete/
+    register_best_models/download)."""
+
+    def __init__(self, tracking_uri: Optional[str] = None):
+        self.tracking_uri = tracking_uri or os.getenv("MLFLOW_TRACKING_URI")
+        if self.tracking_uri is None:
+            raise ValueError(
+                "The tracking uri is not defined: pass tracking_uri or set the "
+                "MLFLOW_TRACKING_URI environment variable."
+            )
+        mlflow.set_tracking_uri(self.tracking_uri)
+        self.client = mlflow.MlflowClient(self.tracking_uri)
+
+    @staticmethod
+    def _stamp(description: Optional[str]) -> str:
+        when = datetime.today().strftime("%Y-%m-%d %H:%M:%S")
+        return f"{description or ''}\nRegistered at: {when}".strip()
+
+    def register_model(
+        self,
+        model_uri: str,
+        model_name: str,
+        description: Optional[str] = None,
+        tags: Optional[Mapping[str, Any]] = None,
+    ):
+        version = mlflow.register_model(model_uri=model_uri, name=model_name, tags=dict(tags or {}))
+        self.client.update_model_version(model_name, version.version, self._stamp(description))
+        return version
+
+    def get_latest_version(self, model_name: str):
+        versions = self.client.search_model_versions(f"name = '{model_name}'")
+        if not versions:
+            raise ValueError(f"no versions registered for model {model_name!r}")
+        return max(versions, key=lambda v: int(v.version))
+
+    def transition_model(
+        self,
+        model_name: str,
+        version: int,
+        stage: str,
+        description: Optional[str] = None,
+    ):
+        self.client.transition_model_version_stage(model_name, str(version), stage)
+        if description:
+            self.client.update_model_version(model_name, str(version), self._stamp(description))
+        return self.client.get_model_version(model_name, str(version))
+
+    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+        self.client.delete_model_version(model_name, str(version))
+
+    def register_best_models(
+        self,
+        experiment_name: str,
+        models_info: Mapping[str, Mapping[str, Any]],
+        metric: str = "Test/cumulative_reward",
+        mode: str = "max",
+    ) -> None:
+        """Select the best run of an experiment by ``metric`` and register its models
+        (reference mlflow.py:214-279)."""
+        if mode not in ("max", "min"):
+            raise ValueError(f"mode must be 'max' or 'min', got {mode!r}")
+        experiment = mlflow.get_experiment_by_name(experiment_name)
+        if experiment is None:
+            raise ValueError(f"experiment {experiment_name!r} not found")
+        order = "DESC" if mode == "max" else "ASC"
+        runs = self.client.search_runs(
+            [experiment.experiment_id], order_by=[f"metrics.`{metric}` {order}"], max_results=1
+        )
+        if not runs:
+            raise ValueError(f"no runs found for experiment {experiment_name!r}")
+        best = runs[0]
+        for name, info in models_info.items():
+            self.register_model(
+                f"runs:/{best.info.run_id}/{name}",
+                info["model_name"],
+                info.get("description"),
+                info.get("tags"),
+            )
+
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        os.makedirs(output_path, exist_ok=True)
+        uri = f"models:/{model_name}/{version}"
+        mlflow.artifacts.download_artifacts(artifact_uri=uri, dst_path=output_path)
+
+
+def models_from_checkpoint_state(state: Dict[str, Any], model_names) -> Dict[str, Any]:
+    """Map registry model names onto checkpoint subtrees: ``agent`` is the whole
+    parameter tree, ``moments*`` live beside it in the state, anything else is a
+    named subtree of ``state['agent']`` (Dreamer world_model/actor/critic/...)."""
+    params = state["agent"]
+    out: Dict[str, Any] = {}
+    for name in model_names:
+        if name == "agent":
+            out[name] = params
+        elif name.startswith("moments"):
+            key = name if name in state else "moments"
+            if key not in state:
+                raise KeyError(f"checkpoint has no {name!r} state")
+            out[name] = state[key]
+        elif isinstance(params, Mapping) and name in params:
+            out[name] = params[name]
+        else:
+            raise KeyError(
+                f"model {name!r} not found in the checkpoint "
+                f"(available: {list(params.keys()) if isinstance(params, Mapping) else 'agent'})"
+            )
+    return out
+
+
+def register_model_from_checkpoint(kv: Dict[str, str]) -> Dict[str, Any]:
+    """``sheeprl-registration checkpoint_path=... [tracking_uri=...]`` — load the
+    checkpoint + its run config, log each model_manager-selected parameter tree as a
+    run artifact and register it (reference cli.py:407-449 +
+    utils/mlflow.py:330-381). Returns {model_name: registered version}."""
+    import yaml
+
+    from sheeprl_tpu.config.dotdict import dotdict
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+    ckpt_path = kv.get("checkpoint_path")
+    if ckpt_path is None:
+        raise ValueError("you must specify checkpoint_path=...")
+    cfg_path = os.path.join(os.path.dirname(ckpt_path), "..", "config.yaml")
+    if not os.path.isfile(cfg_path):
+        cfg_path = os.path.join(os.path.dirname(ckpt_path), "config.yaml")
+    with open(cfg_path) as f:
+        cfg = dotdict(yaml.safe_load(f))
+
+    tracking_uri = kv.get("tracking_uri") or os.getenv("MLFLOW_TRACKING_URI")
+    manager = MlflowModelManager(tracking_uri)
+
+    state = load_checkpoint(ckpt_path)
+
+    mm = cfg.get("model_manager") or {}
+    models_cfg = dict(mm.get("models") or {})
+    if not models_cfg:
+        raise RuntimeError(
+            "model_manager.models is empty; select a model_manager config for this "
+            "algorithm (e.g. model_manager@model_manager=dreamer_v3)"
+        )
+    models = models_from_checkpoint_state(state, models_cfg.keys())
+
+    exp_name = kv.get("experiment_name", cfg.get("exp_name", cfg.algo.name))
+    experiment_id = get_or_create_experiment(exp_name)
+    run_name = f"{cfg.algo.name}_{cfg.env.id}_{datetime.today().strftime('%Y-%m-%d %H:%M:%S')}"
+    registered: Dict[str, Any] = {}
+    with mlflow.start_run(experiment_id=experiment_id, run_name=run_name):
+        for name, model_cfg in models_cfg.items():
+            uri = log_params_as_model(name, models[name], {"checkpoint_path": ckpt_path})
+            registered[model_cfg["model_name"]] = manager.register_model(
+                uri, model_cfg["model_name"], model_cfg.get("description"), model_cfg.get("tags")
+            )
+    return registered

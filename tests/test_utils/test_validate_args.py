@@ -1,0 +1,49 @@
+"""The distribution.validate_args config must actually gate shape validation
+(reference analogue: the global torch-distributions toggle, sheeprl/cli.py:71;
+round-2 VERDICT flagged the key as dead config)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.utils import distribution as D
+
+
+@pytest.fixture(autouse=True)
+def _reset_validate_args():
+    yield
+    D.set_validate_args(False)
+
+
+def test_disabled_by_default_allows_mismatch():
+    D.set_validate_args(False)
+    d = D.OneHotCategorical(logits=jnp.zeros((4, 6)))
+    # wrong event size silently broadcasts when validation is off (torch parity)
+    d.log_prob(jnp.zeros((4, 1)))
+
+
+def test_enabled_raises_on_bad_event_dim():
+    D.set_validate_args(True)
+    d = D.OneHotCategorical(logits=jnp.zeros((4, 6)))
+    with pytest.raises(ValueError, match="event dimension"):
+        d.log_prob(jnp.zeros((4, 3)))
+
+
+def test_enabled_raises_on_non_broadcastable_normal():
+    D.set_validate_args(True)
+    d = D.Normal(jnp.zeros((4, 2)), jnp.ones((4, 2)))
+    with pytest.raises(ValueError, match="broadcastable"):
+        d.log_prob(jnp.zeros((3, 5)))
+    # broadcastable values still fine
+    d.log_prob(jnp.zeros((1, 2)))
+
+
+def test_cli_flag_flows_to_module():
+    from sheeprl_tpu.cli import _apply_distribution_cfg
+    from sheeprl_tpu.config.dotdict import dotdict
+
+    _apply_distribution_cfg(dotdict({"distribution": {"validate_args": True}}))
+    assert D.validate_args_enabled()
+    _apply_distribution_cfg(dotdict({"distribution": {"validate_args": False}}))
+    assert not D.validate_args_enabled()
