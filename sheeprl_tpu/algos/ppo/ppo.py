@@ -237,13 +237,21 @@ def main(fabric, cfg: Dict[str, Any]):
             cfg.algo.gamma,
             cfg.algo.gae_lambda,
         )
-        flat = {k: v.reshape(-1, *v.shape[2:]) for k, v in data.items()}
-        flat["returns"] = returns.reshape(-1, 1)
-        flat["advantages"] = advantages.reshape(-1, 1)
+        # env-major flatten: the rollout arrives sharded on the env axis
+        # (P(None, "data")), so flattening (T, E) -> (E*T) keeps each device's rows
+        # as ONE contiguous block — the layout epoch_permutation's device-local
+        # minibatching assumes. A time-major reshape would interleave shards.
+        flat = {k: jnp.swapaxes(v, 0, 1).reshape(-1, *v.shape[2:]) for k, v in data.items()}
+        flat["returns"] = jnp.swapaxes(returns, 0, 1).reshape(-1, 1)
+        flat["advantages"] = jnp.swapaxes(advantages, 0, 1).reshape(-1, 1)
+        if world_size > 1:
+            flat = jax.lax.with_sharding_constraint(
+                flat, jax.sharding.NamedSharding(fabric.mesh, jax.sharding.PartitionSpec("data"))
+            )
 
         def epoch_body(carry, epoch_key):
             params, opt_state = carry
-            perm = epoch_permutation(epoch_key, num_rows, world_size, share_data)
+            perm = epoch_permutation(epoch_key, num_rows, world_size, share_data, global_bs)
             # pad (wrapping into the permutation) so every row is visited each epoch
             # even when num_rows is not a multiple of the global batch
             pad = num_minibatches * global_bs - num_rows

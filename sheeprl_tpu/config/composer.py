@@ -398,11 +398,20 @@ def resolve_interpolations(cfg: Dict[str, Any]) -> Dict[str, Any]:
             var = var.strip()
             if var in os.environ:
                 return os.environ[var]
-            # YAML-style scalars in the DEFAULT position keep their type; a set env
-            # var always passes through as a raw string (OmegaConf parity:
-            # ${oc.env:VAR,null} -> None only when VAR is unset)
+            # YAML-style scalars in the DEFAULT position keep their type (null/bool/
+            # int/float); a set env var always passes through as a raw string
+            # (OmegaConf parity: ${oc.env:VAR,null} -> None only when VAR is unset)
             default = default.strip()
-            return {"null": None, "None": None, "true": True, "false": False}.get(default, default)
+            if default in ("null", "None"):
+                return None
+            if default in ("true", "false"):
+                return default == "true"
+            for cast in (int, float):
+                try:
+                    return cast(default)
+                except ValueError:
+                    pass
+            return default
         try:
             return resolve_value(get_by_path(cfg, ref), depth + 1)
         except KeyError:

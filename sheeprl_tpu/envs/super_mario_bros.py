@@ -48,7 +48,12 @@ class SuperMarioBrosWrapper(gym.Env):
         if isinstance(action, np.ndarray):
             action = action.squeeze().item()
         obs, reward, done, info = self._env.step(action)
-        is_timelimit = info.get("time", False)
+        # ``info["time"]`` is the in-game countdown clock: an episode is a time-limit
+        # truncation only when the clock actually EXPIRED. (The reference wrapper
+        # treats any nonzero clock as truncation — sheeprl/envs/super_mario_bros.py —
+        # which mislabels deaths as truncated and skews value bootstrapping;
+        # ADVICE round-2 flagged it, fixed here rather than preserved.)
+        is_timelimit = info.get("time", 1) == 0
         return {"rgb": obs.copy()}, reward, done and not is_timelimit, done and is_timelimit, info
 
     def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):

@@ -117,7 +117,7 @@ class MlflowModelManager:
             self.client.update_model_version(model_name, str(version), self._stamp(description))
         return self.client.get_model_version(model_name, str(version))
 
-    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+    def delete_model(self, model_name: str, version: int) -> None:
         self.client.delete_model_version(model_name, str(version))
 
     def register_best_models(
@@ -155,27 +155,54 @@ class MlflowModelManager:
         mlflow.artifacts.download_artifacts(artifact_uri=uri, dst_path=output_path)
 
 
+def _walk_named_subtree(node: Any, name: str):
+    """Resolve a registry model name against a nested param mapping by greedy
+    longest-key prefix matching: ``moments_exploration_intrinsic`` walks
+    ``node['exploration']['intrinsic']``, ``world_model`` matches the literal key."""
+    if isinstance(node, Mapping) and name in node:
+        return node[name]
+    if isinstance(node, Mapping):
+        for key in sorted(node, key=len, reverse=True):
+            if name.startswith(key + "_"):
+                try:
+                    return _walk_named_subtree(node[key], name[len(key) + 1 :])
+                except KeyError:
+                    continue
+    raise KeyError(name)
+
+
 def models_from_checkpoint_state(state: Dict[str, Any], model_names) -> Dict[str, Any]:
     """Map registry model names onto checkpoint subtrees: ``agent`` is the whole
-    parameter tree, ``moments*`` live beside it in the state, anything else is a
-    named subtree of ``state['agent']`` (Dreamer world_model/actor/critic/...)."""
+    parameter tree, ``moments*`` resolve inside the ``moments`` state (per-stream
+    Moments like p2e_dv3's ``{'task', 'exploration': {'intrinsic', 'extrinsic'}}``
+    resolve to their own subtree, never the whole dict), anything else is a named
+    subtree of ``state['agent']`` (Dreamer world_model/actor/critic/...)."""
     params = state["agent"]
     out: Dict[str, Any] = {}
     for name in model_names:
         if name == "agent":
             out[name] = params
-        elif name.startswith("moments"):
-            key = name if name in state else "moments"
-            if key not in state:
-                raise KeyError(f"checkpoint has no {name!r} state")
-            out[name] = state[key]
-        elif isinstance(params, Mapping) and name in params:
-            out[name] = params[name]
+        elif name == "moments" or name.startswith("moments_"):
+            if "moments" not in state:
+                raise KeyError(f"checkpoint has no moments state for model {name!r}")
+            if name == "moments":
+                out[name] = state["moments"]
+            else:
+                try:
+                    out[name] = _walk_named_subtree(state["moments"], name[len("moments_") :])
+                except KeyError:
+                    raise KeyError(
+                        f"model {name!r} does not resolve inside the checkpoint's moments "
+                        f"state (top-level keys: {list(state['moments'])})"
+                    ) from None
         else:
-            raise KeyError(
-                f"model {name!r} not found in the checkpoint "
-                f"(available: {list(params.keys()) if isinstance(params, Mapping) else 'agent'})"
-            )
+            try:
+                out[name] = _walk_named_subtree(params, name)
+            except KeyError:
+                raise KeyError(
+                    f"model {name!r} not found in the checkpoint "
+                    f"(available: {list(params.keys()) if isinstance(params, Mapping) else 'agent'})"
+                ) from None
     return out
 
 

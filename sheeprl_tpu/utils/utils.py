@@ -138,7 +138,11 @@ def compute_lambda_values(
 # misc numerics
 # ---------------------------------------------------------------------------------
 def epoch_permutation(
-    key: jax.Array, num_rows: int, world_size: int, share_data: bool
+    key: jax.Array,
+    num_rows: int,
+    world_size: int,
+    share_data: bool,
+    minibatch_size: Optional[int] = None,
 ) -> jax.Array:
     """Row-visit order for one optimization epoch over a ``data``-axis-sharded rollout.
 
@@ -148,11 +152,22 @@ def epoch_permutation(
     ``DistributedSampler``) — here a global permutation whose gathers XLA turns into
     ICI collectives; without it every device samples only its own rows (reference:
     ``RandomSampler`` on local data) — here a per-shard permutation, so minibatch
-    gathers stay device-local and no collective is emitted for the data plane.
+    gathers can stay device-local and no collective is needed for the data plane.
 
-    Rows are assumed contiguous per device shard (``device_put`` with a leading-axis
-    ``P("data")`` sharding). The returned order interleaves shards so every global
-    minibatch takes an equal slice of each device's rows.
+    Rows MUST be laid out contiguous per device shard — i.e. the flat axis carries a
+    plain leading-axis ``P("data")`` sharding, shard ``s`` owning rows
+    ``[s*rows_per_shard, (s+1)*rows_per_shard)``. (PPO flattens its ``(T, E)`` rollout
+    env-major — ``swapaxes(0, 1)`` before the reshape — precisely so the env-axis
+    sharding becomes this contiguous block layout.)
+
+    When ``minibatch_size`` is given (and divisible by ``world_size`` with
+    ``num_rows`` a multiple of it), each consecutive ``minibatch_size`` slice of the
+    returned order is arranged as per-shard contiguous blocks
+    ``[shard0 rows | shard1 rows | ...]`` — gathering such a minibatch from the
+    block-sharded operand leaves each output block on the shard that owns its rows,
+    so the take requires no cross-device movement. Otherwise the shards are
+    interleaved cyclically (position ``i`` belongs to shard ``i % world_size``),
+    which still draws equally from every shard per slice.
     """
     if share_data or world_size == 1 or num_rows % world_size != 0:
         return jax.random.permutation(key, num_rows)
@@ -161,6 +176,14 @@ def epoch_permutation(
     local = jnp.stack(
         [jax.random.permutation(k, rows_per_shard) for k in keys]
     ) + jnp.arange(world_size)[:, None] * rows_per_shard
+    if (
+        minibatch_size is not None
+        and minibatch_size % world_size == 0
+        and num_rows % minibatch_size == 0
+    ):
+        num_minibatches = num_rows // minibatch_size
+        block = minibatch_size // world_size
+        return local.reshape(world_size, num_minibatches, block).transpose(1, 0, 2).reshape(-1)
     return local.T.reshape(-1)
 
 

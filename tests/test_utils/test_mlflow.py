@@ -114,6 +114,27 @@ def test_models_from_checkpoint_state(mlflow_stub):
         mod.models_from_checkpoint_state(state, ["critic"])
 
 
+def test_models_from_checkpoint_state_per_stream_moments(mlflow_stub):
+    """p2e_dv3-shaped moments: every moments_* name must resolve to ITS OWN subtree,
+    never the whole moments dict (round-3 review finding)."""
+    mod, _ = mlflow_stub
+    state = {
+        "agent": {"world_model": {"w": np.ones(2)}},
+        "moments": {
+            "task": {"low": np.zeros(())},
+            "exploration": {"intrinsic": {"low": np.ones(())}, "extrinsic": {"low": 2 * np.ones(())}},
+        },
+    }
+    models = mod.models_from_checkpoint_state(
+        state, ["moments_task", "moments_exploration_intrinsic", "moments_exploration_extrinsic"]
+    )
+    assert models["moments_task"] == state["moments"]["task"]
+    assert models["moments_exploration_intrinsic"] == state["moments"]["exploration"]["intrinsic"]
+    assert models["moments_exploration_extrinsic"] == state["moments"]["exploration"]["extrinsic"]
+    with pytest.raises(KeyError, match="moments"):
+        mod.models_from_checkpoint_state(state, ["moments_bogus"])
+
+
 def test_register_model_from_checkpoint_flow(mlflow_stub, tmp_path):
     mod, rec = mlflow_stub
     from sheeprl_tpu.utils.checkpoint import save_checkpoint

@@ -21,6 +21,31 @@ def test_epoch_permutation_local_stays_in_shard():
         assert np.all((vals >= shard * rows_per) & (vals < (shard + 1) * rows_per))
 
 
+def test_epoch_permutation_minibatch_blocks_align_with_shards():
+    """With minibatch_size given, every minibatch slice is per-shard contiguous
+    blocks [shard0 | shard1 | ...] so the gather stays device-local (ADVICE round-2:
+    the cyclic interleave did not line up with block-contiguous output sharding)."""
+    num_rows, world, mb = 64, 4, 16
+    rows_per = num_rows // world
+    block = mb // world
+    perm = np.asarray(
+        epoch_permutation(jax.random.PRNGKey(0), num_rows, world, share_data=False, minibatch_size=mb)
+    )
+    assert sorted(perm.tolist()) == list(range(num_rows))
+    for m in range(num_rows // mb):
+        mb_rows = perm[m * mb : (m + 1) * mb].reshape(world, block)
+        for shard in range(world):
+            vals = mb_rows[shard]
+            assert np.all((vals >= shard * rows_per) & (vals < (shard + 1) * rows_per))
+
+
+def test_epoch_permutation_minibatch_fallback_when_indivisible():
+    perm = np.asarray(
+        epoch_permutation(jax.random.PRNGKey(0), 64, 4, share_data=False, minibatch_size=24)
+    )
+    assert sorted(perm.tolist()) == list(range(64))
+
+
 def test_epoch_permutation_shared_mixes_shards():
     num_rows, world = 64, 8
     perm = np.asarray(epoch_permutation(jax.random.PRNGKey(0), num_rows, world, share_data=True))
