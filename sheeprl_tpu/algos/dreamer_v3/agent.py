@@ -281,6 +281,58 @@ class Actor(nn.Module):
         ]
 
 
+class MinedojoActor(Actor):
+    """Marker subclass selecting MineDojo action masking (reference agent.py:850-935).
+
+    Parameters and forward pass are identical to ``Actor`` — the masking is
+    sampling-time logic applied to the head logits (``apply_minedojo_masks`` below),
+    driven by the ``mask_*`` observation keys, so it lives in the pure sampling path
+    rather than the module."""
+
+
+# MineDojo functional-action ids whose argument heads are conditionally masked
+# (reference agent.py:908-925: 15=craft, 16/17=equip/place, 18=destroy)
+_MINEDOJO_CRAFT_ACTION = 15
+_MINEDOJO_EQUIP_PLACE_ACTIONS = (16, 17)
+_MINEDOJO_DESTROY_ACTION = 18
+MINEDOJO_MASK_KEYS = ("mask_action_type", "mask_craft_smelt", "mask_destroy", "mask_equip_place")
+
+
+def mask_minedojo_head(
+    head_idx: int,
+    logits: jax.Array,
+    mask: Dict[str, jax.Array],
+    functional_action: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Mask one MineDojo actor head's logits with the env-provided validity masks.
+
+    Head 0 (action type) is masked unconditionally; head 1 (craft argument) only
+    where the sampled functional action is craft; head 2 (equip/place/destroy
+    argument) per the sampled functional action. The reference does the conditional
+    part with a per-(t, b) python loop (agent.py:911-925); here it is a vectorized
+    ``jnp.where`` over the whole batch. ``functional_action`` (int ids, shape [...])
+    is the argmax of the freshly-sampled head-0 one-hot."""
+    neg_inf = jnp.asarray(-1e9, logits.dtype)
+    if head_idx == 0:
+        return jnp.where(mask["mask_action_type"].astype(bool), logits, neg_inf)
+    if functional_action is None:
+        return logits
+    if head_idx == 1 and "mask_craft_smelt" in mask:
+        is_craft = (functional_action == _MINEDOJO_CRAFT_ACTION)[..., None]
+        invalid = jnp.logical_not(mask["mask_craft_smelt"].astype(bool))
+        return jnp.where(jnp.logical_and(is_craft, invalid), neg_inf, logits)
+    if head_idx == 2 and "mask_equip_place" in mask and "mask_destroy" in mask:
+        is_equip_place = jnp.isin(
+            functional_action, jnp.asarray(_MINEDOJO_EQUIP_PLACE_ACTIONS)
+        )[..., None]
+        is_destroy = (functional_action == _MINEDOJO_DESTROY_ACTION)[..., None]
+        invalid_ep = jnp.logical_not(mask["mask_equip_place"].astype(bool))
+        invalid_d = jnp.logical_not(mask["mask_destroy"].astype(bool))
+        logits = jnp.where(jnp.logical_and(is_equip_place, invalid_ep), neg_inf, logits)
+        return jnp.where(jnp.logical_and(is_destroy, invalid_d), neg_inf, logits)
+    return logits
+
+
 # ---------------------------------------------------------------------------------
 # pure stochastic-state math
 # ---------------------------------------------------------------------------------
@@ -332,10 +384,13 @@ def actor_sample(
     pre_dist: List[jax.Array],
     key: jax.Array,
     greedy: bool = False,
+    mask: Optional[Dict[str, jax.Array]] = None,
 ) -> jax.Array:
     """Sample concatenated actions from the raw actor outputs (one-hot blocks for
     discrete dims, clipped tanh-mean scaled-normal for continuous — reference
-    Actor.forward, agent.py:790-855)."""
+    Actor.forward, agent.py:790-855). ``mask`` applies MineDojo per-head validity
+    masking (reference MinedojoActor.forward, agent.py:884-935): head 0 sampled
+    first, its functional action gating the argument heads."""
     cfg = agent.actor_cfg
     if agent.is_continuous:
         mean, std_raw = jnp.split(pre_dist[0], 2, axis=-1)
@@ -353,8 +408,11 @@ def actor_sample(
         return actions
     keys = jax.random.split(key, len(pre_dist))
     outs = []
+    functional_action = None
     for i, logits in enumerate(pre_dist):
         logits = unimix_logits(logits, logits.shape[-1], cfg.get("unimix", 0.01))
+        if mask is not None:
+            logits = mask_minedojo_head(i, logits, mask, functional_action)
         if greedy:
             idx = jnp.argmax(logits, axis=-1)
             outs.append(jax.nn.one_hot(idx, logits.shape[-1], dtype=logits.dtype))
@@ -363,6 +421,8 @@ def actor_sample(
             onehot = jax.nn.one_hot(idx, logits.shape[-1], dtype=logits.dtype)
             probs = jax.nn.softmax(logits, axis=-1)
             outs.append(jax.lax.stop_gradient(onehot) + probs - jax.lax.stop_gradient(probs))
+        if functional_action is None:
+            functional_action = jnp.argmax(outs[0], axis=-1)
     return jnp.concatenate(outs, axis=-1)
 
 
@@ -428,6 +488,10 @@ class DV3Agent:
     @property
     def stoch_state_size(self) -> int:
         return self.stochastic_size * self.discrete_size
+
+    @property
+    def is_minedojo(self) -> bool:
+        return isinstance(self.actor, MinedojoActor)
 
     @property
     def latent_state_size(self) -> int:
@@ -670,7 +734,14 @@ def build_agent(
         head_init_scale=1.0 if cfg.algo.hafner_initialization else None,
         dtype=dtype,
     )
-    actor = Actor(
+    cls_path = str(actor_cfg.get("cls") or "")
+    if cls_path:
+        from sheeprl_tpu.config.instantiate import locate
+
+        actor_cls = locate(cls_path)
+    else:
+        actor_cls = Actor
+    actor = actor_cls(
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,
         dense_units=actor_cfg.dense_units,
@@ -790,7 +861,10 @@ class PlayerDV3:
             _, z = agent_ref._representation(wm, h, embedded, k_repr)
             latent = jnp.concatenate([z, h], axis=-1)
             pre = agent_ref.actor.apply({"params": params["actor"]}, latent)
-            actions = actor_sample(agent_ref, pre, k_act, greedy=greedy)
+            mask = None
+            if agent_ref.is_minedojo and "mask_action_type" in obs:
+                mask = {k: obs[k] for k in MINEDOJO_MASK_KEYS if k in obs}
+            actions = actor_sample(agent_ref, pre, k_act, greedy=greedy, mask=mask)
             return actions, h, z, key
 
         self._step = jax.jit(_step, static_argnames=("greedy",))

@@ -406,11 +406,12 @@ def resolve_interpolations(cfg: Dict[str, Any]) -> Dict[str, Any]:
                 return None
             if default in ("true", "false"):
                 return default == "true"
-            for cast in (int, float):
-                try:
-                    return cast(default)
-                except ValueError:
-                    pass
+            # YAML number forms only — python-only spellings (nan/inf/1_000) stay
+            # strings, matching OmegaConf
+            if re.fullmatch(r"[+-]?\d+", default):
+                return int(default)
+            if re.fullmatch(r"[+-]?(\d+\.\d*|\.\d+|\d+)([eE][+-]?\d+)?", default):
+                return float(default)
             return default
         try:
             return resolve_value(get_by_path(cfg, ref), depth + 1)

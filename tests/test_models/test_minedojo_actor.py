@@ -1,0 +1,86 @@
+"""MineDojo action masking (reference MinedojoActor, dreamer_v3/agent.py:850-935):
+invalid action types can never be sampled, and the argument heads are masked only
+when the sampled functional action needs them."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    _MINEDOJO_CRAFT_ACTION,
+    mask_minedojo_head,
+)
+
+
+def test_head0_invalid_types_are_suppressed():
+    logits = jnp.zeros((4, 20))
+    mask = {"mask_action_type": jnp.asarray(np.eye(20)[3])[None].repeat(4, 0)}
+    out = mask_minedojo_head(0, logits, mask)
+    # only action 3 survives; sampling can never pick a masked type
+    assert np.all(np.argmax(np.asarray(out), -1) == 3)
+    assert np.all(np.asarray(out)[:, :3] < -1e8)
+
+
+def test_head1_masked_only_for_craft_action():
+    logits = jnp.zeros((4, 8))
+    craft_mask = jnp.concatenate([jnp.ones((4, 2)), jnp.zeros((4, 6))], axis=-1)
+    mask = {"mask_action_type": jnp.ones((4, 20)), "mask_craft_smelt": craft_mask}
+    fa_craft = jnp.full((4,), _MINEDOJO_CRAFT_ACTION)
+    fa_other = jnp.zeros((4,), jnp.int32)
+    out_craft = np.asarray(mask_minedojo_head(1, logits, mask, fa_craft))
+    out_other = np.asarray(mask_minedojo_head(1, logits, mask, fa_other))
+    assert np.all(out_craft[:, 2:] < -1e8) and np.all(out_craft[:, :2] == 0)
+    assert np.all(out_other == 0)  # non-craft actions leave the head unmasked
+
+
+def test_head2_equip_place_vs_destroy():
+    logits = jnp.zeros((3, 5))
+    mask = {
+        "mask_action_type": jnp.ones((3, 20)),
+        "mask_equip_place": jnp.asarray([[1, 1, 0, 0, 0]] * 3, jnp.float32),
+        "mask_destroy": jnp.asarray([[0, 0, 0, 1, 1]] * 3, jnp.float32),
+    }
+    fa = jnp.asarray([16, 18, 0])  # equip, destroy, other
+    out = np.asarray(mask_minedojo_head(2, logits, mask, fa))
+    assert np.all(out[0, 2:] < -1e8) and np.all(out[0, :2] == 0)  # equip mask row
+    assert np.all(out[1, :3] < -1e8) and np.all(out[1, 3:] == 0)  # destroy mask row
+    assert np.all(out[2] == 0)  # untouched
+
+
+def test_minedojo_actor_selected_from_config():
+    from sheeprl_tpu.algos.dreamer_v3.agent import MinedojoActor, build_agent
+    from sheeprl_tpu.config.composer import compose
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    import gymnasium as gym
+
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.actor.cls=sheeprl_tpu.algos.dreamer_v3.agent.MinedojoActor",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.mlp_keys.decoder=[]",
+        ]
+    )
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric._setup()
+    obs_space = gym.spaces.Dict(
+        {"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)}
+    )
+    agent, params = build_agent(fabric, (6,), False, cfg, obs_space, jax.random.PRNGKey(0), None)
+    assert isinstance(agent.actor, MinedojoActor)
+    assert agent.is_minedojo
