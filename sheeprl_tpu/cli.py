@@ -184,8 +184,18 @@ def run_algorithm(cfg: dotdict) -> None:
             cfg.fabric.devices = exploration_cfg.fabric.devices
         kwargs["exploration_cfg"] = exploration_cfg
 
-    fabric = instantiate(cfg.fabric)
-    fabric.launch(main, cfg, **kwargs)
+    fabric = instantiate(
+        cfg.fabric,
+        checkpoint_backend=str(cfg.checkpoint.get("backend", "pickle")),
+        checkpoint_async=bool(cfg.checkpoint.get("async_save", False)),
+    )
+    try:
+        fabric.launch(main, cfg, **kwargs)
+    finally:
+        if fabric.checkpoint_async:
+            from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
+
+            wait_for_checkpoint()
 
 
 def run(args: Optional[Sequence[str]] = None) -> None:
@@ -230,7 +240,12 @@ def eval_algorithm(cfg: dotdict) -> None:
         devices=1,
         accelerator=cfg.fabric.get("accelerator", "auto"),
         precision=cfg.fabric.get("precision", "32-true"),
+        checkpoint_backend=str((cfg.get("checkpoint") or {}).get("backend", "pickle")),
     )
+    # pin the platform BEFORE loading: the sharded (orbax) checkpoint reader touches
+    # jax, and backend discovery must respect fabric.accelerator=cpu (otherwise a
+    # cpu-pinned eval would still initialize — and possibly block on — the TPU)
+    fabric._setup()
     state = None
     if cfg.checkpoint_path:
         from sheeprl_tpu.utils.checkpoint import load_checkpoint

@@ -36,12 +36,16 @@ class Fabric:
         accelerator: str = "auto",
         precision: str = "32-true",
         callbacks: Optional[Sequence[Any]] = None,
+        checkpoint_backend: str = "pickle",
+        checkpoint_async: bool = False,
     ) -> None:
         self.requested_devices = devices
         self.num_nodes = num_nodes
         self.strategy = strategy
         self.accelerator = accelerator
         self.precision = precision
+        self.checkpoint_backend = checkpoint_backend
+        self.checkpoint_async = checkpoint_async
         self._callbacks = []
         for cb in callbacks or []:
             if isinstance(cb, dict) and "_target_" in cb:
@@ -195,10 +199,19 @@ class Fabric:
                 fn(fabric=self, **kwargs)
 
     def save(self, path: str, state: Dict[str, Any]) -> None:
-        from sheeprl_tpu.utils.checkpoint import save_checkpoint
-
+        """Write a checkpoint with the configured backend: ``pickle`` (default, one
+        consolidated file — reference fabric.save semantics) or ``sharded`` (orbax
+        directory, optionally async — the XL/pod-scale option). The backend is set
+        from ``cfg.checkpoint.backend`` by the CLI."""
         if self.is_global_zero:
-            save_checkpoint(path, state)
+            if self.checkpoint_backend == "sharded":
+                from sheeprl_tpu.utils.checkpoint import save_checkpoint_sharded
+
+                save_checkpoint_sharded(path, state, async_save=self.checkpoint_async)
+            else:
+                from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+                save_checkpoint(path, state)
         distributed.barrier("checkpoint")
 
     def load(self, path: str) -> Dict[str, Any]:
@@ -230,5 +243,7 @@ def get_single_device_fabric(fabric: Fabric) -> Fabric:
         accelerator=fabric.accelerator,
         precision=fabric.precision,
         callbacks=[],
+        checkpoint_backend=fabric.checkpoint_backend,
+        checkpoint_async=fabric.checkpoint_async,
     )
     return f

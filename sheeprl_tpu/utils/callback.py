@@ -88,6 +88,20 @@ class CheckpointCallback:
         ckpts = sorted(glob.glob(os.path.join(ckpt_folder, "*.ckpt")), key=os.path.getmtime)
         for stale in ckpts[: max(0, len(ckpts) - self.keep_last)]:
             try:
-                os.remove(stale)
+                if os.path.isdir(stale):  # sharded (orbax) checkpoint directory
+                    import shutil
+
+                    shutil.rmtree(stale, ignore_errors=True)
+                    if os.path.exists(stale + ".extras.pkl"):
+                        os.remove(stale + ".extras.pkl")
+                else:
+                    os.remove(stale)
             except OSError:
                 pass
+        # orphan sidecars from a crash between sidecar write and orbax commit
+        for sidecar in glob.glob(os.path.join(ckpt_folder, "*.ckpt.extras.pkl")):
+            if not os.path.isdir(sidecar[: -len(".extras.pkl")]):
+                try:
+                    os.remove(sidecar)
+                except OSError:
+                    pass

@@ -1,0 +1,109 @@
+"""Sharded (orbax) checkpoint backend: array pytrees round-trip through the orbax
+directory format, object leaves (replay buffers, python counters) ride the sidecar,
+and a Dreamer-V3 run checkpoints + resumes through it at devices=2 (VERDICT round-2
+item 9)."""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu.utils.checkpoint import (
+    load_checkpoint,
+    load_checkpoint_sharded,
+    save_checkpoint_sharded,
+)
+
+
+def test_array_pytree_roundtrip(tmp_path):
+    params = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.zeros(3)}
+    tx = optax.adam(1e-3)
+    state = {
+        "agent": params,
+        "opt_state": tx.init(params),
+        "iter_num": 7,
+        "ratio": {"calls": 3, "value": 0.5},
+    }
+    path = str(tmp_path / "ckpt_100_0.ckpt")
+    save_checkpoint_sharded(path, state)
+    assert os.path.isdir(path)
+    restored = load_checkpoint_sharded(path)
+    np.testing.assert_array_equal(restored["agent"]["w"], np.asarray(params["w"]))
+    # optax namedtuple structure survives
+    assert type(restored["opt_state"]).__name__ == type(state["opt_state"]).__name__
+    # python scalars keep their type (counters must stay ints across resume)
+    assert restored["iter_num"] == 7 and isinstance(restored["iter_num"], int)
+    assert restored["ratio"] == {"calls": 3, "value": 0.5}
+
+
+def test_object_leaves_ride_sidecar(tmp_path):
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    rb = ReplayBuffer(8, n_envs=1)
+    rb.add({"obs": np.ones((1, 1, 2), np.float32), "dones": np.zeros((1, 1, 1), np.float32)})
+    state = {"agent": {"w": jnp.ones(2)}, "rb": rb, "note": "hello"}
+    path = str(tmp_path / "ckpt_1_0.ckpt")
+    save_checkpoint_sharded(path, state)
+    restored = load_checkpoint(path)  # auto-detects the directory format
+    assert isinstance(restored["rb"], ReplayBuffer)
+    np.testing.assert_array_equal(restored["rb"]["obs"][0], rb["obs"][0])
+    assert restored["note"] == "hello"
+
+
+def test_async_save_lands(tmp_path):
+    from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
+
+    state = {"w": jnp.arange(4.0)}
+    path = str(tmp_path / "ckpt_async.ckpt")
+    save_checkpoint_sharded(path, state, async_save=True)
+    wait_for_checkpoint()
+    restored = load_checkpoint_sharded(path)
+    np.testing.assert_array_equal(restored["w"], np.arange(4.0))
+
+
+@pytest.mark.timeout(280)
+def test_dreamer_v3_sharded_checkpoint_resume_devices2(standard_args):
+    """Full path: DV3 trains at devices=2 with the sharded backend, writes an orbax
+    checkpoint directory, and a resumed run restores from it."""
+    from sheeprl_tpu.cli import run
+
+    args = standard_args + [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "fabric.devices=2",
+        "checkpoint.backend=sharded",
+        "checkpoint.save_last=True",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.horizon=4",
+        "algo.per_rank_batch_size=1",
+        "algo.per_rank_sequence_length=1",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.cnn_keys.decoder=[rgb]",
+        "algo.mlp_keys.encoder=[]",
+        "algo.mlp_keys.decoder=[]",
+        "root_dir=test_sharded",
+        "run_name=dv3",
+    ]
+    run(args)
+    ckpts = [p for p in glob.glob("logs/runs/test_sharded/dv3/**/ckpt_*.ckpt", recursive=True)]
+    assert ckpts, "no checkpoint written"
+    assert any(os.path.isdir(c) for c in ckpts), "sharded backend must write a directory"
+    ckpt = sorted(c for c in ckpts if os.path.isdir(c))[-1]
+    assert os.path.isfile(ckpt + ".extras.pkl")
+    run(args + [f"checkpoint.resume_from={ckpt}"])
