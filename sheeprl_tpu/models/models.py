@@ -196,12 +196,44 @@ class LayerNormGRUCell(nn.Module):
     @nn.compact
     def __call__(self, hx: jax.Array, x: jax.Array) -> jax.Array:
         inp = jnp.concatenate([x, hx], axis=-1).astype(self.dtype)
-        dense_kwargs = {"use_bias": self.bias, "dtype": self.dtype}
-        if self.kernel_init is not None:
-            dense_kwargs["kernel_init"] = self.kernel_init
-        gates = nn.Dense(3 * self.hidden_size, **dense_kwargs)(inp)
+        kernel_init = self.kernel_init or nn.initializers.lecun_normal()
+        # params stay float32 (flax's param_dtype convention — bf16-mixed keeps f32
+        # master weights); self.dtype only selects the COMPUTE dtype
+        w = self.param("kernel", kernel_init, (inp.shape[-1], 3 * self.hidden_size), jnp.float32)
+        b = (
+            self.param("bias", nn.initializers.zeros_init(), (3 * self.hidden_size,), jnp.float32)
+            if self.bias
+            else jnp.zeros((3 * self.hidden_size,), jnp.float32)
+        )
+        w = w.astype(self.dtype)
+        b = b.astype(self.dtype)
         if self.layer_norm:
-            gates = nn.LayerNorm(dtype=self.dtype, epsilon=self.layer_norm_eps)(gates)
+            scale = self.param(
+                "ln_scale", nn.initializers.ones_init(), (3 * self.hidden_size,), jnp.float32
+            )
+            offset = self.param(
+                "ln_bias", nn.initializers.zeros_init(), (3 * self.hidden_size,), jnp.float32
+            )
+            # the fused Pallas step (matmul + layernorm + gating in one VMEM pass)
+            # applies when lowering for TPU with the weight block VMEM-resident; any
+            # other lowering platform (e.g. the CPU-pinned act path of a TPU run)
+            # takes the XLA path — same math, parity-tested in tests/test_ops
+            from sheeprl_tpu import ops
+
+            hx_d = hx.astype(self.dtype)
+            if inp.ndim == 2 and ops.pallas_gru_applicable(inp.shape[-1], self.hidden_size):
+                return jax.lax.platform_dependent(
+                    tpu=lambda: ops.fused_ln_gru_step(
+                        inp, hx_d, w, b, scale, offset, eps=self.layer_norm_eps
+                    ),
+                    default=lambda: ops.ln_gru_step_reference(
+                        inp, hx_d, w, b, scale, offset, eps=self.layer_norm_eps
+                    ),
+                ).astype(self.dtype)
+            return ops.ln_gru_step_reference(
+                inp, hx_d, w, b, scale, offset, eps=self.layer_norm_eps
+            ).astype(self.dtype)
+        gates = inp @ w + b
         reset, cand, update = jnp.split(gates, 3, axis=-1)
         reset = jax.nn.sigmoid(reset)
         cand = jnp.tanh(reset * cand)

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the hot ops (SURVEY §2.4: the reference's native-speed
+layer is external libtorch/cuDNN kernels; here the custom-kernel layer is Pallas)."""
+
+from sheeprl_tpu.ops.gru import (
+    fused_ln_gru_step,
+    ln_gru_step_reference,
+    pallas_gru_applicable,
+)
+
+__all__ = [
+    "fused_ln_gru_step",
+    "ln_gru_step_reference",
+    "pallas_gru_applicable",
+]
