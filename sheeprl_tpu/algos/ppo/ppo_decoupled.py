@@ -53,11 +53,18 @@ def _trainer_loop(
     data_q: "queue.Queue",
     params_q: "queue.Queue",
     error: Dict[str, Any],
+    geometry: Optional[Dict[str, int]] = None,
 ):
     """Learner role (reference trainer(), ppo_decoupled.py:368-620): consume rollout
-    blocks, run the fused epochs×minibatches program on the mesh, publish params."""
+    blocks, run the fused epochs×minibatches program on the mesh, publish params.
+
+    ``geometry`` overrides the rollout-derived sizes with the PLAYER's (two-process
+    topology, where the roles may own different device counts); None derives them
+    locally (threaded topology: both roles share one fabric)."""
     try:
         world_size = fabric.world_size
+        if geometry is not None:
+            world_size = int(geometry["player_world_size"])
         total_num_envs = int(cfg.env.num_envs * world_size)
         loss_reduction = cfg.algo.loss_reduction
         vf_coef = float(cfg.algo.vf_coef)
@@ -125,7 +132,9 @@ def _trainer_loop(
             (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
             return params, opt_state, losses.mean(axis=0)
 
-        if world_size > 1:
+        # sharding/replication follow the learner's OWN mesh, not the data geometry
+        mesh_size = fabric.world_size
+        if mesh_size > 1:
             params = fabric.replicate_pytree(params)
             opt_state = fabric.replicate_pytree(opt_state)
 
@@ -136,7 +145,7 @@ def _trainer_loop(
                 params_q.put(None)
                 return
             flat, clip_coef, ent_coef, want_opt_state = msg
-            if world_size > 1:
+            if mesh_size > 1:
                 flat = jax.device_put(flat, fabric.data_sharding)
             key, train_key = jax.random.split(key)
             params, opt_state, mean_losses = train_phase(
@@ -157,291 +166,390 @@ def _trainer_loop(
         params_q.put(None)
 
 
+class _BcastChannel:
+    """Pod-level plane over the host object channel with the in-process queue's
+    ``put``/``get`` surface, so the player body and ``_trainer_loop`` run unchanged
+    over either topology. ``src=0`` is the data plane — the player's rollout block
+    (role of the reference's pickled-object scatter, ppo_decoupled.py:294-299);
+    ``src=1`` the weight plane — the learner's updated params (the reference's
+    flattened-parameter broadcast, :302-305). Broadcasts are lockstep collectives,
+    so a blocking ``get`` preserves the reference's synchronous alternation."""
+
+    def __init__(self, src: int) -> None:
+        self.src = src
+
+    def put(self, msg):
+        from sheeprl_tpu.parallel import distributed
+
+        distributed.host_broadcast_object(msg, src=self.src)
+
+    def get(self):
+        from sheeprl_tpu.parallel import distributed
+
+        return distributed.host_broadcast_object(None, src=self.src)
+
+
+def _learner_process(fabric, cfg: Dict[str, Any]):
+    """Learner role of the TWO-PROCESS topology (reference trainer ranks,
+    ppo_decoupled.py:368-620): its own jax.distributed process with a local device
+    mesh; consumes rollout blocks and publishes params over the host channels."""
+    env = make_env(cfg, cfg.seed, 0, None, "learner")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    env.close()
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    # same seed as the player's rank-0 init -> identical initial params, so no
+    # initial weight transfer is needed (the reference instead ships the first
+    # flattened parameter vector, ppo_decoupled.py:126)
+    key = fabric.seed_everything(cfg.seed)
+    key, agent_key = jax.random.split(key)
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
+    data_q, params_q = _BcastChannel(src=0), _BcastChannel(src=1)
+    # geometry handshake: the PLAYER's rollout shape drives the learner's minibatch
+    # math — the two roles may own different device counts (env-hosts vs learner
+    # slice), so deriving it from the learner's own world_size would corrupt
+    # training (the reference likewise broadcasts cfg/agent args first, :114-117)
+    geometry = data_q.get()
+    if geometry is None:  # player failed before the first rollout
+        return
+    error: Dict[str, Any] = {}
+    _trainer_loop(fabric, cfg, agent, params, data_q, params_q, error, geometry=geometry)
+    if "exc" in error:
+        # the player is (or will be) blocked sending its final sentinel — consume
+        # it and ack so the lockstep broadcasts stay paired, then surface the crash
+        data_q.get()
+        params_q.put(None)
+        raise error["exc"]
+
+
 @register_algorithm(decoupled=True)
 def main(fabric, cfg: Dict[str, Any]):
-    initial_ent_coef = float(cfg.algo.ent_coef)
-    initial_clip_coef = float(cfg.algo.clip_coef)
-
-    rank = fabric.global_rank
-    world_size = fabric.world_size
+    from sheeprl_tpu.parallel import distributed
 
     if cfg.checkpoint.resume_from:
+        # checked before the role split so every process raises consistently
         raise ValueError(
             "The decoupled PPO implementation does not support resuming from a checkpoint; "
             "use the coupled `ppo` algorithm to resume"
         )
 
-    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
-    logger = get_logger(fabric, cfg, log_dir=log_dir)
-    fabric.logger = logger
-    if logger is not None:
-        logger.log_hyperparams(cfg.as_dict())
-    fabric.print(f"Log dir: {log_dir}")
+    two_process = distributed.process_count() >= 2
+    if two_process:
+        # MPMD role split over jax.distributed processes: each role computes on its
+        # OWN devices; the data/weight planes ride the host object channel
+        fabric.local_mesh = True
+        fabric._setup()
+        if distributed.process_index() >= 1:
+            return _learner_process(fabric, cfg)
 
-    total_num_envs = int(cfg.env.num_envs * world_size)
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = vectorized_env(
-        [
-            make_env(
-                cfg,
-                cfg.seed + rank * total_num_envs + i,
-                rank * total_num_envs,
-                log_dir if rank == 0 else None,
-                "train",
-                vector_env_idx=i,
-            )
-            for i in range(total_num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
-    observation_space = envs.single_observation_space
-    if not isinstance(observation_space, gym.spaces.Dict):
-        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
-    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
-    cnn_keys = cfg.algo.cnn_keys.encoder
+    # any player-side failure must release a learner blocked in a channel
+    _protocol_done = False
+    try:
+        initial_ent_coef = float(cfg.algo.ent_coef)
+        initial_clip_coef = float(cfg.algo.clip_coef)
 
-    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
-    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
-    actions_dim = tuple(
-        envs.single_action_space.shape
-        if is_continuous
-        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
-    )
+        rank = fabric.global_rank
+        world_size = fabric.world_size
 
-    key = fabric.seed_everything(cfg.seed + rank)
-    key, agent_key = jax.random.split(key)
-    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
+        # two-process mode: the learner never calls get_log_dir, so sharing the dir over
+        # a collective would desync the channel pairing — the player keeps it local
+        log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, share=not two_process)
+        logger = get_logger(fabric, cfg, log_dir=log_dir)
+        fabric.logger = logger
+        if logger is not None:
+            logger.log_hyperparams(cfg.as_dict())
+        fabric.print(f"Log dir: {log_dir}")
 
-    if fabric.is_global_zero:
-        save_configs(cfg, log_dir)
-
-    aggregator = None
-    if not MetricAggregator.disabled:
-        aggregator = instantiate(cfg.metric.aggregator)
-
-    rb = ReplayBuffer(
-        cfg.algo.rollout_steps,
-        total_num_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        obs_keys=obs_keys,
-    )
-
-    policy_steps_per_iter = int(total_num_envs * cfg.algo.rollout_steps)
-    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
-    last_log = 0
-    last_checkpoint = 0
-    policy_step = 0
-
-    # ---------------- channels + learner thread ----------------
-    data_q: "queue.Queue" = queue.Queue(maxsize=1)
-    params_q: "queue.Queue" = queue.Queue(maxsize=1)
-    error: Dict[str, Any] = {}
-    trainer = threading.Thread(
-        target=_trainer_loop,
-        args=(fabric, cfg, agent, params, data_q, params_q, error),
-        daemon=True,
-        name="ppo-learner",
-    )
-    trainer.start()
-
-    cpu_device = jax.devices("cpu")[0]
-    act_on_cpu = fabric.device.platform != "cpu"
-
-    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
-    def policy_step_fn(params, obs: Dict[str, jax.Array], key):
-        # PRNG chain advances inside the jitted program (saves ~0.5 ms/step)
-        key, step_key = jax.random.split(key)
-        norm_obs = normalize_obs(obs, cnn_keys, obs_keys)
-        norm_obs = {k: v.astype(jnp.float32) for k, v in norm_obs.items()}
-        actor_outs, values = agent.apply({"params": params}, norm_obs)
-        out = policy_output(actor_outs, values, step_key, actions_dim, is_continuous)
-        if is_continuous:
-            real_actions = out["actions"]
-        else:
-            split = jnp.split(out["actions"], np.cumsum(actions_dim)[:-1].tolist(), axis=-1)
-            real_actions = jnp.stack([s.argmax(axis=-1) for s in split], axis=-1)
-        return out, real_actions, key
-
-    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
-    def get_values(params, obs: Dict[str, jax.Array]):
-        norm_obs = normalize_obs(obs, cnn_keys, obs_keys)
-        norm_obs = {k: v.astype(jnp.float32) for k, v in norm_obs.items()}
-        _, values = agent.apply({"params": params}, norm_obs)
-        return values
-
-    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
-    def gae_fn(data, next_values):
-        returns, advantages = gae(
-            data["rewards"],
-            data["values"],
-            data["dones"],
-            next_values,
-            cfg.algo.rollout_steps,
-            cfg.algo.gamma,
-            cfg.algo.gae_lambda,
+        total_num_envs = int(cfg.env.num_envs * world_size)
+        vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+        envs = vectorized_env(
+            [
+                make_env(
+                    cfg,
+                    cfg.seed + rank * total_num_envs + i,
+                    rank * total_num_envs,
+                    log_dir if rank == 0 else None,
+                    "train",
+                    vector_env_idx=i,
+                )
+                for i in range(total_num_envs)
+            ],
+            autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
         )
-        flat = {k: v.reshape(-1, *v.shape[2:]) for k, v in data.items()}
-        flat["returns"] = returns.reshape(-1, 1)
-        flat["advantages"] = advantages.reshape(-1, 1)
-        return flat
+        observation_space = envs.single_observation_space
+        if not isinstance(observation_space, gym.spaces.Dict):
+            raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+        obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+        cnn_keys = cfg.algo.cnn_keys.encoder
 
-    act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
-    if act_on_cpu:
-        key = jax.device_put(key, cpu_device)
+        is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+        is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+        actions_dim = tuple(
+            envs.single_action_space.shape
+            if is_continuous
+            else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+        )
 
-    ent_coef = initial_ent_coef
-    clip_coef = initial_clip_coef
-    opt_state_host: Optional[Any] = None
-    params_host = jax.tree_util.tree_map(np.asarray, params)
+        key = fabric.seed_everything(cfg.seed + rank)
+        key, agent_key = jax.random.split(key)
+        agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
 
-    step_data: Dict[str, np.ndarray] = {}
-    next_obs = envs.reset(seed=cfg.seed)[0]
-    for k in obs_keys:
-        step_data[k] = next_obs[k][np.newaxis]
+        if fabric.is_global_zero:
+            save_configs(cfg, log_dir)
 
-    for iter_num in range(1, total_iters + 1):
-        with timer("Time/env_interaction_time"):
-            for _ in range(cfg.algo.rollout_steps):
-                policy_step += total_num_envs
-                obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
-                out, real_actions, key = policy_step_fn(act_params, obs_host, key)
-                real_actions_np = np.asarray(real_actions)
-                if is_continuous:
-                    env_actions = real_actions_np.reshape(envs.action_space.shape)
-                else:
-                    env_actions = real_actions_np.reshape(
-                        (total_num_envs, -1) if is_multidiscrete else (total_num_envs,)
-                    )
+        aggregator = None
+        if not MetricAggregator.disabled:
+            aggregator = instantiate(cfg.metric.aggregator)
 
-                obs, rewards, terminated, truncated, info = envs.step(env_actions)
-                dones = np.logical_or(terminated, truncated).reshape(total_num_envs, 1).astype(np.float32)
-                rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, 1)
+        rb = ReplayBuffer(
+            cfg.algo.rollout_steps,
+            total_num_envs,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+            obs_keys=obs_keys,
+        )
 
-                final_obs_arr = info.get("final_observation", info.get("final_obs"))
-                truncated_envs = np.nonzero(truncated)[0]
-                if final_obs_arr is not None and len(truncated_envs) > 0:
-                    real_next_obs = {
-                        k: np.stack(
-                            [np.asarray(final_obs_arr[i][k], dtype=np.float32) for i in truncated_envs]
+        policy_steps_per_iter = int(total_num_envs * cfg.algo.rollout_steps)
+        total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+        last_log = 0
+        last_checkpoint = 0
+        policy_step = 0
+
+        # ---------------- channels + learner (thread or separate process) -----------
+        error: Dict[str, Any] = {}
+        if two_process:
+            data_q: Any = _BcastChannel(src=0)
+            params_q: Any = _BcastChannel(src=1)
+            trainer = None
+            # geometry handshake, then the learner enters its data loop; a None releases
+            # it if the player dies before the first rollout
+            data_q.put({"player_world_size": world_size})
+        else:
+            data_q = queue.Queue(maxsize=1)
+            params_q = queue.Queue(maxsize=1)
+            trainer = threading.Thread(
+                target=_trainer_loop,
+                args=(fabric, cfg, agent, params, data_q, params_q, error),
+                daemon=True,
+                name="ppo-learner",
+            )
+            trainer.start()
+
+        cpu_device = jax.devices("cpu")[0]
+        act_on_cpu = fabric.device.platform != "cpu"
+
+        @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+        def policy_step_fn(params, obs: Dict[str, jax.Array], key):
+            # PRNG chain advances inside the jitted program (saves ~0.5 ms/step)
+            key, step_key = jax.random.split(key)
+            norm_obs = normalize_obs(obs, cnn_keys, obs_keys)
+            norm_obs = {k: v.astype(jnp.float32) for k, v in norm_obs.items()}
+            actor_outs, values = agent.apply({"params": params}, norm_obs)
+            out = policy_output(actor_outs, values, step_key, actions_dim, is_continuous)
+            if is_continuous:
+                real_actions = out["actions"]
+            else:
+                split = jnp.split(out["actions"], np.cumsum(actions_dim)[:-1].tolist(), axis=-1)
+                real_actions = jnp.stack([s.argmax(axis=-1) for s in split], axis=-1)
+            return out, real_actions, key
+
+        @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+        def get_values(params, obs: Dict[str, jax.Array]):
+            norm_obs = normalize_obs(obs, cnn_keys, obs_keys)
+            norm_obs = {k: v.astype(jnp.float32) for k, v in norm_obs.items()}
+            _, values = agent.apply({"params": params}, norm_obs)
+            return values
+
+        @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+        def gae_fn(data, next_values):
+            returns, advantages = gae(
+                data["rewards"],
+                data["values"],
+                data["dones"],
+                next_values,
+                cfg.algo.rollout_steps,
+                cfg.algo.gamma,
+                cfg.algo.gae_lambda,
+            )
+            flat = {k: v.reshape(-1, *v.shape[2:]) for k, v in data.items()}
+            flat["returns"] = returns.reshape(-1, 1)
+            flat["advantages"] = advantages.reshape(-1, 1)
+            return flat
+
+        act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
+        if act_on_cpu:
+            key = jax.device_put(key, cpu_device)
+
+        ent_coef = initial_ent_coef
+        clip_coef = initial_clip_coef
+        opt_state_host: Optional[Any] = None
+        params_host = jax.tree_util.tree_map(np.asarray, params)
+
+        step_data: Dict[str, np.ndarray] = {}
+        next_obs = envs.reset(seed=cfg.seed)[0]
+        for k in obs_keys:
+            step_data[k] = next_obs[k][np.newaxis]
+
+        for iter_num in range(1, total_iters + 1):
+            with timer("Time/env_interaction_time"):
+                for _ in range(cfg.algo.rollout_steps):
+                    policy_step += total_num_envs
+                    obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
+                    out, real_actions, key = policy_step_fn(act_params, obs_host, key)
+                    real_actions_np = np.asarray(real_actions)
+                    if is_continuous:
+                        env_actions = real_actions_np.reshape(envs.action_space.shape)
+                    else:
+                        env_actions = real_actions_np.reshape(
+                            (total_num_envs, -1) if is_multidiscrete else (total_num_envs,)
                         )
-                        for k in obs_keys
-                    }
-                    vals = np.asarray(get_values(act_params, real_next_obs)).reshape(len(truncated_envs))
-                    rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(-1, 1)
 
-                step_data["dones"] = dones[np.newaxis]
-                step_data["values"] = np.asarray(out["values"], np.float32)[np.newaxis]
-                step_data["actions"] = np.asarray(out["actions"], np.float32)[np.newaxis]
-                step_data["logprobs"] = np.asarray(out["logprob"], np.float32)[np.newaxis]
-                step_data["rewards"] = rewards[np.newaxis]
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                    obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                    dones = np.logical_or(terminated, truncated).reshape(total_num_envs, 1).astype(np.float32)
+                    rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, 1)
 
-                next_obs = obs
-                for k in obs_keys:
-                    step_data[k] = obs[k][np.newaxis]
+                    final_obs_arr = info.get("final_observation", info.get("final_obs"))
+                    truncated_envs = np.nonzero(truncated)[0]
+                    if final_obs_arr is not None and len(truncated_envs) > 0:
+                        real_next_obs = {
+                            k: np.stack(
+                                [np.asarray(final_obs_arr[i][k], dtype=np.float32) for i in truncated_envs]
+                            )
+                            for k in obs_keys
+                        }
+                        vals = np.asarray(get_values(act_params, real_next_obs)).reshape(len(truncated_envs))
+                        rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(-1, 1)
 
-                ep_info = info.get("final_info", info)
-                if "episode" in ep_info:
-                    ep = ep_info["episode"]
-                    mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
-                    rews, lens = ep["r"][mask], ep["l"][mask]
-                    if aggregator and not aggregator.disabled and len(rews) > 0:
-                        aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                        aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+                    step_data["dones"] = dones[np.newaxis]
+                    step_data["values"] = np.asarray(out["values"], np.float32)[np.newaxis]
+                    step_data["actions"] = np.asarray(out["actions"], np.float32)[np.newaxis]
+                    step_data["logprobs"] = np.asarray(out["logprob"], np.float32)[np.newaxis]
+                    step_data["rewards"] = rewards[np.newaxis]
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
-        # GAE on the player (reference ppo_decoupled.py:277-289), then ship the block
-        obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
-        next_values = np.asarray(get_values(act_params, obs_host))
-        data = {k: np.asarray(rb[k]) for k in rb.buffer.keys()}
-        flat = jax.tree_util.tree_map(np.asarray, gae_fn(data, next_values))
+                    next_obs = obs
+                    for k in obs_keys:
+                        step_data[k] = obs[k][np.newaxis]
 
-        with timer("Time/train_time"):
-            # ask the learner for its opt_state only when this iteration will write a
-            # checkpoint (the weight plane otherwise carries params alone)
-            want_opt_state = (
+                    ep_info = info.get("final_info", info)
+                    if "episode" in ep_info:
+                        ep = ep_info["episode"]
+                        mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
+                        rews, lens = ep["r"][mask], ep["l"][mask]
+                        if aggregator and not aggregator.disabled and len(rews) > 0:
+                            aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                            aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+
+            # GAE on the player (reference ppo_decoupled.py:277-289), then ship the block
+            obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
+            next_values = np.asarray(get_values(act_params, obs_host))
+            data = {k: np.asarray(rb[k]) for k in rb.buffer.keys()}
+            flat = jax.tree_util.tree_map(np.asarray, gae_fn(data, next_values))
+
+            with timer("Time/train_time"):
+                # ask the learner for its opt_state only when this iteration will write a
+                # checkpoint (the weight plane otherwise carries params alone)
+                want_opt_state = (
+                    (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+                    or cfg.dry_run
+                    or (iter_num == total_iters and cfg.checkpoint.save_last)
+                )
+                data_q.put((flat, clip_coef, ent_coef, want_opt_state))
+                # weight plane: BLOCK until the learner finishes (reference :302)
+                msg = params_q.get()
+                if msg is None:
+                    if "exc" in error:
+                        raise error["exc"]
+                    break
+                params_host, opt_state_host, mean_losses = msg
+                act_params = (
+                    jax.device_put(params_host, cpu_device) if act_on_cpu else params_host
+                )
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Loss/policy_loss", float(mean_losses[0]))
+                    aggregator.update("Loss/value_loss", float(mean_losses[1]))
+                    aggregator.update("Loss/entropy_loss", float(mean_losses[2]))
+
+            if cfg.metric.log_level > 0 and (
+                policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
+            ):
+                metrics_dict = aggregator.compute() if aggregator else {}
+                if logger is not None:
+                    logger.log_metrics(metrics_dict, policy_step)
+                    timers = timer.to_dict(reset=False)
+                    if timers.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                            policy_step,
+                        )
+                    if timers.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (policy_step - last_log)
+                                / max(timers["Time/env_interaction_time"], 1e-9)
+                            },
+                            policy_step,
+                        )
+                timer.to_dict(reset=True)
+                if aggregator:
+                    aggregator.reset()
+                last_log = policy_step
+
+            if cfg.algo.anneal_clip_coef:
+                clip_coef = polynomial_decay(
+                    iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+                )
+            if cfg.algo.anneal_ent_coef:
+                ent_coef = polynomial_decay(
+                    iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+                )
+
+            if (
                 (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
                 or cfg.dry_run
                 or (iter_num == total_iters and cfg.checkpoint.save_last)
-            )
-            data_q.put((flat, clip_coef, ent_coef, want_opt_state))
-            # weight plane: BLOCK until the learner finishes (reference :302)
-            msg = params_q.get()
-            if msg is None:
-                if "exc" in error:
-                    raise error["exc"]
-                break
-            params_host, opt_state_host, mean_losses = msg
-            act_params = (
-                jax.device_put(params_host, cpu_device) if act_on_cpu else params_host
-            )
-            if aggregator and not aggregator.disabled:
-                aggregator.update("Loss/policy_loss", float(mean_losses[0]))
-                aggregator.update("Loss/value_loss", float(mean_losses[1]))
-                aggregator.update("Loss/entropy_loss", float(mean_losses[2]))
+            ):
+                last_checkpoint = policy_step
+                ckpt_state = {
+                    "agent": params_host,
+                    "optimizer": opt_state_host,
+                    "iter_num": iter_num * world_size,
+                    "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                }
+                fabric.call(
+                    "on_checkpoint_player",
+                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    state=ckpt_state,
+                )
 
-        if cfg.metric.log_level > 0 and (
-            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
-        ):
-            metrics_dict = aggregator.compute() if aggregator else {}
-            if logger is not None:
-                logger.log_metrics(metrics_dict, policy_step)
-                timers = timer.to_dict(reset=False)
-                if timers.get("Time/train_time", 0) > 0:
-                    logger.log_metrics(
-                        {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
-                        policy_step,
-                    )
-                if timers.get("Time/env_interaction_time", 0) > 0:
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (policy_step - last_log)
-                            / max(timers["Time/env_interaction_time"], 1e-9)
-                        },
-                        policy_step,
-                    )
-            timer.to_dict(reset=True)
-            if aggregator:
-                aggregator.reset()
-            last_log = policy_step
+        # sentinel → learner exits (reference :344)
+        data_q.put(None)
+        if trainer is not None:
+            trainer.join(timeout=60)
+        else:
+            # lockstep broadcast pairing: consume the learner's sentinel ack
+            params_q.get()
+        _protocol_done = True
+        if "exc" in error:
+            raise error["exc"]
 
-        if cfg.algo.anneal_clip_coef:
-            clip_coef = polynomial_decay(
-                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
-            )
-        if cfg.algo.anneal_ent_coef:
-            ent_coef = polynomial_decay(
-                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
-            )
-
-        if (
-            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
-            or cfg.dry_run
-            or (iter_num == total_iters and cfg.checkpoint.save_last)
-        ):
-            last_checkpoint = policy_step
-            ckpt_state = {
-                "agent": params_host,
-                "optimizer": opt_state_host,
-                "iter_num": iter_num * world_size,
-                "batch_size": cfg.algo.per_rank_batch_size * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-            }
-            fabric.call(
-                "on_checkpoint_player",
-                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
-                state=ckpt_state,
-            )
-
-    # sentinel → learner exits (reference :344)
-    data_q.put(None)
-    trainer.join(timeout=60)
-    if "exc" in error:
-        raise error["exc"]
-
-    envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
-        test(agent.apply, jax.tree_util.tree_map(jnp.asarray, act_params), fabric, cfg, log_dir)
-    if logger is not None:
-        logger.finalize()
+        envs.close()
+        if fabric.is_global_zero and cfg.algo.run_test:
+            test(agent.apply, jax.tree_util.tree_map(jnp.asarray, act_params), fabric, cfg, log_dir)
+        if logger is not None:
+            logger.finalize()
+    except BaseException:
+        if two_process and not _protocol_done:
+            try:
+                _BcastChannel(src=0).put(None)
+            except Exception:
+                pass
+        raise

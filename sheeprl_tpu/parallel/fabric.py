@@ -38,7 +38,12 @@ class Fabric:
         callbacks: Optional[Sequence[Any]] = None,
         checkpoint_backend: str = "pickle",
         checkpoint_async: bool = False,
+        local_mesh: bool = False,
     ) -> None:
+        # local_mesh=True restricts the mesh to THIS process's devices — the MPMD
+        # role topology (player process / learner process run different programs on
+        # their own devices); False keeps the global SPMD mesh across processes
+        self.local_mesh = local_mesh
         self.requested_devices = devices
         self.num_nodes = num_nodes
         self.strategy = strategy
@@ -123,6 +128,8 @@ class Fabric:
             all_devices = jax.devices(platform)
         except RuntimeError:
             all_devices = jax.devices()
+        if self.local_mesh:
+            all_devices = [d for d in all_devices if d.process_index == jax.process_index()]
         n = self.requested_devices
         if n in ("auto", -1, "-1", None):
             n = len(all_devices)
@@ -212,7 +219,11 @@ class Fabric:
                 from sheeprl_tpu.utils.checkpoint import save_checkpoint
 
                 save_checkpoint(path, state)
-        distributed.barrier("checkpoint")
+        # SPMD ranks sync so nobody races ahead of the write; under an MPMD role
+        # split (local_mesh) only ONE role checkpoints — a global barrier here would
+        # deadlock against the other role's data-plane broadcast
+        if not self.local_mesh:
+            distributed.barrier("checkpoint")
 
     def load(self, path: str) -> Dict[str, Any]:
         from sheeprl_tpu.utils.checkpoint import load_checkpoint

@@ -1,0 +1,41 @@
+"""Worker for the two-process decoupled-PPO test: brings up jax.distributed on CPU
+and runs the real CLI; process 0 becomes the player, process 1 the learner."""
+
+import json
+import sys
+
+
+def main() -> None:
+    coordinator, num_processes, process_id, out_path = sys.argv[1:5]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator, int(num_processes), int(process_id))
+
+    from sheeprl_tpu.cli import run
+
+    run(
+        [
+            "exp=ppo_decoupled",
+            "dry_run=True",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.save_last=True",
+            "buffer.memmap=False",
+            "env.num_envs=2",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+            "algo.run_test=False",
+            "root_dir=decoupled2p",
+            "run_name=ppo",
+        ]
+    )
+    with open(out_path, "w") as f:
+        json.dump({"process": int(process_id), "ok": True}, f)
+
+
+if __name__ == "__main__":
+    main()
