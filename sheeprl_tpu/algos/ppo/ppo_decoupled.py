@@ -34,7 +34,7 @@ import optax
 
 from sheeprl_tpu.algos.ppo.agent import build_agent, policy_output
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
-from sheeprl_tpu.algos.ppo.utils import normalize_obs, test
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, space_actions_info, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import make_env
@@ -197,13 +197,7 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     observation_space = env.observation_space
     action_space = env.action_space
     env.close()
-    is_continuous = isinstance(action_space, gym.spaces.Box)
-    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
-    actions_dim = tuple(
-        action_space.shape
-        if is_continuous
-        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
-    )
+    is_continuous, is_multidiscrete, actions_dim = space_actions_info(action_space)
     # same seed as the player's rank-0 init -> identical initial params, so no
     # initial weight transfer is needed (the reference instead ships the first
     # flattened parameter vector, ppo_decoupled.py:126)
@@ -217,6 +211,7 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     # training (the reference likewise broadcasts cfg/agent args first, :114-117)
     geometry = data_q.get()
     if geometry is None:  # player failed before the first rollout
+        params_q.put(None)  # pairs the player's cleanup ack-consume
         return
     error: Dict[str, Any] = {}
     _trainer_loop(fabric, cfg, agent, params, data_q, params_q, error, geometry=geometry)
@@ -240,6 +235,12 @@ def main(fabric, cfg: Dict[str, Any]):
         )
 
     two_process = distributed.process_count() >= 2
+    if distributed.process_count() > 2:
+        raise ValueError(
+            "decoupled PPO currently supports exactly 2 jax.distributed processes "
+            "(player + learner); sharding the learner slice across processes is not "
+            f"implemented — got {distributed.process_count()}"
+        )
     if two_process:
         # MPMD role split over jax.distributed processes: each role computes on its
         # OWN devices; the data/weight planes ride the host object channel
@@ -288,13 +289,7 @@ def main(fabric, cfg: Dict[str, Any]):
         obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
         cnn_keys = cfg.algo.cnn_keys.encoder
 
-        is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
-        is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
-        actions_dim = tuple(
-            envs.single_action_space.shape
-            if is_continuous
-            else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
-        )
+        is_continuous, is_multidiscrete, actions_dim = space_actions_info(envs.single_action_space)
 
         key = fabric.seed_everything(cfg.seed + rank)
         key, agent_key = jax.random.split(key)
@@ -466,6 +461,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 if msg is None:
                     if "exc" in error:
                         raise error["exc"]
+                    if two_process:
+                        # a mid-run None on the weight plane is the remote learner's
+                        # crash signal, not a clean shutdown
+                        raise RuntimeError(
+                            "the learner process crashed mid-run (sent a weight-plane "
+                            "sentinel before the player finished); see its log"
+                        )
                     break
                 params_host, opt_state_host, mean_losses = msg
                 act_params = (
@@ -547,9 +549,15 @@ def main(fabric, cfg: Dict[str, Any]):
         if logger is not None:
             logger.finalize()
     except BaseException:
+        # Best-effort learner release: send the data-plane sentinel, then consume
+        # the learner's crash-path ack so its final broadcast is paired too. A crash
+        # DURING a collective (e.g. KeyboardInterrupt mid-broadcast) cannot be
+        # repaired from here — the distributed runtime's failure detection is the
+        # backstop — but every between-collectives crash point exits both roles.
         if two_process and not _protocol_done:
             try:
                 _BcastChannel(src=0).put(None)
+                _BcastChannel(src=1).get()
             except Exception:
                 pass
         raise

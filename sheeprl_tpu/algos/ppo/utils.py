@@ -88,3 +88,17 @@ def agent_actions_dim(cfg, env) -> Sequence[int]:
     if isinstance(space, gym.spaces.MultiDiscrete):
         return space.nvec.tolist()
     return [space.n]
+
+
+def space_actions_info(action_space):
+    """(is_continuous, is_multidiscrete, actions_dim) for a single action space —
+    shared by the player and learner roles so their agents derive identical shapes
+    (the no-initial-weight-transfer design relies on identical init)."""
+    import gymnasium as gym
+
+    cont = isinstance(action_space, gym.spaces.Box)
+    multi = isinstance(action_space, gym.spaces.MultiDiscrete)
+    dims = tuple(
+        action_space.shape if cont else (action_space.nvec.tolist() if multi else [action_space.n])
+    )
+    return cont, multi, dims
