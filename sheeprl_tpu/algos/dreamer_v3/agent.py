@@ -542,6 +542,35 @@ class DV3Agent:
         Returns (recurrent_states, posteriors, posterior_logits, prior_logits), all
         time-major with flattened stochastic states.
         """
+        step, init, xs = self._dynamic_scan_pieces(wm_params, embedded, actions, is_first, key)
+        _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(step, init, xs)
+        return hs, zs, post_logits, prior_logits
+
+    def dynamic_scan_sp(
+        self,
+        wm_params: Dict,
+        embedded: jax.Array,  # [T, B, E], T sharded over the mesh seq axis
+        actions: jax.Array,
+        is_first: jax.Array,
+        key: jax.Array,
+        mesh,
+        axis: str = "seq",
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Sequence-parallel posterior/prior unroll: the long-context variant of
+        ``dynamic_scan`` — the TIME axis is sharded over the mesh ``axis`` and the
+        carry hops along a ppermute ring, so each device holds only T/S steps of
+        inputs and activations (SURVEY §5.7's extension hook; no reference
+        counterpart). Numerically identical to ``dynamic_scan`` (parity-tested);
+        both run the SAME step body from ``_dynamic_scan_pieces``."""
+        from sheeprl_tpu.parallel.sequence import ring_sequence_scan
+
+        step, init, xs = self._dynamic_scan_pieces(wm_params, embedded, actions, is_first, key)
+        _, (hs, zs, post_logits, prior_logits) = ring_sequence_scan(step, init, xs, mesh, axis)
+        return hs, zs, post_logits, prior_logits
+
+    def _dynamic_scan_pieces(self, wm_params, embedded, actions, is_first, key):
+        """The shared RSSM step body + init + per-step inputs consumed by both the
+        plain and the sequence-parallel unrolls."""
         T, B = embedded.shape[:2]
         h0, z0 = self.initial_state(wm_params, (B,))
         keys = jax.random.split(key, T)
@@ -562,10 +591,7 @@ class DV3Agent:
             jnp.zeros((B, self.recurrent_state_size), embedded.dtype),
             jnp.zeros((B, self.stoch_state_size), embedded.dtype),
         )
-        _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
-            step, init, (actions, embedded, is_first, keys)
-        )
-        return hs, zs, post_logits, prior_logits
+        return step, init, (actions, embedded, is_first, keys)
 
     def imagination_scan(
         self,

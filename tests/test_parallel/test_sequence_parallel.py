@@ -1,0 +1,134 @@
+"""Sequence/context parallelism: the ring-chained scan must match the plain
+lax.scan exactly, with the time axis sharded over a mesh axis (long-context
+extension, SURVEY §5.7 — no reference counterpart)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sheeprl_tpu.parallel.sequence import ring_sequence_scan, seq_sharding
+
+
+def _mesh(n, axis="seq"):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.asarray(devs[:n]), (axis,))
+
+
+def _gru_like(carry, inp):
+    x, k = inp
+    # a recurrent body with state feedback, per-step randomness and two outputs
+    noise = jax.random.normal(k, carry.shape) * 0.01
+    new = jnp.tanh(carry @ jnp.full((4, 4), 0.1) + x + noise)
+    return new, (new, new.sum(axis=-1))
+
+
+@pytest.mark.parametrize("S", [2, 4, 8])
+def test_ring_scan_matches_lax_scan(S):
+    mesh = _mesh(S)
+    T, B = 16, 3
+    xs = jax.random.normal(jax.random.PRNGKey(0), (T, B, 4))
+    keys = jax.random.split(jax.random.PRNGKey(1), T)
+    init = jnp.zeros((B, 4))
+
+    ref_carry, (ref_h, ref_s) = jax.lax.scan(_gru_like, init, (xs, keys))
+    carry, (hs, sums) = ring_sequence_scan(_gru_like, init, (xs, keys), mesh)
+    np.testing.assert_allclose(np.asarray(carry), np.asarray(ref_carry), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(ref_h), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(sums), np.asarray(ref_s), rtol=1e-5, atol=1e-6)
+
+
+def test_ring_scan_gradient_parity():
+    """Backward pass through the ring (cond/fori_loop/ppermute) must match the
+    plain scan's gradients — the memory-saving claim is about the BACKWARD pass."""
+    mesh = _mesh(4)
+    T, B = 8, 2
+    xs = jax.random.normal(jax.random.PRNGKey(4), (T, B, 4))
+    keys = jax.random.split(jax.random.PRNGKey(5), T)
+    init = jnp.ones((B, 4)) * 0.1
+
+    def loss_ref(init, xs):
+        carry, (hs, _) = jax.lax.scan(_gru_like, init, (xs, keys))
+        return jnp.sum(hs**2) + jnp.sum(carry)
+
+    def loss_ring(init, xs):
+        carry, (hs, _) = ring_sequence_scan(_gru_like, init, (xs, keys), mesh)
+        return jnp.sum(hs**2) + jnp.sum(carry)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(init, xs)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1))(init, xs)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_ring_scan_accepts_sharded_inputs():
+    """Inputs placed with the seq sharding (each device holding only its chunk)
+    produce the same result — the memory-scaling contract."""
+    mesh = _mesh(4)
+    T, B = 16, 2
+    xs = jax.random.normal(jax.random.PRNGKey(2), (T, B, 4))
+    keys = jax.random.split(jax.random.PRNGKey(3), T)
+    init = jnp.zeros((B, 4))
+    sh = seq_sharding(mesh)
+    xs_sharded = jax.device_put(xs, sh)
+    keys_sharded = jax.device_put(keys, sh)
+    ref_carry, (ref_h, _) = jax.lax.scan(_gru_like, init, (xs, keys))
+    carry, (hs, _) = ring_sequence_scan(_gru_like, init, (xs_sharded, keys_sharded), mesh)
+    np.testing.assert_allclose(np.asarray(carry), np.asarray(ref_carry), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(ref_h), rtol=1e-5, atol=1e-6)
+
+
+def test_dv3_dynamic_scan_sp_parity():
+    """The Dreamer-V3 world-model unroll over a sequence-sharded mesh equals the
+    single-device dynamic_scan bit-for-bit (same PRNG folding)."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.config.composer import compose
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    mesh = _mesh(4)
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.mlp_keys.decoder=[]",
+        ]
+    )
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric._setup()
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    agent, params = build_agent(fabric, (6,), False, cfg, obs_space, jax.random.PRNGKey(0), None)
+    wm = params["world_model"]
+
+    T, B = 8, 2
+    rng = np.random.default_rng(0)
+    obs = {"rgb": jnp.asarray(rng.integers(0, 255, (T, B, 3, 64, 64), np.uint8)) / 255.0 - 0.5}
+    embedded = agent.encoder.apply({"params": wm["encoder"]}, obs)
+    actions = jnp.zeros((T, B, 6))
+    is_first = jnp.zeros((T, B, 1)).at[0].set(1.0)
+    key = jax.random.PRNGKey(7)
+
+    hs, zs, post, prior = agent.dynamic_scan(wm, embedded, actions, is_first, key)
+    hs2, zs2, post2, prior2 = agent.dynamic_scan_sp(wm, embedded, actions, is_first, key, mesh)
+    np.testing.assert_allclose(np.asarray(hs2), np.asarray(hs), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(zs2), np.asarray(zs), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(post2), np.asarray(post), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(prior2), np.asarray(prior), rtol=1e-5, atol=1e-5)
