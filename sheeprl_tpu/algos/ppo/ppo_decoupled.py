@@ -166,27 +166,7 @@ def _trainer_loop(
         params_q.put(None)
 
 
-class _BcastChannel:
-    """Pod-level plane over the host object channel with the in-process queue's
-    ``put``/``get`` surface, so the player body and ``_trainer_loop`` run unchanged
-    over either topology. ``src=0`` is the data plane — the player's rollout block
-    (role of the reference's pickled-object scatter, ppo_decoupled.py:294-299);
-    ``src=1`` the weight plane — the learner's updated params (the reference's
-    flattened-parameter broadcast, :302-305). Broadcasts are lockstep collectives,
-    so a blocking ``get`` preserves the reference's synchronous alternation."""
-
-    def __init__(self, src: int) -> None:
-        self.src = src
-
-    def put(self, msg):
-        from sheeprl_tpu.parallel import distributed
-
-        distributed.host_broadcast_object(msg, src=self.src)
-
-    def get(self):
-        from sheeprl_tpu.parallel import distributed
-
-        return distributed.host_broadcast_object(None, src=self.src)
+from sheeprl_tpu.parallel.distributed import BroadcastChannel as _BcastChannel
 
 
 def _learner_process(fabric, cfg: Dict[str, Any]):

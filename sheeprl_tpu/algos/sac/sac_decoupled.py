@@ -28,6 +28,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.parallel.distributed import BroadcastChannel
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -36,9 +37,13 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
-def _trainer_loop(fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error):
+def _trainer_loop(
+    fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error, geometry=None
+):
     try:
-        world_size = fabric.world_size
+        # two-process topology: batch/EMA-period math follows the PLAYER's device
+        # count (the roles may own different meshes)
+        world_size = fabric.world_size if geometry is None else int(geometry["player_world_size"])
         gamma = float(cfg.algo.gamma)
         tau = float(cfg.algo.tau)
         num_critics = int(cfg.algo.critic.n)
@@ -115,7 +120,8 @@ def _trainer_loop(fabric, cfg, actor, critic, params, target_entropy, data_q, pa
             (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (data, keys))
             return params, opt_state, losses.mean(axis=0)
 
-        if world_size > 1:
+        mesh_size = fabric.world_size
+        if mesh_size > 1:
             params = fabric.replicate_pytree(params)
             opt_state = fabric.replicate_pytree(opt_state)
 
@@ -125,17 +131,19 @@ def _trainer_loop(fabric, cfg, actor, critic, params, target_entropy, data_q, pa
             if msg is None:
                 params_q.put(None)
                 return
-            data, iter_num = msg
-            if world_size > 1:
+            data, iter_num, want_opt_state = msg
+            if mesh_size > 1:
                 data = jax.device_put(data, fabric.sharding(None, "data"))
             key, train_key = jax.random.split(key)
             params, opt_state, mean_losses = train_phase(
                 params, opt_state, data, jnp.asarray(iter_num), np.asarray(train_key)
             )
+            # opt_state only crosses when the player is about to checkpoint
+            # (reference parity with the PPO weight plane's want_opt_state)
             params_q.put(
                 (
                     jax.tree_util.tree_map(np.asarray, params),
-                    jax.tree_util.tree_map(np.asarray, opt_state),
+                    jax.tree_util.tree_map(np.asarray, opt_state) if want_opt_state else None,
                     np.asarray(mean_losses),
                 )
             )
@@ -144,10 +152,37 @@ def _trainer_loop(fabric, cfg, actor, critic, params, target_entropy, data_q, pa
         params_q.put(None)
 
 
+def _learner_process(fabric, cfg: Dict[str, Any]):
+    """Learner role of the TWO-PROCESS topology (reference trainer ranks,
+    sac_decoupled.py:356-545): its own jax.distributed process and local mesh;
+    replay blocks in, updated params out, over the host channels."""
+    env = make_env(cfg, cfg.seed, 0, None, "learner")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    env.close()
+    # same seed as the player's rank-0 init -> identical initial params
+    key = fabric.seed_everything(cfg.seed)
+    key, agent_key = jax.random.split(key)
+    actor, critic, params = build_agent(fabric, cfg, observation_space, action_space, agent_key, None)
+    target_entropy = -float(int(np.prod(action_space.shape)))
+    data_q, params_q = BroadcastChannel(src=0), BroadcastChannel(src=1)
+    geometry = data_q.get()
+    if geometry is None:  # player failed before the first block
+        params_q.put(None)  # pairs the player's cleanup ack-consume
+        return
+    error: Dict[str, Any] = {}
+    _trainer_loop(
+        fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error, geometry=geometry
+    )
+    if "exc" in error:
+        data_q.get()
+        params_q.put(None)
+        raise error["exc"]
+
+
 @register_algorithm(decoupled=True)
 def main(fabric, cfg: Dict[str, Any]):
-    rank = fabric.global_rank
-    world_size = fabric.world_size
+    from sheeprl_tpu.parallel import distributed
 
     if cfg.checkpoint.resume_from:
         raise ValueError(
@@ -159,236 +194,287 @@ def main(fabric, cfg: Dict[str, Any]):
         warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
         cfg.algo.cnn_keys.encoder = []
 
-    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
-    logger = get_logger(fabric, cfg, log_dir=log_dir)
-    fabric.logger = logger
-    if logger is not None:
-        logger.log_hyperparams(cfg.as_dict())
-    fabric.print(f"Log dir: {log_dir}")
+    two_process = distributed.process_count() >= 2
+    if distributed.process_count() > 2:
+        raise ValueError(
+            "decoupled SAC currently supports exactly 2 jax.distributed processes "
+            "(player + learner); got {}".format(distributed.process_count())
+        )
+    if two_process:
+        fabric.local_mesh = True
+        fabric._setup()
+        if distributed.process_index() >= 1:
+            return _learner_process(fabric, cfg)
 
-    total_num_envs = int(cfg.env.num_envs * world_size)
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = vectorized_env(
-        [
-            make_env(
-                cfg,
-                cfg.seed + rank * total_num_envs + i,
-                rank * total_num_envs,
-                log_dir if rank == 0 else None,
-                "train",
-                vector_env_idx=i,
+    # read AFTER the role split: the two-process branch rebuilds the mesh with only
+    # this process's devices, and all player-local sizes must follow that mesh
+    rank = fabric.global_rank
+    world_size = fabric.world_size
+
+    # any player-side failure must release a learner blocked in a channel
+    _protocol_done = False
+    try:
+        log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, share=not two_process)
+        logger = get_logger(fabric, cfg, log_dir=log_dir)
+        fabric.logger = logger
+        if logger is not None:
+            logger.log_hyperparams(cfg.as_dict())
+        fabric.print(f"Log dir: {log_dir}")
+
+        total_num_envs = int(cfg.env.num_envs * world_size)
+        vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+        envs = vectorized_env(
+            [
+                make_env(
+                    cfg,
+                    cfg.seed + rank * total_num_envs + i,
+                    rank * total_num_envs,
+                    log_dir if rank == 0 else None,
+                    "train",
+                    vector_env_idx=i,
+                )
+                for i in range(total_num_envs)
+            ],
+            autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+        )
+        action_space = envs.single_action_space
+        observation_space = envs.single_observation_space
+        if not isinstance(action_space, gym.spaces.Box):
+            raise ValueError("Only continuous action space is supported for the SAC agent")
+        mlp_keys = cfg.algo.mlp_keys.encoder
+
+        key = fabric.seed_everything(cfg.seed + rank)
+        key, agent_key = jax.random.split(key)
+        actor, critic, params = build_agent(fabric, cfg, observation_space, action_space, agent_key, None)
+        act_dim = int(np.prod(action_space.shape))
+        target_entropy = -float(act_dim)
+        action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
+        action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
+
+        if fabric.is_global_zero:
+            save_configs(cfg, log_dir)
+
+        aggregator = None
+        if not MetricAggregator.disabled:
+            aggregator = instantiate(cfg.metric.aggregator)
+
+        buffer_size = cfg.buffer.size // total_num_envs if not cfg.dry_run else 1
+        rb = ReplayBuffer(
+            buffer_size,
+            total_num_envs,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+            obs_keys=("observations",),
+        )
+
+        policy_steps_per_iter = int(total_num_envs)
+        total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+        learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+        prefill_steps = learning_starts - int(learning_starts > 0)
+        ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+        sample_next_obs = bool(cfg.buffer.sample_next_obs)
+
+        error: Dict[str, Any] = {}
+        if two_process:
+            data_q: Any = BroadcastChannel(src=0)
+            params_q: Any = BroadcastChannel(src=1)
+            trainer = None
+            data_q.put({"player_world_size": world_size})  # geometry handshake
+        else:
+            data_q = queue.Queue(maxsize=1)
+            params_q = queue.Queue(maxsize=1)
+            trainer = threading.Thread(
+                target=_trainer_loop,
+                args=(fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error),
+                daemon=True,
+                name="sac-learner",
             )
-            for i in range(total_num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
-    action_space = envs.single_action_space
-    observation_space = envs.single_observation_space
-    if not isinstance(action_space, gym.spaces.Box):
-        raise ValueError("Only continuous action space is supported for the SAC agent")
-    mlp_keys = cfg.algo.mlp_keys.encoder
+            trainer.start()
 
-    key = fabric.seed_everything(cfg.seed + rank)
-    key, agent_key = jax.random.split(key)
-    actor, critic, params = build_agent(fabric, cfg, observation_space, action_space, agent_key, None)
-    act_dim = int(np.prod(action_space.shape))
-    target_entropy = -float(act_dim)
-    action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
-    action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
+        cpu_device = jax.devices("cpu")[0]
+        act_on_cpu = fabric.device.platform != "cpu"
 
-    if fabric.is_global_zero:
-        save_configs(cfg, log_dir)
+        from functools import partial
 
-    aggregator = None
-    if not MetricAggregator.disabled:
-        aggregator = instantiate(cfg.metric.aggregator)
+        @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+        def act_fn(actor_params, obs: jax.Array, key):
+            # PRNG chain advances inside the jitted program (un-jitted per-step
+            # jax.random.split costs ~0.5 ms of host dispatch)
+            key, step_key = jax.random.split(key)
+            mean, std = actor.apply({"params": actor_params}, obs)
+            actions, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+            return actions, key
 
-    buffer_size = cfg.buffer.size // total_num_envs if not cfg.dry_run else 1
-    rb = ReplayBuffer(
-        buffer_size,
-        total_num_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        obs_keys=("observations",),
-    )
+        act_params = jax.device_put(params["actor"], cpu_device) if act_on_cpu else params["actor"]
+        params_host = jax.tree_util.tree_map(np.asarray, params)
+        opt_state_host: Optional[Any] = None
+        if act_on_cpu:
+            key = jax.device_put(key, cpu_device)
 
-    policy_steps_per_iter = int(total_num_envs)
-    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
-    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
-    prefill_steps = learning_starts - int(learning_starts > 0)
-    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
-    sample_next_obs = bool(cfg.buffer.sample_next_obs)
+        policy_step = 0
+        last_log = 0
+        last_checkpoint = 0
+        cumulative_per_rank_gradient_steps = 0
+        step_data: Dict[str, np.ndarray] = {}
+        obs = envs.reset(seed=cfg.seed)[0]
 
-    data_q: "queue.Queue" = queue.Queue(maxsize=1)
-    params_q: "queue.Queue" = queue.Queue(maxsize=1)
-    error: Dict[str, Any] = {}
-    trainer = threading.Thread(
-        target=_trainer_loop,
-        args=(fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error),
-        daemon=True,
-        name="sac-learner",
-    )
-    trainer.start()
+        for iter_num in range(1, total_iters + 1):
+            policy_step += policy_steps_per_iter
 
-    cpu_device = jax.devices("cpu")[0]
-    act_on_cpu = fabric.device.platform != "cpu"
+            with timer("Time/env_interaction_time"):
+                if iter_num <= learning_starts:
+                    actions = envs.action_space.sample()
+                else:
+                    flat_obs = prepare_obs(fabric, obs, mlp_keys=mlp_keys, num_envs=total_num_envs)
+                    actions, key = act_fn(act_params, flat_obs, key)
+                    actions = np.asarray(actions)
+                next_obs, rewards, terminated, truncated, infos = envs.step(
+                    np.asarray(actions).reshape(envs.action_space.shape)
+                )
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, -1)
 
-    from functools import partial
+            ep_info = infos.get("final_info", infos)
+            if "episode" in ep_info:
+                ep = ep_info["episode"]
+                mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
+                rews, lens = ep["r"][mask], ep["l"][mask]
+                if aggregator and not aggregator.disabled and len(rews) > 0:
+                    aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                    aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
-    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
-    def act_fn(actor_params, obs: jax.Array, key):
-        # PRNG chain advances inside the jitted program (un-jitted per-step
-        # jax.random.split costs ~0.5 ms of host dispatch)
-        key, step_key = jax.random.split(key)
-        mean, std = actor.apply({"params": actor_params}, obs)
-        actions, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
-        return actions, key
+            real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
+            final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
+            if final_obs_arr is not None:
+                for idx in range(total_num_envs):
+                    if final_obs_arr[idx] is not None:
+                        for k in mlp_keys:
+                            real_next_obs[k][idx] = np.asarray(final_obs_arr[idx][k])
+            flat_real_next = np.concatenate(
+                [real_next_obs[k].reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
+            ).astype(np.float32)
 
-    act_params = jax.device_put(params["actor"], cpu_device) if act_on_cpu else params["actor"]
-    params_host = jax.tree_util.tree_map(np.asarray, params)
-    opt_state_host: Optional[Any] = None
-    if act_on_cpu:
-        key = jax.device_put(key, cpu_device)
+            step_data["terminated"] = np.asarray(terminated).reshape(1, total_num_envs, -1).astype(np.float32)
+            step_data["truncated"] = np.asarray(truncated).reshape(1, total_num_envs, -1).astype(np.float32)
+            step_data["actions"] = np.asarray(actions).reshape(1, total_num_envs, -1).astype(np.float32)
+            step_data["observations"] = np.concatenate(
+                [np.asarray(obs[k]).reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
+            ).astype(np.float32)[np.newaxis]
+            if not sample_next_obs:
+                step_data["next_observations"] = flat_real_next[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis]
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
-    policy_step = 0
-    last_log = 0
-    last_checkpoint = 0
-    cumulative_per_rank_gradient_steps = 0
-    step_data: Dict[str, np.ndarray] = {}
-    obs = envs.reset(seed=cfg.seed)[0]
+            obs = next_obs
 
-    for iter_num in range(1, total_iters + 1):
-        policy_step += policy_steps_per_iter
+            if iter_num >= learning_starts:
+                per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
+                if per_rank_gradient_steps > 0:
+                    with timer("Time/train_time"):
+                        sample = rb.sample(
+                            batch_size=cfg.algo.per_rank_batch_size * world_size,
+                            n_samples=per_rank_gradient_steps,
+                            sample_next_obs=sample_next_obs,
+                        )
+                        data = {k: np.asarray(v, dtype=np.float32) for k, v in sample.items()}
+                        # data plane: ship the replay block to the learner (reference
+                        # scatter, sac_decoupled.py:243-257) and BLOCK on the weight plane
+                        want_opt_state = bool(
+                            (
+                                cfg.checkpoint.every > 0
+                                and policy_step - last_checkpoint >= cfg.checkpoint.every
+                            )
+                            or cfg.dry_run
+                            or (iter_num == total_iters and cfg.checkpoint.save_last)
+                        )
+                        data_q.put((data, iter_num, want_opt_state))
+                        msg = params_q.get()
+                        if msg is None:
+                            if "exc" in error:
+                                raise error["exc"]
+                            if two_process:
+                                raise RuntimeError(
+                                    "the learner process crashed mid-run (sent a weight-plane "
+                                    "sentinel before the player finished); see its log"
+                                )
+                            break
+                        params_host, opt_state_host, mean_losses = msg
+                        act_params = (
+                            jax.device_put(params_host["actor"], cpu_device)
+                            if act_on_cpu
+                            else params_host["actor"]
+                        )
+                        cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                        if aggregator and not aggregator.disabled:
+                            aggregator.update("Loss/value_loss", float(mean_losses[0]))
+                            aggregator.update("Loss/policy_loss", float(mean_losses[1]))
+                            aggregator.update("Loss/alpha_loss", float(mean_losses[2]))
 
-        with timer("Time/env_interaction_time"):
-            if iter_num <= learning_starts:
-                actions = envs.action_space.sample()
-            else:
-                flat_obs = prepare_obs(fabric, obs, mlp_keys=mlp_keys, num_envs=total_num_envs)
-                actions, key = act_fn(act_params, flat_obs, key)
-                actions = np.asarray(actions)
-            next_obs, rewards, terminated, truncated, infos = envs.step(
-                np.asarray(actions).reshape(envs.action_space.shape)
-            )
-            rewards = np.asarray(rewards, dtype=np.float32).reshape(total_num_envs, -1)
+            if cfg.metric.log_level > 0 and (
+                policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
+            ):
+                metrics_dict = aggregator.compute() if aggregator else {}
+                if logger is not None:
+                    logger.log_metrics(metrics_dict, policy_step)
+                    timers = timer.to_dict(reset=False)
+                    if timers.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                            policy_step,
+                        )
+                    if timers.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (policy_step - last_log)
+                                / max(timers["Time/env_interaction_time"], 1e-9)
+                            },
+                            policy_step,
+                        )
+                timer.to_dict(reset=True)
+                if aggregator:
+                    aggregator.reset()
+                last_log = policy_step
 
-        ep_info = infos.get("final_info", infos)
-        if "episode" in ep_info:
-            ep = ep_info["episode"]
-            mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
-            rews, lens = ep["r"][mask], ep["l"][mask]
-            if aggregator and not aggregator.disabled and len(rews) > 0:
-                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+            if (
+                (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+                or cfg.dry_run
+                or (iter_num == total_iters and cfg.checkpoint.save_last)
+            ):
+                last_checkpoint = policy_step
+                ckpt_state = {
+                    "agent": params_host,
+                    "opt_state": opt_state_host,
+                    "ratio": ratio.state_dict(),
+                    "iter_num": iter_num * world_size,
+                    "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                }
+                fabric.call(
+                    "on_checkpoint_player",
+                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
 
-        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
-        final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
-        if final_obs_arr is not None:
-            for idx in range(total_num_envs):
-                if final_obs_arr[idx] is not None:
-                    for k in mlp_keys:
-                        real_next_obs[k][idx] = np.asarray(final_obs_arr[idx][k])
-        flat_real_next = np.concatenate(
-            [real_next_obs[k].reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
-        ).astype(np.float32)
+        data_q.put(None)
+        if trainer is not None:
+            trainer.join(timeout=60)
+        else:
+            params_q.get()  # consume the learner's sentinel ack (lockstep pairing)
+        _protocol_done = True
+        if "exc" in error:
+            raise error["exc"]
 
-        step_data["terminated"] = np.asarray(terminated).reshape(1, total_num_envs, -1).astype(np.float32)
-        step_data["truncated"] = np.asarray(truncated).reshape(1, total_num_envs, -1).astype(np.float32)
-        step_data["actions"] = np.asarray(actions).reshape(1, total_num_envs, -1).astype(np.float32)
-        step_data["observations"] = np.concatenate(
-            [np.asarray(obs[k]).reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
-        ).astype(np.float32)[np.newaxis]
-        if not sample_next_obs:
-            step_data["next_observations"] = flat_real_next[np.newaxis]
-        step_data["rewards"] = rewards[np.newaxis]
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
-
-        obs = next_obs
-
-        if iter_num >= learning_starts:
-            per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
-            if per_rank_gradient_steps > 0:
-                with timer("Time/train_time"):
-                    sample = rb.sample(
-                        batch_size=cfg.algo.per_rank_batch_size * world_size,
-                        n_samples=per_rank_gradient_steps,
-                        sample_next_obs=sample_next_obs,
-                    )
-                    data = {k: np.asarray(v, dtype=np.float32) for k, v in sample.items()}
-                    # data plane: ship the replay block to the learner (reference
-                    # scatter, sac_decoupled.py:243-257) and BLOCK on the weight plane
-                    data_q.put((data, iter_num))
-                    msg = params_q.get()
-                    if msg is None:
-                        if "exc" in error:
-                            raise error["exc"]
-                        break
-                    params_host, opt_state_host, mean_losses = msg
-                    act_params = (
-                        jax.device_put(params_host["actor"], cpu_device)
-                        if act_on_cpu
-                        else params_host["actor"]
-                    )
-                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                    if aggregator and not aggregator.disabled:
-                        aggregator.update("Loss/value_loss", float(mean_losses[0]))
-                        aggregator.update("Loss/policy_loss", float(mean_losses[1]))
-                        aggregator.update("Loss/alpha_loss", float(mean_losses[2]))
-
-        if cfg.metric.log_level > 0 and (
-            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
-        ):
-            metrics_dict = aggregator.compute() if aggregator else {}
-            if logger is not None:
-                logger.log_metrics(metrics_dict, policy_step)
-                timers = timer.to_dict(reset=False)
-                if timers.get("Time/train_time", 0) > 0:
-                    logger.log_metrics(
-                        {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
-                        policy_step,
-                    )
-                if timers.get("Time/env_interaction_time", 0) > 0:
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (policy_step - last_log)
-                            / max(timers["Time/env_interaction_time"], 1e-9)
-                        },
-                        policy_step,
-                    )
-            timer.to_dict(reset=True)
-            if aggregator:
-                aggregator.reset()
-            last_log = policy_step
-
-        if (
-            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
-            or cfg.dry_run
-            or (iter_num == total_iters and cfg.checkpoint.save_last)
-        ):
-            last_checkpoint = policy_step
-            ckpt_state = {
-                "agent": params_host,
-                "opt_state": opt_state_host,
-                "ratio": ratio.state_dict(),
-                "iter_num": iter_num * world_size,
-                "batch_size": cfg.algo.per_rank_batch_size * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-            }
-            fabric.call(
-                "on_checkpoint_player",
-                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
-            )
-
-    data_q.put(None)
-    trainer.join(timeout=60)
-    if "exc" in error:
-        raise error["exc"]
-
-    envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
-        test(actor.apply, jax.tree_util.tree_map(jnp.asarray, params_host["actor"]), fabric, cfg, log_dir)
-    if logger is not None:
-        logger.finalize()
+        envs.close()
+        if fabric.is_global_zero and cfg.algo.run_test:
+            test(actor.apply, jax.tree_util.tree_map(jnp.asarray, params_host["actor"]), fabric, cfg, log_dir)
+        if logger is not None:
+            logger.finalize()
+    except BaseException:
+        if two_process and not _protocol_done:
+            try:
+                BroadcastChannel(src=0).put(None)
+                BroadcastChannel(src=1).get()
+            except Exception:
+                pass
+        raise

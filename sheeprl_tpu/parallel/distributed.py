@@ -88,3 +88,20 @@ def barrier(name: str = "barrier") -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)
+
+
+class BroadcastChannel:
+    """A cross-process channel with a queue's ``put``/``get`` surface, carried by
+    lockstep ``host_broadcast_object`` collectives from a fixed source process.
+    The MPMD decoupled topologies use one per plane (data: src=player, weights:
+    src=learner); a blocking ``get`` preserves the reference's synchronous
+    alternation (sheeprl/algos/ppo/ppo_decoupled.py:294-305)."""
+
+    def __init__(self, src: int) -> None:
+        self.src = src
+
+    def put(self, msg: Any) -> None:
+        host_broadcast_object(msg, src=self.src)
+
+    def get(self) -> Any:
+        return host_broadcast_object(None, src=self.src)

@@ -104,9 +104,13 @@ def get_log_dir(fabric, root_dir: str, run_name: str, share: bool = True) -> str
         os.makedirs(log_dir, exist_ok=True)
     else:
         log_dir = None
-    if share and fabric.world_size > 1:
-        from sheeprl_tpu.parallel import distributed
+    from sheeprl_tpu.parallel import distributed
 
+    # sharing is an inter-PROCESS concern (multi-host SPMD: every process calls this
+    # and rank-0's dir wins); a single controller process — however many devices its
+    # mesh holds — already knows its dir, and MPMD roles pass share=False because
+    # only the player calls get_log_dir at all
+    if share and distributed.process_count() > 1:
         log_dir = distributed.host_broadcast_object(log_dir, src=0)
     return log_dir
 

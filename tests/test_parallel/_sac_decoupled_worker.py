@@ -1,0 +1,39 @@
+"""Worker for the two-process decoupled-SAC test (player = process 0, learner = 1)."""
+
+import json
+import sys
+
+
+def main() -> None:
+    coordinator, num_processes, process_id, out_path = sys.argv[1:5]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator, int(num_processes), int(process_id))
+
+    from sheeprl_tpu.cli import run
+
+    run(
+        [
+            "exp=sac_decoupled",
+            "dry_run=True",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.save_last=True",
+            "buffer.memmap=False",
+            "env.num_envs=2",
+            "algo.learning_starts=0",
+            "algo.per_rank_batch_size=16",
+            "algo.run_test=False",
+            "root_dir=sacdec2p",
+            "run_name=sac",
+        ]
+    )
+    with open(out_path, "w") as f:
+        json.dump({"process": int(process_id), "ok": True}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,7 @@ import sys
 import pytest
 
 _WORKER = os.path.join(os.path.dirname(__file__), "_decoupled_worker.py")
+_SAC_WORKER = os.path.join(os.path.dirname(__file__), "_sac_decoupled_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -46,4 +47,30 @@ def test_decoupled_ppo_two_processes(tmp_path):
     assert [r["ok"] for r in results] == [True, True]
     # the player (process 0) wrote the checkpoint with the learner-sent state
     ckpts = glob.glob(str(tmp_path / "logs/runs/decoupled2p/ppo/**/ckpt_*.ckpt"), recursive=True)
+    assert ckpts, "player should have written a checkpoint"
+
+
+@pytest.mark.timeout(280)
+def test_decoupled_sac_two_processes(tmp_path):
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    outs = [str(tmp_path / f"out_{i}.json") for i in range(2)]
+    env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _SAC_WORKER, coordinator, "2", str(i), outs[i]],
+            cwd=str(tmp_path),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    logs = [p.communicate(timeout=260)[0].decode() for p in procs]
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker rank failed:\n{log[-4000:]}"
+    results = [json.load(open(o)) for o in outs]
+    assert [r["ok"] for r in results] == [True, True]
+    ckpts = glob.glob(str(tmp_path / "logs/runs/sacdec2p/sac/**/ckpt_*.ckpt"), recursive=True)
     assert ckpts, "player should have written a checkpoint"
