@@ -237,6 +237,11 @@ class ActionsAsObservationWrapper(gym.Wrapper):
             raise ValueError(f"The actions stack dilation argument must be greater than zero, got: {dilation}")
         if not isinstance(noop, (int, float, list)):
             raise ValueError(f"The noop action must be an integer or float or list, got: {noop} ({type(noop)})")
+        if not isinstance(env.observation_space, gym.spaces.Dict):
+            raise ValueError(
+                "ActionsAsObservationWrapper requires a Dict observation space; apply it "
+                "after the dict-obs coercion (make_env does this automatically)"
+            )
         self._num_stack = num_stack
         self._dilation = dilation
         self._actions: deque = deque(maxlen=num_stack * dilation)

@@ -1,0 +1,171 @@
+"""Generic wrapper contracts (reference tests/test_envs/test_wrappers.py):
+ActionRepeat accumulation/early-stop, RestartOnException crash recovery + fail
+budget, FrameStack shapes, RewardAsObservation key injection, ActionsAsObservation
+stacking, MaskVelocity dims, GrayscaleRender channel expansion."""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    MaskVelocityWrapper,
+    RestartOnException,
+    RewardAsObservationWrapper,
+)
+
+
+class _CountingEnv(gym.Env):
+    """Deterministic env: reward 1 per step, terminates at step `horizon`."""
+
+    observation_space = gym.spaces.Box(-np.inf, np.inf, (2,), np.float32)
+    action_space = gym.spaces.Discrete(2)
+
+    def __init__(self, horizon=1000):
+        self.horizon = horizon
+        self.t = 0
+
+    def reset(self, *, seed=None, options=None):
+        self.t = 0
+        return np.zeros(2, np.float32), {}
+
+    def step(self, action):
+        self.t += 1
+        return np.full(2, self.t, np.float32), 1.0, self.t >= self.horizon, False, {}
+
+
+def test_action_repeat_accumulates_and_stops_on_done():
+    env = ActionRepeat(_CountingEnv(), amount=4)
+    env.reset()
+    obs, reward, term, trunc, _ = env.step(0)
+    assert reward == 4.0 and not term
+    env2 = ActionRepeat(_CountingEnv(horizon=2), amount=4)
+    env2.reset()
+    obs, reward, term, trunc, _ = env2.step(0)
+    assert reward == 2.0 and term  # stopped early at the terminal step
+    with pytest.raises(ValueError):
+        ActionRepeat(_CountingEnv(), amount=0)
+
+
+class _CrashingEnv(_CountingEnv):
+    crash_at = 2
+
+    def step(self, action):
+        if self.t + 1 == self.crash_at:
+            self.t += 1  # crash once, then behave after rebuild
+            raise RuntimeError("boom")
+        return super().step(action)
+
+
+def test_restart_on_exception_rebuilds_and_flags():
+    env = RestartOnException(lambda: _CrashingEnv(), window=300, maxfails=2, wait=0)
+    env.reset()
+    env.step(0)
+    obs, reward, term, trunc, info = env.step(0)  # crash -> rebuild -> fresh reset
+    assert info.get("restart_on_exception") is True
+    assert reward == 0.0 and not term and not trunc
+    # the rebuilt env starts over
+    assert np.all(obs == 0)
+
+
+def test_restart_on_exception_fail_budget():
+    class _AlwaysCrash(_CountingEnv):
+        def step(self, action):
+            raise RuntimeError("always")
+
+    env = RestartOnException(lambda: _AlwaysCrash(), window=300, maxfails=1, wait=0)
+    env.reset()
+    env.step(0)  # first crash tolerated
+    with pytest.raises(RuntimeError, match="crashed too many times"):
+        env.step(0)
+
+
+class _PixelDictEnv(gym.Env):
+    observation_space = gym.spaces.Dict(
+        {"rgb": gym.spaces.Box(0, 255, (3, 8, 8), np.uint8)}
+    )
+    action_space = gym.spaces.Discrete(2)
+
+    def __init__(self):
+        self.t = 0
+
+    def reset(self, *, seed=None, options=None):
+        self.t = 0
+        return {"rgb": np.zeros((3, 8, 8), np.uint8)}, {}
+
+    def step(self, action):
+        self.t += 1
+        return {"rgb": np.full((3, 8, 8), self.t, np.uint8)}, 0.0, False, False, {}
+
+
+def test_frame_stack_shapes_and_rolling():
+    env = FrameStack(_PixelDictEnv(), num_stack=4, cnn_keys=["rgb"])
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (4, 3, 8, 8)
+    for _ in range(2):
+        obs, *_ = env.step(0)
+    # newest frame is last, values [0, 0, 1, 2]
+    assert obs["rgb"][-1].max() == 2 and obs["rgb"][0].max() == 0
+
+
+def test_reward_as_observation_injects_key():
+    env = RewardAsObservationWrapper(_CountingEnv())
+    obs, _ = env.reset()
+    assert set(obs.keys()) == {"obs", "reward"} and obs["reward"] == 0.0
+    obs, reward, *_ = env.step(0)
+    assert obs["reward"] == np.float32(1.0) == np.float32(reward)
+    assert "reward" in env.observation_space.spaces
+
+
+class _DictCountingEnv(_CountingEnv):
+    observation_space = gym.spaces.Dict(
+        {"state": gym.spaces.Box(-np.inf, np.inf, (2,), np.float32)}
+    )
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = super().reset(seed=seed, options=options)
+        return {"state": obs}, info
+
+    def step(self, action):
+        obs, *rest = super().step(action)
+        return {"state": obs}, *rest
+
+
+def test_actions_as_observation_one_hot_stack():
+    env = ActionsAsObservationWrapper(_DictCountingEnv(), num_stack=3, noop=0)
+    obs, _ = env.reset()
+    assert obs["action_stack"].shape == (3 * 2,)
+    assert np.all(obs["action_stack"].reshape(3, 2)[:, 0] == 1)  # noop one-hots
+    obs, *_ = env.step(1)
+    assert obs["action_stack"].reshape(3, 2)[-1, 1] == 1  # newest action last
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(_DictCountingEnv(), num_stack=0, noop=0)
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(_DictCountingEnv(), num_stack=2, noop=0, dilation=0)
+    with pytest.raises(ValueError, match="Dict observation space"):
+        ActionsAsObservationWrapper(_CountingEnv(), num_stack=2, noop=0)
+
+
+def test_mask_velocity_wrapper():
+    env = MaskVelocityWrapper(gym.make("CartPole-v1"))
+    obs, _ = env.reset(seed=0)
+    # CartPole: velocity dims (1, 3) zeroed
+    assert obs[1] == 0.0 and obs[3] == 0.0
+    with pytest.raises(NotImplementedError):
+        MaskVelocityWrapper(gym.make("Acrobot-v1"))
+
+
+def test_grayscale_render_expands_channels():
+    class _GrayEnv(_CountingEnv):
+        render_mode = "rgb_array"
+
+        def render(self):
+            return np.zeros((8, 8), np.uint8)
+
+    frame = GrayscaleRenderWrapper(_GrayEnv()).render()
+    assert frame.shape == (8, 8, 3)
