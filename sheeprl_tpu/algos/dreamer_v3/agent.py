@@ -512,8 +512,14 @@ class DV3Agent:
         return h0, z0
 
     def _representation(self, wm_params: Dict, h: jax.Array, embedded: jax.Array, key: jax.Array):
+        if self.decoupled_rssm:
+            # DecoupledRSSM (reference agent.py:501-596): the posterior depends on
+            # the embedded observation ALONE — no recurrent-state input
+            rep_in = embedded
+        else:
+            rep_in = jnp.concatenate([h, embedded], axis=-1)
         logits = self.representation_model.apply(
-            {"params": wm_params["representation_model"]}, jnp.concatenate([h, embedded], axis=-1)
+            {"params": wm_params["representation_model"]}, rep_in
         )
         logits = unimix_logits(logits, self.discrete_size, self.unimix)
         return logits, stochastic_state(logits, self.discrete_size, key)
@@ -574,23 +580,44 @@ class DV3Agent:
         T, B = embedded.shape[:2]
         h0, z0 = self.initial_state(wm_params, (B,))
         keys = jax.random.split(key, T)
-
-        def step(carry, inp):
-            h, z = carry
-            a, e, first, k = inp
-            a = (1 - first) * a
-            h = (1 - first) * h + first * h0
-            z = (1 - first) * z + first * z0
-            h = self._recurrent(wm_params, z, a, h)
-            prior_logits = self.transition_model.apply({"params": wm_params["transition_model"]}, h)
-            prior_logits = unimix_logits(prior_logits, self.discrete_size, self.unimix)
-            post_logits, z = self._representation(wm_params, h, e, k)
-            return (h, z), (h, z, post_logits, prior_logits)
-
         init = (
             jnp.zeros((B, self.recurrent_state_size), embedded.dtype),
             jnp.zeros((B, self.stoch_state_size), embedded.dtype),
         )
+
+        def _recurrent_prior(h, z_prev, a, first):
+            """Shared step prefix: reset masking, recurrent update, unimixed prior."""
+            a = (1 - first) * a
+            h = (1 - first) * h + first * h0
+            z_prev = (1 - first) * z_prev + first * z0
+            h = self._recurrent(wm_params, z_prev, a, h)
+            prior_logits = self.transition_model.apply({"params": wm_params["transition_model"]}, h)
+            return h, unimix_logits(prior_logits, self.discrete_size, self.unimix)
+
+        if self.decoupled_rssm:
+            # the posterior is non-recurrent, so the WHOLE sequence's posteriors come
+            # from one batched feedforward pass (reference DecoupledRSSM samples the
+            # posterior outside the time loop); only the recurrent/prior chain stays
+            # sequential
+            post_logits_all, zs_all = jax.vmap(
+                lambda e, k: self._representation(wm_params, h0, e, k)
+            )(embedded, keys)
+
+            def step(carry, inp):
+                h, z_prev = carry
+                a, z_t, post_logits_t, first = inp
+                h, prior_logits = _recurrent_prior(h, z_prev, a, first)
+                return (h, z_t), (h, z_t, post_logits_t, prior_logits)
+
+            return step, init, (actions, zs_all, post_logits_all, is_first)
+
+        def step(carry, inp):
+            h, z, = carry
+            a, e, first, k = inp
+            h, prior_logits = _recurrent_prior(h, z, a, first)
+            post_logits, z = self._representation(wm_params, h, e, k)
+            return (h, z), (h, z, post_logits, prior_logits)
+
         return step, init, (actions, embedded, is_first, keys)
 
     def imagination_scan(
@@ -643,10 +670,6 @@ def build_agent(
     actor_cfg = cfg.algo.actor
     critic_cfg = cfg.algo.critic
     dtype = fabric.compute_dtype
-    if wm_cfg.get("decoupled_rssm", False):
-        raise NotImplementedError(
-            "decoupled_rssm is not implemented yet; set algo.world_model.decoupled_rssm=False"
-        )
 
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
@@ -810,6 +833,7 @@ def build_agent(
             "action_clip": actor_cfg.get("action_clip", 1.0),
         },
         learnable_initial_recurrent_state=wm_cfg.learnable_initial_recurrent_state,
+        decoupled_rssm=bool(wm_cfg.get("decoupled_rssm", False)),
     )
 
     # -- init params -------------------------------------------------------------
@@ -837,7 +861,11 @@ def build_agent(
                 keys[1], jnp.concatenate([z, jnp.zeros((1, act_dim), jnp.float32)], axis=-1), h
             )["params"],
             "representation_model": representation_model.init(
-                keys[2], jnp.concatenate([h, embedded], axis=-1)
+                keys[2],
+                # decoupled RSSM: the posterior head consumes the embedding alone
+                embedded
+                if wm_cfg.get("decoupled_rssm", False)
+                else jnp.concatenate([h, embedded], axis=-1),
             )["params"],
             "transition_model": transition_model.init(keys[3], h)["params"],
             "observation_model": observation_model.init(keys[4], latent)["params"],

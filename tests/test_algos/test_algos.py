@@ -272,6 +272,12 @@ def test_dreamer_v3(standard_args, env_id):
     _run(standard_args + _DV3_TINY + [f"env.id={env_id}"])
 
 
+def test_dreamer_v3_decoupled_rssm(standard_args):
+    """DecoupledRSSM variant (reference agent.py:501-596): non-recurrent posterior,
+    whole-sequence representation pass."""
+    _run(standard_args + _DV3_TINY + ["env.id=discrete_dummy", "algo.world_model.decoupled_rssm=True"])
+
+
 def test_dreamer_v3_devices2(standard_args):
     _run(standard_args + _DV3_TINY + ["fabric.devices=2"])
 
