@@ -62,6 +62,43 @@ def test_dmc_through_make_env():
     env.close()
 
 
+@pytest.mark.timeout(280)
+def test_dreamer_v3_trains_on_dmc_pixels():
+    """Full-system check on a REAL pixel env: Dreamer-V3 runs its act+train loop on
+    dm_control walker-walk through the config system (tiny model, few steps)."""
+    pytest.importorskip("dm_control")
+    os.environ.setdefault("MUJOCO_GL", "egl")
+    from sheeprl_tpu.cli import run
+
+    run(
+        [
+            "exp=dreamer_v3_dmc_walker_walk",
+            "fabric.accelerator=cpu",
+            "fabric.precision=32-true",
+            "env.num_envs=1",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "buffer.memmap=False",
+            "algo.total_steps=24",
+            "algo.learning_starts=16",
+            "algo.per_rank_batch_size=1",
+            "algo.per_rank_sequence_length=8",
+            "algo.horizon=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.run_test=False",
+        ]
+    )
+
+
 @pytest.mark.parametrize(
     "sdk, module, cls",
     [

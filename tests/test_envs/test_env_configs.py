@@ -66,32 +66,21 @@ def test_env_group_obtain_variants_override_minerl():
     assert cfg.env.wrapper.dense is True
 
 
-@pytest.mark.parametrize(
-    "exp",
-    [
-        "dreamer_v3_XL_crafter",
-        "dreamer_v3_dmc_walker_walk",
-        "dreamer_v3_dmc_cartpole_swingup_sparse",
-        "dreamer_v3_100k_boxing",
-        "dreamer_v3_super_mario_bros",
-        "dreamer_v3_minedojo",
-        "dreamer_v3_L_doapp",
-        "dreamer_v3_L_doapp_128px_gray_combo_discrete",
-        "dreamer_v3_L_navigate",
-        "dreamer_v2_crafter",
-        "dreamer_v2_ms_pacman",
-        "dreamer_v1_benchmarks",
-        "dreamer_v2_benchmarks",
-        "ppo_super_mario_bros",
-        "offline_dreamer_dmc_walker_walk",
-        "p2e_dv3_expl_L_doapp_128px_gray_combo_discrete_15Mexpl_20Mstps",
-        "p2e_dv3_fntn_L_doapp_64px_gray_combo_discrete_5Mstps",
-        "a2c_benchmarks",
-        "sac_benchmarks",
-        "ppo_benchmarks",
-        "dreamer_v3_benchmarks",
-    ],
-)
+def _all_exp_configs():
+    import glob
+    import os
+
+    import sheeprl_tpu
+
+    exp_dir = os.path.join(os.path.dirname(sheeprl_tpu.__file__), "configs", "exp")
+    return sorted(
+        os.path.splitext(os.path.basename(p))[0]
+        for p in glob.glob(os.path.join(exp_dir, "*.yaml"))
+        if os.path.basename(p) != "default.yaml"
+    )
+
+
+@pytest.mark.parametrize("exp", _all_exp_configs())
 def test_exp_config_composes(exp):
     overrides = [f"exp={exp}"]
     if "fntn" in exp or "finetuning" in exp:
