@@ -16,7 +16,8 @@ stops at the first line gets the headline.
 Select a single workload with BENCH_ALGO:
 - ppo / a2c / sac — the reference's *_benchmarks exp configs verbatim, whole-run
   wall-clock (compile included), like the reference's benchmarks/benchmark.py.
-- dreamer_v3 — the reference's dreamer_v3_benchmarks conditions (tiny model,
+- dreamer_v1 / dreamer_v2 / dreamer_v3 — the reference's dreamer_*_benchmarks
+  conditions (tiny model,
   replay_ratio 1/16, sequence 64, batch 16). Reported as STEADY-STATE env-steps/sec:
   wall time over the post-compile window (policy steps after
   SHEEPRL_BENCH_STEADY_START, see run_dreamer), because the reference's 16384-step
@@ -44,15 +45,22 @@ BASELINES = {
     "ppo": (65536, 81.27),
     "a2c": (65536, 84.76),
     "sac": (65536, 320.21),
+    "dreamer_v1": (16384, 2207.13),
+    "dreamer_v2": (16384, 906.42),
     "dreamer_v3": (16384, 1589.30),
 }
 
-# Dreamer steady-state window: warm up through learning_starts (1024, where the
-# first train/act compiles land) plus 512 post-compile steps (32 compiled train
-# calls at replay ratio 1/16), then measure steps 1536..3072 — sized so the whole
-# run fits the extra's budget even on the single-core CPU fallback (~9 sps).
-DREAMER_TOTAL_STEPS = 3072
-DREAMER_STEADY_START = 1536
+# Dreamer steady-state windows: warm up through learning_starts (1024, where the
+# first train/act compiles land) plus post-compile steps, then measure to
+# total_steps — sized per algorithm so the whole run fits the extra's budget even
+# on the single-core CPU fallback (dv3 ~9 sps; dv1's Gaussian RSSM step is the
+# slowest, so it gets the shortest window).
+DREAMER_WINDOWS = {
+    # algo: (total_steps, steady_start)
+    "dreamer_v1": (2048, 1280),
+    "dreamer_v2": (3072, 1536),
+    "dreamer_v3": (3072, 1536),
+}
 
 
 def _dummy_pixel_overrides() -> list:
@@ -107,19 +115,20 @@ def _accelerator_alive(timeout: int = 90) -> bool:
         return False
 
 
-def _bench_dreamer_steady() -> dict:
-    """Dreamer-V3 steady-state env-steps/sec over a bounded post-compile window."""
-    total_steps, ref_seconds = BASELINES["dreamer_v3"]
-    baseline_sps = total_steps / ref_seconds  # 10.31 sps on 4 CPUs
+def _bench_dreamer_steady(algo: str = "dreamer_v3") -> dict:
+    """Dreamer-family steady-state env-steps/sec over a bounded post-compile window."""
+    total_steps, ref_seconds = BASELINES[algo]
+    baseline_sps = total_steps / ref_seconds  # dv3: 10.31 sps on 4 CPUs
 
     from sheeprl_tpu.cli import run
 
-    args = ["exp=dreamer_v3_benchmarks"]
+    args = [f"exp={algo}_benchmarks"]
     try:
         import ale_py  # noqa: F401
     except ImportError:
         args += _dummy_pixel_overrides()
-    args += [f"algo.total_steps={DREAMER_TOTAL_STEPS}"]
+    total, steady_start = DREAMER_WINDOWS[algo]
+    args += [f"algo.total_steps={total}"]
     on_cpu = False
     if os.environ.get("JAX_PLATFORMS", "").lower() not in ("", "cpu") and not _accelerator_alive():
         args += ["fabric.accelerator=cpu"]
@@ -128,7 +137,7 @@ def _bench_dreamer_steady() -> dict:
     with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
         steady_file = f.name
     os.environ["SHEEPRL_BENCH_STEADY_FILE"] = steady_file
-    os.environ["SHEEPRL_BENCH_STEADY_START"] = str(DREAMER_STEADY_START)
+    os.environ["SHEEPRL_BENCH_STEADY_START"] = str(steady_start)
     try:
         run(args)
         with open(steady_file) as f:
@@ -142,14 +151,14 @@ def _bench_dreamer_steady() -> dict:
             pass
     sps = steady["steps"] / steady["seconds"]
     return {
-        "metric": "dreamer_v3_env_steps_per_sec",
+        "metric": f"{algo}_env_steps_per_sec",
         "value": round(sps, 2),
         "unit": "env-steps/sec (steady-state)",
         "vs_baseline": round(sps / baseline_sps, 3),
         "conditions": {
             "steady_window_steps": steady["steps"],
             "steady_window_seconds": round(steady["seconds"], 2),
-            "total_steps": DREAMER_TOTAL_STEPS,
+            "total_steps": total,
             "baseline_sps": round(baseline_sps, 2),
             "accelerator": "cpu-fallback" if on_cpu else "auto",
         },
@@ -157,8 +166,8 @@ def _bench_dreamer_steady() -> dict:
 
 
 def _bench(algo: str) -> dict:
-    if algo == "dreamer_v3":
-        return _bench_dreamer_steady()
+    if algo.startswith("dreamer_v"):
+        return _bench_dreamer_steady(algo)
     return _bench_wallclock(algo)
 
 

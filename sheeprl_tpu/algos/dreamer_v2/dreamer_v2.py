@@ -43,7 +43,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, foreach_gradient_step, save_configs
+from sheeprl_tpu.utils.utils import BenchWindow, Ratio, foreach_gradient_step, save_configs
 
 def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx):
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
@@ -374,7 +374,10 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
     last_train = 0
     act_dim = int(np.sum(actions_dim))
 
+    bench = BenchWindow()
+
     for iter_num in range(start_iter, total_iters + 1):
+        bench.maybe_start(policy_step, params)
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time"):
@@ -540,6 +543,8 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
+
+    bench.finish(policy_step, params)
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:

@@ -54,6 +54,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import (
+    BenchWindow,
     Ratio,
     compute_lambda_values,
     foreach_gradient_step,
@@ -473,21 +474,11 @@ def run_dreamer(
     last_train = 0
     act_dim = int(np.sum(actions_dim))
 
-    # Optional steady-state measurement window for bench.py: record wall time over the
-    # policy steps after SHEEPRL_BENCH_STEADY_START (set past warmup+compile), so the
-    # reported throughput is the post-compile regime (see bench.py docstring).
-    import time as _time
-
-    bench_file = os.environ.get("SHEEPRL_BENCH_STEADY_FILE")
-    bench_start_step = int(os.environ.get("SHEEPRL_BENCH_STEADY_START", "0"))
-    bench_t0 = None
-    bench_step0 = 0
+    # Optional steady-state measurement window for bench.py (see bench.py docstring)
+    bench = BenchWindow()
 
     for iter_num in range(start_iter, total_iters + 1):
-        if bench_file and bench_t0 is None and policy_step >= bench_start_step:
-            jax.block_until_ready(params)
-            bench_t0 = _time.perf_counter()
-            bench_step0 = policy_step
+        bench.maybe_start(policy_step, params)
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time"):
@@ -681,15 +672,7 @@ def run_dreamer(
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
-    if bench_file and bench_t0 is not None:
-        import json
-
-        jax.block_until_ready(params)
-        with open(bench_file, "w") as f:
-            json.dump(
-                {"steps": policy_step - bench_step0, "seconds": _time.perf_counter() - bench_t0},
-                f,
-            )
+    bench.finish(policy_step, params)
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:

@@ -358,3 +358,39 @@ def foreach_gradient_step(train_step, state, data, train_key, cum_steps=None):
     else:
         metrics = all_metrics[0]
     return (*state, metrics)
+
+
+class BenchWindow:
+    """Steady-state wall-clock window for bench.py: starts timing once the policy
+    step passes SHEEPRL_BENCH_STEADY_START (set past warmup+compile) and writes
+    {steps, seconds} to SHEEPRL_BENCH_STEADY_FILE at the end of the run. Inactive
+    (zero overhead beyond two attribute checks per iteration) when the env vars are
+    unset. Shared by the Dreamer-family training loops."""
+
+    def __init__(self) -> None:
+        self.file = os.environ.get("SHEEPRL_BENCH_STEADY_FILE")
+        self.start_step = int(os.environ.get("SHEEPRL_BENCH_STEADY_START", "0"))
+        self._t0: Optional[float] = None
+        self._step0 = 0
+
+    def maybe_start(self, policy_step: int, sync_tree: Any = None) -> None:
+        if self.file and self._t0 is None and policy_step >= self.start_step:
+            import time
+
+            if sync_tree is not None:
+                jax.block_until_ready(sync_tree)
+            self._t0 = time.perf_counter()
+            self._step0 = policy_step
+
+    def finish(self, policy_step: int, sync_tree: Any = None) -> None:
+        if self.file and self._t0 is not None:
+            import json
+            import time
+
+            if sync_tree is not None:
+                jax.block_until_ready(sync_tree)
+            with open(self.file, "w") as f:
+                json.dump(
+                    {"steps": policy_step - self._step0, "seconds": time.perf_counter() - self._t0},
+                    f,
+                )
