@@ -79,6 +79,15 @@ def check_configs(cfg: dotdict) -> None:
         raise ValueError("single_device strategy requires fabric.devices=1")
 
 
+def _apply_hydra_cfg(cfg: dotdict) -> None:
+    """Honor the hydra config group's run-dir layout (reference
+    sheeprl/configs/hydra/default.yaml: hydra.run.dir places the run directory)."""
+    from sheeprl_tpu.utils.logger import set_run_dir
+
+    hydra_cfg = cfg.get("hydra") or {}
+    set_run_dir((hydra_cfg.get("run") or {}).get("dir"))
+
+
 def _apply_distribution_cfg(cfg: dotdict) -> None:
     """Global distribution argument-validation switch (reference cli.py:71 sets the
     torch-distributions default from configs/distribution/default.yaml)."""
@@ -209,6 +218,7 @@ def run(args: Optional[Sequence[str]] = None) -> None:
     check_configs(cfg)
     _setup_xla_env(cfg)
     _apply_distribution_cfg(cfg)
+    _apply_hydra_cfg(cfg)
     if cfg.metric.log_level > 0:
         print_config(cfg)
     run_algorithm(cfg)

@@ -91,9 +91,23 @@ def get_logger(fabric, cfg, log_dir: Optional[str] = None) -> Optional[TensorBoa
     return instantiate(logger_cfg)
 
 
+_run_dir_override: Optional[str] = None
+
+
+def set_run_dir(path: Optional[str]) -> None:
+    """Configure the run-directory base from ``cfg.hydra.run.dir`` (role of the
+    reference's hydra/default.yaml run-dir control): when set, every versioned run
+    dir is created under it instead of the default ``logs/runs/<root>/<name>``."""
+    global _run_dir_override
+    _run_dir_override = str(path) if path else None
+
+
 def get_log_dir(fabric, root_dir: str, run_name: str, share: bool = True) -> str:
     """Create (rank-0) and share the versioned log dir (sheeprl/utils/logger.py:40-91)."""
-    base = Path("logs") / "runs" / root_dir / run_name
+    if _run_dir_override:
+        base = Path(_run_dir_override)
+    else:
+        base = Path("logs") / "runs" / root_dir / run_name
     if fabric.global_rank == 0:
         existing = []
         if base.is_dir():

@@ -90,6 +90,38 @@ def test_exp_config_composes(exp):
     assert cfg.algo.total_steps > 0
 
 
+def test_hydra_run_dir_controls_run_directory(tmp_path, monkeypatch):
+    """The hydra config group is live config, not a stub: overriding hydra.run.dir
+    relocates the versioned run directory (reference hydra/default.yaml)."""
+    import os
+
+    from sheeprl_tpu.cli import run
+
+    monkeypatch.chdir(tmp_path)
+    run(
+        [
+            "exp=ppo",
+            "dry_run=True",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "buffer.memmap=False",
+            "env.num_envs=1",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+            "algo.run_test=False",
+            "hydra.run.dir=custom_runs/mydir",
+        ]
+    )
+    assert os.path.isdir(tmp_path / "custom_runs/mydir/version_0")
+
+    cfg = compose(["exp=ppo"])
+    assert cfg.hydra.run.dir == f"logs/runs/{cfg.root_dir}/{cfg.run_name}"
+
+
 def test_crafter_is_reachable_through_config():
     """VERDICT round-2 'adapters are dead code' regression guard: the crafter group
     selects the sheeprl_tpu adapter."""
