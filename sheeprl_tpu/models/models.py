@@ -218,10 +218,16 @@ class LayerNormGRUCell(nn.Module):
             # applies when lowering for TPU with the weight block VMEM-resident; any
             # other lowering platform (e.g. the CPU-pinned act path of a TPU run)
             # takes the XLA path — same math, parity-tested in tests/test_ops
+            import os
+
             from sheeprl_tpu import ops
 
             hx_d = hx.astype(self.dtype)
-            if inp.ndim == 2 and ops.pallas_gru_applicable(inp.shape[-1], self.hidden_size):
+            if (
+                inp.ndim == 2
+                and ops.pallas_gru_applicable(inp.shape[-1], self.hidden_size)
+                and os.environ.get("SHEEPRL_DISABLE_PALLAS", "0") != "1"
+            ):
                 return jax.lax.platform_dependent(
                     tpu=lambda: ops.fused_ln_gru_step(
                         inp, hx_d, w, b, scale, offset, eps=self.layer_norm_eps

@@ -35,7 +35,8 @@ PALLAS_GRU_VMEM_WEIGHT_BUDGET = 8 * 1024 * 1024
 
 def _ln_gru_kernel(inp_ref, hx_ref, w_ref, b_ref, scale_ref, bias_ref, out_ref, *, eps: float):
     # operands keep their storage dtype (bf16 inputs feed the MXU natively);
-    # accumulation and the layernorm/gating chain run in f32
+    # accumulation and the layernorm/gating chain run in f32. The per-feature
+    # vectors arrive as (1, 3H) blocks — TPU tiling wants >=2-D operands.
     gates = jnp.dot(inp_ref[...], w_ref[...], preferred_element_type=jnp.float32)
     gates = gates + b_ref[...].astype(jnp.float32)
     # LayerNorm over the full 3H feature axis (reference norms the stacked
@@ -60,6 +61,8 @@ def _pallas_forward(eps, block_b, interpret, inp, hx, w, b, scale, bias) -> jax.
     H = hx.shape[-1]
     block_b = min(block_b, B)
     grid = ((B + block_b - 1) // block_b,)
+    # feature vectors ride as (1, 3H): TPU memory tiling is defined over the last
+    # two dims, so every operand is kept >=2-D
     return pl.pallas_call(
         functools.partial(_ln_gru_kernel, eps=eps),
         out_shape=jax.ShapeDtypeStruct((B, H), hx.dtype),
@@ -68,13 +71,13 @@ def _pallas_forward(eps, block_b, interpret, inp, hx, w, b, scale, bias) -> jax.
             pl.BlockSpec((block_b, K), lambda i: (i, 0)),
             pl.BlockSpec((block_b, H), lambda i: (i, 0)),
             pl.BlockSpec((K, 3 * H), lambda i: (0, 0)),
-            pl.BlockSpec((3 * H,), lambda i: (0,)),
-            pl.BlockSpec((3 * H,), lambda i: (0,)),
-            pl.BlockSpec((3 * H,), lambda i: (0,)),
+            pl.BlockSpec((1, 3 * H), lambda i: (0, 0)),
+            pl.BlockSpec((1, 3 * H), lambda i: (0, 0)),
+            pl.BlockSpec((1, 3 * H), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((block_b, H), lambda i: (i, 0)),
         interpret=interpret,
-    )(inp, hx, w, b, scale, bias)
+    )(inp, hx, w, b.reshape(1, -1), scale.reshape(1, -1), bias.reshape(1, -1))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
