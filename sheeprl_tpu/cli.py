@@ -101,17 +101,9 @@ def _setup_xla_env(cfg: dotdict) -> None:
     """Apply the XLA/runtime knobs (replacing torch/cuDNN knobs, reference cli.py:186-196)."""
     import jax
 
-    # Persistent compilation cache: the fused train programs take tens of seconds to
-    # compile; caching them on disk makes every later process (tests, bench re-runs,
-    # resumed experiments) skip the compile entirely. Opt out with
-    # SHEEPRL_JAX_CACHE=0 or point SHEEPRL_JAX_CACHE at another directory.
-    cache_dir = os.environ.get("SHEEPRL_JAX_CACHE", os.path.expanduser("~/.cache/sheeprl_tpu/jax"))
-    if cache_dir not in ("0", ""):
-        try:
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:
-            pass
+    from sheeprl_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
 
     # torch set_float32_matmul_precision names map 1:1 onto JAX's tri-state
     # (high → bf16_3x passes, highest → f32, default → bf16 on the MXU)

@@ -1,0 +1,22 @@
+"""Persistent XLA compilation cache policy, shared by every entry point (CLI,
+tests, driver hooks). The fused train programs take tens of seconds to compile;
+caching them on disk lets later processes skip the compile entirely. Opt out with
+``SHEEPRL_JAX_CACHE=0`` or point ``SHEEPRL_JAX_CACHE`` at another directory."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache() -> None:
+    import jax
+
+    cache_dir = os.environ.get(
+        "SHEEPRL_JAX_CACHE", os.path.expanduser("~/.cache/sheeprl_tpu/jax")
+    )
+    if cache_dir not in ("0", ""):
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass

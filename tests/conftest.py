@@ -16,17 +16,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache (same knob cli._setup_xla_env applies for real runs):
-# the fused Dreamer train programs take 30-60 s to compile; with the cache, repeat
-# suite runs skip every compile that already happened. Keyed by program, so shape
-# changes in a test invalidate only that test's entries.
-_cache_dir = os.environ.get("SHEEPRL_JAX_CACHE", os.path.expanduser("~/.cache/sheeprl_tpu/jax"))
-if _cache_dir not in ("0", ""):
-    try:
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+# Persistent compilation cache (same policy as cli._setup_xla_env): the fused
+# Dreamer train programs take 30-60 s to compile; with the cache, repeat suite runs
+# skip every compile that already happened. Keyed by program, so shape changes in a
+# test invalidate only that test's entries.
+from sheeprl_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
 
 import signal  # noqa: E402
 
