@@ -163,10 +163,18 @@ def _trainer_loop(
             )
     except BaseException as e:  # surface learner crashes to the player
         error["exc"] = e
-        params_q.put(None)
+        # If the crash came from a channel collective the broadcast plane is
+        # desynced — another lockstep put can block forever and bury the real
+        # traceback. Only unblock the player while the channel is healthy.
+        if not isinstance(e, _ChannelError):
+            try:
+                params_q.put(None)
+            except _ChannelError:
+                pass
 
 
 from sheeprl_tpu.parallel.distributed import BroadcastChannel as _BcastChannel
+from sheeprl_tpu.parallel.distributed import ChannelError as _ChannelError
 
 
 def _learner_process(fabric, cfg: Dict[str, Any]):
@@ -197,9 +205,15 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     _trainer_loop(fabric, cfg, agent, params, data_q, params_q, error, geometry=geometry)
     if "exc" in error:
         # the player is (or will be) blocked sending its final sentinel — consume
-        # it and ack so the lockstep broadcasts stay paired, then surface the crash
-        data_q.get()
-        params_q.put(None)
+        # it and ack so the lockstep broadcasts stay paired, then surface the crash.
+        # Skip the pairing when the crash WAS the channel: its collectives are
+        # desynced and would hang instead of pairing.
+        if not isinstance(error["exc"], _ChannelError):
+            try:
+                data_q.get()
+                params_q.put(None)
+            except _ChannelError:
+                pass
         raise error["exc"]
 
 
@@ -528,13 +542,14 @@ def main(fabric, cfg: Dict[str, Any]):
             test(agent.apply, jax.tree_util.tree_map(jnp.asarray, act_params), fabric, cfg, log_dir)
         if logger is not None:
             logger.finalize()
-    except BaseException:
+    except BaseException as e:
         # Best-effort learner release: send the data-plane sentinel, then consume
         # the learner's crash-path ack so its final broadcast is paired too. A crash
-        # DURING a collective (e.g. KeyboardInterrupt mid-broadcast) cannot be
-        # repaired from here — the distributed runtime's failure detection is the
-        # backstop — but every between-collectives crash point exits both roles.
-        if two_process and not _protocol_done:
+        # that WAS a channel collective (ChannelError) cannot be repaired from
+        # here — the plane is desynced and another lockstep collective would hang,
+        # not raise; the distributed runtime's failure detection is the backstop —
+        # but every between-collectives crash point exits both roles.
+        if two_process and not _protocol_done and not isinstance(e, _ChannelError):
             try:
                 _BcastChannel(src=0).put(None)
                 _BcastChannel(src=1).get()

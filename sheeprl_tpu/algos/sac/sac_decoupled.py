@@ -28,7 +28,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.parallel.distributed import BroadcastChannel
+from sheeprl_tpu.parallel.distributed import BroadcastChannel, ChannelError
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -149,7 +149,14 @@ def _trainer_loop(
             )
     except BaseException as e:
         error["exc"] = e
-        params_q.put(None)
+        # If the crash came from a channel collective the broadcast plane is
+        # desynced — another lockstep put can block forever and bury the real
+        # traceback. Only unblock the player while the channel is healthy.
+        if not isinstance(e, ChannelError):
+            try:
+                params_q.put(None)
+            except ChannelError:
+                pass
 
 
 def _learner_process(fabric, cfg: Dict[str, Any]):
@@ -175,8 +182,14 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
         fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error, geometry=geometry
     )
     if "exc" in error:
-        data_q.get()
-        params_q.put(None)
+        # pair the player's final sentinel — unless the crash WAS the channel,
+        # whose collectives are desynced and would hang instead of pairing
+        if not isinstance(error["exc"], ChannelError):
+            try:
+                data_q.get()
+                params_q.put(None)
+            except ChannelError:
+                pass
         raise error["exc"]
 
 
@@ -470,8 +483,10 @@ def main(fabric, cfg: Dict[str, Any]):
             test(actor.apply, jax.tree_util.tree_map(jnp.asarray, params_host["actor"]), fabric, cfg, log_dir)
         if logger is not None:
             logger.finalize()
-    except BaseException:
-        if two_process and not _protocol_done:
+    except BaseException as e:
+        # skip the release when the crash WAS a channel collective: the plane is
+        # desynced and another lockstep collective would hang, not raise
+        if two_process and not _protocol_done and not isinstance(e, ChannelError):
             try:
                 BroadcastChannel(src=0).put(None)
                 BroadcastChannel(src=1).get()

@@ -90,6 +90,12 @@ def barrier(name: str = "barrier") -> None:
     multihost_utils.sync_global_devices(name)
 
 
+class ChannelError(RuntimeError):
+    """A collective underlying a :class:`BroadcastChannel` op failed. Once raised,
+    the lockstep broadcast plane is desynced: issuing another collective on the same
+    channel can block forever, so crash paths must NOT attempt further puts."""
+
+
 class BroadcastChannel:
     """A cross-process channel with a queue's ``put``/``get`` surface, carried by
     lockstep ``host_broadcast_object`` collectives from a fixed source process.
@@ -101,7 +107,13 @@ class BroadcastChannel:
         self.src = src
 
     def put(self, msg: Any) -> None:
-        host_broadcast_object(msg, src=self.src)
+        try:
+            host_broadcast_object(msg, src=self.src)
+        except Exception as e:
+            raise ChannelError(f"broadcast put (src={self.src}) failed") from e
 
     def get(self) -> Any:
-        return host_broadcast_object(None, src=self.src)
+        try:
+            return host_broadcast_object(None, src=self.src)
+        except Exception as e:
+            raise ChannelError(f"broadcast get (src={self.src}) failed") from e

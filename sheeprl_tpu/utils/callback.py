@@ -33,7 +33,7 @@ class CheckpointCallback:
             self._experiment_consistent_rb(replay_buffer, true_dones)
             state.pop("rb", None)
         if fabric.is_global_zero:
-            self._delete_old_checkpoints(os.path.dirname(ckpt_path))
+            self._delete_old_checkpoints(os.path.dirname(ckpt_path), live=ckpt_path)
 
     def on_checkpoint_player(self, fabric, ckpt_path: str, state: Dict[str, Any], replay_buffer=None) -> None:
         # decoupled topology: the player holds the buffer, the trainer sent the weights
@@ -82,11 +82,21 @@ class CheckpointCallback:
             for b, flags in zip(rb.buffer, true_dones):
                 self._experiment_consistent_rb(b, flags)
 
-    def _delete_old_checkpoints(self, ckpt_folder: str) -> None:
+    def _delete_old_checkpoints(self, ckpt_folder: str, live: Optional[str] = None) -> None:
         if not self.keep_last:
             return
+        # ``live`` is the checkpoint just written. An async sharded save commits
+        # its directory via a background tmp-dir rename; until it lands, the live
+        # sidecar has no directory next to it and would be swept as an orphan —
+        # corrupting the checkpoint. Excluding the live path (instead of blocking
+        # on the in-flight write) keeps async saves actually asynchronous.
+        live = os.path.abspath(live) if live else None
         ckpts = sorted(glob.glob(os.path.join(ckpt_folder, "*.ckpt")), key=os.path.getmtime)
-        for stale in ckpts[: max(0, len(ckpts) - self.keep_last)]:
+        visible = [c for c in ckpts if os.path.abspath(c) != live]
+        # the live checkpoint occupies one keep_last slot whether or not its async
+        # commit has landed yet (i.e. whether or not the glob saw it)
+        budget = self.keep_last - (1 if live else 0)
+        for stale in visible[: max(0, len(visible) - max(0, budget))]:
             try:
                 if os.path.isdir(stale):  # sharded (orbax) checkpoint directory
                     import shutil
@@ -100,6 +110,8 @@ class CheckpointCallback:
                 pass
         # orphan sidecars from a crash between sidecar write and orbax commit
         for sidecar in glob.glob(os.path.join(ckpt_folder, "*.ckpt.extras.pkl")):
+            if live is not None and os.path.abspath(sidecar) == live + ".extras.pkl":
+                continue  # in-flight async write: directory lands at commit time
             if not os.path.isdir(sidecar[: -len(".extras.pkl")]):
                 try:
                     os.remove(sidecar)

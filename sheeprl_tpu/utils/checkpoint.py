@@ -56,6 +56,21 @@ def load_checkpoint(path: str) -> Dict[str, Any]:
 
 _ARRAY_TYPES = (np.ndarray, jax.Array, np.integer, np.floating, np.bool_)
 _async_checkpointer = None
+_displaced: list = []  # previous checkpoints moved aside by an in-place overwrite
+
+
+def _gc_displaced() -> None:
+    import shutil
+
+    while _displaced:
+        stale = _displaced.pop()
+        if os.path.isdir(stale):
+            shutil.rmtree(stale, ignore_errors=True)
+        elif os.path.exists(stale):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
 
 
 def _partition_state(state: Any):
@@ -94,13 +109,26 @@ def save_checkpoint_sharded(path: str, state: Dict[str, Any], async_save: bool =
         if _async_checkpointer is None:
             _async_checkpointer = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
         _async_checkpointer.wait_until_finished()
+        _gc_displaced()  # the previous write (whose displaced .old we kept) has landed
         checkpointer = _async_checkpointer
     else:
         checkpointer = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
     if os.path.exists(path):
+        # Overwriting a path in place must be crash-safe: displace the previous
+        # checkpoint atomically (rename, not delete) so a crash mid-write still
+        # leaves the old state on disk as <path>.old; it is GC'd only after the
+        # new write has committed (sync: below; async: at the next wait).
         import shutil
 
-        shutil.rmtree(path, ignore_errors=True)
+        old = path + ".old"
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.exists(old + ".extras.pkl"):
+            os.remove(old + ".extras.pkl")
+        if os.path.exists(path + ".extras.pkl"):
+            os.replace(path + ".extras.pkl", old + ".extras.pkl")
+            _displaced.append(old + ".extras.pkl")
+        os.replace(path, old)
+        _displaced.append(old)
     # Crash-atomic ordering: the sidecar lands BEFORE the orbax commit. Orbax itself
     # writes to a tmp dir and renames on finalize, and load auto-detection keys on
     # the DIRECTORY — so a crash mid-write leaves at worst an orphan sidecar (GC'd
@@ -111,12 +139,15 @@ def save_checkpoint_sharded(path: str, state: Dict[str, Any], async_save: bool =
         pickle.dump(sidecar, f, protocol=pickle.HIGHEST_PROTOCOL)
     os.replace(tmp, path + ".extras.pkl")
     checkpointer.save(path, {"leaves": arrays})
+    if not async_save:
+        _gc_displaced()
 
 
 def wait_for_checkpoint() -> None:
     """Block until any in-flight async checkpoint write has landed."""
     if _async_checkpointer is not None:
         _async_checkpointer.wait_until_finished()
+    _gc_displaced()
 
 
 def load_checkpoint_sharded(path: str) -> Dict[str, Any]:

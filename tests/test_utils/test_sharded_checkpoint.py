@@ -107,3 +107,45 @@ def test_dreamer_v3_sharded_checkpoint_resume_devices2(standard_args):
     ckpt = sorted(c for c in ckpts if os.path.isdir(c))[-1]
     assert os.path.isfile(ckpt + ".extras.pkl")
     run(args + [f"checkpoint.resume_from={ckpt}"])
+
+
+def test_gc_spares_inflight_async_sidecar_without_blocking(tmp_path, monkeypatch):
+    """The keep_last sweep must neither treat the sidecar of an in-flight async save
+    as an orphan (the orbax directory only appears at commit time) nor block on the
+    background write (which would make async saves synchronous)."""
+    import sheeprl_tpu.utils.checkpoint as ckpt_mod
+    from sheeprl_tpu.utils.callback import CheckpointCallback
+
+    path = str(tmp_path / "ckpt_live.ckpt")
+
+    class InFlightStub:
+        def wait_until_finished(self):
+            raise AssertionError("the GC sweep must not block on the async write")
+
+    # on-disk state mid-write: sidecar present, directory not yet committed
+    with open(path + ".extras.pkl", "wb") as f:
+        f.write(b"sidecar")
+    # a genuinely orphaned sidecar from an earlier crash must still be swept
+    orphan = str(tmp_path / "ckpt_crashed.ckpt.extras.pkl")
+    with open(orphan, "wb") as f:
+        f.write(b"orphan")
+    monkeypatch.setattr(ckpt_mod, "_async_checkpointer", InFlightStub())
+
+    CheckpointCallback(keep_last=5)._delete_old_checkpoints(str(tmp_path), live=path)
+    assert os.path.isfile(path + ".extras.pkl"), "live sidecar must survive the sweep"
+    assert not os.path.exists(orphan), "crashed-write orphan must still be collected"
+
+
+def test_sharded_overwrite_in_place_keeps_old_until_commit(tmp_path):
+    """Overwriting a checkpoint path in place displaces the previous checkpoint
+    (rename) instead of deleting it before the new write, and GCs it after the
+    commit — so no crash window loses both."""
+    path = str(tmp_path / "ckpt_fixed.ckpt")
+    save_checkpoint_sharded(path, {"w": jnp.zeros(3), "step": 1})
+    save_checkpoint_sharded(path, {"w": jnp.ones(3), "step": 2})
+    restored = load_checkpoint_sharded(path)
+    np.testing.assert_array_equal(restored["w"], np.ones(3))
+    assert restored["step"] == 2
+    # the displaced copy is gone after the sync commit
+    assert not os.path.exists(path + ".old")
+    assert not os.path.exists(path + ".old.extras.pkl")
