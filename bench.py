@@ -29,6 +29,13 @@ Select a single workload with BENCH_ALGO:
   image, so the env falls back to the pixel dummy env (same 64x64 rgb obs shape).
   The emulator is a sub-ms slice of the reference's ~97 ms/step, so the comparison
   is dominated by framework+training cost either way.
+- dreamer_v3_mfu — flagship-size (S preset) DV3 train-program MFU on the
+  accelerator: FLOPs from XLA's own cost model over achieved step time vs chip
+  peak (sheeprl_tpu/utils/mfu.py). Run automatically as an extra when the
+  accelerator probe reports a live non-CPU chip.
+
+The dreamer_v3 extra also records the MFU of the benchmark-size train program in
+its ``conditions.train_mfu`` block (and mirrors ``mfu`` top-level).
 """
 
 from __future__ import annotations
@@ -97,22 +104,44 @@ def _bench_wallclock(algo: str) -> dict:
     }
 
 
-def _accelerator_alive(timeout: int = 90) -> bool:
+def _accelerator_probe(timeout: int = 90) -> dict:
     """Probe accelerator-backend bring-up in a THROWAWAY process. The tunneled TPU
     backend can wedge (a killed client's claim blocks new ones indefinitely) — and a
     wedged init inside the bench process would burn the whole budget. A dead probe
-    demotes the run to CPU so the scoreboard still gets a number."""
+    demotes the run to CPU so the scoreboard still gets a number. Returns
+    {alive, platform, device_kind}."""
     import subprocess
 
     try:
         probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [
+                sys.executable,
+                "-c",
+                "import jax; d=jax.devices()[0]; print(d.platform + '|' + d.device_kind)",
+            ],
             timeout=timeout,
             capture_output=True,
+            text=True,
         )
-        return probe.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        return {"alive": False, "platform": None, "device_kind": None}
+    if probe.returncode != 0:
+        return {"alive": False, "platform": None, "device_kind": None}
+    line = probe.stdout.strip().splitlines()[-1]
+    platform, _, kind = line.partition("|")
+    return {"alive": True, "platform": platform, "device_kind": kind}
+
+
+def _accelerator_probe_cached(timeout: int = 90) -> dict:
+    """Probe once per bench invocation: main() shares its result with the workload
+    subprocesses through SHEEPRL_BENCH_PROBE, so the (up to 90 s on a wedged
+    tunnel) throwaway-process probe is not paid per workload."""
+    cached = os.environ.get("SHEEPRL_BENCH_PROBE")
+    if cached:
+        return json.loads(cached)
+    result = _accelerator_probe(timeout)
+    os.environ["SHEEPRL_BENCH_PROBE"] = json.dumps(result)
+    return result
 
 
 def _bench_dreamer_steady(algo: str = "dreamer_v3") -> dict:
@@ -128,11 +157,15 @@ def _bench_dreamer_steady(algo: str = "dreamer_v3") -> dict:
     except ImportError:
         args += _dummy_pixel_overrides()
     total, steady_start = DREAMER_WINDOWS[algo]
-    args += [f"algo.total_steps={total}"]
-    on_cpu = False
-    if os.environ.get("JAX_PLATFORMS", "").lower() not in ("", "cpu") and not _accelerator_alive():
+    probe = _accelerator_probe_cached()
+    on_cpu = not probe["alive"] or probe["platform"] == "cpu"
+    if on_cpu:
         args += ["fabric.accelerator=cpu"]
-        on_cpu = True
+    else:
+        # a live chip turns over steps much faster than the 1-core CPU fallback the
+        # windows are sized for — measure a longer steady window (VERDICT r03 weak #6)
+        total = max(total, 4096)
+    args += [f"algo.total_steps={total}"]
 
     with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
         steady_file = f.name
@@ -150,7 +183,7 @@ def _bench_dreamer_steady(algo: str = "dreamer_v3") -> dict:
         except OSError:
             pass
     sps = steady["steps"] / steady["seconds"]
-    return {
+    result = {
         "metric": f"{algo}_env_steps_per_sec",
         "value": round(sps, 2),
         "unit": "env-steps/sec (steady-state)",
@@ -160,12 +193,127 @@ def _bench_dreamer_steady(algo: str = "dreamer_v3") -> dict:
             "steady_window_seconds": round(steady["seconds"], 2),
             "total_steps": total,
             "baseline_sps": round(baseline_sps, 2),
-            "accelerator": "cpu-fallback" if on_cpu else "auto",
+            # "cpu-fallback" strictly means a dead/wedged accelerator was demoted;
+            # a healthy CPU-only machine reports plain "cpu"
+            "accelerator": "cpu-fallback"
+            if not probe["alive"]
+            else "cpu"
+            if probe["platform"] == "cpu"
+            else f"tpu ({probe['device_kind']})"
+            if probe["platform"] in ("tpu", "axon")
+            else probe["platform"],
         },
+    }
+    if algo == "dreamer_v3":
+        # MFU of the fused train program at the exact benchmark shapes (the act
+        # program is host-side by design; the train program is where the FLOPs are)
+        try:
+            result["conditions"]["train_mfu"] = _dv3_train_mfu(size=None)
+            result["mfu"] = result["conditions"]["train_mfu"].get("mfu")
+        except Exception as exc:
+            result["conditions"]["train_mfu_error"] = repr(exc)[:300]
+    return result
+
+
+def _dv3_train_mfu(size: str | None = None, reps: int = 5) -> dict:
+    """MFU of the fused Dreamer-V3 train program. ``size=None`` uses the benchmark
+    exp's tiny model at the exact shapes the steady-state run compiles (cache hit);
+    a preset name ('S', 'M', ...) measures a flagship-size program instead — the
+    number that shows whether the design can feed the MXU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import gymnasium as gym
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_phase
+    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+    from sheeprl_tpu.config import compose, instantiate
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.utils.mfu import measure_mfu
+
+    if size is None:
+        overrides = ["exp=dreamer_v3_benchmarks"] + _dummy_pixel_overrides()
+    else:
+        overrides = [
+            "exp=dreamer_v3",
+            f"algo=dreamer_v3_{size}",
+            "algo.per_rank_batch_size=16",
+            "algo.per_rank_sequence_length=64",
+        ] + _dummy_pixel_overrides()
+    cfg = compose(overrides)
+
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    actions_dim = (2,)  # matches DiscreteDummyEnv's action space in the steady run
+    fabric = Fabric(devices=1)
+    fabric._setup()
+    agent, params = build_agent(fabric, actions_dim, False, cfg, obs_space, jax.random.PRNGKey(0))
+
+    def _tx(opt_cfg, clip):
+        base = instantiate(opt_cfg)
+        return optax.chain(optax.clip_by_global_norm(clip), base) if clip else base
+
+    world_tx = _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
+    actor_tx = _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_tx = _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    opt_state = {
+        "world_model": world_tx.init(params["world_model"]),
+        "actor": actor_tx.init(params["actor"]),
+        "critic": critic_tx.init(params["critic"]),
+    }
+    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+
+    T, B = int(cfg.algo.per_rank_sequence_length), int(cfg.algo.per_rank_batch_size)
+    rng = np.random.default_rng(0)
+    batch = {
+        "rgb": rng.integers(0, 255, (T, B, 3, 64, 64)).astype(np.uint8),
+        "actions": np.eye(2, dtype=np.float32)[rng.integers(0, 2, (T, B))],
+        "rewards": rng.normal(size=(T, B, 1)).astype(np.float32),
+        "terminated": np.zeros((T, B, 1), np.float32),
+        "truncated": np.zeros((T, B, 1), np.float32),
+        "is_first": np.zeros((T, B, 1), np.float32),
+    }
+    # the compiled unit is the single fused gradient step the host G-loop drives
+    stats = measure_mfu(
+        train_phase.train_step,
+        (
+            params,
+            opt_state,
+            init_moments(),
+            batch,
+            jnp.asarray(1),  # cum step 1: skips the tau=1 hard target sync branch
+            jnp.asarray(jax.random.PRNGKey(0)),
+        ),
+        reps=reps,
+        device=fabric.device,
+    )
+    stats["shapes"] = {"T": T, "B": B, "size": size or "benchmark-tiny"}
+    return stats
+
+
+def _bench_dv3_mfu_flagship(size: str = "S") -> dict:
+    """Standalone extra: flagship-size DV3 train-program MFU on the accelerator."""
+    stats = _dv3_train_mfu(size=size)
+    mfu, fps = stats.get("mfu"), stats.get("flops_per_sec")
+    if mfu:
+        value, unit = round(mfu, 4), "MFU (fraction of chip peak bf16)"
+    elif fps:
+        value, unit = round(fps / 1e12, 3), "TFLOP/s (no chip peak table entry)"
+    else:  # backend without an XLA cost model: fall back to raw step latency
+        value, unit = round(stats["step_seconds"], 4), "seconds/train-step (no XLA cost model)"
+    return {
+        "metric": f"dreamer_v3_{size}_train_mfu",
+        "value": value,
+        "unit": unit,
+        "vs_baseline": None,  # the reference publishes no FLOPs-utilization numbers
+        "conditions": stats,
     }
 
 
 def _bench(algo: str) -> dict:
+    if algo == "dreamer_v3_mfu":
+        return _bench_dv3_mfu_flagship()
     if algo.startswith("dreamer_v"):
         return _bench_dreamer_steady(algo)
     return _bench_wallclock(algo)
@@ -199,10 +347,24 @@ def main() -> None:
     # budgeted extra; the final combined line repeats the headline plus the extra.
     result = _bench_subprocess("ppo", timeout=600)
     print(json.dumps(result), flush=True)
+    # probe once HERE so the cached result rides SHEEPRL_BENCH_PROBE into every
+    # workload subprocess — on a wedged tunnel each probe burns up to 90 s
+    probe = _accelerator_probe_cached()
+    extras = []
     try:
-        result["extras"] = [_bench_subprocess("dreamer_v3", timeout=540)]
+        extras.append(_bench_subprocess("dreamer_v3", timeout=540))
+        print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:  # the already-printed headline must survive a failing extra
         result["extras_error"] = repr(exc)[:500]
+    # flagship-size MFU only makes sense on a live chip (a 1-core CPU run of the
+    # S-size program would burn minutes compiling for a meaningless number)
+    if probe["alive"] and probe["platform"] != "cpu":
+        try:
+            extras.append(_bench_subprocess("dreamer_v3_mfu", timeout=600))
+        except Exception as exc:
+            result["mfu_extra_error"] = repr(exc)[:500]
+    if extras:
+        result["extras"] = extras
     print(json.dumps(result), flush=True)
 
 

@@ -264,6 +264,8 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx, world_
             train_step, (params, opt_state, moments_state), data, train_key, cum_steps
         )
 
+    # the compiled unit, exposed for FLOPs/MFU accounting (utils/mfu.py, bench.py)
+    train_phase.train_step = train_step
     return train_phase
 
 

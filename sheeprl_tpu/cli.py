@@ -65,18 +65,58 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
 
 
 def check_configs(cfg: dotdict) -> None:
-    """Config policing (role of reference cli.py:270-344)."""
+    """Config policing (role of reference cli.py:270-344): algorithm existence,
+    decoupled × strategy × devices combinations, optional-dependency downgrades,
+    and basic value sanity — each with an actionable message."""
     entry = algorithm_registry.get(cfg.algo.name)
     if entry is None:
         available = ", ".join(sorted(algorithm_registry.keys()))
         raise ValueError(f"algorithm {cfg.algo.name!r} is not registered; available: {available}")
     decoupled = entry[0]["decoupled"]
-    if decoupled and int(os.environ.get("SHEEPRL_NUM_ACTORS", "1")) < 0:
+    if decoupled and int(os.environ.get("SHEEPRL_NUM_ACTORS", "1")) < 1:
         raise ValueError("decoupled algorithms need at least one actor process")
-    if cfg.fabric.strategy not in ("auto", "dp", "single_device"):
-        raise ValueError(f"unknown fabric.strategy {cfg.fabric.strategy!r}")
-    if cfg.fabric.strategy == "single_device" and int(cfg.fabric.devices) > 1:
-        raise ValueError("single_device strategy requires fabric.devices=1")
+
+    strategy = str(cfg.fabric.strategy)
+    if strategy not in ("auto", "dp", "single_device"):
+        raise ValueError(
+            f"unknown fabric.strategy {strategy!r}; available: auto, dp, single_device "
+            "(the reference's DDP/SingleDevice strategies map onto the mesh `dp` and "
+            "`single_device` strategies here)"
+        )
+    devices = int(cfg.fabric.devices)
+    if strategy == "single_device" and devices > 1:
+        raise ValueError(
+            f"single_device strategy requires fabric.devices=1, got {devices}; "
+            "launch with 'fabric.strategy=dp' (or 'auto') to use the whole mesh"
+        )
+    if decoupled and strategy == "single_device":
+        # reference parity: decoupled algorithms refuse non-DDP strategies
+        # (reference cli.py:290-307) — the player/trainer split needs the mesh
+        raise ValueError(
+            f"{cfg.algo.name} is decoupled and is not supported by the single_device "
+            "strategy; launch with 'fabric.strategy=dp' or 'fabric.strategy=auto'"
+        )
+    if decoupled and devices < 1:
+        raise ValueError(f"decoupled algorithms need fabric.devices >= 1, got {devices}")
+
+    # optional-dependency downgrade (reference cli.py:333-340)
+    if not cfg.model_manager.get("disabled", True):
+        from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+        if not _IS_MLFLOW_AVAILABLE:
+            warnings.warn(
+                "MLflow is not installed: model registration is disabled for this run. "
+                "Install it with 'pip install mlflow' to use the model manager.",
+                UserWarning,
+            )
+            cfg.model_manager.disabled = True
+
+    # value sanity (reference cli.py:341-344)
+    learning_starts = cfg.algo.get("learning_starts")
+    if learning_starts is not None and int(learning_starts) < 0:
+        raise ValueError("The `algo.learning_starts` parameter must be greater or equal to zero.")
+    if int(cfg.env.action_repeat) < 1:
+        cfg.env.action_repeat = 1
 
 
 def _apply_hydra_cfg(cfg: dotdict) -> None:
@@ -190,6 +230,33 @@ def run_algorithm(cfg: dotdict) -> None:
         checkpoint_backend=str(cfg.checkpoint.get("backend", "pickle")),
         checkpoint_async=bool(cfg.checkpoint.get("async_save", False)),
     )
+
+    # Optional XLA trace capture (SURVEY §5.1's TPU equivalent of the reference's
+    # profiling story): metric.profiler=True wraps the launched entrypoint in a
+    # jax.profiler trace whose dump lands under the run's log tree, viewable in
+    # TensorBoard's profile plugin / Perfetto. Meant for short diagnostic runs —
+    # a full-length training run produces a very large trace. The trace starts
+    # INSIDE the launch, after fabric._setup has pinned the platform:
+    # jax.profiler.start_trace initializes the backend, and doing that before the
+    # pin would touch the accelerator even for accelerator=cpu runs.
+    if cfg.metric.get("profiler", False):
+        from sheeprl_tpu.utils.logger import run_base_dir
+
+        profiler_dir = cfg.metric.get("profiler_dir") or str(
+            run_base_dir(cfg.root_dir, cfg.run_name) / "profiler"
+        )
+        inner_main = main
+
+        def main(fabric_, cfg_, **kw):  # noqa: F811 — deliberate profiled wrapper
+            import jax
+
+            os.makedirs(profiler_dir, exist_ok=True)
+            jax.profiler.start_trace(profiler_dir)
+            try:
+                return inner_main(fabric_, cfg_, **kw)
+            finally:
+                jax.profiler.stop_trace()
+
     try:
         fabric.launch(main, cfg, **kwargs)
     finally:

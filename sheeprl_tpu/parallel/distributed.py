@@ -107,13 +107,15 @@ class BroadcastChannel:
         self.src = src
 
     def put(self, msg: Any) -> None:
+        # BaseException on purpose: a KeyboardInterrupt mid-collective desyncs the
+        # plane exactly like an error does; the original exception rides __cause__
         try:
             host_broadcast_object(msg, src=self.src)
-        except Exception as e:
+        except BaseException as e:
             raise ChannelError(f"broadcast put (src={self.src}) failed") from e
 
     def get(self) -> Any:
         try:
             return host_broadcast_object(None, src=self.src)
-        except Exception as e:
+        except BaseException as e:
             raise ChannelError(f"broadcast get (src={self.src}) failed") from e

@@ -102,12 +102,18 @@ def set_run_dir(path: Optional[str]) -> None:
     _run_dir_override = str(path) if path else None
 
 
+def run_base_dir(root_dir: str, run_name: str) -> Path:
+    """The run's base directory (before versioning), honoring the hydra run-dir
+    override — the single source of truth for anything that must land next to the
+    run's artifacts (versioned log dirs, profiler traces)."""
+    if _run_dir_override:
+        return Path(_run_dir_override)
+    return Path("logs") / "runs" / root_dir / run_name
+
+
 def get_log_dir(fabric, root_dir: str, run_name: str, share: bool = True) -> str:
     """Create (rank-0) and share the versioned log dir (sheeprl/utils/logger.py:40-91)."""
-    if _run_dir_override:
-        base = Path(_run_dir_override)
-    else:
-        base = Path("logs") / "runs" / root_dir / run_name
+    base = run_base_dir(root_dir, run_name)
     if fabric.global_rank == 0:
         existing = []
         if base.is_dir():

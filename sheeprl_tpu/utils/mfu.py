@@ -1,0 +1,93 @@
+"""Model-FLOPs-utilization accounting.
+
+The reference publishes wall-clocks only (README.md:99-189); on TPU the honest
+efficiency metric is MFU: FLOPs the compiled program performs per second, over the
+chip's peak. XLA already knows the program's FLOPs — ``compiled.cost_analysis()``
+— so no analytic per-layer counting is needed; this works for any jitted program
+(train steps, act steps, kernels alike).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Sequence
+
+# bf16 peak FLOP/s per chip (public spec sheets). Keyed by lowercase substrings of
+# jax's Device.device_kind.
+_TPU_PEAK_BF16: Dict[str, float] = {
+    "v2": 45e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def peak_flops(device) -> Optional[float]:
+    """Peak bf16 FLOP/s for ``device``, or None when unknown (e.g. host CPU)."""
+    if device.platform not in ("tpu", "axon"):  # axon = tunneled-TPU plugin platform
+        return None
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for tag, peak in sorted(_TPU_PEAK_BF16.items(), key=lambda kv: -len(kv[0])):
+        if tag in kind:
+            return peak
+    return None
+
+
+def compiled_flops(compiled) -> Optional[float]:
+    """Total FLOPs of a compiled program, from XLA's own cost model. Handles both
+    cost_analysis() return conventions (dict, or list of one dict per program)."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    flops = cost.get("flops") if isinstance(cost, dict) else None
+    return float(flops) if flops and flops > 0 else None
+
+
+def measure_mfu(
+    fn: Callable,
+    args: Sequence[Any],
+    *,
+    warmup: int = 2,
+    reps: int = 5,
+    device=None,
+) -> Dict[str, Any]:
+    """Jit ``fn``, read its FLOPs from the compiled cost model, time ``reps``
+    steady-state executions, and relate the achieved FLOP/s to the chip peak.
+
+    Returns flops_per_step / step_seconds / flops_per_sec always; ``mfu`` is None
+    off-TPU (no meaningful peak) or when XLA reports no FLOPs.
+    """
+    import jax
+
+    jitted = jax.jit(fn)
+    compiled = jitted.lower(*args).compile()
+    flops = compiled_flops(compiled)
+    for _ in range(max(1, warmup)):
+        out = compiled(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = compiled(*args)
+    jax.block_until_ready(out)
+    step_seconds = (time.perf_counter() - t0) / reps
+
+    if device is None:
+        leaves = jax.tree_util.tree_leaves(out)
+        device = next(iter(leaves[0].devices())) if leaves else jax.devices()[0]
+    peak = peak_flops(device)
+    flops_per_sec = (flops / step_seconds) if flops else None
+    return {
+        "flops_per_step": flops,
+        "step_seconds": step_seconds,
+        "flops_per_sec": flops_per_sec,
+        "peak_flops": peak,
+        "device_kind": getattr(device, "device_kind", device.platform),
+        "mfu": (flops_per_sec / peak) if (flops_per_sec and peak) else None,
+    }

@@ -1,0 +1,85 @@
+"""CLI contract tests (role of reference tests/test_algos/test_cli.py:14-277):
+strategy/decoupled policing, optional-dependency downgrades, value sanity, and the
+jax.profiler trace hook."""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import check_configs, run
+from sheeprl_tpu.config import compose
+
+
+def _cfg(overrides):
+    return compose(["exp=ppo", "env=dummy", "env.id=discrete_dummy"] + list(overrides))
+
+
+def test_unknown_strategy_fails():
+    cfg = _cfg(["fabric.strategy=fsdp"])
+    with pytest.raises(ValueError, match="unknown fabric.strategy"):
+        check_configs(cfg)
+
+
+def test_single_device_with_many_devices_fails():
+    cfg = _cfg(["fabric.strategy=single_device", "fabric.devices=2"])
+    with pytest.raises(ValueError, match="fabric.devices=1"):
+        check_configs(cfg)
+
+
+def test_decoupled_single_device_strategy_fails():
+    cfg = compose(
+        ["exp=ppo_decoupled", "env=dummy", "env.id=discrete_dummy", "fabric.strategy=single_device"]
+    )
+    with pytest.raises(ValueError, match="decoupled"):
+        check_configs(cfg)
+
+
+def test_decoupled_dp_strategy_passes():
+    cfg = compose(["exp=ppo_decoupled", "env=dummy", "env.id=discrete_dummy", "fabric.strategy=dp"])
+    check_configs(cfg)
+
+
+def test_negative_learning_starts_fails():
+    cfg = compose(["exp=sac", "env=dummy", "env.id=continuous_dummy", "algo.learning_starts=-1"])
+    with pytest.raises(ValueError, match="learning_starts"):
+        check_configs(cfg)
+
+
+def test_action_repeat_clamped():
+    cfg = _cfg(["env.action_repeat=0"])
+    check_configs(cfg)
+    assert cfg.env.action_repeat == 1
+
+
+def test_model_manager_downgraded_without_mlflow(monkeypatch):
+    import sheeprl_tpu.utils.imports as imports
+
+    monkeypatch.setattr(imports, "_IS_MLFLOW_AVAILABLE", False)
+    cfg = _cfg(["model_manager.disabled=False"])
+    with pytest.warns(UserWarning, match="MLflow is not installed"):
+        check_configs(cfg)
+    assert cfg.model_manager.disabled is True
+
+
+@pytest.mark.timeout(180)
+def test_profiler_trace_hook(standard_args, tmp_path):
+    """metric.profiler=True wraps the launch in a jax.profiler trace whose dump
+    lands in the configured directory (SURVEY §5.1 tracing equivalence)."""
+    trace_dir = str(tmp_path / "profiler")
+    run(
+        standard_args
+        + [
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "metric.profiler=True",
+            f"metric.profiler_dir={trace_dir}",
+            "root_dir=test_profiler",
+            "run_name=trace",
+        ]
+    )
+    dumps = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(p) for p in dumps), f"no trace files written under {trace_dir}"
