@@ -356,13 +356,19 @@ def main() -> None:
         print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:  # the already-printed headline must survive a failing extra
         result["extras_error"] = repr(exc)[:500]
-    # flagship-size MFU only makes sense on a live chip (a 1-core CPU run of the
-    # S-size program would burn minutes compiling for a meaningless number)
     if probe["alive"] and probe["platform"] != "cpu":
-        try:
-            extras.append(_bench_subprocess("dreamer_v3_mfu", timeout=600))
-        except Exception as exc:
-            result["mfu_extra_error"] = repr(exc)[:500]
+        # Live chip: also capture the DV1/DV2 steady states (their act programs are
+        # host-side, the conv-heavy train programs ride the chip — the TPU numbers
+        # supersede the 1-core CPU-fallback scoreboard entries) and the
+        # flagship-size MFU (meaningless on CPU: minutes of compile for a number
+        # with no chip peak to compare against). Each extra reprints the cumulative
+        # line so a bench cut short by the driver still reports what finished.
+        for extra_algo, budget in (("dreamer_v1", 540), ("dreamer_v2", 540), ("dreamer_v3_mfu", 600)):
+            try:
+                extras.append(_bench_subprocess(extra_algo, timeout=budget))
+                print(json.dumps({**result, "extras": extras}), flush=True)
+            except Exception as exc:
+                result[f"{extra_algo}_extra_error"] = repr(exc)[:500]
     if extras:
         result["extras"] = extras
     print(json.dumps(result), flush=True)

@@ -35,7 +35,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import BenchWindow, Ratio, foreach_gradient_step, save_configs
+from sheeprl_tpu.utils.utils import ActPlacement, BenchWindow, Ratio, foreach_gradient_step, save_configs
 
 def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx):
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
@@ -271,6 +271,12 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
 
     train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
 
+    act = ActPlacement(fabric, lambda p: {"world_model": p["world_model"], "actor": p["actor"]})
+    act_params = act.view(params)
+    key = act.place(key)
+    if exploration_actor_params is not None:
+        exploration_actor_params = act.place(exploration_actor_params)
+
     start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
     policy_step = state["iter_num"] * num_envs if state is not None else 0
     last_log = state["last_log"] if state is not None else 0
@@ -310,7 +316,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    player.init_states(params)
+    player.init_states(act_params)
 
     cumulative_per_rank_gradient_steps = 0
     train_step = 0
@@ -337,9 +343,9 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                 actions, key = player.get_actions(
                     # p2e finetuning acts with the exploration actor during the
                     # prefill, then switches to the (trained) task actor
-                    {**params, "actor": exploration_actor_params}
+                    {**act_params, "actor": exploration_actor_params}
                     if exploration_actor_params is not None and iter_num <= learning_starts
-                    else params,
+                    else act_params,
                     jobs,
                     key,
                     expl_amount=expl_amount(policy_step),
@@ -405,7 +411,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             step_data["terminated"][:, dones_idxes] = 0.0
             step_data["truncated"][:, dones_idxes] = 0.0
             step_data["is_first"][:, dones_idxes] = 1.0
-            player.init_states(params, dones_idxes)
+            player.init_states(act_params, dones_idxes)
 
         if iter_num >= learning_starts:
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
@@ -429,6 +435,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
+                    act_params = act.view(params)
                     if aggregator and not aggregator.disabled:
                         for mk, mv in metrics.items():
                             aggregator.update(mk, float(np.asarray(mv)))
@@ -487,6 +494,6 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
-        test(player, params, fabric, cfg, log_dir, greedy=False)
+        test(player, act_params, fabric, cfg, log_dir, greedy=False)
     if logger is not None:
         logger.finalize()

@@ -54,12 +54,12 @@ from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import (
+    ActPlacement,
     BenchWindow,
     Ratio,
     compute_lambda_values,
     foreach_gradient_step,
     packed_device_get,
-    packed_device_put,
     save_configs,
 )
 
@@ -413,23 +413,15 @@ def run_dreamer(
 
     train_phase = make_train_phase_fn(agent, cfg, world_tx, actor_tx, critic_tx)
 
-    # Act/train device split: with the fabric on an accelerator the per-step player
-    # program runs on the host CPU backend (per-dispatch latency to a TPU dwarfs the
-    # one-frame forward; the reference pays per-step .cpu() syncs instead,
-    # dreamer_v3.py:630-664) while the fused multi-gradient-step train program runs
-    # on the accelerator. Only the player-visible params cross back per train call.
-    cpu_device = jax.devices("cpu")[0]
-    act_on_cpu = fabric.device.platform != "cpu"
-
-    def _act_view(p):
-        if not act_on_cpu:
-            return p
-        # one packed transfer instead of one RTT per param leaf
-        return packed_device_put({"world_model": p["world_model"], "actor": p["actor"]}, cpu_device)
-
-    act_params = _act_view(params)
-    if act_on_cpu:
-        key = jax.device_put(key, cpu_device)
+    # Act/train device split (shared ActPlacement design, utils/utils.py): with the
+    # fabric on an accelerator the per-step player program runs on the host CPU
+    # backend — per-dispatch latency to a TPU dwarfs the one-frame forward; the
+    # reference pays per-step .cpu() syncs instead (dreamer_v3.py:630-664) — while
+    # the fused multi-gradient-step train program runs on the accelerator. Only the
+    # player-visible params cross back per train call, as one packed transfer.
+    act = ActPlacement(fabric, lambda p: {"world_model": p["world_model"], "actor": p["actor"]})
+    act_params = act.view(params)
+    key = act.place(key)
 
     # counters (reference dreamer_v3.py:571-597)
     start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
@@ -607,7 +599,7 @@ def run_dreamer(
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
-                    act_params = _act_view(params)
+                    act_params = act.view(params)
                     if aggregator and not aggregator.disabled:
                         for mk, mv in packed_device_get(metrics).items():
                             aggregator.update(mk, float(mv))

@@ -40,7 +40,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, foreach_gradient_step, save_configs
+from sheeprl_tpu.utils.utils import ActPlacement, Ratio, foreach_gradient_step, save_configs
 
 
 def make_train_phase(agent: DV2Agent, ensembles: EnsembleHeads, cfg, txs: Dict[str, Any]):
@@ -397,6 +397,10 @@ def main(fabric, cfg: Dict[str, Any]):
 
     train_phase = make_train_phase(agent, ensembles, cfg, txs)
 
+    act = ActPlacement(fabric, lambda p: player_params(p, actor_type))
+    act_params = act.view(params)
+    key = act.place(key)
+
     start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
     policy_step = state["iter_num"] * num_envs if state is not None else 0
     last_log = state["last_log"] if state is not None else 0
@@ -458,7 +462,7 @@ def main(fabric, cfg: Dict[str, Any]):
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
                 actions, key = player.get_actions(
-                    player_params(params, actor_type), jobs, key, expl_amount=expl_amount(policy_step)
+                    act_params, jobs, key, expl_amount=expl_amount(policy_step)
                 )
                 actions = np.asarray(actions)
                 if is_continuous:
@@ -549,6 +553,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
+                    act_params = act.view(params)
                     if aggregator and not aggregator.disabled:
                         for mk, mv in metrics.items():
                             aggregator.update(mk, float(np.asarray(mv)))
@@ -605,6 +610,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
-        test(player, player_params(params, actor_type), fabric, cfg, log_dir, greedy=False)
+        test(player, act_params, fabric, cfg, log_dir, greedy=False)
     if logger is not None:
         logger.finalize()

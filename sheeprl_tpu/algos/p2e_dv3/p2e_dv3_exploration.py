@@ -39,7 +39,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, compute_lambda_values, foreach_gradient_step, save_configs
+from sheeprl_tpu.utils.utils import ActPlacement, Ratio, compute_lambda_values, foreach_gradient_step, save_configs
 
 
 def make_train_phase(agent: DV3Agent, ensembles: EnsembleHeads, cfg, txs: Dict[str, Any]):
@@ -484,6 +484,10 @@ def main(fabric, cfg: Dict[str, Any]):
 
     train_phase = make_train_phase(agent, ensembles, cfg, txs)
 
+    act = ActPlacement(fabric, lambda p: player_params(p, actor_type))
+    act_params = act.view(params)
+    key = act.place(key)
+
     start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
     policy_step = state["iter_num"] * num_envs if state is not None else 0
     last_log = state["last_log"] if state is not None else 0
@@ -515,7 +519,7 @@ def main(fabric, cfg: Dict[str, Any]):
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    player.init_states(player_params(params, actor_type))
+    player.init_states(act_params)
 
     cumulative_per_rank_gradient_steps = 0
     train_step = 0
@@ -536,7 +540,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
-                actions, key = player.get_actions(player_params(params, actor_type), jobs, key)
+                actions, key = player.get_actions(act_params, jobs, key)
                 actions = np.asarray(actions)
                 if is_continuous:
                     real_actions = actions
@@ -598,7 +602,7 @@ def main(fabric, cfg: Dict[str, Any]):
             step_data["terminated"][:, dones_idxes] = 0.0
             step_data["truncated"][:, dones_idxes] = 0.0
             step_data["is_first"][:, dones_idxes] = 1.0
-            player.init_states(player_params(params, actor_type), dones_idxes)
+            player.init_states(act_params, dones_idxes)
 
         if iter_num >= learning_starts:
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
@@ -627,6 +631,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
+                    act_params = act.view(params)
                     if aggregator and not aggregator.disabled:
                         for mk, mv in metrics.items():
                             aggregator.update(mk, float(np.asarray(mv)))
@@ -684,6 +689,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
-        test(player, player_params(params, actor_type), fabric, cfg, log_dir, greedy=False)
+        test(player, act_params, fabric, cfg, log_dir, greedy=False)
     if logger is not None:
         logger.finalize()

@@ -55,6 +55,94 @@ def _build_optimizer(cfg, total_iters: int) -> optax.GradientTransformation:
     return tx
 
 
+def make_train_phase(agent, cfg, fabric, tx, actions_dim, is_continuous, cnn_keys, obs_keys, total_num_envs):
+    """Build the fused per-iteration optimization program (GAE + update_epochs ×
+    minibatches in one jitted scan). Module-level so the DP numerical-parity tests
+    exercise exactly the program main() ships (reference train(), ppo.py:52-102)."""
+    world_size = fabric.world_size
+    loss_reduction = cfg.algo.loss_reduction
+    vf_coef = float(cfg.algo.vf_coef)
+    clip_vloss = bool(cfg.algo.clip_vloss)
+    normalize_advantages = bool(cfg.algo.normalize_advantages)
+    global_bs = min(
+        int(cfg.algo.per_rank_batch_size * world_size), int(cfg.algo.rollout_steps * total_num_envs)
+    )
+    num_rows = int(cfg.algo.rollout_steps * total_num_envs)
+    num_minibatches = -(-num_rows // global_bs)  # ceil: partial minibatches pad-wrap
+    share_data = bool(cfg.buffer.share_data)
+
+    def loss_fn(params, batch, clip_coef, ent_coef):
+        norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
+        actor_outs, new_values = agent.apply({"params": params}, norm_obs)
+        out = policy_output(
+            actor_outs, new_values, jax.random.PRNGKey(0), actions_dim, is_continuous, actions=batch["actions"]
+        )
+        advantages = batch["advantages"]
+        if normalize_advantages:
+            advantages = normalize_tensor(advantages)
+        pg_loss = policy_loss(out["logprob"], batch["logprobs"], advantages, clip_coef, loss_reduction)
+        v_loss = value_loss(
+            out["values"], batch["values"], batch["returns"], clip_coef, clip_vloss, loss_reduction
+        )
+        ent_loss = entropy_loss(out["entropy"], loss_reduction)
+        loss = pg_loss + vf_coef * v_loss + ent_coef * ent_loss
+        return loss, (pg_loss, v_loss, ent_loss)
+
+    @jax.jit
+    def train_phase(params, opt_state, data, next_values, train_key, clip_coef, ent_coef):
+        """One fused device program per iteration: GAE + update_epochs x minibatches."""
+        returns, advantages = gae(
+            data["rewards"],
+            data["values"],
+            data["dones"],
+            next_values,
+            cfg.algo.rollout_steps,
+            cfg.algo.gamma,
+            cfg.algo.gae_lambda,
+        )
+        # env-major flatten: the rollout arrives sharded on the env axis
+        # (P(None, "data")), so flattening (T, E) -> (E*T) keeps each device's rows
+        # as ONE contiguous block — the layout epoch_permutation's device-local
+        # minibatching assumes. A time-major reshape would interleave shards.
+        flat = {k: jnp.swapaxes(v, 0, 1).reshape(-1, *v.shape[2:]) for k, v in data.items()}
+        flat["returns"] = jnp.swapaxes(returns, 0, 1).reshape(-1, 1)
+        flat["advantages"] = jnp.swapaxes(advantages, 0, 1).reshape(-1, 1)
+        if world_size > 1:
+            flat = jax.lax.with_sharding_constraint(
+                flat, jax.sharding.NamedSharding(fabric.mesh, jax.sharding.PartitionSpec("data"))
+            )
+
+        def epoch_body(carry, epoch_key):
+            params, opt_state = carry
+            perm = epoch_permutation(epoch_key, num_rows, world_size, share_data, global_bs)
+            # pad (wrapping into the permutation) so every row is visited each epoch
+            # even when num_rows is not a multiple of the global batch
+            pad = num_minibatches * global_bs - num_rows
+            if pad > 0:
+                perm = jnp.concatenate([perm, perm[:pad]])
+            mb_idx = perm[: num_minibatches * global_bs].reshape(num_minibatches, global_bs)
+
+            def mb_body(carry, idx):
+                params, opt_state = carry
+                batch = {k: jnp.take(v, idx, axis=0) for k, v in flat.items()}
+                grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
+                    params, batch, clip_coef, ent_coef
+                )
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), jnp.stack([pg, vl, ent])
+
+            (params, opt_state), losses = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
+            return (params, opt_state), losses.mean(axis=0)
+
+        epoch_keys = jax.random.split(train_key, cfg.algo.update_epochs)
+        (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
+        mean_losses = losses.mean(axis=0)
+        return params, opt_state, mean_losses
+
+    return train_phase
+
+
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     initial_ent_coef = float(cfg.algo.ent_coef)
@@ -172,15 +260,6 @@ def main(fabric, cfg: Dict[str, Any]):
     # device program per iteration (all epochs x minibatches fused via lax.scan), and
     # weights cross host<->device once per iteration. This replaces the reference's
     # per-step .cpu().numpy() syncs + per-minibatch optimizer steps (ppo.py:279-372).
-    loss_reduction = cfg.algo.loss_reduction
-    vf_coef = float(cfg.algo.vf_coef)
-    clip_vloss = bool(cfg.algo.clip_vloss)
-    normalize_advantages = bool(cfg.algo.normalize_advantages)
-    global_bs = min(int(cfg.algo.per_rank_batch_size * world_size), int(cfg.algo.rollout_steps * total_num_envs))
-    num_rows = int(cfg.algo.rollout_steps * total_num_envs)
-    num_minibatches = -(-num_rows // global_bs)  # ceil: partial minibatches pad-wrap
-    share_data = bool(cfg.buffer.share_data)
-
     cpu_device = jax.devices("cpu")[0]
     act_on_cpu = fabric.device.platform != "cpu"
 
@@ -208,74 +287,9 @@ def main(fabric, cfg: Dict[str, Any]):
         _, values = agent.apply({"params": params}, norm_obs)
         return values
 
-    def loss_fn(params, batch, clip_coef, ent_coef):
-        norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
-        actor_outs, new_values = agent.apply({"params": params}, norm_obs)
-        out = policy_output(
-            actor_outs, new_values, jax.random.PRNGKey(0), actions_dim, is_continuous, actions=batch["actions"]
-        )
-        advantages = batch["advantages"]
-        if normalize_advantages:
-            advantages = normalize_tensor(advantages)
-        pg_loss = policy_loss(out["logprob"], batch["logprobs"], advantages, clip_coef, loss_reduction)
-        v_loss = value_loss(
-            out["values"], batch["values"], batch["returns"], clip_coef, clip_vloss, loss_reduction
-        )
-        ent_loss = entropy_loss(out["entropy"], loss_reduction)
-        loss = pg_loss + vf_coef * v_loss + ent_coef * ent_loss
-        return loss, (pg_loss, v_loss, ent_loss)
-
-    @jax.jit
-    def train_phase(params, opt_state, data, next_values, train_key, clip_coef, ent_coef):
-        """One fused device program per iteration: GAE + update_epochs x minibatches."""
-        returns, advantages = gae(
-            data["rewards"],
-            data["values"],
-            data["dones"],
-            next_values,
-            cfg.algo.rollout_steps,
-            cfg.algo.gamma,
-            cfg.algo.gae_lambda,
-        )
-        # env-major flatten: the rollout arrives sharded on the env axis
-        # (P(None, "data")), so flattening (T, E) -> (E*T) keeps each device's rows
-        # as ONE contiguous block — the layout epoch_permutation's device-local
-        # minibatching assumes. A time-major reshape would interleave shards.
-        flat = {k: jnp.swapaxes(v, 0, 1).reshape(-1, *v.shape[2:]) for k, v in data.items()}
-        flat["returns"] = jnp.swapaxes(returns, 0, 1).reshape(-1, 1)
-        flat["advantages"] = jnp.swapaxes(advantages, 0, 1).reshape(-1, 1)
-        if world_size > 1:
-            flat = jax.lax.with_sharding_constraint(
-                flat, jax.sharding.NamedSharding(fabric.mesh, jax.sharding.PartitionSpec("data"))
-            )
-
-        def epoch_body(carry, epoch_key):
-            params, opt_state = carry
-            perm = epoch_permutation(epoch_key, num_rows, world_size, share_data, global_bs)
-            # pad (wrapping into the permutation) so every row is visited each epoch
-            # even when num_rows is not a multiple of the global batch
-            pad = num_minibatches * global_bs - num_rows
-            if pad > 0:
-                perm = jnp.concatenate([perm, perm[:pad]])
-            mb_idx = perm[: num_minibatches * global_bs].reshape(num_minibatches, global_bs)
-
-            def mb_body(carry, idx):
-                params, opt_state = carry
-                batch = {k: jnp.take(v, idx, axis=0) for k, v in flat.items()}
-                grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
-                    params, batch, clip_coef, ent_coef
-                )
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state), jnp.stack([pg, vl, ent])
-
-            (params, opt_state), losses = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
-            return (params, opt_state), losses.mean(axis=0)
-
-        epoch_keys = jax.random.split(train_key, cfg.algo.update_epochs)
-        (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
-        mean_losses = losses.mean(axis=0)
-        return params, opt_state, mean_losses
+    train_phase = make_train_phase(
+        agent, cfg, fabric, tx, actions_dim, is_continuous, cnn_keys, obs_keys, total_num_envs
+    )
 
     # replicate params/opt_state over the mesh once; rollout data arrives data-sharded
     if world_size > 1:

@@ -33,7 +33,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import ActPlacement, Ratio, save_configs
 
 
 def _masked_update(tx, grads, opt_state, group, apply_flag):
@@ -203,6 +203,14 @@ def main(fabric, cfg: Dict[str, Any]):
         actions, _ = squash_and_logprob(mean, std, step_key, agent.action_scale, agent.action_bias)
         return actions, key
 
+    # act/train placement split (shared ActPlacement design): the act view carries
+    # exactly what act_fn reads — the shared conv trunk, the actor-side cnn fc,
+    # the mlp encoder and the actor head (agent.features(side="actor") + actor).
+    act = ActPlacement(
+        fabric,
+        lambda p: {k: p[k] for k in ("conv", "actor_cnn_fc", "mlp_enc", "actor") if k in p},
+    )
+
     def critic_loss_fn(cg, params, batch, step_key):
         p = {**params, **cg}
         next_obs = _norm(batch, "next_")
@@ -320,6 +328,9 @@ def main(fabric, cfg: Dict[str, Any]):
         params = fabric.replicate_pytree(params)
         opt_state = fabric.replicate_pytree(opt_state)
 
+    act_params = act.view(params)
+    key = act.place(key)
+
     # ---------------- main loop ----------------
     cumulative_per_rank_gradient_steps = 0
     step_data: Dict[str, np.ndarray] = {}
@@ -335,7 +346,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 jobs = prepare_obs(
                     fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=total_num_envs
                 )
-                actions, key = act_fn(params, jobs, key)
+                actions, key = act_fn(act_params, jobs, key)
                 actions = np.asarray(actions)
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 np.asarray(actions).reshape(envs.action_space.shape)
@@ -397,6 +408,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         np.asarray(train_key),
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    act_params = act.view(params)
                     if aggregator and not aggregator.disabled:
                         losses_np = np.asarray(mean_losses)
                         aggregator.update("Loss/value_loss", losses_np[0])

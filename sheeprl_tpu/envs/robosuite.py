@@ -2,8 +2,10 @@
 sheeprl/envs/robosuite.py:17-301; robosuite and libero are optional).
 
 Exposes a robosuite manipulation task (or a LIBERO bddl task) as a gymnasium env
-with a Dict observation: ``rgb`` (agentview camera) and/or ``state`` (robot
-proprioception), and a [-1, 1]-normalized continuous action space.
+with a Dict observation — per-camera images (first camera under ``rgb``, further
+cameras under ``rgb_<name>``), robot proprioception under ``state``/``state<i>``,
+and the task's object state under ``object_state`` — plus a [-1, 1]-normalized
+continuous action space.
 """
 
 from __future__ import annotations
@@ -14,7 +16,7 @@ if not _IS_ROBOSUITE_AVAILABLE:
     raise ModuleNotFoundError("robosuite is not installed: pip install robosuite")
 
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence
 
 import gymnasium as gym
 import numpy as np
@@ -30,6 +32,7 @@ class RobosuiteWrapper(gym.Env):
         robot: str,
         bddl_file: Optional[str] = None,
         controller: Any = "OSC_POSE",
+        controller_kwargs: Optional[Dict[str, Any]] = None,
         hard_reset: bool = False,
         horizon: int = 500,
         reward_scale: float = 1.0,
@@ -38,21 +41,43 @@ class RobosuiteWrapper(gym.Env):
         has_renderer: bool = False,
         has_offscreen_renderer: bool = False,
         use_camera_obs: bool = False,
+        use_object_obs: bool = True,
+        camera_names: Sequence[str] = ("agentview",),
+        camera_heights: int = 84,
+        camera_widths: int = 84,
+        render_camera: str = "agentview",
         control_freq: int = 20,
+        keys: Optional[Sequence[str]] = None,
         channels_first: bool = True,
     ):
+        """Option surface of reference robosuite.py:18-52, extended with the camera
+        block (names/sizes/render camera), object-state exposure, per-controller
+        kwargs and raw-key selection the reference leaves at robosuite defaults."""
+        controller_configs = suite.controllers.load_controller_config(default_controller=controller)
+        if controller_kwargs:
+            controller_configs = {**controller_configs, **dict(controller_kwargs)}
+        camera_names = list(camera_names)
+        # robosuite only produces `<cam>_image` entries for cameras in camera_names;
+        # an unlisted render_camera would KeyError at the first render() (e.g. video
+        # capture during evaluation), long after training started — fall back.
+        if render_camera not in camera_names:
+            render_camera = camera_names[0]
         make_args = dict(
             env_configuration=env_config,
             robots=[robot],
-            controller_configs=suite.controllers.load_controller_config(default_controller=controller),
+            controller_configs=controller_configs,
             hard_reset=hard_reset,
             horizon=horizon,
             reward_scale=reward_scale,
             reward_shaping=reward_shaping,
             ignore_done=ignore_done,
             has_renderer=has_renderer,
-            has_offscreen_renderer=has_offscreen_renderer,
+            has_offscreen_renderer=has_offscreen_renderer or use_camera_obs,
             use_camera_obs=use_camera_obs,
+            use_object_obs=use_object_obs,
+            camera_names=camera_names,
+            camera_heights=camera_heights,
+            camera_widths=camera_widths,
             control_freq=control_freq,
         )
         if bddl_file:
@@ -73,18 +98,43 @@ class RobosuiteWrapper(gym.Env):
         obs_spec = self._env.observation_spec()
         self._channels_first = channels_first
         self._from_pixels = bool(self._env.use_camera_obs)
-        self._from_vectors = "robot0_proprio-state" in obs_spec
+        self._cameras = camera_names
+        self._render_camera = render_camera
         self.name = f"{robot}_{type(self._env).__name__}"
 
-        obs_space: Dict[str, spaces.Space] = {}
+        # raw-key selection (reference robosuite.py:128-154): by default every
+        # available modality is exposed; ``keys`` restricts to a subset of the raw
+        # robosuite observation keys.
+        available: Dict[str, str] = {}  # raw robosuite key -> exposed dict key
         if self._from_pixels:
-            h, w = first_obs["agentview_image"].shape[:2]
-            shape = (3, h, w) if channels_first else (h, w, 3)
-            obs_space["rgb"] = spaces.Box(0, 255, shape=shape, dtype=np.uint8)
+            for i, cam in enumerate(self._cameras):
+                available[f"{cam}_image"] = "rgb" if i == 0 else f"rgb_{cam}"
         for idx in range(len(self._env.robots)):
-            key = "state" if idx == 0 else f"state{idx}"
-            spec = obs_spec[f"robot{idx}_proprio-state"]
-            obs_space[key] = spaces.Box(-np.inf, np.inf, shape=spec.shape, dtype=np.float64)
+            available[f"robot{idx}_proprio-state"] = "state" if idx == 0 else f"state{idx}"
+        if use_object_obs and "object-state" in obs_spec:
+            available["object-state"] = "object_state"
+        if keys is not None:
+            unknown = set(keys) - set(available)
+            if unknown:
+                raise ValueError(
+                    f"unknown robosuite observation keys {sorted(unknown)}; "
+                    f"available: {sorted(available)}"
+                )
+            available = {k: v for k, v in available.items() if k in set(keys)}
+        self._key_map = available
+
+        obs_space: Dict[str, spaces.Space] = {}
+        for raw, exposed in available.items():
+            if raw.endswith("_image"):
+                shape = (
+                    (3, camera_heights, camera_widths)
+                    if channels_first
+                    else (camera_heights, camera_widths, 3)
+                )
+                obs_space[exposed] = spaces.Box(0, 255, shape=shape, dtype=np.uint8)
+            else:
+                spec = obs_spec[raw]
+                obs_space[exposed] = spaces.Box(-np.inf, np.inf, shape=spec.shape, dtype=np.float64)
         self.observation_space = spaces.Dict(obs_space)
         self.state_space = obs_space.get("state")
 
@@ -102,13 +152,11 @@ class RobosuiteWrapper(gym.Env):
 
     def _obs(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         obs = {}
-        if self._from_pixels:
-            rgb = raw["agentview_image"]
-            obs["rgb"] = rgb.transpose(2, 0, 1).copy() if self._channels_first else rgb
-        if self._from_vectors:
-            for idx in range(len(self._env.robots)):
-                key = "state" if idx == 0 else f"state{idx}"
-                obs[key] = raw[f"robot{idx}_proprio-state"]
+        for raw_key, exposed in self._key_map.items():
+            v = np.asarray(raw[raw_key])
+            if raw_key.endswith("_image") and self._channels_first:
+                v = v.transpose(2, 0, 1).copy()
+            obs[exposed] = v
         return obs
 
     def step(self, action):
@@ -125,7 +173,7 @@ class RobosuiteWrapper(gym.Env):
         return self._obs(raw), {}
 
     def render(self):
-        return self._env._get_observations()["agentview_image"]
+        return self._env._get_observations()[f"{self._render_camera}_image"]
 
     def close(self) -> None:
         self._env.close()

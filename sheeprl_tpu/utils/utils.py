@@ -12,7 +12,7 @@ from __future__ import annotations
 import copy
 import os
 import warnings
-from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -218,6 +218,32 @@ def packed_device_get(tree: Any) -> Any:
             out[i] = flat[off : off + size].reshape(np.shape(leaves[i]))
             off += size
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class ActPlacement:
+    """Act/train device-placement split, shared by every per-step-acting algorithm.
+
+    The one-frame act program runs on the host CPU backend — per-step dispatch
+    latency to an accelerator dwarfs the forward — while the fused train program
+    runs on the accelerator; only the player-visible subtree (``select``) crosses
+    back per train call, as one packed transfer. On a CPU fabric everything is the
+    identity, so call sites need no branching.
+    """
+
+    def __init__(self, fabric, select: Optional[Callable[[Any], Any]] = None) -> None:
+        self.cpu_device = jax.devices("cpu")[0]
+        self.on_cpu = fabric.device.platform != "cpu"
+        self._select = select or (lambda p: p)
+
+    def view(self, params: Any) -> Any:
+        """The player-visible act params: ``select(params)``, landed host-side."""
+        view = self._select(params)
+        return packed_device_put(view, self.cpu_device) if self.on_cpu else view
+
+    def place(self, tree: Any) -> Any:
+        """Land an arbitrary pytree (PRNG key, frozen exploration params) host-side
+        so the act program's dispatch and key chain never touch the accelerator."""
+        return packed_device_put(tree, self.cpu_device) if self.on_cpu else tree
 
 
 def packed_device_put(tree: Any, device: jax.Device) -> Any:

@@ -142,3 +142,128 @@ def test_adapter_import_error_is_actionable(module):
         # sheeprl_tpu-internal symbol
         assert "sheeprl_tpu" not in str(err)
         assert "install" in str(err) or (err.name and not err.name.startswith("sheeprl_tpu"))
+
+
+# ---------------------------------------------------------------------------------
+# Robosuite option-surface tests against a FAKE SDK: robosuite is not installable in
+# CI, but the adapter's key-mapping / space construction / action normalization are
+# ours and deserve real coverage (VERDICT r03 adapter-depth item).
+# ---------------------------------------------------------------------------------
+
+
+class _FakeRobosuiteEnv:
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        self.use_camera_obs = kwargs.get("use_camera_obs", False)
+        self.use_object_obs = kwargs.get("use_object_obs", True)
+        self.camera_names = list(kwargs.get("camera_names", ["agentview"]))
+        self.camera_heights = kwargs.get("camera_heights", 84)
+        self.camera_widths = kwargs.get("camera_widths", 84)
+        self.robots = [object()]
+        self.reward_scale = kwargs.get("reward_scale", 1.0)
+        self.action_spec = (np.full(7, -0.5, np.float64), np.full(7, 0.5, np.float64))
+        self.last_action = None
+
+    def _make_obs(self):
+        obs = {"robot0_proprio-state": np.zeros(32, np.float64)}
+        if self.use_object_obs:
+            obs["object-state"] = np.zeros(10, np.float64)
+        if self.use_camera_obs:
+            for cam in self.camera_names:
+                obs[f"{cam}_image"] = np.zeros(
+                    (self.camera_heights, self.camera_widths, 3), np.uint8
+                )
+        return obs
+
+    def reset(self):
+        return self._make_obs()
+
+    def observation_spec(self):
+        return self._make_obs()
+
+    def step(self, action):
+        self.last_action = np.asarray(action)
+        return self._make_obs(), 1.0, False, {}
+
+    def _get_observations(self):
+        return self._make_obs()
+
+    def close(self):
+        pass
+
+
+@pytest.fixture()
+def fake_robosuite(monkeypatch):
+    import sys
+    import types
+
+    fake = types.ModuleType("robosuite")
+    fake.make = lambda env_name, **kw: _FakeRobosuiteEnv(**kw)
+    fake.controllers = types.SimpleNamespace(
+        load_controller_config=lambda default_controller: {"type": default_controller}
+    )
+    monkeypatch.setitem(sys.modules, "robosuite", fake)
+    import sheeprl_tpu.utils.imports as imports
+
+    monkeypatch.setattr(imports, "_IS_ROBOSUITE_AVAILABLE", True)
+    # force a re-import against the fake SDK
+    sys.modules.pop("sheeprl_tpu.envs.robosuite", None)
+    yield fake
+    sys.modules.pop("sheeprl_tpu.envs.robosuite", None)
+
+
+def _make_robosuite(fake_robosuite, **kw):
+    from sheeprl_tpu.envs.robosuite import RobosuiteWrapper
+
+    args = dict(env_name="PickPlace", env_config="single-arm-opposed", robot="Panda")
+    args.update(kw)
+    return RobosuiteWrapper(**args)
+
+
+def test_robosuite_multi_camera_and_object_state(fake_robosuite):
+    env = _make_robosuite(
+        fake_robosuite,
+        use_camera_obs=True,
+        camera_names=["agentview", "robot0_eye_in_hand"],
+        camera_heights=64,
+        camera_widths=64,
+    )
+    assert set(env.observation_space.spaces) == {"rgb", "rgb_robot0_eye_in_hand", "state", "object_state"}
+    assert env.observation_space["rgb"].shape == (3, 64, 64)
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (3, 64, 64)
+    assert obs["object_state"].shape == (10,)
+
+
+def test_robosuite_keys_selection_and_errors(fake_robosuite):
+    env = _make_robosuite(fake_robosuite, use_camera_obs=False, keys=["robot0_proprio-state"])
+    assert set(env.observation_space.spaces) == {"state"}
+    with pytest.raises(ValueError, match="unknown robosuite observation keys"):
+        _make_robosuite(fake_robosuite, keys=["not-a-key"])
+
+
+def test_robosuite_action_denormalization(fake_robosuite):
+    env = _make_robosuite(fake_robosuite, use_camera_obs=False)
+    assert env.action_space.shape == (7,)
+    env.step(np.ones(7, np.float32))  # +1 normalized -> true high
+    np.testing.assert_allclose(env._env.last_action, np.full(7, 0.5), atol=1e-6)
+    env.step(-np.ones(7, np.float32))  # -1 normalized -> true low
+    np.testing.assert_allclose(env._env.last_action, np.full(7, -0.5), atol=1e-6)
+
+
+def test_robosuite_controller_kwargs_merge(fake_robosuite):
+    env = _make_robosuite(
+        fake_robosuite, use_camera_obs=False, controller_kwargs={"kp": 150}
+    )
+    cc = env._env.kwargs["controller_configs"]
+    assert cc["type"] == "OSC_POSE" and cc["kp"] == 150
+
+
+def test_robosuite_render_camera_falls_back_to_listed_camera(fake_robosuite):
+    env = _make_robosuite(
+        fake_robosuite,
+        use_camera_obs=True,
+        camera_names=["robot0_eye_in_hand"],
+        render_camera="agentview",  # not in camera_names -> must fall back
+    )
+    assert env.render().shape[-1] == 3
