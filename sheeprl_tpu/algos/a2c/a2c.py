@@ -29,7 +29,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, save_configs
+from sheeprl_tpu.utils.utils import ActPlacement, gae, save_configs
 
 
 @register_algorithm(decoupled=False)
@@ -127,8 +127,8 @@ def main(fabric, cfg: Dict[str, Any]):
 
     # same latency design as PPO: act path on the host CPU backend, one fused jitted
     # device program per iteration (GAE + full-rollout accumulated update)
-    cpu_device = jax.devices("cpu")[0]
-    act_on_cpu = fabric.device.platform != "cpu"
+    act = ActPlacement(fabric)
+    act_on_cpu = act.on_cpu
 
     @jax.jit
     def policy_step_fn(params, obs: Dict[str, jax.Array], key):
@@ -183,9 +183,8 @@ def main(fabric, cfg: Dict[str, Any]):
     if world_size > 1:
         params = fabric.replicate_pytree(params)
         opt_state = fabric.replicate_pytree(opt_state)
-    act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
-    if act_on_cpu:
-        key = jax.device_put(key, cpu_device)
+    act_params = act.view(params)
+    key = act.place(key)
 
     step_data: Dict[str, np.ndarray] = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
@@ -256,10 +255,7 @@ def main(fabric, cfg: Dict[str, Any]):
             if world_size > 1:
                 data = jax.device_put(data, fabric.sharding(None, "data"))
             params, opt_state, metrics = train_phase(params, opt_state, data, next_values)
-            if act_on_cpu:
-                act_params = jax.device_put(params, cpu_device)
-            else:
-                act_params = params
+            act_params = act.view(params)
             if aggregator and not aggregator.disabled:
                 aggregator.update("Loss/policy_loss", np.asarray(metrics["pg"]))
                 aggregator.update("Loss/value_loss", np.asarray(metrics["vl"]))

@@ -36,7 +36,14 @@ def compute_lambda_values(
 ) -> jax.Array:
     """DV1 lambda-return recursion (reference dreamer_v1/utils.py:42-77): produces
     ``horizon - 1`` targets; the final step bootstraps with the *full* last value
-    (not scaled by 1 - lambda)."""
+    (not scaled by 1 - lambda).
+
+    Accumulates in float32 regardless of compute precision (see the shared
+    compute_lambda_values note in utils/utils.py): mixed bf16/fp32 inputs would
+    otherwise break the scan carry-type invariant."""
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    continues = continues.astype(jnp.float32)
     # entries t = 0..H-2: t < H-2 uses values[t+1] * (1 - lambda), t == H-2 uses
     # values[H-1] unscaled
     next_values = jnp.concatenate([values[1:-1] * (1 - lmbda), values[-1:]], axis=0)

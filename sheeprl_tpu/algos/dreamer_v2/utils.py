@@ -52,7 +52,15 @@ def compute_lambda_values(
     lmbda: float = 0.95,
 ) -> jax.Array:
     """DV2 lambda-return recursion with explicit bootstrap (reference
-    dreamer_v2/utils.py:85-102), as a reversed lax.scan."""
+    dreamer_v2/utils.py:85-102), as a reversed lax.scan.
+
+    Accumulates in float32 regardless of compute precision (see the shared
+    compute_lambda_values note in utils/utils.py): mixed bf16/fp32 inputs would
+    otherwise break the scan carry-type invariant."""
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    continues = continues.astype(jnp.float32)
+    bootstrap = bootstrap.astype(jnp.float32)
     next_values = jnp.concatenate([values[1:], bootstrap], axis=0)
     inputs = rewards + continues * next_values * (1 - lmbda)
 

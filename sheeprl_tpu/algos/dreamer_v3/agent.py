@@ -580,6 +580,12 @@ class DV3Agent:
         T, B = embedded.shape[:2]
         h0, z0 = self.initial_state(wm_params, (B,))
         keys = jax.random.split(key, T)
+        # the carry must keep the compute dtype through the whole scan: fp32
+        # actions/is_first would promote the bf16 body output back to fp32 and break
+        # the carry-type invariant under precision=bf16-*
+        actions = actions.astype(embedded.dtype)
+        is_first = is_first.astype(embedded.dtype)
+        h0, z0 = h0.astype(embedded.dtype), z0.astype(embedded.dtype)
         init = (
             jnp.zeros((B, self.recurrent_state_size), embedded.dtype),
             jnp.zeros((B, self.stoch_state_size), embedded.dtype),

@@ -670,7 +670,7 @@ def run_dreamer(
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
-        test_fn(player, params, fabric, cfg, log_dir, greedy=False)
+        test_fn(player, act_params, fabric, cfg, log_dir, greedy=False)
     if logger is not None:
         logger.finalize()
 

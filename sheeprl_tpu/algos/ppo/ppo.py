@@ -35,7 +35,14 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import epoch_permutation, gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import (
+    ActPlacement,
+    epoch_permutation,
+    gae,
+    normalize_tensor,
+    polynomial_decay,
+    save_configs,
+)
 
 
 def _build_optimizer(cfg, total_iters: int) -> optax.GradientTransformation:
@@ -260,8 +267,8 @@ def main(fabric, cfg: Dict[str, Any]):
     # device program per iteration (all epochs x minibatches fused via lax.scan), and
     # weights cross host<->device once per iteration. This replaces the reference's
     # per-step .cpu().numpy() syncs + per-minibatch optimizer steps (ppo.py:279-372).
-    cpu_device = jax.devices("cpu")[0]
-    act_on_cpu = fabric.device.platform != "cpu"
+    act = ActPlacement(fabric)
+    act_on_cpu = act.on_cpu
 
     @partial(jax.jit, backend="cpu" if act_on_cpu else None)
     def policy_step_fn(params, obs: Dict[str, jax.Array], key):
@@ -296,7 +303,7 @@ def main(fabric, cfg: Dict[str, Any]):
         params = fabric.replicate_pytree(params)
         opt_state = fabric.replicate_pytree(opt_state)
 
-    act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
+    act_params = act.view(params)
 
     # ---------------- main loop ----------------
     ent_coef = initial_ent_coef
@@ -304,8 +311,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     # host-side PRNG chain lives on the CPU backend: splitting keys must never cost a
     # device roundtrip
-    if act_on_cpu:
-        key = jax.device_put(key, cpu_device)
+    key = act.place(key)
 
     step_data: Dict[str, np.ndarray] = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
@@ -390,10 +396,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Loss/policy_loss", losses_np[0])
                 aggregator.update("Loss/value_loss", losses_np[1])
                 aggregator.update("Loss/entropy_loss", losses_np[2])
-            if act_on_cpu:
-                act_params = jax.device_put(params, cpu_device)
-            else:
-                act_params = params
+            act_params = act.view(params)
 
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run):
             metrics_dict = aggregator.compute() if aggregator else {}

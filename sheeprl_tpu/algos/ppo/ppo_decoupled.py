@@ -42,7 +42,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import ActPlacement, gae, normalize_tensor, polynomial_decay, save_configs
 
 
 def _trainer_loop(
@@ -330,8 +330,8 @@ def main(fabric, cfg: Dict[str, Any]):
             )
             trainer.start()
 
-        cpu_device = jax.devices("cpu")[0]
-        act_on_cpu = fabric.device.platform != "cpu"
+        act = ActPlacement(fabric)
+        act_on_cpu = act.on_cpu
 
         @partial(jax.jit, backend="cpu" if act_on_cpu else None)
         def policy_step_fn(params, obs: Dict[str, jax.Array], key):
@@ -371,9 +371,8 @@ def main(fabric, cfg: Dict[str, Any]):
             flat["advantages"] = advantages.reshape(-1, 1)
             return flat
 
-        act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
-        if act_on_cpu:
-            key = jax.device_put(key, cpu_device)
+        act_params = act.view(params)
+        key = act.place(key)
 
         ent_coef = initial_ent_coef
         clip_coef = initial_clip_coef
@@ -464,9 +463,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         )
                     break
                 params_host, opt_state_host, mean_losses = msg
-                act_params = (
-                    jax.device_put(params_host, cpu_device) if act_on_cpu else params_host
-                )
+                act_params = act.view(params_host)
                 if aggregator and not aggregator.disabled:
                     aggregator.update("Loss/policy_loss", float(mean_losses[0]))
                     aggregator.update("Loss/value_loss", float(mean_losses[1]))

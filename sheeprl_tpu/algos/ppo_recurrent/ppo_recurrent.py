@@ -39,7 +39,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import ActPlacement, gae, polynomial_decay, save_configs
 
 
 def _next_pow2(n: int) -> int:
@@ -163,8 +163,8 @@ def main(fabric, cfg: Dict[str, Any]):
     num_batches = max(1, int(cfg.algo.per_rank_num_batches))
     sl = int(cfg.algo.per_rank_sequence_length)
 
-    cpu_device = jax.devices("cpu")[0]
-    act_on_cpu = fabric.device.platform != "cpu"
+    act = ActPlacement(fabric)
+    act_on_cpu = act.on_cpu
 
     @partial(jax.jit, backend="cpu" if act_on_cpu else None)
     def policy_step_fn(params, obs, prev_actions, hx, cx, key):
@@ -262,9 +262,8 @@ def main(fabric, cfg: Dict[str, Any]):
     if world_size > 1:
         params = fabric.replicate_pytree(params)
         opt_state = fabric.replicate_pytree(opt_state)
-    act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
-    if act_on_cpu:
-        key = jax.device_put(key, cpu_device)
+    act_params = act.view(params)
+    key = act.place(key)
 
     # ---------------- main loop ----------------
     ent_coef = initial_ent_coef
@@ -423,7 +422,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Loss/policy_loss", losses_np[0])
                 aggregator.update("Loss/value_loss", losses_np[1])
                 aggregator.update("Loss/entropy_loss", losses_np[2])
-            act_params = jax.device_put(params, cpu_device) if act_on_cpu else params
+            act_params = act.view(params)
 
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run

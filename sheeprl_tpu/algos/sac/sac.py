@@ -37,7 +37,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import ActPlacement, Ratio, save_configs
 
 
 @register_algorithm()
@@ -169,8 +169,8 @@ def main(fabric, cfg: Dict[str, Any]):
     target_period = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
     sample_next_obs = bool(cfg.buffer.sample_next_obs)
 
-    cpu_device = jax.devices("cpu")[0]
-    act_on_cpu = fabric.device.platform != "cpu"
+    act = ActPlacement(fabric, lambda p: p["actor"])
+    act_on_cpu = act.on_cpu
 
     @partial(jax.jit, backend="cpu" if act_on_cpu else None)
     def act_fn(actor_params, obs: jax.Array, key):
@@ -250,9 +250,8 @@ def main(fabric, cfg: Dict[str, Any]):
     if world_size > 1:
         params = fabric.replicate_pytree(params)
         opt_state = fabric.replicate_pytree(opt_state)
-    act_params = jax.device_put(params["actor"], cpu_device) if act_on_cpu else params["actor"]
-    if act_on_cpu:
-        key = jax.device_put(key, cpu_device)
+    act_params = act.view(params)
+    key = act.place(key)
 
     # ---------------- main loop ----------------
     cumulative_per_rank_gradient_steps = 0
@@ -326,10 +325,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         params, opt_state, data, jnp.asarray(iter_num), np.asarray(train_key)
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                    if act_on_cpu:
-                        act_params = jax.device_put(params["actor"], cpu_device)
-                    else:
-                        act_params = params["actor"]
+                    act_params = act.view(params)
                     if aggregator and not aggregator.disabled:
                         losses_np = np.asarray(mean_losses)
                         aggregator.update("Loss/value_loss", losses_np[0])

@@ -34,7 +34,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import ActPlacement, Ratio, save_configs
 
 
 def _trainer_loop(
@@ -304,8 +304,8 @@ def main(fabric, cfg: Dict[str, Any]):
             )
             trainer.start()
 
-        cpu_device = jax.devices("cpu")[0]
-        act_on_cpu = fabric.device.platform != "cpu"
+        act = ActPlacement(fabric, lambda p: p["actor"])
+        act_on_cpu = act.on_cpu
 
         from functools import partial
 
@@ -318,11 +318,10 @@ def main(fabric, cfg: Dict[str, Any]):
             actions, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
             return actions, key
 
-        act_params = jax.device_put(params["actor"], cpu_device) if act_on_cpu else params["actor"]
+        act_params = act.view(params)
         params_host = jax.tree_util.tree_map(np.asarray, params)
         opt_state_host: Optional[Any] = None
-        if act_on_cpu:
-            key = jax.device_put(key, cpu_device)
+        key = act.place(key)
 
         policy_step = 0
         last_log = 0
@@ -411,11 +410,7 @@ def main(fabric, cfg: Dict[str, Any]):
                                 )
                             break
                         params_host, opt_state_host, mean_losses = msg
-                        act_params = (
-                            jax.device_put(params_host["actor"], cpu_device)
-                            if act_on_cpu
-                            else params_host["actor"]
-                        )
+                        act_params = act.view(params_host)
                         cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                         if aggregator and not aggregator.disabled:
                             aggregator.update("Loss/value_loss", float(mean_losses[0]))

@@ -60,7 +60,7 @@ class RobosuiteWrapper(gym.Env):
         # robosuite only produces `<cam>_image` entries for cameras in camera_names;
         # an unlisted render_camera would KeyError at the first render() (e.g. video
         # capture during evaluation), long after training started — fall back.
-        if render_camera not in camera_names:
+        if camera_names and render_camera not in camera_names:
             render_camera = camera_names[0]
         make_args = dict(
             env_configuration=env_config,

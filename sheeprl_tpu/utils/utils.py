@@ -121,7 +121,15 @@ def compute_lambda_values(
     reference's ``compute_lambda_values`` (sheeprl/algos/dreamer_v3/utils.py:67-78):
     ``ret[t] = r[t] + c[t] * ((1-lambda) * v[t] + lambda * ret[t+1])`` with carry
     initialized at ``v[T-1]``. Callers pass the inputs already shifted the way the
-    reference does (rewards[1:], values[1:], continues[1:] * gamma)."""
+    reference does (rewards[1:], values[1:], continues[1:] * gamma).
+
+    Return accumulation runs in float32 regardless of the compute precision (the
+    same spirit as the reference's GAE-in-float64, ppo.py:350): it is a tiny
+    tensor, the recursion compounds rounding over the horizon, and mixed
+    bf16/fp32 inputs would otherwise break the scan's carry-type invariant."""
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    continues = continues.astype(jnp.float32)
     interm = rewards + continues * values * (1 - lmbda)
 
     def step(carry, inp):
