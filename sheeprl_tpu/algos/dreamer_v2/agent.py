@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.models.models import LayerNormGRUCell, resolve_activation
+from sheeprl_tpu.ops.deconv import FusedConvTransposeS2Valid
 from sheeprl_tpu.utils.distribution import TruncatedNormal
 
 
@@ -139,14 +140,26 @@ class CNNDecoder(nn.Module):
             (2 * self.channels_multiplier, 5),
             (1 * self.channels_multiplier, 6),
         ]
-        for ch, k in specs:
-            x = nn.ConvTranspose(
-                ch, (k, k), strides=(2, 2), padding="VALID", use_bias=not self.layer_norm, dtype=self.dtype
+        # FusedConvTransposeS2Valid == nn.ConvTranspose(k, s=2, VALID) exactly
+        # (ops/deconv.py; parity-tested), ~3x faster under XLA:CPU's lowering;
+        # explicit names keep the nn.ConvTranspose param tree (checkpoints intact).
+        for i, (ch, k) in enumerate(specs):
+            x = FusedConvTransposeS2Valid(
+                ch,
+                kernel_size=k,
+                use_bias=not self.layer_norm,
+                dtype=self.dtype,
+                name=f"ConvTranspose_{i}",
             )(x)
             if self.layer_norm:
                 x = nn.LayerNorm(epsilon=1e-3, dtype=self.dtype)(x)
             x = act(x)
-        x = nn.ConvTranspose(sum(self.output_channels), (6, 6), strides=(2, 2), padding="VALID", dtype=self.dtype)(x)
+        x = FusedConvTransposeS2Valid(
+            sum(self.output_channels),
+            kernel_size=6,
+            dtype=self.dtype,
+            name=f"ConvTranspose_{len(specs)}",
+        )(x)
         x = jnp.moveaxis(x, -1, -3)
         x = x.reshape(*lead, *x.shape[-3:])
         splits = np.cumsum(self.output_channels)[:-1].tolist()

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.models.models import LayerNormGRUCell, resolve_activation
+from sheeprl_tpu.ops.deconv import FusedConvTranspose4x4S2
 from sheeprl_tpu.utils.utils import symlog
 
 # Hafner init: trunc-normal with variance 1/fan_avg and the 0.8796... correction —
@@ -172,25 +173,25 @@ class CNNDecoder(nn.Module):
         )(latent)
         lead = x.shape[:-1]
         x = x.reshape(-1, spatial, spatial, top_channels)
+        # FusedConvTranspose4x4S2 == nn.ConvTranspose(k=4, s=2, SAME) exactly
+        # (ops/deconv.py; parity-tested), in the phase-decomposed form XLA:CPU runs
+        # ~3x faster; explicit names keep the nn.ConvTranspose param tree, so
+        # checkpoints are unaffected.
         for i in range(self.stages - 1):
-            x = nn.ConvTranspose(
+            x = FusedConvTranspose4x4S2(
                 (2 ** (self.stages - 2 - i)) * self.channels_multiplier,
-                (4, 4),
-                strides=(2, 2),
-                padding="SAME",
                 use_bias=False,
                 kernel_init=hafner_init,
                 dtype=self.dtype,
+                name=f"ConvTranspose_{i}",
             )(x)
             x = nn.LayerNorm(epsilon=self.eps, dtype=self.dtype)(x)
             x = act(x)
-        x = nn.ConvTranspose(
+        x = FusedConvTranspose4x4S2(
             sum(self.output_channels),
-            (4, 4),
-            strides=(2, 2),
-            padding="SAME",
             kernel_init=uniform_init(1.0) if self.hafner_heads else hafner_init,
             dtype=self.dtype,
+            name=f"ConvTranspose_{self.stages - 1}",
         )(x)
         x = jnp.moveaxis(x, -1, -3)  # NHWC -> NCHW
         x = x.reshape(*lead, *x.shape[-3:])

@@ -1,6 +1,7 @@
 """Pallas TPU kernels for the hot ops (SURVEY §2.4: the reference's native-speed
 layer is external libtorch/cuDNN kernels; here the custom-kernel layer is Pallas)."""
 
+from sheeprl_tpu.ops.deconv import FusedConvTranspose4x4S2, FusedConvTransposeS2Valid
 from sheeprl_tpu.ops.gru import (
     fused_ln_gru_step,
     ln_gru_step_reference,
@@ -8,6 +9,8 @@ from sheeprl_tpu.ops.gru import (
 )
 
 __all__ = [
+    "FusedConvTranspose4x4S2",
+    "FusedConvTransposeS2Valid",
     "fused_ln_gru_step",
     "ln_gru_step_reference",
     "pallas_gru_applicable",

@@ -1,0 +1,123 @@
+"""FusedConvTranspose4x4S2 must be an exact drop-in for
+nn.ConvTranspose(k=4, s=2, SAME): same parameter tree, same values, same
+gradients (to fp32 rounding), across shapes, bias settings and dtypes."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.ops.deconv import FusedConvTranspose4x4S2
+
+
+def _pair(features, use_bias, dtype=jnp.float32):
+    ref = nn.ConvTranspose(features, (4, 4), strides=(2, 2), padding="SAME", use_bias=use_bias, dtype=dtype)
+    fused = FusedConvTranspose4x4S2(features, use_bias=use_bias, dtype=dtype)
+    return ref, fused
+
+
+@pytest.mark.parametrize("shape", [(2, 4, 4, 8), (3, 8, 8, 3), (1, 5, 7, 2)])
+@pytest.mark.parametrize("use_bias", [True, False])
+def test_forward_parity(shape, use_bias):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    ref, fused = _pair(6, use_bias)
+    params = ref.init(jax.random.PRNGKey(0), x)
+    # identical parameter trees -> the reference params drive the fused op directly
+    out_ref = ref.apply(params, x)
+    out_fused = fused.apply(params, x)
+    assert out_fused.shape == out_ref.shape == (shape[0], 2 * shape[1], 2 * shape[2], 6)
+    np.testing.assert_allclose(np.asarray(out_fused), np.asarray(out_ref), atol=1e-5, rtol=1e-5)
+
+
+def test_param_tree_identical():
+    x = jnp.zeros((1, 4, 4, 3), jnp.float32)
+    ref, fused = _pair(5, True)
+    ref_params = jax.tree_util.tree_map(np.shape, ref.init(jax.random.PRNGKey(0), x))
+    fused_params = jax.tree_util.tree_map(np.shape, fused.init(jax.random.PRNGKey(0), x))
+    assert ref_params == fused_params
+
+
+def test_gradient_parity():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 6, 6, 4)), jnp.float32)
+    tgt = jnp.asarray(rng.normal(size=(2, 12, 12, 3)), jnp.float32)
+    ref, fused = _pair(3, True)
+    params = ref.init(jax.random.PRNGKey(1), x)
+
+    def loss(mod):
+        return lambda p, x: jnp.mean((mod.apply(p, x) - tgt) ** 2)
+
+    g_ref = jax.grad(loss(ref))(params, x)
+    g_fused = jax.grad(loss(fused))(params, x)
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(g_ref), jax.tree_util.tree_leaves(g_fused)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4,
+            err_msg=f"grad leaf {jax.tree_util.keystr(path)}",
+        )
+    gx_ref = jax.grad(lambda x: loss(ref)(params, x))(x)
+    gx_fused = jax.grad(lambda x: loss(fused)(params, x))(x)
+    np.testing.assert_allclose(np.asarray(gx_fused), np.asarray(gx_ref), atol=1e-5, rtol=1e-4)
+
+
+def test_bf16_runs_and_tracks_fp32():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 4, 4, 3)), jnp.float32)
+    ref32, fused16 = _pair(4, True)
+    _, fused32 = _pair(4, True)
+    params = ref32.init(jax.random.PRNGKey(2), x)
+    out32 = FusedConvTranspose4x4S2(4, use_bias=True).apply(params, x)
+    out16 = FusedConvTranspose4x4S2(4, use_bias=True, dtype=jnp.bfloat16).apply(params, x)
+    assert out16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out16, np.float32), np.asarray(out32), atol=0.1, rtol=0.1
+    )
+
+
+@pytest.mark.parametrize("k", [4, 5, 6])
+@pytest.mark.parametrize("shape", [(2, 1, 1, 8), (2, 5, 7, 3), (1, 13, 13, 4)])
+def test_valid_forward_parity(k, shape):
+    from sheeprl_tpu.ops.deconv import FusedConvTransposeS2Valid
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    ref = nn.ConvTranspose(4, (k, k), strides=(2, 2), padding="VALID")
+    fused = FusedConvTransposeS2Valid(4, kernel_size=k)
+    params = ref.init(jax.random.PRNGKey(0), x)
+    out_ref = ref.apply(params, x)
+    out_fused = fused.apply(params, x)
+    assert out_fused.shape == out_ref.shape
+    np.testing.assert_allclose(np.asarray(out_fused), np.asarray(out_ref), atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("k", [4, 5, 6])
+def test_valid_gradient_parity(k):
+    from sheeprl_tpu.ops.deconv import FusedConvTransposeS2Valid
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 5, 5, 3)), jnp.float32)
+    ref = nn.ConvTranspose(2, (k, k), strides=(2, 2), padding="VALID")
+    fused = FusedConvTransposeS2Valid(2, kernel_size=k)
+    params = ref.init(jax.random.PRNGKey(1), x)
+    tgt = jnp.asarray(rng.normal(size=ref.apply(params, x).shape), jnp.float32)
+
+    def loss(mod):
+        return lambda p, x: jnp.mean((mod.apply(p, x) - tgt) ** 2)
+
+    g_ref = jax.grad(loss(ref))(params, x)
+    g_fused = jax.grad(loss(fused))(params, x)
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(g_ref), jax.tree_util.tree_leaves(g_fused)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4,
+            err_msg=f"grad leaf {jax.tree_util.keystr(path)}",
+        )
+    gx_ref = jax.grad(lambda x: loss(ref)(params, x))(x)
+    gx_fused = jax.grad(lambda x: loss(fused)(params, x))(x)
+    np.testing.assert_allclose(np.asarray(gx_fused), np.asarray(gx_ref), atol=1e-5, rtol=1e-4)
