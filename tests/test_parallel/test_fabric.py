@@ -73,3 +73,25 @@ def test_local_mesh_restricts_to_this_process():
     f = Fabric(devices=2, accelerator="cpu", local_mesh=True)
     f._setup()
     assert all(d.process_index == jax.process_index() for d in f.devices)
+
+
+def test_act_placement_identity_on_cpu_fabric():
+    """On a CPU fabric ActPlacement is the identity (no transfers, no copies);
+    the select function still shapes the view."""
+    import jax
+    import numpy as np
+
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.utils.utils import ActPlacement
+
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric._setup()
+    act = ActPlacement(fabric, lambda p: {"actor": p["actor"]})
+    assert act.on_cpu is False
+    params = {"actor": jax.numpy.ones(3), "critic": jax.numpy.zeros(3)}
+    view = act.view(params)
+    assert set(view) == {"actor"}
+    assert view["actor"] is params["actor"]  # identity, not a copy
+    key = jax.random.PRNGKey(0)
+    assert act.place(key) is key
+    np.testing.assert_array_equal(np.asarray(view["actor"]), np.ones(3))
