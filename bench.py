@@ -112,25 +112,45 @@ def _accelerator_probe(timeout: int = 90) -> dict:
     backend can wedge (a killed client's claim blocks new ones indefinitely) — and a
     wedged init inside the bench process would burn the whole budget. A dead probe
     demotes the run to CPU so the scoreboard still gets a number. Returns
-    {alive, platform, device_kind}."""
-    import subprocess
+    {alive, platform, device_kind}.
 
+    Crucially the probe child is NEVER killed: killing a client mid-claim is
+    precisely what wedges the single-tenant tunnel in the first place. On timeout
+    the child is left running (it exits on its own once its claim resolves, cleanly
+    releasing the chip) and only the WAIT is abandoned."""
+    import subprocess
+    import tempfile
+    import time as _time
+
+    with tempfile.NamedTemporaryFile("r", suffix=".probe", delete=False) as f:
+        out_path = f.name
+    child = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import sys; import jax; d=jax.devices()[0];"
+            f" open({out_path!r}, 'w').write(d.platform + '|' + d.device_kind)",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if child.poll() is not None:
+            break
+        _time.sleep(0.5)
+    rc = child.poll()
+    if rc is None or rc != 0:
+        # rc None: still claiming — abandon the wait, leave the child to finish
+        return {"alive": False, "platform": None, "device_kind": None}
     try:
-        probe = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax; d=jax.devices()[0]; print(d.platform + '|' + d.device_kind)",
-            ],
-            timeout=timeout,
-            capture_output=True,
-            text=True,
-        )
-    except subprocess.TimeoutExpired:
+        with open(out_path) as f:
+            line = f.read().strip()
+        os.unlink(out_path)
+    except OSError:
         return {"alive": False, "platform": None, "device_kind": None}
-    if probe.returncode != 0:
+    if "|" not in line:
         return {"alive": False, "platform": None, "device_kind": None}
-    line = probe.stdout.strip().splitlines()[-1]
     platform, _, kind = line.partition("|")
     return {"alive": True, "platform": platform, "device_kind": kind}
 
