@@ -140,19 +140,28 @@ def _accelerator_probe(timeout: int = 90) -> dict:
             break
         _time.sleep(0.5)
     rc = child.poll()
-    if rc is None or rc != 0:
-        # rc None: still claiming — abandon the wait, leave the child to finish
-        return {"alive": False, "platform": None, "device_kind": None}
     try:
-        with open(out_path) as f:
-            line = f.read().strip()
-        os.unlink(out_path)
-    except OSError:
-        return {"alive": False, "platform": None, "device_kind": None}
-    if "|" not in line:
-        return {"alive": False, "platform": None, "device_kind": None}
-    platform, _, kind = line.partition("|")
-    return {"alive": True, "platform": platform, "device_kind": kind}
+        if rc is None or rc != 0:
+            # rc None: still claiming — abandon the wait, leave the child to finish
+            return {"alive": False, "platform": None, "device_kind": None}
+        try:
+            with open(out_path) as f:
+                line = f.read().strip()
+        except OSError:
+            return {"alive": False, "platform": None, "device_kind": None}
+        if "|" not in line:
+            return {"alive": False, "platform": None, "device_kind": None}
+        platform, _, kind = line.partition("|")
+        return {"alive": True, "platform": platform, "device_kind": kind}
+    finally:
+        # Best-effort: an abandoned child opens the path BY NAME at write time, so
+        # it can re-create the file after this unlink — at worst one small /tmp
+        # file per wedged probe survives, which is acceptable (the child must not
+        # be killed, and reaping its output race-free isn't worth the machinery).
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
 
 
 def _accelerator_probe_cached(timeout: int = 90) -> dict:

@@ -39,6 +39,8 @@ def save_checkpoint(path: str, state: Dict[str, Any]) -> None:
 def load_checkpoint(path: str) -> Dict[str, Any]:
     if os.path.isdir(path):  # orbax-backed checkpoint directory (sharded backend)
         return load_checkpoint_sharded(path)
+    if not os.path.exists(path) and os.path.isdir(path + ".old"):
+        return load_checkpoint_sharded(path)  # falls back to the .old sibling
     with open(path, "rb") as f:
         return pickle.load(f)
 
@@ -112,6 +114,11 @@ def save_checkpoint_sharded(path: str, state: Dict[str, Any], async_save: bool =
         _gc_displaced()  # the previous write (whose displaced .old we kept) has landed
         checkpointer = _async_checkpointer
     else:
+        if _async_checkpointer is not None:
+            # A mixed async-then-sync sequence to the same path must not race the
+            # background orbax commit rename; waiting is a no-op when idle.
+            _async_checkpointer.wait_until_finished()
+            _gc_displaced()
         checkpointer = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
     if os.path.exists(path):
         # Overwriting a path in place must be crash-safe: displace the previous
@@ -154,10 +161,20 @@ def load_checkpoint_sharded(path: str) -> Dict[str, Any]:
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    if not os.path.isdir(path) and os.path.isdir(path + ".old"):
+        # In-place overwrite displaces the live checkpoint to <path>.old before the
+        # new write commits; a crash in that window leaves only the .old sibling.
+        path = path + ".old"
     checkpointer = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
     restored = checkpointer.restore(path)
     arrays = list(restored["leaves"])
-    with open(path + ".extras.pkl", "rb") as f:
+    sidecar_path = path + ".extras.pkl"
+    if not os.path.exists(sidecar_path) and os.path.exists(path + ".old.extras.pkl"):
+        # Crash window mid-displacement: the sidecar was already renamed to
+        # <path>.old.extras.pkl but the directory rename never happened, so the
+        # dir still at <path> pairs with the .old sidecar.
+        sidecar_path = path + ".old.extras.pkl"
+    with open(sidecar_path, "rb") as f:
         sidecar = pickle.load(f)
     treedef = jax.tree_util.tree_structure(sidecar["skeleton"])
     arrays_iter, objects_iter = iter(arrays), iter(sidecar["objects"])

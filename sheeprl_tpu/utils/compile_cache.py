@@ -1,19 +1,49 @@
 """Persistent XLA compilation cache policy, shared by every entry point (CLI,
 tests, driver hooks). The fused train programs take tens of seconds to compile;
 caching them on disk lets later processes skip the compile entirely. Opt out with
-``SHEEPRL_JAX_CACHE=0`` or point ``SHEEPRL_JAX_CACHE`` at another directory."""
+``SHEEPRL_JAX_CACHE=0`` or point ``SHEEPRL_JAX_CACHE`` at another directory.
+
+The default cache dir is suffixed with a host-CPU-feature fingerprint: XLA:CPU
+AOT-compiles against the build machine's feature set, and loading such an entry
+on a machine with different features can SIGILL (cpu_aot_loader warns about
+exactly this). Fingerprinting the dir means a cache written on one machine is
+simply invisible on a different one instead of a hazard. An explicit
+``SHEEPRL_JAX_CACHE=<dir>`` is used verbatim — the caller owns the key."""
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
+
+
+def _cpu_fingerprint() -> str:
+    """Short stable hash of the host's CPU ISA features (+ arch)."""
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    # sorted: flag ORDER is not guaranteed stable across kernels
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    if not flags:
+        # Non-Linux host (no /proc/cpuinfo): fall back to the coarser
+        # OS/release/processor identity for per-machine-class separation. Linux
+        # keeps the pure ISA-flags key so kernel upgrades don't churn the cache.
+        flags = f"{platform.platform()}|{platform.processor()}"
+    raw = f"{platform.machine()}|{flags}"
+    return hashlib.sha256(raw.encode()).hexdigest()[:12]
 
 
 def enable_compile_cache() -> None:
     import jax
 
-    cache_dir = os.environ.get(
-        "SHEEPRL_JAX_CACHE", os.path.expanduser("~/.cache/sheeprl_tpu/jax")
-    )
+    cache_dir = os.environ.get("SHEEPRL_JAX_CACHE")
+    if cache_dir is None:
+        cache_dir = os.path.expanduser(f"~/.cache/sheeprl_tpu/jax-{_cpu_fingerprint()}")
     if cache_dir not in ("0", ""):
         try:
             jax.config.update("jax_compilation_cache_dir", cache_dir)

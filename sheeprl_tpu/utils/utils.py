@@ -244,7 +244,11 @@ class ActPlacement:
         self._select = select or (lambda p: p)
 
     def view(self, params: Any) -> Any:
-        """The player-visible act params: ``select(params)``, landed host-side."""
+        """The player-visible act params: ``select(params)``, landed host-side.
+
+        Note ``select`` narrows the tree on EVERY fabric, CPU included — a test()
+        path that reads keys outside the act view would break identically on all
+        placements, rather than only when an accelerator is attached."""
         view = self._select(params)
         return packed_device_put(view, self.cpu_device) if self.on_cpu else view
 
