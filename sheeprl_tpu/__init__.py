@@ -29,6 +29,7 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.droq.droq",
     "sheeprl_tpu.algos.droq.evaluate",
     "sheeprl_tpu.algos.dreamer_v3.dreamer_v3",
+    "sheeprl_tpu.algos.dreamer_v3.dreamer_v3_decoupled",
     "sheeprl_tpu.algos.dreamer_v3.evaluate",
     "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",
     "sheeprl_tpu.algos.ppo_recurrent.evaluate",

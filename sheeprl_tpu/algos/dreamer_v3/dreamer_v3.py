@@ -269,6 +269,80 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx, world_
     return train_phase
 
 
+def build_optimizers(cfg, params):
+    """The three Dreamer optimizers with per-group clipping (reference
+    dreamer_v3.py:525-538). ONE construction shared by the coupled loop and the
+    decoupled learner: the learner rebuilds training state from the shared seed
+    with no weight transfer, so the two must stay bit-identical."""
+
+    def _tx(opt_cfg, clip):
+        base = instantiate(opt_cfg)
+        if clip is not None and clip > 0:
+            return optax.chain(optax.clip_by_global_norm(clip), base)
+        return base
+
+    world_tx = _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
+    actor_tx = _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_tx = _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    opt_state = {
+        "world_model": world_tx.init(params["world_model"]),
+        "actor": actor_tx.init(params["actor"]),
+        "critic": critic_tx.init(params["critic"]),
+    }
+    return world_tx, actor_tx, critic_tx, opt_state
+
+
+class _InlineTrainer:
+    """Owns the training state and runs the fused train program in-process — the
+    coupled path. The decoupled variant ships the replay block over a data channel
+    to a learner (thread or process slice) instead and implements this same
+    surface (dreamer_v3_decoupled.py), which is the only difference between the
+    two training topologies."""
+
+    # a deferring trainer (channel-backed) can only produce full checkpoint state
+    # at train rounds; the loop then postpones an off-round checkpoint to the next
+    # train round (or to close())
+    defers_checkpoints = False
+
+    def __init__(self, *, fabric, cfg, act, train_phase, params, opt_state, moments_state):
+        self.fabric = fabric
+        self.act = act
+        self.train_phase = train_phase
+        self.params = params
+        self.opt_state = opt_state
+        self.moments_state = moments_state
+
+    def train(self, data, cum_steps, train_key, want_full_state: bool, want_metrics: bool):
+        """One train round over the ``[G, T, B, ...]`` block. Returns
+        ``(act_params, host_metrics_or_None)``."""
+        if self.fabric.world_size > 1:
+            data = jax.device_put(data, self.fabric.sharding(None, None, "data"))
+        self.params, self.opt_state, self.moments_state, metrics = self.train_phase(
+            self.params,
+            self.opt_state,
+            self.moments_state,
+            data,
+            jnp.asarray(cum_steps),
+            np.asarray(train_key),
+        )
+        host_metrics = packed_device_get(metrics) if want_metrics else None
+        return self.act.view(self.params), host_metrics
+
+    def checkpoint_state(self):
+        """(agent_params, opt_state, moments) for the checkpoint callback."""
+        return self.params, self.opt_state, self.moments_state
+
+    def sync_tree(self):
+        """Tree to block on for steady-state bench windows (None = nothing)."""
+        return self.params
+
+    def close(self):
+        """End-of-run teardown. A channel trainer returns the learner's FINAL full
+        state here (paired with the shutdown sentinel) for a deferred last
+        checkpoint; inline training has nothing deferred."""
+        return None
+
+
 def run_dreamer(
     fabric,
     cfg: Dict[str, Any],
@@ -277,10 +351,17 @@ def run_dreamer(
     player_cls=None,
     make_train_phase_fn=None,
     test_fn=None,
+    trainer_factory=None,
+    share_log_dir: bool = True,
 ):
     """The full Dreamer-V3 training loop, with the agent/player/train-phase factories
     injectable so forks with the same loop shape (offline_dreamer's CBWM, reference
-    offline_dreamer.py:446-866) reuse it instead of copying ~400 lines."""
+    offline_dreamer.py:446-866) reuse it instead of copying ~400 lines.
+    ``trainer_factory`` swaps the in-process trainer for a channel-backed one — the
+    decoupled actor–learner topology (dreamer_v3_decoupled.py) reuses this exact
+    loop as its player, passing ``share_log_dir=False`` in the multi-process
+    topology: the learner processes never pair the log-dir share collective, so
+    issuing it would desync the channel planes."""
     build_agent_fn = build_agent_fn or build_agent
     player_cls = player_cls or PlayerDV3
     make_train_phase_fn = make_train_phase_fn or make_train_phase
@@ -295,7 +376,7 @@ def run_dreamer(
     if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
         raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
 
-    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, share=share_log_dir)
     logger = get_logger(fabric, cfg, log_dir=log_dir)
     fabric.logger = logger
     if logger is not None:
@@ -371,21 +452,7 @@ def run_dreamer(
     )
     player = player_cls(agent, num_envs, cnn_keys, mlp_keys)
 
-    # three optimizers with per-group clipping (reference dreamer_v3.py:525-538)
-    def _tx(opt_cfg, clip):
-        base = instantiate(opt_cfg)
-        if clip is not None and clip > 0:
-            return optax.chain(optax.clip_by_global_norm(clip), base)
-        return base
-
-    world_tx = _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
-    actor_tx = _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
-    critic_tx = _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
-    opt_state = {
-        "world_model": world_tx.init(params["world_model"]),
-        "actor": actor_tx.init(params["actor"]),
-        "critic": critic_tx.init(params["critic"]),
-    }
+    world_tx, actor_tx, critic_tx, opt_state = build_optimizers(cfg, params)
     if state is not None and "opt_state" in state:
         opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
     moments_state = init_moments()
@@ -422,6 +489,16 @@ def run_dreamer(
     act = ActPlacement(fabric, lambda p: {"world_model": p["world_model"], "actor": p["actor"]})
     act_params = act.view(params)
     key = act.place(key)
+
+    trainer = (trainer_factory or _InlineTrainer)(
+        fabric=fabric,
+        cfg=cfg,
+        act=act,
+        train_phase=train_phase,
+        params=params,
+        opt_state=opt_state,
+        moments_state=moments_state,
+    )
 
     # counters (reference dreamer_v3.py:571-597)
     start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
@@ -467,12 +544,13 @@ def run_dreamer(
     train_step = 0
     last_train = 0
     act_dim = int(np.sum(actions_dim))
+    pending_ckpt = False
 
     # Optional steady-state measurement window for bench.py (see bench.py docstring)
     bench = BenchWindow()
 
     for iter_num in range(start_iter, total_iters + 1):
-        bench.maybe_start(policy_step, params)
+        bench.maybe_start(policy_step, trainer.sync_tree())
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time"):
@@ -569,6 +647,16 @@ def run_dreamer(
             step_data["is_first"][:, dones_idxes] = 1.0
             player.init_states(act_params, dones_idxes)
 
+        # checkpoint due? (computed BEFORE the train round so a channel trainer can
+        # ship the full state with it; a deferring trainer postpones off-round
+        # checkpoints to the next train round)
+        pending_ckpt = pending_ckpt or (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        )
+        trained_this_iter = False
+
         # train
         if iter_num >= learning_starts:
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
@@ -586,22 +674,19 @@ def run_dreamer(
                         k: np.asarray(v) if k in cnn_keys else np.asarray(v, dtype=np.float32)
                         for k, v in sample.items()
                     }
-                    if world_size > 1:
-                        data = jax.device_put(data, fabric.sharding(None, None, "data"))
                     key, train_key = jax.random.split(key)
-                    params, opt_state, moments_state, metrics = train_phase(
-                        params,
-                        opt_state,
-                        moments_state,
+                    act_params, host_metrics = trainer.train(
                         data,
-                        jnp.asarray(cumulative_per_rank_gradient_steps),
-                        np.asarray(train_key),
+                        cumulative_per_rank_gradient_steps,
+                        train_key,
+                        want_full_state=pending_ckpt,
+                        want_metrics=bool(aggregator and not aggregator.disabled),
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
-                    act_params = act.view(params)
-                    if aggregator and not aggregator.disabled:
-                        for mk, mv in packed_device_get(metrics).items():
+                    trained_this_iter = True
+                    if host_metrics is not None and aggregator and not aggregator.disabled:
+                        for mk, mv in host_metrics.items():
                             aggregator.update(mk, float(mv))
 
         # log
@@ -642,17 +727,16 @@ def run_dreamer(
             last_log = policy_step
             last_train = train_step
 
-        # checkpoint
-        if (
-            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
-            or cfg.dry_run
-            or (iter_num == total_iters and cfg.checkpoint.save_last)
-        ):
+        # checkpoint (a deferring trainer only has full state at train rounds; its
+        # last pending checkpoint, if any, is flushed by close() below)
+        if pending_ckpt and (not trainer.defers_checkpoints or trained_this_iter):
             last_checkpoint = policy_step
+            pending_ckpt = False
+            ckpt_agent, ckpt_opt, ckpt_moments = trainer.checkpoint_state()
             ckpt_state = {
-                "agent": params,
-                "opt_state": opt_state,
-                "moments": moments_state,
+                "agent": ckpt_agent,
+                "opt_state": ckpt_opt,
+                "moments": ckpt_moments,
                 "ratio": ratio.state_dict(),
                 "iter_num": iter_num * world_size,
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,
@@ -666,7 +750,29 @@ def run_dreamer(
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
-    bench.finish(policy_step, params)
+    bench.finish(policy_step, trainer.sync_tree())
+
+    final_state = trainer.close()
+    if pending_ckpt and final_state is not None:
+        # deferred last checkpoint: the learner's final full state rode the
+        # shutdown handshake
+        ckpt_agent, ckpt_opt, ckpt_moments = final_state
+        ckpt_state = {
+            "agent": ckpt_agent,
+            "opt_state": ckpt_opt,
+            "moments": ckpt_moments,
+            "ratio": ratio.state_dict(),
+            "iter_num": total_iters * world_size,
+            "batch_size": cfg.algo.per_rank_batch_size * world_size,
+            "last_log": last_log,
+            "last_checkpoint": policy_step,
+        }
+        fabric.call(
+            "on_checkpoint_coupled",
+            ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+            state=ckpt_state,
+            replay_buffer=rb if cfg.buffer.checkpoint else None,
+        )
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:

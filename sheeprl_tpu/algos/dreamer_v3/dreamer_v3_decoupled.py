@@ -1,0 +1,299 @@
+"""Dreamer-V3, decoupled actor–learner (MPMD) training.
+
+The reference has NO decoupled Dreamer — this is the BASELINE.md north-star
+topology ("DV3 XL, decoupled, v5e-16"): the env-host player runs `run_dreamer`'s
+exact loop (dreamer_v3.py) with a channel-backed trainer in place of the inline
+one, and the learner — a thread on the accelerator mesh in one process, or a
+multi-process LEARNER SLICE sharing one DP mesh under ``jax.distributed`` —
+consumes ``[G, T, B, ...]`` replay blocks and publishes updated params. Planes
+and role split mirror the decoupled PPO/SAC modules (reference
+sheeprl/algos/ppo/ppo_decoupled.py:623-666 for the process topology):
+
+- data plane — depth-1 channel of sampled replay blocks; under a multi-process
+  slice the block is broadcast and sharded over the slice's ``data`` axis;
+- weight plane — the act view ({world_model, actor} — the player's RSSM needs
+  the world model) each round; full (params, opt_state, moments) only when the
+  player is about to checkpoint, and once more on shutdown (the final-state
+  handshake that pairs the sentinel), so off-round checkpoints can be deferred
+  rather than dropped.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import build_optimizers, make_train_phase, run_dreamer
+from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+from sheeprl_tpu.parallel.distributed import (
+    BroadcastChannel,
+    ChannelError,
+    coordination_barrier,
+    replicated_to_host,
+)
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+def _act_select(params):
+    return {"world_model": params["world_model"], "actor": params["actor"]}
+
+
+def _full_state_host(params, opt_state, moments_state):
+    return (
+        replicated_to_host(params),
+        replicated_to_host(opt_state),
+        replicated_to_host(moments_state),
+    )
+
+
+def _warmup_train_step(fabric, cfg, train_phase, params, opt_state, observation_space, actions_dim, player_world):
+    """Compile + first-execute the train program on an all-zeros batch with the
+    EXACT shapes/dtypes/shardings of a real round, then discard the outputs.
+    Runs before the lockstep channel protocol starts (fenced by the warmup
+    coordination barrier), so no channel collective ever spans the multi-minute
+    compile — the CPU gloo backend's context rendezvous dies at ~30 s."""
+    mesh_size = fabric.world_size
+    T = int(cfg.algo.per_rank_sequence_length)
+    B = int(cfg.algo.per_rank_batch_size) * int(player_world)
+    batch: Dict[str, np.ndarray] = {}
+    for k in cfg.algo.cnn_keys.encoder:
+        batch[k] = np.zeros((T, B, *observation_space[k].shape), np.uint8)
+    for k in cfg.algo.mlp_keys.encoder:
+        batch[k] = np.zeros((T, B, *observation_space[k].shape), np.float32)
+    batch["actions"] = np.zeros((T, B, int(np.sum(actions_dim))), np.float32)
+    for k in ("rewards", "terminated", "truncated", "is_first"):
+        batch[k] = np.zeros((T, B, 1), np.float32)
+    p, o, m = params, opt_state, init_moments()
+    if mesh_size > 1:
+        p = fabric.replicate_pytree(p)
+        o = fabric.replicate_pytree(o)
+        m = fabric.replicate_pytree(m)
+        batch = jax.device_put(batch, fabric.sharding(None, "data"))
+    out = train_phase.train_step(p, o, m, batch, jnp.asarray(0), jax.random.PRNGKey(0))
+    jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+
+
+def _trainer_loop(fabric, cfg, train_phase, params, opt_state, moments_state, data_q, params_q, error):
+    """Learner role: consume replay blocks, run the fused per-gradient-step program
+    over them, publish the act view (full state on request). The shutdown sentinel
+    is answered with the FINAL full state so the player can flush a deferred last
+    checkpoint."""
+    try:
+        mesh_size = fabric.world_size
+        if mesh_size > 1:
+            params = fabric.replicate_pytree(params)
+            opt_state = fabric.replicate_pytree(opt_state)
+            moments_state = fabric.replicate_pytree(moments_state)
+        while True:
+            msg = data_q.get()
+            if msg is None:
+                params_q.put(_full_state_host(params, opt_state, moments_state))
+                return
+            data, cum_steps, train_key, want_full, want_metrics = msg
+            if mesh_size > 1:
+                # every learner process holds the full broadcast block; this forms
+                # the global array sharded over the slice mesh (batch axis). The
+                # host G-loop inside train_phase slices global arrays eagerly,
+                # which all slice members execute in lockstep.
+                data = jax.device_put(data, fabric.sharding(None, None, "data"))
+            params, opt_state, moments_state, metrics = train_phase(
+                params, opt_state, moments_state, data, jnp.asarray(cum_steps), np.asarray(train_key)
+            )
+            params_q.put(
+                (
+                    replicated_to_host(_act_select(params)),
+                    _full_state_host(params, opt_state, moments_state) if want_full else None,
+                    replicated_to_host(metrics) if want_metrics else None,
+                )
+            )
+    except BaseException as e:  # surface learner crashes to the player
+        error["exc"] = e
+        # a crash inside a channel collective leaves the plane desynced: further
+        # lockstep puts could hang and bury the traceback
+        if not isinstance(e, ChannelError):
+            try:
+                params_q.put(None)
+            except ChannelError:
+                pass
+
+
+class _ChannelTrainer:
+    """run_dreamer trainer backed by the data/weight channels (thread or process
+    slice). ``defers_checkpoints``: full state exists only at train rounds, so the
+    loop postpones off-round checkpoints to the next round (or to close())."""
+
+    defers_checkpoints = True
+
+    def __init__(self, *, fabric, cfg, act, train_phase, params, opt_state, moments_state, multi_process, protocol_done):
+        self.act = act
+        self.error: Dict[str, Any] = {}
+        self._last_full: Optional[tuple] = None
+        self._protocol_done = protocol_done
+        self._thread: Optional[threading.Thread] = None
+        self._multi = multi_process
+        if multi_process:
+            self.data_q: Any = BroadcastChannel(src=0)
+            self.params_q: Any = BroadcastChannel(src=1)
+            # the channels are stateful (KV sequence counters): expose them so
+            # main()'s crash path releases the learners through the SAME instances
+            protocol_done["data_q"] = self.data_q
+            protocol_done["params_q"] = self.params_q
+            # release point: a learner blocked here exits cleanly if the player
+            # dies before the first round (gets None from the crash path)
+            self.data_q.put({"player_world_size": fabric.world_size})
+            # fence the learners' train-program compile (minutes for big models)
+            # out of the lockstep channel protocol: XLA collective contexts have a
+            # hard ~30 s rendezvous deadline on the CPU gloo backend, so a channel
+            # op must never span a long one-sided compile
+            coordination_barrier("dv3_decoupled_warmup")
+        else:
+            self.data_q = queue.Queue(maxsize=1)
+            self.params_q = queue.Queue(maxsize=1)
+            self._thread = threading.Thread(
+                target=_trainer_loop,
+                args=(fabric, cfg, train_phase, params, opt_state, moments_state, self.data_q, self.params_q, self.error),
+                daemon=True,
+                name="dv3-learner",
+            )
+            self._thread.start()
+
+    def train(self, data, cum_steps, train_key, want_full_state: bool, want_metrics: bool):
+        self.data_q.put((data, int(cum_steps), np.asarray(train_key), bool(want_full_state), bool(want_metrics)))
+        msg = self.params_q.get()
+        if msg is None:
+            if "exc" in self.error:
+                raise self.error["exc"]
+            raise RuntimeError(
+                "the learner crashed mid-run (sent a weight-plane sentinel before "
+                "the player finished); see its log"
+            )
+        act_view_host, full, metrics = msg
+        if full is not None:
+            self._last_full = full
+        return self.act.view(act_view_host), metrics
+
+    def checkpoint_state(self):
+        assert self._last_full is not None, (
+            "checkpoint_state before any full-state round — run_dreamer only calls "
+            "this after a train round with want_full_state=True (defers_checkpoints)"
+        )
+        return self._last_full
+
+    def sync_tree(self):
+        return None  # training state lives with the learner
+
+    def close(self):
+        self.data_q.put(None)
+        final = self.params_q.get()  # final-state handshake pairs the sentinel
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+        self._protocol_done["done"] = True
+        if final is None:
+            if "exc" in self.error:
+                raise self.error["exc"]
+            raise RuntimeError("the learner crashed during shutdown; see its log")
+        if "exc" in self.error:
+            raise self.error["exc"]
+        return final
+
+
+def _learner_process(fabric, cfg: Dict[str, Any]):
+    """One process of the learner slice: rebuild the agent from the shared seed
+    (no initial weight transfer — same pattern as decoupled PPO/SAC), then enter
+    the data loop. All slice members run this same program in lockstep."""
+    import gymnasium as gym
+
+    cfg.env.frame_stack = -1  # match the player's forced setting (run_dreamer)
+    env = make_env(cfg, cfg.seed, 0, None, "learner")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    env.close()
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    key = fabric.seed_everything(cfg.seed)  # player is rank 0: cfg.seed + 0
+    key, agent_key = jax.random.split(key)
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
+    world_tx, actor_tx, critic_tx, opt_state = build_optimizers(cfg, params)
+    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+
+    data_q, params_q = BroadcastChannel(src=0), BroadcastChannel(src=1)
+    geometry = data_q.get()
+    if geometry is None:  # player failed before the first round
+        params_q.put(None)  # pairs the player's cleanup ack-consume
+        return
+    _warmup_train_step(
+        fabric, cfg, train_phase, params, opt_state, observation_space, actions_dim,
+        geometry["player_world_size"],
+    )
+    coordination_barrier("dv3_decoupled_warmup")
+    error: Dict[str, Any] = {}
+    _trainer_loop(fabric, cfg, train_phase, params, opt_state, init_moments(), data_q, params_q, error)
+    if "exc" in error:
+        # pair the player's final sentinel — unless the crash WAS the channel,
+        # whose collectives are desynced and would hang instead of pairing
+        if not isinstance(error["exc"], ChannelError):
+            try:
+                data_q.get()
+                params_q.put(None)
+            except ChannelError:
+                pass
+        raise error["exc"]
+
+
+@register_algorithm(decoupled=True)
+def main(fabric, cfg: Dict[str, Any]):
+    from functools import partial
+
+    from sheeprl_tpu.parallel import distributed
+
+    if cfg.checkpoint.resume_from:
+        raise ValueError(
+            "The decoupled Dreamer-V3 implementation does not support resuming from "
+            "a checkpoint; use the coupled `dreamer_v3` algorithm to resume"
+        )
+
+    multi_process = distributed.process_count() >= 2
+    if multi_process:
+        # process 0: player on its own devices; processes 1..N-1: learner slice
+        # sharing one DP mesh (same topology as decoupled PPO/SAC)
+        if distributed.process_index() >= 1:
+            fabric.process_group = tuple(range(1, distributed.process_count()))
+        fabric.local_mesh = True
+        fabric._setup()
+        if distributed.process_index() >= 1:
+            return _learner_process(fabric, cfg)
+
+    protocol_done = {"done": False}
+    try:
+        return run_dreamer(
+            fabric,
+            cfg,
+            trainer_factory=partial(
+                _ChannelTrainer, multi_process=multi_process, protocol_done=protocol_done
+            ),
+            # the learner processes never pair the log-dir share collective
+            share_log_dir=not multi_process,
+        )
+    except BaseException as e:
+        # best-effort learner release; a ChannelError means the plane itself is
+        # desynced and another lockstep collective would hang, not raise
+        if multi_process and not protocol_done["done"] and not isinstance(e, ChannelError):
+            try:
+                # reuse the live (stateful) channel instances when they exist
+                protocol_done.get("data_q", BroadcastChannel(src=0)).put(None)
+                protocol_done.get("params_q", BroadcastChannel(src=1)).get()
+            except Exception:
+                pass
+        raise

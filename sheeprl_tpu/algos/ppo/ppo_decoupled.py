@@ -15,8 +15,14 @@ The two planes become explicit channels with the reference's blocking semantics:
   reference's flattened-parameter broadcast, ppo_decoupled.py:302-305): the player
   BLOCKS on it before the next rollout, preserving the synchronous alternation.
 
-On a multi-host pod the same roles map to env-hosts + a learner slice with the
-host object channel (parallel/distributed.py) as the data plane."""
+Under ``jax.distributed`` the same roles map onto N processes: process 0 is the
+player (env host, local mesh); processes 1..N-1 form the LEARNER SLICE — one DP
+mesh over all their devices (the reference's trainer DDP subgroup,
+ppo_decoupled.py:645-666), every learner process running the same jitted train
+program multi-controller-SPMD style. The data plane broadcasts the whole rollout
+block to the slice and the block is then sharded over the slice's ``data`` axis —
+a global reshuffle, strictly stronger than the reference's static N-1-chunk
+scatter + Join for uneven shards."""
 
 from __future__ import annotations
 
@@ -88,6 +94,10 @@ def _trainer_loop(
         tx = _build_optimizer(cfg, total_iters)
         opt_state = tx.init(params)
 
+        batch_sharding = None
+        if fabric.world_size > 1 and global_bs % fabric.world_size == 0:
+            batch_sharding = fabric.data_sharding
+
         def loss_fn(params, batch, clip_coef, ent_coef):
             norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
             actor_outs, new_values = agent.apply({"params": params}, norm_obs)
@@ -118,6 +128,11 @@ def _trainer_loop(
                 def mb_body(carry, idx):
                     params, opt_state = carry
                     batch = {k: jnp.take(v, idx, axis=0) for k, v in flat.items()}
+                    if batch_sharding is not None:
+                        # keep the gathered minibatch sharded over the learner mesh
+                        # (XLA's propagation may otherwise replicate it, making the
+                        # slice's DP redundant compute)
+                        batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
                     grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
                         params, batch, clip_coef, ent_coef
                     )
@@ -146,6 +161,8 @@ def _trainer_loop(
                 return
             flat, clip_coef, ent_coef, want_opt_state = msg
             if mesh_size > 1:
+                # every learner process holds the full broadcast block, so this
+                # device_put forms the GLOBAL sharded array across the slice mesh
                 flat = jax.device_put(flat, fabric.data_sharding)
             key, train_key = jax.random.split(key)
             params, opt_state, mean_losses = train_phase(
@@ -153,12 +170,13 @@ def _trainer_loop(
             )
             # weight plane: the player needs the full agent each round (it predicts
             # values during the rollout); opt_state only crosses when a checkpoint
-            # is due
+            # is due. replicated_to_host handles the multi-process slice mesh, where
+            # np.asarray refuses non-addressable (but replicated) outputs.
             params_q.put(
                 (
-                    jax.tree_util.tree_map(np.asarray, params),
-                    jax.tree_util.tree_map(np.asarray, opt_state) if want_opt_state else None,
-                    np.asarray(mean_losses),
+                    replicated_to_host(params),
+                    replicated_to_host(opt_state) if want_opt_state else None,
+                    replicated_to_host(mean_losses),
                 )
             )
     except BaseException as e:  # surface learner crashes to the player
@@ -175,12 +193,14 @@ def _trainer_loop(
 
 from sheeprl_tpu.parallel.distributed import BroadcastChannel as _BcastChannel
 from sheeprl_tpu.parallel.distributed import ChannelError as _ChannelError
+from sheeprl_tpu.parallel.distributed import replicated_to_host
 
 
 def _learner_process(fabric, cfg: Dict[str, Any]):
-    """Learner role of the TWO-PROCESS topology (reference trainer ranks,
-    ppo_decoupled.py:368-620): its own jax.distributed process with a local device
-    mesh; consumes rollout blocks and publishes params over the host channels."""
+    """Learner role of the multi-process topology (reference trainer ranks,
+    ppo_decoupled.py:368-620): one process of the learner SLICE, whose DP mesh
+    spans every learner process's devices; consumes rollout blocks and publishes
+    params over the host channels (all slice members run this same program)."""
     env = make_env(cfg, cfg.seed, 0, None, "learner")()
     observation_space = env.observation_space
     action_space = env.action_space
@@ -229,22 +249,24 @@ def main(fabric, cfg: Dict[str, Any]):
         )
 
     two_process = distributed.process_count() >= 2
-    if distributed.process_count() > 2:
-        raise ValueError(
-            "decoupled PPO currently supports exactly 2 jax.distributed processes "
-            "(player + learner); sharding the learner slice across processes is not "
-            f"implemented — got {distributed.process_count()}"
-        )
     if two_process:
-        # MPMD role split over jax.distributed processes: each role computes on its
-        # OWN devices; the data/weight planes ride the host object channel
+        # MPMD role split over jax.distributed processes: process 0 is the player
+        # on its OWN devices; processes 1..N-1 are the learner slice sharing one DP
+        # mesh (reference trainer subgroup, ppo_decoupled.py:645-666). The
+        # data/weight planes ride the host object channel across all N.
+        if distributed.process_index() >= 1:
+            fabric.process_group = tuple(range(1, distributed.process_count()))
         fabric.local_mesh = True
         fabric._setup()
         if distributed.process_index() >= 1:
             return _learner_process(fabric, cfg)
 
-    # any player-side failure must release a learner blocked in a channel
+    # any player-side failure must release a learner blocked in a channel; the
+    # KV-backed channels are STATEFUL (sequence counters), so the crash path must
+    # reuse the live instances once they exist
     _protocol_done = False
+    data_q: Any = None
+    params_q: Any = None
     try:
         initial_ent_coef = float(cfg.algo.ent_coef)
         initial_clip_coef = float(cfg.algo.clip_coef)
@@ -313,8 +335,8 @@ def main(fabric, cfg: Dict[str, Any]):
         # ---------------- channels + learner (thread or separate process) -----------
         error: Dict[str, Any] = {}
         if two_process:
-            data_q: Any = _BcastChannel(src=0)
-            params_q: Any = _BcastChannel(src=1)
+            data_q = _BcastChannel(src=0)
+            params_q = _BcastChannel(src=1)
             trainer = None
             # geometry handshake, then the learner enters its data loop; a None releases
             # it if the player dies before the first rollout
@@ -548,8 +570,10 @@ def main(fabric, cfg: Dict[str, Any]):
         # but every between-collectives crash point exits both roles.
         if two_process and not _protocol_done and not isinstance(e, _ChannelError):
             try:
-                _BcastChannel(src=0).put(None)
-                _BcastChannel(src=1).get()
+                # the channels are stateful: reuse the live instances when the
+                # crash happened after their creation
+                (data_q if data_q is not None else _BcastChannel(src=0)).put(None)
+                (params_q if params_q is not None else _BcastChannel(src=1)).get()
             except Exception:
                 pass
         raise

@@ -28,7 +28,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.parallel.distributed import BroadcastChannel, ChannelError
+from sheeprl_tpu.parallel.distributed import BroadcastChannel, ChannelError, replicated_to_host
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -133,18 +133,23 @@ def _trainer_loop(
                 return
             data, iter_num, want_opt_state = msg
             if mesh_size > 1:
+                # every learner process holds the full broadcast block; sharding the
+                # batch axis over the slice mesh forms the global array (the G-scan
+                # leading axis stays unsharded)
                 data = jax.device_put(data, fabric.sharding(None, "data"))
             key, train_key = jax.random.split(key)
             params, opt_state, mean_losses = train_phase(
                 params, opt_state, data, jnp.asarray(iter_num), np.asarray(train_key)
             )
             # opt_state only crosses when the player is about to checkpoint
-            # (reference parity with the PPO weight plane's want_opt_state)
+            # (reference parity with the PPO weight plane's want_opt_state).
+            # replicated_to_host handles the multi-process slice mesh, where
+            # np.asarray refuses non-addressable (but replicated) outputs.
             params_q.put(
                 (
-                    jax.tree_util.tree_map(np.asarray, params),
-                    jax.tree_util.tree_map(np.asarray, opt_state) if want_opt_state else None,
-                    np.asarray(mean_losses),
+                    replicated_to_host(params),
+                    replicated_to_host(opt_state) if want_opt_state else None,
+                    replicated_to_host(mean_losses),
                 )
             )
     except BaseException as e:
@@ -160,9 +165,10 @@ def _trainer_loop(
 
 
 def _learner_process(fabric, cfg: Dict[str, Any]):
-    """Learner role of the TWO-PROCESS topology (reference trainer ranks,
-    sac_decoupled.py:356-545): its own jax.distributed process and local mesh;
-    replay blocks in, updated params out, over the host channels."""
+    """Learner role of the multi-process topology (reference trainer ranks,
+    sac_decoupled.py:356-545): one process of the learner SLICE, whose DP mesh
+    spans every learner process's devices; replay blocks in, updated params out,
+    over the host channels (all slice members run this same program)."""
     env = make_env(cfg, cfg.seed, 0, None, "learner")()
     observation_space = env.observation_space
     action_space = env.action_space
@@ -208,12 +214,11 @@ def main(fabric, cfg: Dict[str, Any]):
         cfg.algo.cnn_keys.encoder = []
 
     two_process = distributed.process_count() >= 2
-    if distributed.process_count() > 2:
-        raise ValueError(
-            "decoupled SAC currently supports exactly 2 jax.distributed processes "
-            "(player + learner); got {}".format(distributed.process_count())
-        )
     if two_process:
+        # process 0: player on its own devices; processes 1..N-1: learner slice
+        # sharing one DP mesh (reference trainer subgroup, sac_decoupled.py:548-588)
+        if distributed.process_index() >= 1:
+            fabric.process_group = tuple(range(1, distributed.process_count()))
         fabric.local_mesh = True
         fabric._setup()
         if distributed.process_index() >= 1:
@@ -224,8 +229,12 @@ def main(fabric, cfg: Dict[str, Any]):
     rank = fabric.global_rank
     world_size = fabric.world_size
 
-    # any player-side failure must release a learner blocked in a channel
+    # any player-side failure must release a learner blocked in a channel; the
+    # KV-backed channels are STATEFUL (sequence counters), so the crash path must
+    # reuse the live instances once they exist
     _protocol_done = False
+    data_q: Any = None
+    params_q: Any = None
     try:
         log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, share=not two_process)
         logger = get_logger(fabric, cfg, log_dir=log_dir)
@@ -289,8 +298,8 @@ def main(fabric, cfg: Dict[str, Any]):
 
         error: Dict[str, Any] = {}
         if two_process:
-            data_q: Any = BroadcastChannel(src=0)
-            params_q: Any = BroadcastChannel(src=1)
+            data_q = BroadcastChannel(src=0)
+            params_q = BroadcastChannel(src=1)
             trainer = None
             data_q.put({"player_world_size": world_size})  # geometry handshake
         else:
@@ -483,8 +492,10 @@ def main(fabric, cfg: Dict[str, Any]):
         # desynced and another lockstep collective would hang, not raise
         if two_process and not _protocol_done and not isinstance(e, ChannelError):
             try:
-                BroadcastChannel(src=0).put(None)
-                BroadcastChannel(src=1).get()
+                # the channels are stateful: reuse the live instances when the
+                # crash happened after their creation
+                (data_q if data_q is not None else BroadcastChannel(src=0)).put(None)
+                (params_q if params_q is not None else BroadcastChannel(src=1)).get()
             except Exception:
                 pass
         raise

@@ -11,7 +11,7 @@ algorithm code can call them unconditionally.
 from __future__ import annotations
 
 import pickle
-from typing import Any, List
+from typing import Any, Dict, List
 
 import numpy as np
 
@@ -51,16 +51,28 @@ def host_allsum(value: float) -> float:
     return float(np.asarray(out).sum())
 
 
+def _bucket(n: int) -> int:
+    """Round a payload size up to a power-of-two bucket (≥ 1 KiB). Collective
+    executables are shape-specialized and each NEW shape pays a cross-process
+    context rendezvous with a hard ~30 s key-value deadline (gloo on CPU);
+    bucketing makes repeated object broadcasts reuse one executable — and its
+    already-established context — across varying pickle sizes."""
+    b = 1024
+    while b < n:
+        b *= 2
+    return b
+
+
 def host_broadcast_object(obj: Any, src: int = 0) -> Any:
     if process_count() == 1:
         return obj
     from jax.experimental import multihost_utils
 
     payload = pickle.dumps(obj) if process_index() == src else b""
-    # length first (fixed shape), then padded payload
+    # length first (fixed shape), then bucket-padded payload
     length = np.asarray([len(payload)], dtype=np.int64)
     length = int(np.asarray(multihost_utils.broadcast_one_to_all(length, is_source=process_index() == src))[0])
-    buf = np.zeros(max(length, 1), dtype=np.uint8)
+    buf = np.zeros(_bucket(length), dtype=np.uint8)
     if process_index() == src:
         buf[:length] = np.frombuffer(payload, dtype=np.uint8)
     buf = np.asarray(multihost_utils.broadcast_one_to_all(buf, is_source=process_index() == src))
@@ -75,7 +87,7 @@ def host_allgather_object(obj: Any) -> List[Any]:
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     length = np.asarray([payload.size], dtype=np.int64)
     lengths = np.asarray(multihost_utils.process_allgather(length)).reshape(-1)
-    max_len = int(lengths.max())
+    max_len = _bucket(int(lengths.max()))
     buf = np.zeros(max_len, dtype=np.uint8)
     buf[: payload.size] = payload
     gathered = np.asarray(multihost_utils.process_allgather(buf))
@@ -90,32 +102,124 @@ def barrier(name: str = "barrier") -> None:
     multihost_utils.sync_global_devices(name)
 
 
+def coordination_barrier(name: str, timeout_s: float = 1800.0) -> None:
+    """Barrier over ALL jax.distributed processes via the coordination service
+    (gRPC). Unlike XLA collectives — whose context rendezvous has a hard ~30 s
+    deadline on the CPU gloo backend — this tolerates arbitrarily skewed arrival,
+    so MPMD roles use it to fence long one-sided work (e.g. a learner compiling
+    its train program for minutes) OUT of the lockstep channel protocol."""
+    if process_count() == 1:
+        return
+    from jax._src import distributed as _dist
+
+    client = getattr(_dist.global_state, "client", None)
+    if client is None:
+        return
+    client.wait_at_barrier(name, int(timeout_s * 1000))
+
+
+def replicated_to_host(tree: Any) -> Any:
+    """Host numpy copy of a pytree whose jax.Array leaves are REPLICATED — possibly
+    over a multi-process mesh, where ``np.asarray`` refuses non-addressable arrays
+    but every addressable shard already holds the full value. Sharded (non-replicated)
+    leaves would silently return one shard; callers own that invariant."""
+    import jax
+
+    def leaf(x: Any) -> Any:
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return np.asarray(x.addressable_data(0))
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 class ChannelError(RuntimeError):
-    """A collective underlying a :class:`BroadcastChannel` op failed. Once raised,
-    the lockstep broadcast plane is desynced: issuing another collective on the same
-    channel can block forever, so crash paths must NOT attempt further puts."""
+    """An operation underlying a :class:`BroadcastChannel` op failed. Once raised,
+    the lockstep plane may be desynced: issuing further ops on the same channel
+    can block until timeout, so crash paths must NOT attempt further puts."""
+
+
+_KV_CHUNK = 2 * 1024 * 1024  # stay under gRPC message-size defaults
+
+
+def _kv_client():
+    from jax._src import distributed as _dist
+
+    return getattr(_dist.global_state, "client", None)
 
 
 class BroadcastChannel:
-    """A cross-process channel with a queue's ``put``/``get`` surface, carried by
-    lockstep ``host_broadcast_object`` collectives from a fixed source process.
-    The MPMD decoupled topologies use one per plane (data: src=player, weights:
-    src=learner); a blocking ``get`` preserves the reference's synchronous
-    alternation (sheeprl/algos/ppo/ppo_decoupled.py:294-305)."""
+    """A cross-process channel with a queue's ``put``/``get`` surface for the MPMD
+    object plane (data: src=player, weights: src=learner); a blocking ``get``
+    preserves the reference's synchronous alternation
+    (sheeprl/algos/ppo/ppo_decoupled.py:294-305).
+
+    Carried by the jax.distributed COORDINATION SERVICE key-value store (gRPC),
+    not by XLA collectives: a gloo-backed broadcast pays a fresh communicator
+    rendezvous with a hard ~30 s deadline on every op, which an MPMD topology
+    breaks the moment one role works >30 s between rounds (a learner compiling
+    its train program, a big G-step round). The KV plane tolerates arbitrary
+    skew: the source writes chunked payloads then a manifest; receivers block on
+    the manifest (long timeout) and reassemble. The source garbage-collects the
+    previous round's keys before writing — the blocking alternation guarantees
+    every receiver has consumed round k-1 before the source enters round k."""
+
+    _TIMEOUT_S = 1800.0
+    # per-process count of channels created per src: namespaces the keyspace so a
+    # SECOND channel with the same src in one jax.distributed session (a later
+    # decoupled run in the same interpreter) neither hits ALREADY_EXISTS on the
+    # un-GC'd final rounds of the first nor reads its stale payloads. Stays
+    # aligned across processes because every process creates its channels at the
+    # same protocol-mandated points.
+    _instances_per_src: Dict[int, int] = {}
 
     def __init__(self, src: int) -> None:
         self.src = src
+        self._seq = 0
+        self._nonce = BroadcastChannel._instances_per_src.get(src, 0)
+        BroadcastChannel._instances_per_src[src] = self._nonce + 1
+
+    def _tag(self, seq: int) -> str:
+        return f"sheeprl_chan/i{self._nonce}/src{self.src}/{seq}"
 
     def put(self, msg: Any) -> None:
-        # BaseException on purpose: a KeyboardInterrupt mid-collective desyncs the
-        # plane exactly like an error does; the original exception rides __cause__
+        # BaseException on purpose: a KeyboardInterrupt mid-op desyncs the plane
+        # exactly like an error does; the original exception rides __cause__
         try:
-            host_broadcast_object(msg, src=self.src)
+            if process_count() == 1:
+                raise RuntimeError("BroadcastChannel requires jax.distributed (use queue.Queue in-process)")
+            client = _kv_client()
+            if process_index() == self.src:
+                payload = pickle.dumps(msg)
+                # GC with a TWO-round lag: consumption of round k-1 is guaranteed
+                # by the blocking alternation once the first full round completes,
+                # but the very first put (e.g. the geometry handshake) has no ack —
+                # receivers may not have read round 0 when round 1 is written.
+                if self._seq > 1:
+                    client.key_value_delete(self._tag(self._seq - 2) + "/")
+                tag = self._tag(self._seq)
+                n = max(1, -(-len(payload) // _KV_CHUNK))
+                for i in range(n):
+                    client.key_value_set_bytes(f"{tag}/c{i}", payload[i * _KV_CHUNK : (i + 1) * _KV_CHUNK])
+                client.key_value_set(f"{tag}/n", str(n))
+            self._seq += 1
         except BaseException as e:
-            raise ChannelError(f"broadcast put (src={self.src}) failed") from e
+            raise ChannelError(f"channel put (src={self.src}) failed") from e
 
     def get(self) -> Any:
         try:
-            return host_broadcast_object(None, src=self.src)
+            if process_count() == 1:
+                raise RuntimeError("BroadcastChannel requires jax.distributed (use queue.Queue in-process)")
+            client = _kv_client()
+            if process_index() == self.src:
+                raise RuntimeError("the channel source must put, not get")
+            tag = self._tag(self._seq)
+            timeout_ms = int(self._TIMEOUT_S * 1000)
+            n = int(client.blocking_key_value_get(f"{tag}/n", timeout_ms))
+            payload = b"".join(
+                client.blocking_key_value_get_bytes(f"{tag}/c{i}", timeout_ms) for i in range(n)
+            )
+            self._seq += 1
+            return pickle.loads(payload)
         except BaseException as e:
-            raise ChannelError(f"broadcast get (src={self.src}) failed") from e
+            raise ChannelError(f"channel get (src={self.src}) failed") from e

@@ -42,8 +42,14 @@ class Fabric:
     ) -> None:
         # local_mesh=True restricts the mesh to THIS process's devices — the MPMD
         # role topology (player process / learner process run different programs on
-        # their own devices); False keeps the global SPMD mesh across processes
+        # their own devices); False keeps the global SPMD mesh across processes.
+        # process_group (set post-init by decoupled topologies) overrides both: the
+        # mesh spans the devices of THOSE processes — the learner-slice DP mesh
+        # (reference trainer DDP subgroup, sheeprl/algos/ppo/ppo_decoupled.py:645-666).
+        # Every process in the group must run the same jitted programs (multi-
+        # controller SPMD); processes outside the group never touch this mesh.
         self.local_mesh = local_mesh
+        self.process_group: Optional[Sequence[int]] = None
         self.requested_devices = devices
         self.num_nodes = num_nodes
         self.strategy = strategy
@@ -128,23 +134,51 @@ class Fabric:
             all_devices = jax.devices(platform)
         except RuntimeError:
             all_devices = jax.devices()
-        if self.local_mesh:
-            all_devices = [d for d in all_devices if d.process_index == jax.process_index()]
-        n = self.requested_devices
-        if n in ("auto", -1, "-1", None):
-            n = len(all_devices)
-        n = int(n)
-        if n > len(all_devices):
-            raise RuntimeError(
-                f"requested {n} devices but only {len(all_devices)} {platform} devices are "
-                "available; for CPU-simulated meshes set "
-                "XLA_FLAGS=--xla_force_host_platform_device_count=N"
-            )
-        mesh_devices = np.asarray(all_devices[:n])
+        if self.process_group is not None:
+            # A process-group mesh spans every member process; ``devices`` counts
+            # devices PER PROCESS (each member contributes the same number, so the
+            # mesh is n × len(group) and every member owns a local slice of it).
+            group = sorted(set(self.process_group))
+            if jax.process_index() not in group:
+                raise RuntimeError(
+                    f"process {jax.process_index()} built a process_group mesh "
+                    f"{group} it does not belong to"
+                )
+            per = self.requested_devices
+            per = None if per in ("auto", -1, "-1", None) else int(per)
+            selected: List[jax.Device] = []
+            for p in group:
+                devs = [d for d in all_devices if d.process_index == p]
+                if per is not None:
+                    if per > len(devs):
+                        raise RuntimeError(
+                            f"requested {per} devices per process but process {p} has "
+                            f"only {len(devs)} {platform} devices"
+                        )
+                    devs = devs[:per]
+                selected.extend(devs)
+            mesh_devices = np.asarray(selected)
+        else:
+            if self.local_mesh:
+                all_devices = [d for d in all_devices if d.process_index == jax.process_index()]
+            n = self.requested_devices
+            if n in ("auto", -1, "-1", None):
+                n = len(all_devices)
+            n = int(n)
+            if n > len(all_devices):
+                raise RuntimeError(
+                    f"requested {n} devices but only {len(all_devices)} {platform} devices are "
+                    "available; for CPU-simulated meshes set "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count=N"
+                )
+            mesh_devices = np.asarray(all_devices[:n])
         self._mesh = Mesh(mesh_devices, axis_names=("data",))
         # make uncommitted computations follow the selected accelerator (otherwise a
-        # `fabric.accelerator=cpu` run would still trace onto a default TPU device)
-        jax.config.update("jax_default_device", all_devices[0])
+        # `fabric.accelerator=cpu` run would still trace onto a default TPU device);
+        # the default must be a LOCAL device — a process_group mesh interleaves
+        # other processes' devices
+        local = [d for d in mesh_devices.reshape(-1) if d.process_index == jax.process_index()]
+        jax.config.update("jax_default_device", (local or list(mesh_devices.reshape(-1)))[0])
 
     def launch(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
         """Run ``fn(self, *args)`` with the mesh set up. Unlike torch DDP there is no

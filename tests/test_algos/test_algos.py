@@ -469,6 +469,7 @@ def _p2e_tiny(version):
     return args
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("version", [1, 2, 3])
 def test_p2e_exploration_then_finetuning(standard_args, version):
     import glob

@@ -62,6 +62,7 @@ def test_dmc_through_make_env():
     env.close()
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(280)
 def test_dreamer_v3_trains_on_dmc_pixels():
     """Full-system check on a REAL pixel env: Dreamer-V3 runs its act+train loop on

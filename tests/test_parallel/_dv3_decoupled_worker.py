@@ -1,0 +1,56 @@
+"""Worker for the multi-process decoupled Dreamer-V3 tests: process 0 is the
+env-host player, processes 1..N-1 the learner slice."""
+
+import json
+import sys
+
+
+def main() -> None:
+    coordinator, num_processes, process_id, out_path = sys.argv[1:5]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator, int(num_processes), int(process_id))
+
+    from sheeprl_tpu.cli import run
+
+    run(
+        [
+            "exp=dreamer_v3_decoupled",
+            "env=dummy",
+            "dry_run=True",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.save_last=True",
+            "buffer.memmap=False",
+            "env.num_envs=2",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=1",
+            "algo.learning_starts=0",
+            "algo.replay_ratio=1",
+            "algo.horizon=8",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.mlp_keys.decoder=[state]",
+            "algo.run_test=False",
+            "root_dir=dv3dec",
+            "run_name=proc",
+        ]
+    )
+    with open(out_path, "w") as f:
+        json.dump({"process": int(process_id), "ok": True}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,25 @@ def main() -> None:
 
     distributed.barrier("object-plane-test")
 
+    # KV-backed MPMD channels (stateful sequence counters, asymmetric roles):
+    # three rounds each way — incl. a payload spanning multiple KV chunks —
+    # plus the coordination barrier that fences long one-sided work
+    down = distributed.BroadcastChannel(src=0)  # rank0 -> others
+    up = distributed.BroadcastChannel(src=1)  # rank1 -> others
+    channel_log = []
+    big = "b" * (3 * 1024 * 1024)  # > _KV_CHUNK: exercises chunked reassembly
+    for rnd, payload in enumerate(["small", big, {"round": 2}]):
+        if process_id == 0:
+            down.put(payload)
+            echoed = up.get()
+        else:
+            got = down.get()
+            up.put(got)
+            echoed = got
+        ok = (echoed == payload) if process_id == 0 else (got == payload)
+        channel_log.append(bool(ok))
+    distributed.coordination_barrier("object-plane-channel-done", timeout_s=120)
+
     with open(out_path, "w") as f:
         json.dump(
             {
@@ -53,6 +72,7 @@ def main() -> None:
                 "gathered_ranks": [g["rank"] for g in gathered],
                 "total": total,
                 "log_dir": log_dir,
+                "channel_roundtrips": channel_log,
             },
             f,
         )

@@ -55,6 +55,8 @@ def test_object_plane_two_processes(tmp_path):
         assert r["bcast"] == {"rank": 0, "nested": [1, 2, {"x": "y"}]}
         assert r["gathered_ranks"] == [0, 1]
         assert r["total"] == 3.0
+        # KV channel: three round-trips each way, incl. a multi-chunk payload
+        assert r["channel_roundtrips"] == [True, True, True]
     # both ranks agreed on the rank-0-created log dir
     assert results[0]["log_dir"] == results[1]["log_dir"]
     assert os.path.isdir(tmp_path / results[0]["log_dir"])
