@@ -7,10 +7,19 @@ data-axis sharding) execute on a true multi-device mesh without TPU hardware.
 """
 
 import os
+import sys
+import types
 
 # must happen before jax initializes any backend
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Force tensorboard's TF *stub*: `tensorboard.compat.tf` falls back to the stub
+# iff `tensorboard.compat.notf` is importable. Without this, the learning-gate
+# tests' EventAccumulator lazily imports the REAL tensorflow into a process that
+# already loaded torch — which segfaults (absl/protobuf symbol clash) and takes
+# the whole pytest process down at ~51% of the suite.
+sys.modules.setdefault("tensorboard.compat.notf", types.ModuleType("tensorboard.compat.notf"))
 
 import jax
 

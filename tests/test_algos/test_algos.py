@@ -282,6 +282,21 @@ def test_dreamer_v3_devices2(standard_args):
     _run(standard_args + _DV3_TINY + ["fabric.devices=2"])
 
 
+def test_dreamer_v3_decoupled_thread_mode(standard_args):
+    """Single-process decoupled DV3: player loop + learner thread over queue
+    channels, deferred-checkpoint protocol with the final-state handshake
+    (dreamer_v3_decoupled.py). The true multi-process topologies are covered by
+    tests/test_parallel/test_decoupled_two_process.py (slow tier)."""
+    import glob
+
+    _run(
+        standard_args
+        + [a for a in _DV3_TINY if a != "exp=dreamer_v3"]
+        + ["exp=dreamer_v3_decoupled", "checkpoint.save_last=True", "root_dir=dv3dect", "run_name=t"]
+    )
+    assert glob.glob("logs/runs/dv3dect/**/ckpt_*.ckpt", recursive=True)
+
+
 _ODV3_TINY = [
     "exp=offline_dreamer",
     "env=dummy",
@@ -313,6 +328,7 @@ def test_offline_dreamer(standard_args, env_id):
     _run(standard_args + _ODV3_TINY + [f"env.id={env_id}"])
 
 
+@pytest.mark.slow
 def test_offline_dreamer_devices2(standard_args):
     _run(standard_args + _ODV3_TINY + ["fabric.devices=2"])
 

@@ -30,7 +30,14 @@ _TINY_DREAMER = [
 
 
 @pytest.mark.timeout(240)
-@pytest.mark.parametrize("algo", ["dreamer_v1", "dreamer_v2", "dreamer_v3"])
+@pytest.mark.parametrize(
+    "algo",
+    [
+        pytest.param("dreamer_v1", marks=pytest.mark.slow),
+        pytest.param("dreamer_v2", marks=pytest.mark.slow),
+        "dreamer_v3",
+    ],
+)
 def test_dreamer_family_bf16(standard_args, algo):
     extra = ["algo.world_model.discrete_size=4"] if algo != "dreamer_v1" else []
     if algo == "dreamer_v3":

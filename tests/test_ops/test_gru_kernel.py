@@ -82,6 +82,7 @@ def test_vmem_budget_gate():
     assert not pallas_gru_applicable(12288, 4096)  # XL falls back to XLA
 
 
+@pytest.mark.slow
 def test_gradients_flow_through_module():
     from sheeprl_tpu.models.models import LayerNormGRUCell
 

@@ -79,6 +79,7 @@ def test_decoupled_sac_player_plus_two_learners(tmp_path):
     _run_workers(_SAC_WORKER, 3, tmp_path, "logs/runs/sacdec2p/sac/**/ckpt_*.ckpt", timeout=400)
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(420)
 def test_decoupled_dreamer_v3_two_processes(tmp_path):
     """Decoupled Dreamer-V3 (no reference counterpart — BASELINE.md's north-star

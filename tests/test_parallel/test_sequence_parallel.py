@@ -43,6 +43,7 @@ def test_ring_scan_matches_lax_scan(S):
     np.testing.assert_allclose(np.asarray(sums), np.asarray(ref_s), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_ring_scan_gradient_parity():
     """Backward pass through the ring (cond/fori_loop/ppermute) must match the
     plain scan's gradients — the memory-saving claim is about the BACKWARD pass."""
@@ -83,6 +84,7 @@ def test_ring_scan_accepts_sharded_inputs():
     np.testing.assert_allclose(np.asarray(hs), np.asarray(ref_h), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_dv3_dynamic_scan_sp_parity():
     """The Dreamer-V3 world-model unroll over a sequence-sharded mesh equals the
     single-device dynamic_scan bit-for-bit (same PRNG folding)."""

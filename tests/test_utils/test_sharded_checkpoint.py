@@ -68,6 +68,7 @@ def test_async_save_lands(tmp_path):
 
 
 @pytest.mark.timeout(280)
+@pytest.mark.slow
 def test_dreamer_v3_sharded_checkpoint_resume_devices2(standard_args):
     """Full path: DV3 trains at devices=2 with the sharded backend, writes an orbax
     checkpoint directory, and a resumed run restores from it."""
