@@ -63,6 +63,55 @@ def test_ppo_cartpole_learns():
 @pytest.mark.slow
 @pytest.mark.learning
 @pytest.mark.timeout(240)
+def test_a2c_cartpole_learns():
+    """A2C clears a learning bar on CartPole-v1 (less sample-efficient than PPO,
+    so the bar is lower but still far above the ~20 of a random policy)."""
+    run(
+        [
+            "exp=a2c",
+            "fabric.accelerator=cpu",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "checkpoint.save_last=False",
+            "metric.log_level=1",
+            "metric.log_every=8192",
+            "algo.total_steps=32768",
+        ]
+    )
+    series = _scalar_series(_version_dir("a2c"), "Test/cumulative_reward")
+    reward = series[-1][1]
+    assert reward >= 120.0, f"A2C did not learn CartPole: greedy test reward {reward} < 120"
+
+
+@pytest.mark.slow
+@pytest.mark.learning
+@pytest.mark.timeout(300)
+def test_ppo_decoupled_cartpole_learns():
+    """The DECOUPLED topology preserves learning: the same CartPole bar as the
+    coupled PPO gate, trained through the player-loop + learner-thread channel
+    protocol (single-process thread mode of ppo_decoupled)."""
+    run(
+        [
+            "exp=ppo_decoupled",
+            "fabric.accelerator=cpu",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "checkpoint.save_last=False",
+            "metric.log_level=1",
+            "metric.log_every=2048",
+            "algo.total_steps=16384",
+        ]
+    )
+    series = _scalar_series(_version_dir("ppo_decoupled"), "Test/cumulative_reward")
+    reward = series[-1][1]
+    assert reward >= 195.0, f"decoupled PPO did not solve CartPole: greedy test reward {reward} < 195"
+
+
+@pytest.mark.slow
+@pytest.mark.learning
+@pytest.mark.timeout(240)
 def test_dreamer_v3_world_model_loss_decreases():
     """Tiny DV3 world model overfits deterministic dummy pixels: reconstruction
     and total world-model losses must drop materially from the first logged
