@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.models.models import LayerNormGRUCell, resolve_activation
+from sheeprl_tpu.ops.conv import FastConv2x
 from sheeprl_tpu.ops.deconv import FusedConvTransposeS2Valid
 from sheeprl_tpu.utils.distribution import TruncatedNormal
 
@@ -75,14 +76,15 @@ class CNNEncoder(nn.Module):
         lead = x.shape[:-3]
         x = x.reshape(-1, *x.shape[-3:])
         x = jnp.moveaxis(x, -3, -1).astype(self.dtype)
-        for mult in (1, 2, 4, 8):
-            x = nn.Conv(
-                mult * self.channels_multiplier,
-                (4, 4),
-                strides=(2, 2),
-                padding="VALID",
+        for i, mult in enumerate((1, 2, 4, 8)):
+            # CPU fast-gradient stride-2 conv (ops/conv.py; TPU keeps the native
+            # lowering); explicit name keeps nn.Conv's parameter tree
+            x = FastConv2x(
+                features=mult * self.channels_multiplier,
+                kernel_size=4,
                 use_bias=not self.layer_norm,
                 dtype=self.dtype,
+                name=f"Conv_{i}",
             )(x)
             if self.layer_norm:
                 x = nn.LayerNorm(epsilon=1e-3, dtype=self.dtype)(x)

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.ops.conv import FastConv2x
+
 ModuleType = Optional[str]
 ArgType = Union[Tuple[Any, ...], Dict[str, Any], None]
 
@@ -110,7 +112,15 @@ class CNN(nn.Module):
             else:
                 p = self.paddings[i] if not isinstance(self.paddings, int) else self.paddings
                 padding = [(p, p), (p, p)]
-            x = nn.Conv(ch, (k, k), strides=(s, s), padding=padding, dtype=self.dtype)(x)
+            # stride-2 VALID even-k convs (the Dreamer-V1/V2 encoder stages) take
+            # the CPU fast-gradient decomposition (ops/conv.py; TPU keeps the
+            # native conv). Explicit names keep the nn.Conv parameter tree.
+            if padding == "VALID" and s == 2 and k % 2 == 0:
+                x = FastConv2x(features=ch, kernel_size=k, dtype=self.dtype, name=f"Conv_{i}")(x)
+            else:
+                x = nn.Conv(
+                    ch, (k, k), strides=(s, s), padding=padding, dtype=self.dtype, name=f"Conv_{i}"
+                )(x)
             if self.layer_norm:
                 x = nn.LayerNorm(dtype=self.dtype, epsilon=1e-3)(x)  # NHWC: normalize channels
             x = act(x)

@@ -1,0 +1,176 @@
+"""Fast-gradient stride-2 VALID convolution (the Dreamer encoder's hot op).
+
+XLA:CPU's lowering of small-channel convolutions is pathological at Dreamer
+replay-batch scale (T*B ≈ thousands of 64x64 frames, 2-32 channels): the
+forward runs at ~1 GFLOP/s on a core whose sgemm peak is >100, the input
+gradient lowers to a slow input-dilated convolution, and the weight gradient
+first PERMUTES the whole activation tensor to [C, H, W, N] — measured 652 ms
+for the first encoder layer alone at the DV1 benchmark shapes (see
+PERF_ANALYSIS.md). None of this is FLOP-bound; it is layout and loop overhead.
+
+For stride-2 VALID convolutions (the reference Dreamer encoders:
+sheeprl/algos/dreamer_v1/agent.py k=4 s=2, dreamer_v2 the same) every piece
+decomposes into bandwidth-friendly primitives:
+
+- space-to-depth once: x[N,H,W,C] -> [N,H/2,W/2,4C] (one cheap rearrange), so
+  the stride-2 k x k conv becomes a STRIDE-1 (k/2) x (k/2) conv with 4x the
+  input channels — a shape XLA:CPU executes near bandwidth;
+- forward and input grad: plain stride-1 VALID convs (the input grad is the
+  full conv with the flipped, io-swapped kernel — no input dilation);
+- weight grad: (k/2)^2 CONTIGUOUS tap slices of the s2d tensor, each one
+  tall-skinny matmul [4Cin, N*H'*W'] x [N*H'*W', Cout] — the CHWN permute
+  never materializes.
+
+The trick is packaged as a ``jax.custom_vjp`` and — like the fused deconv and
+the Pallas GRU — selected per lowering platform: CPU gets the decomposition,
+every other backend (TPU lowers all three conv forms onto the MXU natively)
+keeps ``lax.conv_general_dilated``. ``SHEEPRL_DISABLE_FAST_CONV=1`` forces the
+native form everywhere. Values and gradients are parity-tested against
+``nn.Conv`` (tests/test_ops/test_fast_conv.py); ``FastConv2x`` keeps
+``nn.Conv``'s exact parameter tree so checkpoints are drop-in compatible.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _fast_conv_enabled() -> bool:
+    return os.environ.get("SHEEPRL_DISABLE_FAST_CONV", "0") != "1"
+
+
+def _native_conv(x, w):
+    return lax.conv_general_dilated(
+        x, w, (2, 2), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+def _space_to_depth(x):
+    """[N, H, W, C] -> [N, H/2, W/2, 4C], 2x2 blocks into channels (r, c, ci)."""
+    n, h, w, c = x.shape
+    return (
+        x.reshape(n, h // 2, 2, w // 2, 2, c).transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+    )
+
+
+def _pack_kernel(w):
+    """[k, k, Cin, Cout] -> [k/2, k/2, 4*Cin, Cout] matching _space_to_depth's
+    (r, c, ci) channel order; exact for even k, stride 2."""
+    k = w.shape[0]
+    return jnp.stack([w[r::2, c::2] for r in range(2) for c in range(2)], axis=2).reshape(
+        k // 2, k // 2, 4 * w.shape[2], w.shape[3]
+    )
+
+
+def _conv_s1_valid(x, w):
+    return lax.conv_general_dilated(
+        x, w, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+# custom vjp over the SPACE-TO-DEPTH-domain stride-1 convolution: fwd and input
+# grad are plain stride-1 VALID convs (fast on CPU); the weight grad replaces
+# XLA's CHWN-permute-plus-conv with k2*k2 CONTIGUOUS tap slices, each one
+# tall-skinny matmul.
+@jax.custom_vjp
+def _s1_conv(xs, w2):
+    return _conv_s1_valid(xs, w2)
+
+
+def _s1_conv_fwd(xs, w2):
+    return _conv_s1_valid(xs, w2), (xs, w2)
+
+
+def _s1_conv_bwd(res, g):
+    """Both gradients from ONE shared tensor G of the k2*k2 zero-padded shifts
+    of g at xs's spatial extent (g is the SMALL tensor — Cout channels at output
+    resolution — so shifting it beats slicing xs k2^2 times by ~an order of
+    magnitude of traffic):
+
+        G[n, H, W, (a, b, d)] = g[n, H-a, W-b, d]   (zero outside)
+        dxs[n, H, W, c] = G[n, H, W] . w2[a, b, c, d]  over (a, b, d)
+        dw2[a, b, c, d] = xs[:, :, :, c] . G[:, :, :, (a, b, d)]  over (n, H, W)
+
+    — two tall-skinny matmuls, no CHWN permute, no input-dilated conv."""
+    xs, w2 = res
+    k2, _, c2, cout = w2.shape
+    n, h2, w2_sp, _ = xs.shape
+    ho, wo = g.shape[1], g.shape[2]
+
+    shifts = []
+    for a in range(k2):
+        for b in range(k2):
+            shifts.append(jnp.pad(g, ((0, 0), (a, h2 - ho - a), (b, w2_sp - wo - b), (0, 0))))
+    G = jnp.concatenate(shifts, axis=-1).reshape(-1, k2 * k2 * cout)  # [n*h2*w2_sp, k2*k2*Cout]
+
+    # dxs: [n*h2*w2_sp, k2*k2*Cout] x [k2*k2*Cout, Cin']
+    w_flat = w2.transpose(0, 1, 3, 2).reshape(k2 * k2 * cout, c2)
+    dxs = jnp.dot(G, w_flat).reshape(n, h2, w2_sp, c2)
+
+    # dw2: [Cin', n*h2*w2_sp] x [n*h2*w2_sp, k2*k2*Cout]
+    dw_flat = jnp.dot(xs.reshape(-1, c2).T, G)  # [Cin', k2*k2*Cout]
+    dw2 = dw_flat.reshape(c2, k2, k2, cout).transpose(1, 2, 0, 3)
+    return dxs, dw2
+
+
+_s1_conv.defvjp(_s1_conv_fwd, _s1_conv_bwd)
+
+
+def _fast_conv(x, w):
+    """Stride-2 VALID conv of NHWC x with HWIO w (even k) in s2d form. The s2d
+    rearranges and the final slice are plain jax ops (autodiff handles them);
+    only the inner stride-1 conv carries the custom vjp."""
+    k = w.shape[0]
+    n, h, w_sp, _ = x.shape
+    ho, wo = (h - k) // 2 + 1, (w_sp - k) // 2 + 1
+    # pad odd extents to even for the 2x2 blocking; the padded tail only feeds
+    # conv outputs beyond (ho, wo), which the final slice drops
+    xe = jnp.pad(x, ((0, 0), (0, h % 2), (0, w_sp % 2), (0, 0)))
+    xs = _space_to_depth(xe)
+    y = _s1_conv(xs, _pack_kernel(w))
+    return y[:, :ho, :wo, :]
+
+
+class FastConv2x(nn.Module):
+    """Drop-in for ``nn.Conv(features, (k, k), strides=(2, 2), padding="VALID")``
+    on NHWC inputs, with the CPU fast-gradient decomposition. Identical parameter
+    tree ('kernel' [k, k, Cin, features], optional 'bias' [features])."""
+
+    features: int
+    kernel_size: int
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+    dtype: Any = jnp.float32
+    # The decomposition wins where XLA:CPU's conv is layout/overhead bound:
+    # SMALL input channels over LARGE spatial maps (Dreamer encoder stages,
+    # measured 2.2x). At compute-bound shapes it LOSES (NatureCNN's 32->64
+    # k4-s2 layer measured ~0.5x) — those stay on the native lowering.
+    max_fast_cin: int = 8
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if x.ndim < 4:
+            raise ValueError(f"expected [..., H, W, C] input, got shape {x.shape}")
+        # nn.Conv semantics: arbitrary leading batch dims flatten to one
+        lead = x.shape[:-3]
+        x = x.reshape(-1, *x.shape[-3:])
+        k = int(self.kernel_size)
+        c_in = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init, (k, k, c_in, self.features), jnp.float32)
+        kernel = kernel.astype(self.dtype)
+        x = x.astype(self.dtype)
+        if _fast_conv_enabled() and k % 2 == 0 and c_in <= self.max_fast_cin:
+            out = jax.lax.platform_dependent(x, kernel, cpu=_fast_conv, default=_native_conv)
+        else:
+            out = _native_conv(x, kernel)
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features,), jnp.float32)
+            out = out + bias.astype(self.dtype)
+        return out.reshape(*lead, *out.shape[-3:])

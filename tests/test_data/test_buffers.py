@@ -245,3 +245,38 @@ class TestMemmapArray:
         arr[:] = 2.0
         out = arr * 3
         np.testing.assert_allclose(out, [6.0, 6.0, 6.0])
+
+
+def test_pickle_size_is_fill_proportional_not_capacity():
+    """Checkpointing a barely-filled preallocated buffer must serialize the
+    filled prefix, not the capacity (a 5M-capacity Dreamer buffer pickled ~60 GB
+    for a 320-step run before this guard)."""
+    import pickle
+
+    rb = ReplayBuffer(500_000, 2, obs_keys=("observations",))
+    data = {
+        "observations": np.random.rand(40, 2, 24).astype(np.float32),
+        "rewards": np.random.rand(40, 2, 1).astype(np.float32),
+    }
+    rb.add(data)
+    blob = pickle.dumps(rb)
+    # 40 rows * 2 envs * 25 floats ≈ 8 KB; capacity would be ~100 MB
+    assert len(blob) < 1_000_000, f"pickle is capacity-sized: {len(blob)} bytes"
+    restored = pickle.loads(blob)
+    assert restored.buffer_size == 500_000
+    np.testing.assert_array_equal(restored["observations"][:40], data["observations"])
+    # the restored buffer keeps working: cursor intact, add + sample fine
+    restored.add(data)
+    sample = restored.sample(16, n_samples=2)
+    assert sample["observations"].shape[:2] == (2, 16)
+
+
+def test_pickle_full_buffer_roundtrips_whole_contents():
+    import pickle
+
+    rb = ReplayBuffer(8, 1, obs_keys=("observations",))
+    rb.add({"observations": np.arange(24, dtype=np.float32).reshape(12, 1, 2)})
+    assert rb.full
+    restored = pickle.loads(pickle.dumps(rb))
+    np.testing.assert_array_equal(restored["observations"], rb["observations"])
+    assert restored.full

@@ -1,0 +1,71 @@
+"""Numerical parity of the CPU fast-gradient stride-2 VALID convolution
+(ops/conv.py) against ``nn.Conv`` — values, weight gradients, bias gradients and
+input gradients, across the Dreamer encoder shapes (even k, incl. extents whose
+VALID coverage ends short of the input) plus the odd-k fallback path."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.ops.conv import FastConv2x
+
+SHAPES = [
+    (64, 4, 3, 8),  # DV1/DV2 encoder stage 1
+    (31, 4, 8, 16),  # stage 2: odd extent, last row unused by VALID
+    (14, 4, 16, 4),  # stage 3
+    (6, 4, 8, 2),  # stage 4
+    (10, 6, 2, 3),  # larger even kernel
+    (9, 3, 4, 6),  # odd kernel -> native fallback branch
+]
+
+
+@pytest.mark.parametrize("h,k,ci,co", SHAPES)
+def test_values_and_gradients_match_nn_conv(h, k, ci, co):
+    rng = np.random.default_rng(h * 100 + k)
+    x = jnp.asarray(rng.normal(size=(5, h, h, ci)).astype(np.float32))
+    ref = nn.Conv(co, (k, k), strides=(2, 2), padding="VALID")
+    fast = FastConv2x(features=co, kernel_size=k)
+    params = ref.init(jax.random.PRNGKey(1), x)
+
+    y_ref = ref.apply(params, x)
+    y_fast = fast.apply(params, x)  # same parameter tree: drop-in
+    np.testing.assert_allclose(y_fast, y_ref, atol=1e-5, rtol=1e-5)
+
+    # a non-uniform cotangent so gradient errors cannot cancel
+    cot = jnp.cos(jnp.arange(y_ref.size, dtype=jnp.float32).reshape(y_ref.shape))
+
+    def loss(module):
+        return lambda p, x: (module.apply(p, x) * cot).sum()
+
+    g_ref = jax.grad(loss(ref), argnums=(0, 1))(params, x)
+    g_fast = jax.grad(loss(fast), argnums=(0, 1))(params, x)
+    np.testing.assert_allclose(
+        g_fast[0]["params"]["kernel"], g_ref[0]["params"]["kernel"], atol=2e-4, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        g_fast[0]["params"]["bias"], g_ref[0]["params"]["bias"], atol=1e-4, rtol=1e-4
+    )
+    np.testing.assert_allclose(g_fast[1], g_ref[1], atol=1e-4, rtol=1e-4)
+
+
+def test_escape_hatch_forces_native(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_DISABLE_FAST_CONV", "1")
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 16, 16, 3)).astype(np.float32))
+    fast = FastConv2x(features=4, kernel_size=4)
+    ref = nn.Conv(4, (4, 4), strides=(2, 2), padding="VALID")
+    p = ref.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_allclose(fast.apply(p, x), ref.apply(p, x), atol=1e-5, rtol=1e-5)
+
+
+def test_bf16_compute_dtype_runs():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 16, 16, 3)).astype(np.float32))
+    fast = FastConv2x(features=4, kernel_size=4, dtype=jnp.bfloat16)
+    p = fast.init(jax.random.PRNGKey(0), x)
+    y = fast.apply(p, x)
+    assert y.dtype == jnp.bfloat16
+    g = jax.grad(lambda p: fast.apply(p, x).astype(jnp.float32).sum())(p)
+    assert jnp.isfinite(g["params"]["kernel"].astype(jnp.float32)).all()
