@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.models.models import LayerNormGRUCell, resolve_activation
+from sheeprl_tpu.ops.conv import FastConv2x
 from sheeprl_tpu.ops.deconv import FusedConvTranspose4x4S2
 from sheeprl_tpu.utils.utils import symlog
 
@@ -101,14 +102,17 @@ class CNNEncoder(nn.Module):
         x = x.reshape(-1, *x.shape[-3:])
         x = jnp.moveaxis(x, -3, -1).astype(self.dtype)  # NCHW -> NHWC
         for i in range(self.stages):
-            x = nn.Conv(
-                (2**i) * self.channels_multiplier,
-                (4, 4),
-                strides=(2, 2),
-                padding=[(1, 1), (1, 1)],
+            # CPU fast-gradient stride-2 conv (ops/conv.py; pad-1 folds into the
+            # pre-pad); explicit name keeps nn.Conv's parameter tree. TPU keeps
+            # the native MXU conv.
+            x = FastConv2x(
+                features=(2**i) * self.channels_multiplier,
+                kernel_size=4,
+                padding=1,
                 use_bias=False,
                 kernel_init=hafner_init,
                 dtype=self.dtype,
+                name=f"Conv_{i}",
             )(x)
             x = nn.LayerNorm(epsilon=self.eps, dtype=self.dtype)(x)
             x = act(x)

@@ -107,16 +107,23 @@ class CNN(nn.Module):
             x = jnp.moveaxis(x, -3, -1)  # NCHW -> NHWC
         x = x.astype(self.dtype)
         for i, (ch, k, s) in enumerate(zip(self.channels, self.kernel_sizes, self.strides)):
+            # sym_pad: the symmetric per-side padding when expressible as one int
+            # (every non-string config here is), else None (e.g. "SAME")
             if isinstance(self.paddings, str):
                 padding = self.paddings
+                sym_pad = 0 if padding == "VALID" else None
             else:
                 p = self.paddings[i] if not isinstance(self.paddings, int) else self.paddings
                 padding = [(p, p), (p, p)]
-            # stride-2 VALID even-k convs (the Dreamer-V1/V2 encoder stages) take
-            # the CPU fast-gradient decomposition (ops/conv.py; TPU keeps the
-            # native conv). Explicit names keep the nn.Conv parameter tree.
-            if padding == "VALID" and s == 2 and k % 2 == 0:
-                x = FastConv2x(features=ch, kernel_size=k, dtype=self.dtype, name=f"Conv_{i}")(x)
+                sym_pad = p
+            # stride-2 even-k convs with VALID or symmetric-int padding (the
+            # Dreamer encoder stages) take the CPU fast-gradient decomposition
+            # (ops/conv.py; TPU keeps the native conv). Explicit names keep the
+            # nn.Conv parameter tree.
+            if sym_pad is not None and s == 2 and k % 2 == 0:
+                x = FastConv2x(
+                    features=ch, kernel_size=k, padding=sym_pad, dtype=self.dtype, name=f"Conv_{i}"
+                )(x)
             else:
                 x = nn.Conv(
                     ch, (k, k), strides=(s, s), padding=padding, dtype=self.dtype, name=f"Conv_{i}"

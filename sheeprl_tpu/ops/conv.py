@@ -140,10 +140,15 @@ def _fast_conv(x, w):
 class FastConv2x(nn.Module):
     """Drop-in for ``nn.Conv(features, (k, k), strides=(2, 2), padding="VALID")``
     on NHWC inputs, with the CPU fast-gradient decomposition. Identical parameter
-    tree ('kernel' [k, k, Cin, features], optional 'bias' [features])."""
+    tree ('kernel' [k, k, Cin, features], optional 'bias' [features]).
+
+    ``padding`` adds symmetric spatial zero-padding BEFORE the VALID conv —
+    i.e. ``nn.Conv(..., padding=[(p, p), (p, p)])`` semantics (the Dreamer-V3
+    encoder's p=1 configuration)."""
 
     features: int
     kernel_size: int
+    padding: int = 0
     use_bias: bool = True
     kernel_init: Callable = nn.initializers.lecun_normal()
     bias_init: Callable = nn.initializers.zeros_init()
@@ -166,6 +171,9 @@ class FastConv2x(nn.Module):
         kernel = self.param("kernel", self.kernel_init, (k, k, c_in, self.features), jnp.float32)
         kernel = kernel.astype(self.dtype)
         x = x.astype(self.dtype)
+        if self.padding:
+            p = int(self.padding)
+            x = jnp.pad(x, ((0, 0), (p, p), (p, p), (0, 0)))
         if _fast_conv_enabled() and k % 2 == 0 and c_in <= self.max_fast_cin:
             out = jax.lax.platform_dependent(x, kernel, cpu=_fast_conv, default=_native_conv)
         else:

@@ -50,6 +50,25 @@ def test_values_and_gradients_match_nn_conv(h, k, ci, co):
     np.testing.assert_allclose(g_fast[1], g_ref[1], atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("h,ci,co", [(64, 3, 4), (32, 4, 8), (8, 8, 16)])
+def test_pad1_matches_nn_conv(h, ci, co):
+    """The Dreamer-V3 encoder configuration: k=4 s=2 symmetric pad 1, no bias."""
+    rng = np.random.default_rng(h)
+    x = jnp.asarray(rng.normal(size=(5, h, h, ci)).astype(np.float32))
+    ref = nn.Conv(co, (4, 4), strides=(2, 2), padding=[(1, 1), (1, 1)], use_bias=False)
+    fast = FastConv2x(features=co, kernel_size=4, padding=1, use_bias=False)
+    params = ref.init(jax.random.PRNGKey(1), x)
+    y_ref = ref.apply(params, x)
+    np.testing.assert_allclose(fast.apply(params, x), y_ref, atol=1e-5, rtol=1e-5)
+    cot = jnp.cos(jnp.arange(y_ref.size, dtype=jnp.float32).reshape(y_ref.shape))
+    g_ref = jax.grad(lambda p, x: (ref.apply(p, x) * cot).sum(), argnums=(0, 1))(params, x)
+    g_fast = jax.grad(lambda p, x: (fast.apply(p, x) * cot).sum(), argnums=(0, 1))(params, x)
+    np.testing.assert_allclose(
+        g_fast[0]["params"]["kernel"], g_ref[0]["params"]["kernel"], atol=2e-4, rtol=1e-4
+    )
+    np.testing.assert_allclose(g_fast[1], g_ref[1], atol=1e-4, rtol=1e-4)
+
+
 def test_escape_hatch_forces_native(monkeypatch):
     monkeypatch.setenv("SHEEPRL_DISABLE_FAST_CONV", "1")
     rng = np.random.default_rng(0)
