@@ -351,6 +351,11 @@ def _bench(algo: str) -> dict:
     return _bench_wallclock(algo)
 
 
+class BenchTimeout(RuntimeError):
+    """A workload child outlived its budget and was ABANDONED (never killed) —
+    on a live chip it still holds the single-tenant claim."""
+
+
 def _bench_subprocess(algo: str, timeout: int = 1200) -> dict:
     """Each workload gets a fresh process: a cpu-pinned fabric (ppo benchmark
     conditions) locks jax_platforms for the whole process, which would silently
@@ -389,9 +394,12 @@ def _bench_subprocess(algo: str, timeout: int = 1200) -> dict:
     except OSError:
         stdout = stderr = ""
     if rc is None:
-        raise RuntimeError(
-            f"bench {algo} timed out after {timeout}s (child left running to release "
-            f"the chip cleanly): {stdout[-500:]}\n{stderr[-1000:]}"
+        # keep the temp files: the abandoned child is still writing its
+        # post-mortem to them, and the paths in the message are how to find it
+        raise BenchTimeout(
+            f"bench {algo} timed out after {timeout}s (child pid {child.pid} left "
+            f"running to release the chip cleanly; its output keeps landing in "
+            f"{out_path} / {err_path}): {stdout[-500:]}\n{stderr[-1000:]}"
         )
     for p in (out_path, err_path):
         try:
@@ -428,7 +436,7 @@ def main() -> None:
         print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:  # the already-printed headline must survive a failing extra
         result["extras_error"] = repr(exc)[:500]
-        chip_busy = live and "timed out" in repr(exc)
+        chip_busy = live and isinstance(exc, BenchTimeout)
     if chip_busy:
         # The abandoned child is still compiling/claiming on the single-tenant
         # chip; further live-chip extras would only queue behind it and time out
@@ -447,7 +455,7 @@ def main() -> None:
                 print(json.dumps({**result, "extras": extras}), flush=True)
             except Exception as exc:
                 result[f"{extra_algo}_extra_error"] = repr(exc)[:500]
-                if "timed out" in repr(exc):
+                if isinstance(exc, BenchTimeout):
                     result["extras_skipped"] = (
                         "remaining live-chip extras skipped: timed-out workload still holds the chip"
                     )

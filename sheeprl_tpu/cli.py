@@ -354,6 +354,121 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
     eval_algorithm(cfg)
 
 
+def one_train_phase_steps(cfg: dotdict) -> int:
+    """Smallest ``total_steps`` that carries a run through its FIRST gradient
+    phase (compiling every act + train program the full run would compile):
+    one rollout for on-policy algorithms; learning_starts plus enough steps for
+    the replay-ratio governor to grant a gradient step for off-policy ones.
+
+    Step accounting is GLOBAL (``policy_steps_per_iter = num_envs * world_size``
+    in every training loop), so the budget scales with ``fabric.devices`` — a
+    priming run at devices=4 must still reach its first train phase."""
+    algo = cfg.algo
+    devices = cfg.fabric.get("devices", 1)
+    try:
+        world_size = int(devices)
+    except (TypeError, ValueError):  # "auto"
+        world_size = 1
+    if world_size <= 0:  # -1 = "all local devices" (dp-cpu/dp-tpu fabric configs)
+        import jax
+
+        # resolve the count the way the Fabric will: pin the platform FIRST for
+        # cpu fabrics, so counting devices can never initialize (and on a TPU
+        # box, claim) the accelerator backend for a run that won't use it
+        if str(cfg.fabric.get("accelerator", "auto")) == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        world_size = jax.local_device_count()
+    steps_per_iter = int(cfg.env.num_envs) * max(world_size, 1)
+    if "learning_starts" in algo:
+        ratio = float(algo.get("replay_ratio", 1.0) or 1.0)
+        return int(algo.learning_starts) + (int(1.0 / ratio) + 2) * steps_per_iter
+    if "rollout_steps" in algo:
+        return int(algo.rollout_steps) * steps_per_iter
+    raise ValueError(
+        f"cannot derive a one-train-phase step budget for {algo.name!r} "
+        "(no rollout_steps or learning_starts); pass algo.total_steps yourself and use `sheeprl`"
+    )
+
+
+def compile_warm(args: Optional[Sequence[str]] = None) -> None:
+    """``sheeprl-compile exp=... [overrides]`` — prime the persistent XLA compile
+    cache for an experiment WITHOUT doing a real training run.
+
+    TPU-first rationale: the fused train programs are compiled remotely on
+    TPU backends, which takes MINUTES cold (observed >9 min for the Dreamer-V3
+    train program over a tunneled v5e — see TPU_PROBE_LOG.md). Because compiled
+    executables are keyed by (program, shapes) and every shape in a run is
+    config-derived, running the exp for just long enough to reach its first
+    train phase compiles the exact act + train programs the real run will use
+    and lands them in the persistent cache (``sheeprl_tpu/utils/compile_cache.py``)
+    — so the real job, a pod launch, or a benchmark run starts hot. No analogue
+    exists in the reference (torch is eager); this is XLA-specific operational
+    surface.
+
+    The priming run disables logging/checkpointing/video/final-test and shrinks
+    ``total_steps`` to one train phase:
+
+    - on-policy (``algo.rollout_steps``): one rollout → one update,
+    - off-policy / world-model (``algo.learning_starts`` + ``algo.replay_ratio``):
+      learning_starts, then enough env steps for the replay-ratio governor to
+      grant the first gradient step.
+
+    Model/batch/sequence config is untouched — shapes must match the real run.
+    Finetuning/offline entrypoints that need a checkpoint or dataset are not
+    supported (prime their base exp instead)."""
+    import time
+
+    import sheeprl_tpu  # noqa: F401 - populate registries
+
+    overrides = list(args if args is not None else sys.argv[1:])
+    cfg = compose(overrides)
+    total = one_train_phase_steps(cfg)
+    import tempfile
+
+    scratch = tempfile.mkdtemp(prefix="sheeprl-compile-")
+    prime_overrides = [
+        f"algo.total_steps={total}",
+        "algo.run_test=False",
+        "metric.log_level=0",
+        "metric.disable_timer=True",
+        "checkpoint.save_last=False",
+        f"checkpoint.every={max(total * 2, 1_000_000)}",
+        # buffer capacity does not affect compiled program shapes, so the priming
+        # buffer only needs to hold the priming steps — at real exp sizes (DV2:
+        # 5M transitions) a memmap=False preallocation would OOM the host
+        "buffer.memmap=False",
+        f"buffer.size={max(total, 1)}",
+        "env.capture_video=False",
+        # artifacts (run dir, stray checkpoints) go to a throwaway dir — priming
+        # must leave the user's logs/ tree untouched
+        f"hydra.run.dir={scratch}",
+    ]
+    print(f"[sheeprl-compile] priming {cfg.algo.name} for {total} env steps: one full train phase")
+    start = time.perf_counter()
+    try:
+        run(overrides + prime_overrides)
+    finally:
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
+    elapsed = time.perf_counter() - start
+    import jax
+
+    cache_dir = jax.config.jax_compilation_cache_dir
+    if not cache_dir:
+        print(
+            f"[sheeprl-compile] WARNING: ran in {elapsed:.1f}s but the persistent "
+            "compile cache is DISABLED (SHEEPRL_JAX_CACHE=0?) — nothing was "
+            "persisted, the real run will still compile cold"
+        )
+        return
+    n_entries = len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0
+    print(
+        f"[sheeprl-compile] done in {elapsed:.1f}s — persistent cache at "
+        f"{cache_dir} now holds {n_entries} entries; the real run starts hot"
+    )
+
+
 def registration(args: Optional[Sequence[str]] = None) -> None:
     """Model-registry publication from a checkpoint (reference cli.py:407-449).
     Requires mlflow, which is optional."""

@@ -111,6 +111,92 @@ def test_ppo_decoupled_cartpole_learns():
 
 @pytest.mark.slow
 @pytest.mark.learning
+@pytest.mark.timeout(300)
+def test_sac_pendulum_learns():
+    """SAC (off-policy path: replay buffer, twin critics, auto-alpha) clears a
+    learning bar on Pendulum-v1. Random policy scores ~-1200; a learned one
+    swings up and holds. Small nets/batch keep the G-step cheap on one CPU core."""
+    run(
+        [
+            "exp=sac",
+            "env.id=Pendulum-v1",
+            "env.num_envs=1",
+            "fabric.accelerator=cpu",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "buffer.size=16384",
+            "checkpoint.save_last=False",
+            "metric.log_level=1",
+            "metric.log_every=4096",
+            "algo.total_steps=16384",
+            "algo.learning_starts=1024",
+            "algo.replay_ratio=1.0",
+            "algo.hidden_size=128",
+            "algo.per_rank_batch_size=128",
+        ]
+    )
+    series = _scalar_series(_version_dir("sac"), "Test/cumulative_reward")
+    reward = series[-1][1]
+    assert reward >= -400.0, f"SAC did not learn Pendulum: greedy test reward {reward} < -400"
+
+
+@pytest.mark.slow
+@pytest.mark.learning
+@pytest.mark.timeout(300)
+def test_dreamer_v2_world_model_loss_decreases():
+    """Tiny DV2 world model (KL-balanced discrete RSSM — the pre-symlog loss
+    stack) overfits deterministic dummy pixels, same trend gate as the DV3 one."""
+    run(
+        [
+            "exp=dreamer_v2",
+            "env=dummy",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "env.num_envs=1",
+            "fabric.accelerator=cpu",
+            "buffer.memmap=False",
+            "checkpoint.save_last=False",
+            "metric.log_level=1",
+            "metric.log_every=64",
+            "algo.total_steps=448",
+            "algo.learning_starts=64",
+            "algo.replay_ratio=0.5",
+            "algo.per_rank_batch_size=4",
+            "algo.per_rank_sequence_length=8",
+            "algo.horizon=8",
+            "algo.dense_units=16",
+            "algo.mlp_layers=1",
+            "algo.world_model.discrete_size=8",
+            "algo.world_model.stochastic_size=8",
+            "algo.world_model.encoder.cnn_channels_multiplier=4",
+            "algo.world_model.recurrent_model.recurrent_state_size=32",
+            "algo.world_model.representation_model.hidden_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.mlp_keys.decoder=[]",
+        ]
+    )
+    version_dir = _version_dir("dreamer_v2")
+    recon = _scalar_series(version_dir, "Loss/observation_loss")
+    total = _scalar_series(version_dir, "Loss/world_model_loss")
+    assert len(recon) >= 3, f"need >=3 logged points to judge a trend, got {recon}"
+    # DV2's recon loss is a unit-variance Gaussian -log p over 3*64*64 pixel dims,
+    # so it carries an IRREDUCIBLE floor of 0.5*ln(2*pi) per dim (~11290 nats);
+    # gate on the reducible part above that floor (DV3's symlog-MSE gate has no
+    # such constant, hence its simpler multiplicative check)
+    import math
+
+    floor = 0.5 * math.log(2 * math.pi) * 3 * 64 * 64
+    first, last = recon[0][1] - floor, recon[-1][1] - floor
+    assert last < 0.3 * first, f"reducible recon loss did not collapse: {recon} (floor {floor:.0f})"
+    assert total[-1][1] < total[0][1], f"world-model loss did not decrease: {total}"
+
+
+@pytest.mark.slow
+@pytest.mark.learning
 @pytest.mark.timeout(240)
 def test_dreamer_v3_world_model_loss_decreases():
     """Tiny DV3 world model overfits deterministic dummy pixels: reconstruction

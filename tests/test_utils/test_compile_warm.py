@@ -1,0 +1,55 @@
+"""The sheeprl-compile cache-priming verb (cli.compile_warm).
+
+XLA-specific operational surface with no reference analogue: remote TPU compiles
+take minutes cold, so priming the persistent cache with the exact (program, shape)
+keys of a real run is the difference between a hot and a cold pod launch."""
+
+import pytest
+
+from sheeprl_tpu.cli import compile_warm, one_train_phase_steps
+from sheeprl_tpu.config import compose
+
+
+def test_one_train_phase_steps_on_policy():
+    cfg = compose(["exp=ppo", "env.num_envs=4"])
+    # one full rollout across the vectorized envs = one PPO update
+    assert one_train_phase_steps(cfg) == cfg.algo.rollout_steps * 4
+
+
+def test_one_train_phase_steps_off_policy():
+    cfg = compose(["exp=sac", "env.num_envs=2", "algo.replay_ratio=0.5"])
+    # learning_starts, then 1/ratio iterations for the first granted G-step
+    assert one_train_phase_steps(cfg) == cfg.algo.learning_starts + (2 + 1) * 2 + 2
+
+
+def test_one_train_phase_steps_dreamer():
+    cfg = compose(["exp=dreamer_v3", "env.num_envs=1"])
+    assert one_train_phase_steps(cfg) == cfg.algo.learning_starts + 2 + 1
+
+
+def test_compile_warm_runs_one_update(tmp_path, monkeypatch, capsys):
+    """End-to-end: a tiny PPO priming run completes, reports the cache, and
+    leaves no run directory behind (logging fully off)."""
+    monkeypatch.chdir(tmp_path)
+    compile_warm(
+        [
+            "exp=ppo",
+            "fabric.accelerator=cpu",
+            "env.sync_env=True",
+            "env.num_envs=2",
+            "algo.rollout_steps=16",
+            "algo.per_rank_batch_size=16",
+            "buffer.memmap=False",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "[sheeprl-compile] priming ppo for 32 env steps" in out
+    assert "[sheeprl-compile] done in" in out
+    assert not (tmp_path / "logs").exists()
+
+
+def test_compile_warm_rejects_underivable_budget():
+    cfg = compose(["exp=ppo"])
+    del cfg.algo["rollout_steps"]
+    with pytest.raises(ValueError, match="one-train-phase step budget"):
+        one_train_phase_steps(cfg)
