@@ -227,6 +227,17 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
     world_tx, actor_tx, critic_tx, opt_state = build_optimizers(cfg, params)
     train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+    moments_state = init_moments()
+    if cfg.checkpoint.resume_from:
+        # mirror run_dreamer's resume on the slice (same shared-path assumption
+        # as the reference's fabric.load on all ranks)
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        state = load_checkpoint(cfg.checkpoint.resume_from)
+        params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+        if state.get("moments") is not None:
+            moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
 
     data_q, params_q = BroadcastChannel(src=0), BroadcastChannel(src=1)
     geometry = data_q.get()
@@ -239,7 +250,7 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     )
     coordination_barrier("dv3_decoupled_warmup")
     error: Dict[str, Any] = {}
-    _trainer_loop(fabric, cfg, train_phase, params, opt_state, init_moments(), data_q, params_q, error)
+    _trainer_loop(fabric, cfg, train_phase, params, opt_state, moments_state, data_q, params_q, error)
     if "exc" in error:
         # pair the player's final sentinel — unless the crash WAS the channel,
         # whose collectives are desynced and would hang instead of pairing
@@ -258,12 +269,9 @@ def main(fabric, cfg: Dict[str, Any]):
 
     from sheeprl_tpu.parallel import distributed
 
-    if cfg.checkpoint.resume_from:
-        raise ValueError(
-            "The decoupled Dreamer-V3 implementation does not support resuming from "
-            "a checkpoint; use the coupled `dreamer_v3` algorithm to resume"
-        )
-
+    # Resume: the player path is run_dreamer's own resume (it hands the resumed
+    # params/opt_state/moments to the trainer factory); the learner slice loads
+    # the checkpoint from its own filesystem in _learner_process.
     multi_process = distributed.process_count() >= 2
     if multi_process:
         # process 0: player on its own devices; processes 1..N-1: learner slice

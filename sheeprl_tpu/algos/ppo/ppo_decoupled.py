@@ -60,17 +60,25 @@ def _trainer_loop(
     params_q: "queue.Queue",
     error: Dict[str, Any],
     geometry: Optional[Dict[str, int]] = None,
+    resume_state: Optional[Dict[str, Any]] = None,
 ):
     """Learner role (reference trainer(), ppo_decoupled.py:368-620): consume rollout
     blocks, run the fused epochs×minibatches program on the mesh, publish params.
 
     ``geometry`` overrides the rollout-derived sizes with the PLAYER's (two-process
     topology, where the roles may own different device counts); None derives them
-    locally (threaded topology: both roles share one fabric)."""
+    locally (threaded topology: both roles share one fabric). ``resume_state``
+    restores params/optimizer/batch-size from a checkpoint (reference trainer
+    resume, ppo_decoupled.py:406-437)."""
     try:
         world_size = fabric.world_size
         if geometry is not None:
             world_size = int(geometry["player_world_size"])
+        if resume_state is not None:
+            # derived from the CHECKPOINT, not cfg, so the thread-mode player's own
+            # cfg override (same object) cannot double-divide
+            cfg.algo.per_rank_batch_size = int(resume_state["batch_size"]) // world_size
+            params = jax.tree_util.tree_map(jnp.asarray, resume_state["agent"])
         total_num_envs = int(cfg.env.num_envs * world_size)
         loss_reduction = cfg.algo.loss_reduction
         vf_coef = float(cfg.algo.vf_coef)
@@ -93,6 +101,8 @@ def _trainer_loop(
 
         tx = _build_optimizer(cfg, total_iters)
         opt_state = tx.init(params)
+        if resume_state is not None and resume_state.get("optimizer") is not None:
+            opt_state = jax.tree_util.tree_map(jnp.asarray, resume_state["optimizer"])
 
         batch_sharding = None
         if fabric.world_size > 1 and global_bs % fabric.world_size == 0:
@@ -221,8 +231,15 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     if geometry is None:  # player failed before the first rollout
         params_q.put(None)  # pairs the player's cleanup ack-consume
         return
+    resume_state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        resume_state = load_checkpoint(cfg.checkpoint.resume_from)
     error: Dict[str, Any] = {}
-    _trainer_loop(fabric, cfg, agent, params, data_q, params_q, error, geometry=geometry)
+    _trainer_loop(
+        fabric, cfg, agent, params, data_q, params_q, error, geometry=geometry, resume_state=resume_state
+    )
     if "exc" in error:
         # the player is (or will be) blocked sending its final sentinel — consume
         # it and ack so the lockstep broadcasts stay paired, then surface the crash.
@@ -241,13 +258,6 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.parallel import distributed
 
-    if cfg.checkpoint.resume_from:
-        # checked before the role split so every process raises consistently
-        raise ValueError(
-            "The decoupled PPO implementation does not support resuming from a checkpoint; "
-            "use the coupled `ppo` algorithm to resume"
-        )
-
     two_process = distributed.process_count() >= 2
     if two_process:
         # MPMD role split over jax.distributed processes: process 0 is the player
@@ -260,6 +270,18 @@ def main(fabric, cfg: Dict[str, Any]):
         fabric._setup()
         if distributed.process_index() >= 1:
             return _learner_process(fabric, cfg)
+
+    # Resume (reference ppo_decoupled.py:45-46,111-154): each role loads the
+    # checkpoint from its own filesystem — the player (here, after the role
+    # split, so learner processes don't pay a throwaway load) restores counters +
+    # params; the learner slice restores params + optimizer state inside
+    # _learner_process (same shared-path assumption as the reference's
+    # fabric.load on all ranks).
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        state = load_checkpoint(cfg.checkpoint.resume_from)
 
     # any player-side failure must release a learner blocked in a channel; the
     # KV-backed channels are STATEFUL (sequence counters), so the crash path must
@@ -310,6 +332,8 @@ def main(fabric, cfg: Dict[str, Any]):
         key = fabric.seed_everything(cfg.seed + rank)
         key, agent_key = jax.random.split(key)
         agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
+        if state is not None:
+            params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
 
         if fabric.is_global_zero:
             save_configs(cfg, log_dir)
@@ -328,9 +352,13 @@ def main(fabric, cfg: Dict[str, Any]):
 
         policy_steps_per_iter = int(total_num_envs * cfg.algo.rollout_steps)
         total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
-        last_log = 0
-        last_checkpoint = 0
-        policy_step = 0
+        # counters on resume: same semantics as the coupled path (ppo.py:219-226)
+        start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
+        policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state is not None else 0
+        last_log = state["last_log"] if state is not None else 0
+        last_checkpoint = state["last_checkpoint"] if state is not None else 0
+        if state is not None:
+            cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
 
         # ---------------- channels + learner (thread or separate process) -----------
         error: Dict[str, Any] = {}
@@ -347,6 +375,7 @@ def main(fabric, cfg: Dict[str, Any]):
             trainer = threading.Thread(
                 target=_trainer_loop,
                 args=(fabric, cfg, agent, params, data_q, params_q, error),
+                kwargs={"resume_state": state},
                 daemon=True,
                 name="ppo-learner",
             )
@@ -406,7 +435,7 @@ def main(fabric, cfg: Dict[str, Any]):
         for k in obs_keys:
             step_data[k] = next_obs[k][np.newaxis]
 
-        for iter_num in range(1, total_iters + 1):
+        for iter_num in range(start_iter, total_iters + 1):
             with timer("Time/env_interaction_time"):
                 for _ in range(cfg.algo.rollout_steps):
                     policy_step += total_num_envs

@@ -38,12 +38,17 @@ from sheeprl_tpu.utils.utils import ActPlacement, Ratio, save_configs
 
 
 def _trainer_loop(
-    fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error, geometry=None
+    fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error, geometry=None,
+    resume_state=None,
 ):
     try:
         # two-process topology: batch/EMA-period math follows the PLAYER's device
         # count (the roles may own different meshes)
         world_size = fabric.world_size if geometry is None else int(geometry["player_world_size"])
+        if resume_state is not None:
+            # reference trainer resume (sac_decoupled.py:406-434): restore the
+            # slice's params from the checkpoint, not the seed-matched init
+            params = jax.tree_util.tree_map(jnp.asarray, resume_state["agent"])
         gamma = float(cfg.algo.gamma)
         tau = float(cfg.algo.tau)
         num_critics = int(cfg.algo.critic.n)
@@ -60,6 +65,8 @@ def _trainer_loop(
             "critic": critic_tx.init(params["critic"]),
             "alpha": alpha_tx.init(params["log_alpha"]),
         }
+        if resume_state is not None and resume_state.get("opt_state") is not None:
+            opt_state = jax.tree_util.tree_map(jnp.asarray, resume_state["opt_state"])
 
         def critic_loss_fn(critic_params, other, batch, step_key):
             next_obs = batch["next_observations"]
@@ -183,9 +190,15 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     if geometry is None:  # player failed before the first block
         params_q.put(None)  # pairs the player's cleanup ack-consume
         return
+    resume_state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        resume_state = load_checkpoint(cfg.checkpoint.resume_from)
     error: Dict[str, Any] = {}
     _trainer_loop(
-        fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error, geometry=geometry
+        fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error,
+        geometry=geometry, resume_state=resume_state,
     )
     if "exc" in error:
         # pair the player's final sentinel — unless the crash WAS the channel,
@@ -203,12 +216,6 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.parallel import distributed
 
-    if cfg.checkpoint.resume_from:
-        raise ValueError(
-            "The decoupled SAC implementation does not support resuming from a checkpoint; "
-            "use the coupled `sac` algorithm to resume"
-        )
-
     if len(cfg.algo.cnn_keys.encoder) > 0:
         warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
         cfg.algo.cnn_keys.encoder = []
@@ -223,6 +230,17 @@ def main(fabric, cfg: Dict[str, Any]):
         fabric._setup()
         if distributed.process_index() >= 1:
             return _learner_process(fabric, cfg)
+
+    # Resume (reference sac_decoupled.py:43-44,86-123): each role loads the
+    # checkpoint from its own filesystem — the player (after the role split, so
+    # learner processes don't pay a throwaway load of a potentially buffer-sized
+    # state) restores counters, ratio, params and the replay buffer; the learner
+    # slice restores params + opt state inside _learner_process.
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        state = load_checkpoint(cfg.checkpoint.resume_from)
 
     # read AFTER the role split: the two-process branch rebuilds the mesh with only
     # this process's devices, and all player-local sizes must follow that mesh
@@ -267,7 +285,9 @@ def main(fabric, cfg: Dict[str, Any]):
 
         key = fabric.seed_everything(cfg.seed + rank)
         key, agent_key = jax.random.split(key)
-        actor, critic, params = build_agent(fabric, cfg, observation_space, action_space, agent_key, None)
+        actor, critic, params = build_agent(
+            fabric, cfg, observation_space, action_space, agent_key, state["agent"] if state else None
+        )
         act_dim = int(np.prod(action_space.shape))
         target_entropy = -float(act_dim)
         action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
@@ -288,6 +308,8 @@ def main(fabric, cfg: Dict[str, Any]):
             memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
             obs_keys=("observations",),
         )
+        if state is not None and "rb" in state:
+            rb = state["rb"]
 
         policy_steps_per_iter = int(total_num_envs)
         total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
@@ -295,6 +317,16 @@ def main(fabric, cfg: Dict[str, Any]):
         prefill_steps = learning_starts - int(learning_starts > 0)
         ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
         sample_next_obs = bool(cfg.buffer.sample_next_obs)
+        start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
+        if state is not None:
+            ratio.load_state_dict(state["ratio"])
+            cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+            # re-prefill window (coupled sac.py:145-148, reference sac.py:222-226):
+            # shift learning_starts past the resume point so a resumed run —
+            # in particular one without a restored buffer — refills from the env
+            # before training instead of sampling a near-empty buffer
+            learning_starts += start_iter
+            prefill_steps += start_iter
 
         error: Dict[str, Any] = {}
         if two_process:
@@ -308,6 +340,7 @@ def main(fabric, cfg: Dict[str, Any]):
             trainer = threading.Thread(
                 target=_trainer_loop,
                 args=(fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error),
+                kwargs={"resume_state": state},
                 daemon=True,
                 name="sac-learner",
             )
@@ -332,14 +365,14 @@ def main(fabric, cfg: Dict[str, Any]):
         opt_state_host: Optional[Any] = None
         key = act.place(key)
 
-        policy_step = 0
-        last_log = 0
-        last_checkpoint = 0
+        policy_step = state["iter_num"] * cfg.env.num_envs if state is not None else 0
+        last_log = state["last_log"] if state is not None else 0
+        last_checkpoint = state["last_checkpoint"] if state is not None else 0
         cumulative_per_rank_gradient_steps = 0
         step_data: Dict[str, np.ndarray] = {}
         obs = envs.reset(seed=cfg.seed)[0]
 
-        for iter_num in range(1, total_iters + 1):
+        for iter_num in range(start_iter, total_iters + 1):
             policy_step += policy_steps_per_iter
 
             with timer("Time/env_interaction_time"):

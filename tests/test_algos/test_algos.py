@@ -533,3 +533,94 @@ def test_sac_decoupled(standard_args, devices):
             "algo.per_rank_batch_size=4",
         ]
     )
+
+
+def _sorted_ckpts(pattern):
+    import glob
+    import os
+
+    ckpts = glob.glob(pattern, recursive=True)
+    assert len(ckpts) > 0, f"no checkpoint matches {pattern}"
+    return [os.path.abspath(p) for p in sorted(ckpts)]
+
+
+def test_ppo_decoupled_resume(standard_args):
+    """Decoupled resume (reference ppo_decoupled.py:45-46,111-154): the player
+    restores counters+params, the learner restores params+optimizer, and the
+    resumed run executes REAL further train rounds through the channel protocol.
+    Resume force-merges the ORIGINAL config (total_steps included), so the
+    continuation must start from a MID-run checkpoint — resuming a completed run
+    is a no-op by design."""
+    args = standard_args + [
+        "dry_run=False",
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=1",
+        "algo.total_steps=48",
+        "checkpoint.every=16",
+        "checkpoint.save_last=True",
+    ]
+    _run(args)
+    first = _sorted_ckpts("logs/runs/ppo_decoupled/**/version_0/**/ckpt_*.ckpt")[0]  # ckpt_16
+    _run(args + [f"checkpoint.resume_from={first}"])
+    # iters 2..3 really ran: the resumed run wrote the final checkpoint anew —
+    # and did NOT re-run iter 1 (a silent from-scratch rerun would re-write
+    # ckpt_16, masking ignored resume counters)
+    resumed = _sorted_ckpts("logs/runs/ppo_decoupled/**/version_1/**/ckpt_*.ckpt")
+    assert any(p.endswith("ckpt_48_0.ckpt") for p in resumed), resumed
+    assert not any(p.endswith("ckpt_16_0.ckpt") for p in resumed), resumed
+
+
+def test_sac_decoupled_resume(standard_args):
+    """Decoupled SAC resume incl. the replay buffer and Ratio state (reference
+    sac_decoupled.py:43-44,86-123)."""
+    args = standard_args + [
+        "dry_run=False",
+        "exp=sac_decoupled",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.per_rank_batch_size=4",
+        "algo.learning_starts=2",
+        "algo.total_steps=8",
+        "checkpoint.every=2",
+        "checkpoint.save_last=True",
+    ]
+    _run(args)
+    first = _sorted_ckpts("logs/runs/sac_decoupled/**/version_0/**/ckpt_*.ckpt")[0]  # ckpt_2
+    _run(args + [f"checkpoint.resume_from={first}"])
+    resumed = _sorted_ckpts("logs/runs/sac_decoupled/**/version_1/**/ckpt_*.ckpt")
+    assert any(p.endswith("ckpt_8_0.ckpt") for p in resumed), resumed
+    assert not any(p.endswith("ckpt_2_0.ckpt") for p in resumed), resumed
+
+
+def test_dreamer_v3_decoupled_resume(standard_args):
+    """Decoupled DV3 resume: run_dreamer's own resume drives the player; the
+    channel trainer starts from the restored params/opt_state/moments."""
+    args = (
+        standard_args
+        + [a for a in _DV3_TINY if a != "exp=dreamer_v3"]
+        + [
+            "dry_run=False",
+            "exp=dreamer_v3_decoupled",
+            "algo.learning_starts=0",
+            "algo.total_steps=6",
+            "checkpoint.every=2",
+            "checkpoint.save_last=True",
+            "root_dir=dv3decr",
+            "run_name=t",
+        ]
+    )
+    _run(args)
+    ckpts = _sorted_ckpts("logs/runs/dv3decr/**/version_0/**/ckpt_*.ckpt")
+    first = ckpts[0]
+    first_step = int(first.rsplit("ckpt_", 1)[1].split("_")[0])
+    _run(args + [f"checkpoint.resume_from={first}"])
+    resumed = _sorted_ckpts("logs/runs/dv3decr/**/version_1/**/ckpt_*.ckpt")
+    # every resumed checkpoint sits strictly PAST the resume point (ignored
+    # counters would re-write the early ones)
+    resumed_steps = [int(p.rsplit("ckpt_", 1)[1].split("_")[0]) for p in resumed]
+    assert resumed_steps and all(s > first_step for s in resumed_steps), (first_step, resumed_steps)
