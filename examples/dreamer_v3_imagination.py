@@ -1,0 +1,191 @@
+"""Visualize Dreamer-V3's world model: play some steps, then let the model
+IMAGINE forward and compare its dreamed frames against reality (role of
+reference notebooks/dreamer_v3_imagination.ipynb, as a runnable script).
+
+Given a trained checkpoint, the script:
+
+1. plays ``initial_steps`` env steps with the frozen policy, recording the real
+   frames and the posterior latents (and decoding each posterior back through
+   the observation model — the "reconstruction" track);
+2. rewinds ``imagination_steps`` steps and rolls the world model forward from
+   that latent WITHOUT looking at the env again — actions come from the actor
+   (``imagine_actions=True``) or from the actually-played record
+   (``imagine_actions=False``) and next latents from the transition model;
+3. decodes the imagined latents and writes three GIFs side by side:
+   ``real_obs.gif``, ``reconstructed_obs.gif``, ``imagination.gif``.
+
+    python examples/dreamer_v3_imagination.py \\
+        checkpoint_path=logs/runs/dreamer_v3/.../ckpt_..._0.ckpt \\
+        initial_steps=200 imagination_steps=45 out_dir=./imagination
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+from typing import Dict, List
+
+# runnable from a source checkout without `pip install -e .`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _load_cfg(ckpt_path: pathlib.Path):
+    import yaml
+
+    from sheeprl_tpu.config import dotdict
+
+    cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not cfg_path.is_file():
+        cfg_path = ckpt_path.parent / "config.yaml"
+    with open(cfg_path) as f:
+        return dotdict(yaml.safe_load(f))
+
+
+def _decode_frames(agent, wm_params, latents: jax.Array, cnn_key: str) -> np.ndarray:
+    """Observation-model decode of ``latents`` [N, L] → uint8 frames [N, H, W, C].
+    The cnn decoder predicts (obs/255 - 0.5), so invert that scale."""
+    dec = agent.observation_model.apply({"params": wm_params["observation_model"]}, latents)
+    frames = np.asarray(jnp.clip(dec[cnn_key] + 0.5, 0.0, 1.0) * 255.0).astype(np.uint8)
+    if frames.shape[1] in (1, 3):  # channel-first → HWC
+        frames = np.transpose(frames, (0, 2, 3, 1))
+    return frames
+
+
+def _save_gif(frames: np.ndarray, path: str) -> None:
+    from PIL import Image
+
+    imgs = [Image.fromarray(f.squeeze()) for f in frames]
+    imgs[0].save(path, format="GIF", append_images=imgs[1:], save_all=True, duration=100, loop=0)
+
+
+def main(args=None) -> None:
+    import sheeprl_tpu  # noqa: F401 — populate registries
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3
+    from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.env import make_env
+
+    kv = dict(o.split("=", 1) for o in (args if args is not None else sys.argv[1:]) if "=" in o)
+    ckpt_path = kv.get("checkpoint_path")
+    if ckpt_path is None:
+        raise ValueError("you must specify checkpoint_path=...")
+    ckpt_path = pathlib.Path(ckpt_path)
+    initial_steps = int(kv.get("initial_steps", 200))
+    imagination_steps = int(kv.get("imagination_steps", 45))
+    if imagination_steps > initial_steps:
+        raise ValueError("imagination_steps must be <= initial_steps")
+    imagine_actions = str(kv.get("imagine_actions", "true")).lower() in ("1", "true", "yes")
+    out_dir = kv.get("out_dir", "./imagination")
+    accelerator = kv.get("fabric.accelerator", "cpu")
+
+    cfg = _load_cfg(ckpt_path)
+    cfg.env.num_envs = 1
+    cfg.env.capture_video = False
+    cfg.env.frame_stack = -1  # run_dreamer forces this for training; match it
+    seed = int(kv.get("seed", cfg.seed))
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    if not cnn_keys:
+        raise ValueError("the checkpointed agent has no pixel observation to visualize")
+    cnn_key = cnn_keys[0]
+
+    fabric = Fabric(devices=1, accelerator=accelerator)
+    fabric._setup()  # pin the platform BEFORE the checkpoint load touches jax
+    state = load_checkpoint(str(ckpt_path))
+
+    env = make_env(cfg, seed, 0, None, "imagination")()
+    obs_space = env.observation_space
+    action_space = env.action_space
+    import gymnasium as gym
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    agent, params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, obs_space, jax.random.PRNGKey(seed), state["agent"]
+    )
+    wm_params = params["world_model"]
+    player = PlayerDV3(agent, 1, cnn_keys, mlp_keys)
+    player.init_states(params)
+
+    # ---- 1. play, recording real frames + posterior latents -------------------
+    key = jax.random.PRNGKey(seed)
+    obs = env.reset(seed=seed)[0]
+    real_frames: List[np.ndarray] = []
+    latents: List[np.ndarray] = []  # posterior (z, h) per step
+    played_actions: List[np.ndarray] = []
+    for _ in range(initial_steps):
+        jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=1)
+        actions, key = player.get_actions(params, jobs, key, greedy=True)
+        actions_np = np.asarray(actions)
+        played_actions.append(actions_np[0])
+        latents.append(
+            (np.asarray(player.stochastic_state)[0], np.asarray(player.recurrent_state)[0])
+        )
+        frame = np.asarray(obs[cnn_key])
+        if frame.shape[0] in (1, 3):
+            frame = np.transpose(frame, (1, 2, 0))
+        real_frames.append(frame.astype(np.uint8))
+        if is_continuous:
+            real_act = actions_np[0]
+        else:
+            splits = np.cumsum(actions_dim)[:-1]
+            real_act = np.stack([b.argmax(-1) for b in np.split(actions_np[0], splits, axis=-1)], axis=-1)
+        obs, _, terminated, truncated, _ = env.step(real_act.reshape(action_space.shape))
+        if terminated or truncated:
+            obs = env.reset()[0]
+            player.init_states(params)
+
+    # ---- 2. reconstruction track: decode every posterior ----------------------
+    post = jnp.asarray(np.stack([np.concatenate([z, h], axis=-1) for z, h in latents]))
+    recon_frames = _decode_frames(agent, wm_params, post, cnn_key)
+
+    # ---- 3. imagination from initial_steps - imagination_steps ---------------
+    t0 = initial_steps - imagination_steps
+    z0 = jnp.asarray(latents[t0][0])[None]
+    h0 = jnp.asarray(latents[t0][1])[None]
+    if imagine_actions:
+        imagined, _ = agent.imagination_scan(
+            wm_params, params["actor"], z0, h0, jax.random.PRNGKey(seed + 1), imagination_steps - 1
+        )
+        imagined = imagined[:, 0]  # [H, L]
+    else:
+        # replay the actually-played actions through recurrent + transition
+        def step(carry, inp):
+            z, h = carry
+            a, k = inp
+            h = agent._recurrent(wm_params, z, a[None], h)
+            _, z = agent._transition(wm_params, h, k)
+            return (z, h), jnp.concatenate([z, h], axis=-1)[0]
+
+        acts = jnp.asarray(np.stack(played_actions[t0 : t0 + imagination_steps - 1]))
+        keys = jax.random.split(jax.random.PRNGKey(seed + 1), imagination_steps - 1)
+        _, dreamed = jax.lax.scan(step, (z0, h0), (acts, keys))
+        imagined = jnp.concatenate([jnp.concatenate([z0, h0], axis=-1), dreamed], axis=0)
+    imag_frames = _decode_frames(agent, wm_params, imagined, cnn_key)
+
+    os.makedirs(out_dir, exist_ok=True)
+    _save_gif(np.stack(real_frames[t0:]), os.path.join(out_dir, "real_obs.gif"))
+    _save_gif(recon_frames[t0:], os.path.join(out_dir, "reconstructed_obs.gif"))
+    _save_gif(imag_frames, os.path.join(out_dir, "imagination.gif"))
+    env.close()
+    print(
+        f"wrote {imagination_steps}-frame real_obs.gif / reconstructed_obs.gif / "
+        f"imagination.gif to {out_dir} (actions: {'actor' if imagine_actions else 'replayed'})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Inspect the observation/action space an algorithm will see for a config
+(role of reference examples/observation_space.py): compose the same config tree
+the trainer uses, build the fully-wrapped env, and print its spaces.
+
+    python examples/observation_space.py env=gym env.id=CartPole-v1 agent=ppo
+    python examples/observation_space.py env=dmc env.id=walker_walk agent=dreamer_v3
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# runnable from a source checkout without `pip install -e .`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import algorithm_registry
+
+
+def main(args=None) -> None:
+    import sheeprl_tpu  # noqa: F401 — populate the algorithm registry
+
+    overrides = list(args if args is not None else sys.argv[1:])
+    agent = "ppo"
+    passthrough = []
+    for o in overrides:
+        if o.startswith("agent="):
+            agent = o.split("=", 1)[1]
+        else:
+            passthrough.append(o)
+    if agent not in algorithm_registry:
+        available = ", ".join(sorted(algorithm_registry.keys()))
+        raise ValueError(f"invalid agent {agent!r}; available: {available}")
+    cfg = compose([f"exp={agent}"] + passthrough)
+    cfg.env.capture_video = False
+    env = make_env(cfg, cfg.seed, 0)()
+    print(f"\nObservation space of `{cfg.env.id}` for the `{agent}` agent:")
+    print(env.observation_space)
+    print(f"\nAction space:")
+    print(env.action_space)
+    env.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Demonstrate the replay-ratio governor (role of reference examples/ratio.py):
+``Ratio`` converts a desired gradient-steps-per-env-step ratio into an integer
+number of gradient steps per loop iteration, accumulating fractional credit so
+the long-run ratio is exact regardless of num_envs/world_size granularity.
+
+    python examples/ratio.py
+"""
+
+import os
+import sys
+
+# runnable from a source checkout without `pip install -e .`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.utils.utils import Ratio
+
+if __name__ == "__main__":
+    num_envs = 1
+    world_size = 1
+    replay_ratio = 1 / 16  # Dreamer-V3 benchmark setting
+    per_rank_batch_size = 16
+    per_rank_sequence_length = 64
+    learning_starts = 128
+    total_policy_steps = 2**10
+
+    r = Ratio(ratio=replay_ratio, pretrain_steps=0)
+    policy_steps_per_iter = num_envs * world_size
+    gradient_steps = 0
+    for step in range(0, total_policy_steps, policy_steps_per_iter):
+        if step < learning_starts:
+            continue
+        per_rank = r(step / world_size)
+        if per_rank > 0:
+            print(
+                f"step {step}: {per_rank} gradient steps per rank "
+                f"({per_rank * world_size} global)"
+            )
+        gradient_steps += per_rank * world_size
+
+    replayed = world_size * per_rank_batch_size * per_rank_sequence_length
+    print("\nreplay ratio        ", replay_ratio)
+    print("Hafner train ratio  ", replay_ratio * replayed)
+    print("achieved ratio      ", gradient_steps / (total_policy_steps - learning_starts))

@@ -228,22 +228,36 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     world_tx, actor_tx, critic_tx, opt_state = build_optimizers(cfg, params)
     train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
     moments_state = init_moments()
-    if cfg.checkpoint.resume_from:
-        # mirror run_dreamer's resume on the slice (same shared-path assumption
-        # as the reference's fabric.load on all ranks)
-        from sheeprl_tpu.utils.checkpoint import load_checkpoint
-
-        state = load_checkpoint(cfg.checkpoint.resume_from)
-        params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
-        opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
-        if state.get("moments") is not None:
-            moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
 
     data_q, params_q = BroadcastChannel(src=0), BroadcastChannel(src=1)
     geometry = data_q.get()
     if geometry is None:  # player failed before the first round
         params_q.put(None)  # pairs the player's cleanup ack-consume
         return
+    if cfg.checkpoint.resume_from:
+        # mirror run_dreamer's resume on the slice (same shared-path assumption
+        # as the reference's fabric.load on all ranks)
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        try:
+            state = load_checkpoint(cfg.checkpoint.resume_from)
+            params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+            opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+            if state.get("moments") is not None:
+                moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
+        except Exception:
+            # a load failure must not strand the player: pass the warmup barrier
+            # it is waiting at, then surface the crash on the weight plane so its
+            # first round raises 'learner crashed mid-run'
+            try:
+                coordination_barrier("dv3_decoupled_warmup")
+                params_q.put(None)
+            except Exception:
+                pass
+            raise
+        # the slice only needs params/opt_state/moments; drop the player-side
+        # replay buffer the checkpoint carries
+        state.pop("rb", None)
     _warmup_train_step(
         fabric, cfg, train_phase, params, opt_state, observation_space, actions_dim,
         geometry["player_world_size"],

@@ -235,7 +235,18 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     if cfg.checkpoint.resume_from:
         from sheeprl_tpu.utils.checkpoint import load_checkpoint
 
-        resume_state = load_checkpoint(cfg.checkpoint.resume_from)
+        try:
+            resume_state = load_checkpoint(cfg.checkpoint.resume_from)
+        except Exception:
+            # a load failure (path missing on this host, corrupt pickle) must
+            # surface on the player's weight plane like any learner crash —
+            # otherwise the player blocks on params_q.get until the channel
+            # timeout with the real traceback buried here
+            try:
+                params_q.put(None)
+            except _ChannelError:
+                pass
+            raise
     error: Dict[str, Any] = {}
     _trainer_loop(
         fabric, cfg, agent, params, data_q, params_q, error, geometry=geometry, resume_state=resume_state

@@ -194,7 +194,19 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     if cfg.checkpoint.resume_from:
         from sheeprl_tpu.utils.checkpoint import load_checkpoint
 
-        resume_state = load_checkpoint(cfg.checkpoint.resume_from)
+        try:
+            resume_state = load_checkpoint(cfg.checkpoint.resume_from)
+        except Exception:
+            # surface a load failure on the weight plane like any learner crash
+            # (the player otherwise blocks on params_q.get until the channel timeout)
+            try:
+                params_q.put(None)
+            except ChannelError:
+                pass
+            raise
+        # the slice only needs params + opt_state; drop the (potentially
+        # GB-sized) replay buffer the player-side state carries
+        resume_state.pop("rb", None)
     error: Dict[str, Any] = {}
     _trainer_loop(
         fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error,
