@@ -40,6 +40,41 @@ def _fused_deconv_enabled() -> bool:
     return os.environ.get("SHEEPRL_DISABLE_FUSED_DECONV", "0") != "1"
 
 
+# XLA:CPU's convolution is pathological at SMALL input-channel counts in any
+# form (see ops/conv.py's module header) — which is exactly the late Dreamer
+# decoder stages (2-4 channels at 32x32+ spatial, the most expensive maps). At
+# those shapes the phase convolution runs faster as an explicit im2col matmul,
+# whose AUTODIFF backward is also pure matmuls + slice-adds (measured on the
+# DV3 benchmark decoder: last stage fwd+bwd 186 -> 68 ms, second-to-last
+# 27 -> 15 ms; at cin >= 8 the native conv is at parity or ahead, so the gate).
+_IM2COL_MAX_CIN = 4
+
+
+def _im2col_conv_s1(xp: jax.Array, k2: jax.Array) -> jax.Array:
+    """Stride-1 VALID convolution as an im2col matmul ([t*t*Cin] patch rows x
+    flattened kernel). Exact same math as ``lax.conv_general_dilated`` with
+    stride 1; faster on XLA:CPU for tiny Cin, with a matmul-only backward."""
+    t = k2.shape[0]
+    n, hp, wp, c_in = xp.shape
+    c_out = k2.shape[-1]
+    ho, wo = hp - t + 1, wp - t + 1
+    cols = jnp.concatenate(
+        [xp[:, a : a + ho, b : b + wo, :] for a in range(t) for b in range(t)], axis=-1
+    )
+    w_flat = k2.reshape(t * t * c_in, c_out)
+    # cols channel order is (a, b, ci) — matches k2's (kh, kw, ci) row order
+    return jnp.dot(cols.reshape(-1, t * t * c_in), w_flat).reshape(n, ho, wo, c_out)
+
+
+def _phase_conv(xp: jax.Array, k2: jax.Array) -> jax.Array:
+    """The phase convolution with the small-Cin im2col fast path."""
+    if xp.shape[-1] <= _IM2COL_MAX_CIN:
+        return _im2col_conv_s1(xp, k2)
+    return lax.conv_general_dilated(
+        xp, k2, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
 class FusedConvTranspose4x4S2(nn.Module):
     """Drop-in for ``nn.ConvTranspose(features, (4, 4), strides=(2, 2),
     padding="SAME")`` on NHWC inputs, computed in phase-decomposed form."""
@@ -73,9 +108,7 @@ class FusedConvTranspose4x4S2(nn.Module):
                 [kernel[r::2, c::2] for r in range(2) for c in range(2)], axis=-1
             )  # [2, 2, Cin, 4*Cout]
             xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
-            y = lax.conv_general_dilated(
-                xp, k2, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
-            )  # [N, H+1, W+1, 4*Cout]
+            y = _phase_conv(xp, k2)  # [N, H+1, W+1, 4*Cout]
             # phase (r, c) reads y at spatial offset (r, c); depth-to-space interleave
             phases = [
                 y[:, r : h + r, c : w_sp + c, i * c_out : (i + 1) * c_out]
@@ -174,9 +207,7 @@ class FusedConvTransposeS2Valid(nn.Module):
             pad_r_h = max(n_rows[r] - 1 + delta[r] + t_max - 1 for r in range(2)) - (h - 1)
             pad_r_w = max(n_cols[c] - 1 + delta[c] + t_max - 1 for c in range(2)) - (w_sp - 1)
             xp = jnp.pad(x, ((0, 0), (pad_l, pad_r_h), (pad_l, pad_r_w), (0, 0)))
-            y = lax.conv_general_dilated(
-                xp, k2, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
-            )
+            y = _phase_conv(xp, k2)
 
             # read each phase at its offset, pad ragged phases by one junk row/col so
             # a plain reshape interleaves, then slice the junk off
