@@ -42,18 +42,24 @@ def _fused_deconv_enabled() -> bool:
 
 # XLA:CPU's convolution is pathological at SMALL input-channel counts in any
 # form (see ops/conv.py's module header) — which is exactly the late Dreamer
-# decoder stages (2-4 channels at 32x32+ spatial, the most expensive maps). At
-# those shapes the phase convolution runs faster as an explicit im2col matmul,
-# whose AUTODIFF backward is also pure matmuls + slice-adds (measured on the
-# DV3 benchmark decoder: last stage fwd+bwd 186 -> 68 ms, second-to-last
-# 27 -> 15 ms; at cin >= 8 the native conv is at parity or ahead, so the gate).
+# decoder stages (2-4 channels at 32x32+ spatial, the most expensive maps). For
+# 2x2 phase kernels (the k=4 SAME deconv — Dreamer-V3's decoder) those shapes
+# run ~2.8x faster as an explicit im2col matmul whose AUTODIFF backward is also
+# pure matmuls + slice-adds (last stage fwd+bwd 186 -> 68 ms, second-to-last
+# 27 -> 15 ms; at cin >= 8 the native conv is at parity, so the cin gate). For
+# 3x3 phase kernels (the k=5/6 VALID deconvs — DV1/DV2, SAC-AE) the 9-slice
+# cols concat dominates and im2col measured 1.2-1.6x SLOWER than the native
+# conv at both benchmark batch sizes — every matmul reformulation tried
+# (shift-accumulate, conv_general_dilated_patches, custom tap-matmul vjp)
+# landed at or behind the native lowering, so t=3 keeps it.
 _IM2COL_MAX_CIN = 4
 
 
 def _im2col_conv_s1(xp: jax.Array, k2: jax.Array) -> jax.Array:
     """Stride-1 VALID convolution as an im2col matmul ([t*t*Cin] patch rows x
     flattened kernel). Exact same math as ``lax.conv_general_dilated`` with
-    stride 1; faster on XLA:CPU for tiny Cin, with a matmul-only backward."""
+    stride 1; faster on XLA:CPU for tiny Cin at t=2, with a matmul-only
+    backward."""
     t = k2.shape[0]
     n, hp, wp, c_in = xp.shape
     c_out = k2.shape[-1]
@@ -67,8 +73,8 @@ def _im2col_conv_s1(xp: jax.Array, k2: jax.Array) -> jax.Array:
 
 
 def _phase_conv(xp: jax.Array, k2: jax.Array) -> jax.Array:
-    """The phase convolution with the small-Cin im2col fast path."""
-    if xp.shape[-1] <= _IM2COL_MAX_CIN:
+    """The phase convolution with the small-Cin im2col fast path (t=2 only)."""
+    if k2.shape[0] == 2 and xp.shape[-1] <= _IM2COL_MAX_CIN:
         return _im2col_conv_s1(xp, k2)
     return lax.conv_general_dilated(
         xp, k2, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
