@@ -47,7 +47,8 @@ def _fused_deconv_enabled() -> bool:
 # run ~2.8x faster as an explicit im2col matmul whose AUTODIFF backward is also
 # pure matmuls + slice-adds (last stage fwd+bwd 186 -> 68 ms, second-to-last
 # 27 -> 15 ms; at cin >= 8 the native conv is at parity, so the cin gate). For
-# 3x3 phase kernels (the k=5/6 VALID deconvs — DV1/DV2, SAC-AE) the 9-slice
+# 3x3 phase kernels (the k=5/6 VALID deconvs — DV1/DV2; SAC-AE's k=4 deconv
+# yields t_max=2 but sits above the cin gate) the 9-slice
 # cols concat dominates and im2col measured 1.2-1.6x SLOWER than the native
 # conv at both benchmark batch sizes — every matmul reformulation tried
 # (shift-accumulate, conv_general_dilated_patches, custom tap-matmul vjp)
