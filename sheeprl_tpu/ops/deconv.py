@@ -52,8 +52,13 @@ def _fused_deconv_enabled() -> bool:
 # cols concat dominates and im2col measured 1.2-1.6x SLOWER than the native
 # conv at both benchmark batch sizes — every matmul reformulation tried
 # (shift-accumulate, conv_general_dilated_patches, custom tap-matmul vjp)
-# landed at or behind the native lowering, so t=3 keeps it.
+# landed at or behind the native lowering, so large-map t=3 keeps it. The
+# EARLY decoder stages are the opposite regime: at tiny spatial extents the
+# cols concat is cheap and the matmul dominates regardless of t or cin
+# (4x4 extent, cin=32, t=3: native 71 ms -> im2col 15 ms fwd+bwd), so a small
+# spatial area also takes the path.
 _IM2COL_MAX_CIN = 4
+_IM2COL_MAX_AREA = 36  # padded-extent H*W; 6x6 measured at parity, 4x4 a 4.8x win
 
 
 def _im2col_conv_s1(xp: jax.Array, k2: jax.Array) -> jax.Array:
@@ -74,8 +79,11 @@ def _im2col_conv_s1(xp: jax.Array, k2: jax.Array) -> jax.Array:
 
 
 def _phase_conv(xp: jax.Array, k2: jax.Array) -> jax.Array:
-    """The phase convolution with the small-Cin im2col fast path (t=2 only)."""
-    if k2.shape[0] == 2 and xp.shape[-1] <= _IM2COL_MAX_CIN:
+    """The phase convolution with the im2col fast path (tiny channels at t=2,
+    or tiny spatial extent at any t — see the gate notes above)."""
+    if (k2.shape[0] == 2 and xp.shape[-1] <= _IM2COL_MAX_CIN) or (
+        xp.shape[1] * xp.shape[2] <= _IM2COL_MAX_AREA
+    ):
         return _im2col_conv_s1(xp, k2)
     return lax.conv_general_dilated(
         xp, k2, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
