@@ -1,0 +1,7 @@
+"""Root launcher for no-install source checkouts (role of reference sheeprl.py):
+``python sheeprl.py exp=ppo env=gym env.id=CartPole-v1``."""
+
+from sheeprl_tpu.cli import run
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,7 @@
+"""Root evaluation launcher (role of reference sheeprl_eval.py):
+``python sheeprl_eval.py checkpoint_path=...``."""
+
+from sheeprl_tpu.cli import evaluation
+
+if __name__ == "__main__":
+    evaluation()

@@ -1,0 +1,7 @@
+"""Root model-registration launcher (role of reference sheeprl_model_manager.py):
+``python sheeprl_model_manager.py checkpoint_path=... tracking_uri=...``."""
+
+from sheeprl_tpu.cli import registration
+
+if __name__ == "__main__":
+    registration()

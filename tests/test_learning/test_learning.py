@@ -144,6 +144,36 @@ def test_sac_pendulum_learns():
 @pytest.mark.slow
 @pytest.mark.learning
 @pytest.mark.timeout(300)
+def test_ppo_recurrent_cartpole_learns():
+    """Recurrent PPO (LSTM over rollout sequences, lax.scan BPTT) clears a
+    learning bar on CartPole-v1 — quality evidence for the recurrent path, whose
+    sequence chunking/minibatching differs entirely from feed-forward PPO."""
+    run(
+        [
+            "exp=ppo_recurrent",
+            "fabric.accelerator=cpu",
+            "env.sync_env=True",
+            "env.num_envs=4",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "checkpoint.save_last=False",
+            "metric.log_level=1",
+            "metric.log_every=8192",
+            "algo.total_steps=24576",
+            "algo.rollout_steps=128",
+            "algo.per_rank_sequence_length=16",
+            "algo.per_rank_num_batches=4",
+            "algo.update_epochs=4",
+        ]
+    )
+    series = _scalar_series(_version_dir("ppo_recurrent"), "Test/cumulative_reward")
+    reward = series[-1][1]
+    assert reward >= 120.0, f"recurrent PPO did not learn CartPole: greedy test reward {reward} < 120"
+
+
+@pytest.mark.slow
+@pytest.mark.learning
+@pytest.mark.timeout(300)
 def test_droq_pendulum_learns():
     """DroQ (dropout + layer-norm critics, high replay ratio) learns Pendulum-v1
     with a fraction of SAC's env steps — the algorithm's whole point. Ratio is
