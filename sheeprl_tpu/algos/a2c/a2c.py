@@ -143,7 +143,10 @@ def main(fabric, cfg: Dict[str, Any]):
         else:
             split = jnp.split(out["actions"], np.cumsum(actions_dim)[:-1].tolist(), axis=-1)
             real_actions = jnp.stack([s.argmax(axis=-1) for s in split], axis=-1)
-        return out, real_actions, key
+        # one packed array -> one device-to-host conversion per step (same trick
+        # as ppo.py's policy_step_fn; A2C stores values + actions only)
+        packed = jnp.concatenate([out["values"], out["actions"]], axis=-1).astype(jnp.float32)
+        return packed, real_actions, key
 
     @jax.jit
     def get_values(params, obs: Dict[str, jax.Array]):
@@ -197,7 +200,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 policy_step += total_num_envs
 
                 obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
-                out, real_actions, key = policy_step_fn(act_params, obs_host, key)
+                packed, real_actions, key = policy_step_fn(act_params, obs_host, key)
                 real_actions_np = np.asarray(real_actions)
                 if is_continuous:
                     env_actions = real_actions_np.reshape(envs.action_space.shape)
@@ -224,9 +227,10 @@ def main(fabric, cfg: Dict[str, Any]):
                         vals = np.asarray(get_values(act_params, real_next_obs)).reshape(-1, 1)
                         rewards[truncated_envs] += cfg.algo.gamma * vals
 
+                packed_np = np.asarray(packed)
                 step_data["dones"] = dones[np.newaxis]
-                step_data["values"] = np.asarray(out["values"], dtype=np.float32)[np.newaxis]
-                step_data["actions"] = np.asarray(out["actions"], dtype=np.float32)[np.newaxis]
+                step_data["values"] = packed_np[:, :1][np.newaxis]
+                step_data["actions"] = packed_np[:, 1:][np.newaxis]
                 step_data["rewards"] = rewards[np.newaxis]
                 if cfg.buffer.memmap:
                     step_data["returns"] = np.zeros_like(rewards)[np.newaxis]
