@@ -7,6 +7,12 @@ import sys
 
 def main() -> None:
     coordinator, num_processes, process_id, out_path = sys.argv[1:5]
+    # optional argv[5]: path to a json list of extra overrides (e.g. a
+    # checkpoint.resume_from for the multi-process resume test)
+    extra = []
+    if len(sys.argv) > 5:
+        with open(sys.argv[5]) as f:
+            extra = json.load(f)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -32,6 +38,7 @@ def main() -> None:
             "root_dir=decoupled2p",
             "run_name=ppo",
         ]
+        + extra
     )
     with open(out_path, "w") as f:
         json.dump({"process": int(process_id), "ok": True}, f)

@@ -28,15 +28,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_workers(worker: str, n: int, tmp_path, ckpt_glob: str, timeout: int = 260) -> None:
+def _run_workers(
+    worker: str, n: int, tmp_path, ckpt_glob: str, timeout: int = 260, extra=None
+) -> None:
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
     outs = [str(tmp_path / f"out_{i}.json") for i in range(n)]
     env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    argv_tail = []
+    if extra is not None:
+        extra_path = str(tmp_path / f"extra_{port}.json")
+        with open(extra_path, "w") as f:
+            json.dump(list(extra), f)
+        argv_tail = [extra_path]
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, coordinator, str(n), str(i), outs[i]],
+            [sys.executable, worker, coordinator, str(n), str(i), outs[i]] + argv_tail,
             cwd=str(tmp_path),
             env=env,
             stdout=subprocess.PIPE,
@@ -44,7 +52,17 @@ def _run_workers(worker: str, n: int, tmp_path, ckpt_glob: str, timeout: int = 2
         )
         for i in range(n)
     ]
-    logs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    try:
+        logs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    except subprocess.TimeoutExpired:
+        # kill the whole pod: an orphaned jax.distributed worker would keep the
+        # coordinator port and a core for the rest of the session
+        for p in procs:
+            p.kill()
+        logs = [p.communicate()[0].decode() for p in procs]
+        raise AssertionError(
+            "worker pod timed out; last logs:\n" + "\n---\n".join(log[-2000:] for log in logs)
+        )
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"worker rank failed:\n{log[-4000:]}"
     results = [json.load(open(o)) for o in outs]
@@ -77,6 +95,33 @@ def test_decoupled_ppo_player_plus_two_learners(tmp_path):
 @pytest.mark.timeout(420)
 def test_decoupled_sac_player_plus_two_learners(tmp_path):
     _run_workers(_SAC_WORKER, 3, tmp_path, "logs/runs/sacdec2p/sac/**/ckpt_*.ckpt", timeout=400)
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(560)
+def test_decoupled_ppo_two_process_resume(tmp_path):
+    """Multi-process resume: phase 1 trains 3 real iterations writing mid-run
+    checkpoints; phase 2 resumes from the FIRST one — the learner PROCESS loads
+    the checkpoint itself (params + optimizer) and the continuation runs real
+    train rounds through the channels, re-writing only the later checkpoints."""
+    real = ["dry_run=False", "algo.total_steps=48", "checkpoint.every=16"]
+    _run_workers(
+        _WORKER, 2, tmp_path, "logs/runs/decoupled2p/ppo/**/version_0/**/ckpt_*.ckpt", extra=real
+    )
+    first = sorted(
+        glob.glob(str(tmp_path / "logs/runs/decoupled2p/ppo/**/version_0/**/ckpt_*.ckpt"), recursive=True)
+    )[0]  # ckpt_16
+    _run_workers(
+        _WORKER,
+        2,
+        tmp_path,
+        "logs/runs/decoupled2p/ppo/**/version_1/**/ckpt_48_0.ckpt",
+        extra=real + [f"checkpoint.resume_from={os.path.abspath(first)}"],
+    )
+    resumed = glob.glob(
+        str(tmp_path / "logs/runs/decoupled2p/ppo/**/version_1/**/ckpt_*.ckpt"), recursive=True
+    )
+    assert not any(p.endswith("ckpt_16_0.ckpt") for p in resumed), resumed
 
 
 @pytest.mark.slow
