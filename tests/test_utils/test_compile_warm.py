@@ -48,6 +48,44 @@ def test_compile_warm_runs_one_update(tmp_path, monkeypatch, capsys):
     assert not (tmp_path / "logs").exists()
 
 
+def test_compile_warm_dreamer_runs_one_train_phase(tmp_path, monkeypatch, capsys):
+    """The off-policy branch end-to-end: a tiny DV3 priming run must reach its
+    first gradient phase (learning_starts + replay-ratio credit) and leave no
+    artifacts behind."""
+    monkeypatch.chdir(tmp_path)
+    compile_warm(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "fabric.accelerator=cpu",
+            "env.sync_env=True",
+            "env.num_envs=1",
+            "algo.learning_starts=8",
+            "algo.replay_ratio=1",
+            "algo.per_rank_batch_size=1",
+            "algo.per_rank_sequence_length=1",
+            "algo.horizon=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.mlp_keys.decoder=[state]",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "[sheeprl-compile] priming dreamer_v3 for 11 env steps" in out
+    assert "[sheeprl-compile] done in" in out
+    assert not (tmp_path / "logs").exists()
+
+
 def test_compile_warm_rejects_underivable_budget():
     cfg = compose(["exp=ppo"])
     del cfg.algo["rollout_steps"]
