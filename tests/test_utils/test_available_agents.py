@@ -1,0 +1,29 @@
+"""The sheeprl-agents listing (role of reference sheeprl/available_agents.py)."""
+
+import pytest
+
+
+def test_available_agents_lists_every_algorithm(capsys):
+    from sheeprl_tpu.available_agents import available_agents
+
+    available_agents()
+    out = capsys.readouterr().out
+    for name in (
+        "a2c",
+        "ppo",
+        "ppo_decoupled",
+        "ppo_recurrent",
+        "sac",
+        "sac_decoupled",
+        "sac_ae",
+        "droq",
+        "dreamer_v1",
+        "dreamer_v2",
+        "dreamer_v3",
+        "dreamer_v3_decoupled",
+        "p2e_dv1",
+        "p2e_dv2",
+        "p2e_dv3",
+        "offline_dreamer",
+    ):
+        assert name in out, f"{name} missing from the agents table"
