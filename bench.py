@@ -180,12 +180,76 @@ def _accelerator_probe_cached(timeout: int = 90) -> dict:
     return result
 
 
+def _steady_window_run(args: list, steady_start: int) -> dict:
+    """One training run with the BenchWindow active; returns its {steps, seconds}."""
+    from sheeprl_tpu.cli import run
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        steady_file = f.name
+    os.environ["SHEEPRL_BENCH_STEADY_FILE"] = steady_file
+    os.environ["SHEEPRL_BENCH_STEADY_START"] = str(steady_start)
+    try:
+        run(args)
+        with open(steady_file) as f:
+            return json.load(f)
+    finally:
+        os.environ.pop("SHEEPRL_BENCH_STEADY_FILE", None)
+        os.environ.pop("SHEEPRL_BENCH_STEADY_START", None)
+        try:
+            os.unlink(steady_file)
+        except OSError:
+            pass
+
+
+def _prefetch_ab_enabled(algo: str) -> bool:
+    """Prefetch on/off A/B knob: SHEEPRL_BENCH_PREFETCH_AB=1/0 forces it; unset
+    defaults to ON for the dreamer_v3 north star and the sac steady workload (the
+    two loops the prefetch acceptance gate names) and OFF elsewhere — the off-run
+    doubles the workload's wall-clock."""
+    ab = os.environ.get("SHEEPRL_BENCH_PREFETCH_AB")
+    if ab is not None:
+        return ab not in ("0", "")
+    return algo in ("dreamer_v3", "sac_steady")
+
+
+def _steady_ab_result(
+    ab_key: str, metric: str, args: list, total: int, steady_start: int, baseline_sps: float
+) -> dict:
+    """Shared steady-state measurement + result assembly: one window with the
+    default config (prefetch on), optionally a second with
+    ``buffer.prefetch.enabled=false``, both recorded under ``conditions.prefetch``."""
+    steady = _steady_window_run(args, steady_start)
+    sps = steady["steps"] / steady["seconds"]
+    prefetch_cond = {"enabled_sps": round(sps, 2)}
+    if _prefetch_ab_enabled(ab_key):
+        steady_off = _steady_window_run(args + ["buffer.prefetch.enabled=false"], steady_start)
+        off_sps = steady_off["steps"] / steady_off["seconds"]
+        prefetch_cond["disabled_sps"] = round(off_sps, 2)
+        prefetch_cond["speedup"] = round(sps / off_sps, 3) if off_sps > 0 else None
+    return {
+        "metric": metric,
+        "value": round(sps, 2),
+        "unit": "env-steps/sec (steady-state)",
+        "vs_baseline": round(sps / baseline_sps, 3),
+        "conditions": {
+            "steady_window_steps": steady["steps"],
+            "steady_window_seconds": round(steady["seconds"], 2),
+            "total_steps": total,
+            "baseline_sps": round(baseline_sps, 2),
+            "prefetch": prefetch_cond,
+        },
+    }
+
+
 def _bench_dreamer_steady(algo: str = "dreamer_v3") -> dict:
-    """Dreamer-family steady-state env-steps/sec over a bounded post-compile window."""
+    """Dreamer-family steady-state env-steps/sec over a bounded post-compile window.
+
+    With the A/B knob on (see _prefetch_ab_enabled) the same window is measured a
+    second time with ``buffer.prefetch.enabled=false`` and both numbers land in
+    ``conditions.prefetch`` so the async-prefetch win is visible in BENCH_*.json.
+    """
     total_steps, ref_seconds = BASELINES[algo]
     baseline_sps = total_steps / ref_seconds  # dv3: 10.31 sps on 4 CPUs
-
-    from sheeprl_tpu.cli import run
 
     args = [f"exp={algo}_benchmarks"]
     try:
@@ -203,43 +267,20 @@ def _bench_dreamer_steady(algo: str = "dreamer_v3") -> dict:
         total = max(total, 4096)
     args += [f"algo.total_steps={total}"]
 
-    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
-        steady_file = f.name
-    os.environ["SHEEPRL_BENCH_STEADY_FILE"] = steady_file
-    os.environ["SHEEPRL_BENCH_STEADY_START"] = str(steady_start)
-    try:
-        run(args)
-        with open(steady_file) as f:
-            steady = json.load(f)
-    finally:
-        os.environ.pop("SHEEPRL_BENCH_STEADY_FILE", None)
-        os.environ.pop("SHEEPRL_BENCH_STEADY_START", None)
-        try:
-            os.unlink(steady_file)
-        except OSError:
-            pass
-    sps = steady["steps"] / steady["seconds"]
-    result = {
-        "metric": f"{algo}_env_steps_per_sec",
-        "value": round(sps, 2),
-        "unit": "env-steps/sec (steady-state)",
-        "vs_baseline": round(sps / baseline_sps, 3),
-        "conditions": {
-            "steady_window_steps": steady["steps"],
-            "steady_window_seconds": round(steady["seconds"], 2),
-            "total_steps": total,
-            "baseline_sps": round(baseline_sps, 2),
-            # "cpu-fallback" strictly means a dead/wedged accelerator was demoted;
-            # a healthy CPU-only machine reports plain "cpu"
-            "accelerator": "cpu-fallback"
-            if not probe["alive"]
-            else "cpu"
-            if probe["platform"] == "cpu"
-            else f"tpu ({probe['device_kind']})"
-            if probe["platform"] in ("tpu", "axon")
-            else probe["platform"],
-        },
-    }
+    result = _steady_ab_result(
+        algo, f"{algo}_env_steps_per_sec", args, total, steady_start, baseline_sps
+    )
+    # "cpu-fallback" strictly means a dead/wedged accelerator was demoted;
+    # a healthy CPU-only machine reports plain "cpu"
+    result["conditions"]["accelerator"] = (
+        "cpu-fallback"
+        if not probe["alive"]
+        else "cpu"
+        if probe["platform"] == "cpu"
+        else f"tpu ({probe['device_kind']})"
+        if probe["platform"] in ("tpu", "axon")
+        else probe["platform"]
+    )
     if algo == "dreamer_v3":
         # MFU of the fused train program at the exact benchmark shapes (the act
         # program is host-side by design; the train program is where the FLOPs are)
@@ -328,6 +369,47 @@ def _dv3_train_mfu(size: str | None = None, reps: int = 5) -> dict:
     return stats
 
 
+def _bench_sac_steady() -> dict:
+    """SAC steady-state env-steps/sec over a bounded post-compile window (the
+    BenchWindow in sac.py), with the prefetch on/off A/B recorded like the dreamer
+    steady bench. The whole-run `sac` wall-clock workload stays untouched."""
+    total_steps, ref_seconds = BASELINES["sac"]
+    baseline_sps = total_steps / ref_seconds
+
+    args = ["exp=sac_benchmarks"]
+    try:
+        import Box2D  # noqa: F401  (gymnasium's LunarLanderContinuous backend)
+    except ImportError:
+        args += [
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "env.capture_video=False",
+            "algo.mlp_keys.encoder=[state]",
+            "checkpoint.save_last=False",
+            "metric.log_level=0",
+            "metric.disable_timer=True",
+        ]
+    total, steady_start = 6144, 2048  # warmup spans learning_starts (100) + compiles
+    probe = _accelerator_probe_cached()
+    if not probe["alive"] or probe["platform"] == "cpu":
+        args += ["fabric.accelerator=cpu"]
+    args += [f"algo.total_steps={total}"]
+
+    result = _steady_ab_result(
+        "sac_steady", "sac_env_steps_per_sec", args, total, steady_start, baseline_sps
+    )
+    result["conditions"]["accelerator"] = (
+        "cpu-fallback"
+        if not probe["alive"]
+        else "cpu"
+        if probe["platform"] == "cpu"
+        else f"tpu ({probe['device_kind']})"
+        if probe["platform"] in ("tpu", "axon")
+        else probe["platform"]
+    )
+    return result
+
+
 def _bench_dv3_mfu_flagship(size: str = "S") -> dict:
     """Standalone extra: flagship-size DV3 train-program MFU on the accelerator."""
     stats = _dv3_train_mfu(size=size)
@@ -350,6 +432,8 @@ def _bench_dv3_mfu_flagship(size: str = "S") -> dict:
 def _bench(algo: str) -> dict:
     if algo == "dreamer_v3_mfu":
         return _bench_dv3_mfu_flagship()
+    if algo == "sac_steady":
+        return _bench_sac_steady()
     if algo.startswith("dreamer_v"):
         return _bench_dreamer_steady(algo)
     return _bench_wallclock(algo)
@@ -431,8 +515,10 @@ def main() -> None:
     # Remote (tunneled-TPU) compiles of the fused Dreamer train programs take
     # MINUTES cold (observed >9 min for DV3 over the axon tunnel), so live-chip
     # budgets must absorb a cold compile; warm persistent-cache runs finish far
-    # inside them, and the headline has already been printed either way.
-    v3_budget = 2400 if live else 540
+    # inside them, and the headline has already been printed either way. The
+    # default prefetch on/off A/B doubles the dreamer_v3 steady workload, so its
+    # budget covers two windows.
+    v3_budget = 3000 if live else 960
     extras = []
     chip_busy = False  # a timed-out live-chip child still HOLDS the claim
     try:
@@ -441,6 +527,15 @@ def main() -> None:
     except Exception as exc:  # the already-printed headline must survive a failing extra
         result["extras_error"] = repr(exc)[:500]
         chip_busy = live and isinstance(exc, BenchTimeout)
+    # SAC steady-state with the same prefetch A/B — cheap (MLP program), runs on CPU
+    # or chip alike, and makes the prefetch acceptance numbers visible for both loops
+    if not chip_busy:
+        try:
+            extras.append(_bench_subprocess("sac_steady", timeout=900))
+            print(json.dumps({**result, "extras": extras}), flush=True)
+        except Exception as exc:
+            result["sac_steady_extra_error"] = repr(exc)[:500]
+            chip_busy = live and isinstance(exc, BenchTimeout)
     if chip_busy:
         # The abandoned child is still compiling/claiming on the single-tenant
         # chip; further live-chip extras would only queue behind it and time out

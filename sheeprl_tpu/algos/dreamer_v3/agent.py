@@ -890,7 +890,9 @@ def build_agent(
             "world_model": wm_params,
             "actor": actor_params,
             "critic": critic_params,
-            "target_critic": jax.tree_util.tree_map(lambda x: x, critic_params),
+            # explicit copy so critic/target_critic never alias one buffer — the
+            # donated train program rejects f(donate(a), donate(a))
+            "target_critic": jax.tree_util.tree_map(jnp.copy, critic_params),
         }
 
     if agent_state is not None:

@@ -40,6 +40,7 @@ from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import init_moments, prepare_obs, test, update_moments
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.utils.distribution import (
     BernoulliSafeMode,
@@ -211,7 +212,11 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx, world_
     # body; (b) every distinct ``per_rank_gradient_steps`` value the Ratio governor
     # produces would recompile the whole scanned program (~45 s each); the
     # single-step program compiles once for any G.
-    @jax.jit
+    # donate_argnums: XLA reuses the params/opt-state/moments buffers in place
+    # instead of copying the whole train state every gradient step (all drivers —
+    # foreach_gradient_step, the trainers, warmup — rebind to the returned trees,
+    # so the invalidated inputs are never read again).
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, opt_state, moments_state, batch, cum, k):
         k_world, k_img = jax.random.split(jnp.asarray(k))
 
@@ -311,12 +316,15 @@ class _InlineTrainer:
         self.params = params
         self.opt_state = opt_state
         self.moments_state = moments_state
+        # the replay sampler stages train blocks with this sharding (off-thread when
+        # prefetch is on); a channel trainer keeps it None — its data plane ships
+        # host blocks and the learner stages them itself
+        self.data_sharding = fabric.sharding(None, None, "data") if fabric.world_size > 1 else None
 
     def train(self, data, cum_steps, train_key, want_full_state: bool, want_metrics: bool):
-        """One train round over the ``[G, T, B, ...]`` block. Returns
+        """One train round over the ``[G, T, B, ...]`` block (already staged with
+        ``data_sharding`` by the replay sampler). Returns
         ``(act_params, host_metrics_or_None)``."""
-        if self.fabric.world_size > 1:
-            data = jax.device_put(data, self.fabric.sharding(None, None, "data"))
         self.params, self.opt_state, self.moments_state, metrics = self.train_phase(
             self.params,
             self.opt_state,
@@ -518,6 +526,21 @@ def run_dreamer(
     if state is not None and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
 
+    # replay hot path: async prefetcher (sampling + sharded staging off-thread) or the
+    # exact inline path when buffer.prefetch.enabled=false. Built AFTER the resume
+    # block above so a restored batch size shapes the staged units.
+    sampler = make_replay_sampler(
+        rb,
+        cfg.buffer.get("prefetch"),
+        sample_kwargs=dict(
+            batch_size=cfg.algo.per_rank_batch_size * world_size,
+            sequence_length=cfg.algo.per_rank_sequence_length,
+        ),
+        uint8_keys=cnn_keys,
+        sharding=trainer.data_sharding,
+        name="dv3-replay-prefetch",
+    )
+
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
         warnings.warn(
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
@@ -577,7 +600,7 @@ def run_dreamer(
                     )
 
             step_data["actions"] = actions.reshape((1, num_envs, -1)).astype(np.float32)
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            sampler.add(step_data, validate_args=cfg.buffer.validate_args)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -586,20 +609,23 @@ def run_dreamer(
 
         step_data["is_first"] = np.zeros_like(step_data["terminated"])
         if "restart_on_exception" in infos:
-            for i, agent_roe in enumerate(infos["restart_on_exception"]):
-                if agent_roe and not dones[i]:
-                    sub_rb = rb.buffer[i]
-                    last_inserted_idx = (sub_rb._pos - 1) % sub_rb.buffer_size
-                    sub_rb["terminated"][last_inserted_idx] = np.zeros_like(
-                        sub_rb["terminated"][last_inserted_idx]
-                    )
-                    sub_rb["truncated"][last_inserted_idx] = np.ones_like(
-                        sub_rb["truncated"][last_inserted_idx]
-                    )
-                    sub_rb["is_first"][last_inserted_idx] = np.zeros_like(
-                        sub_rb["is_first"][last_inserted_idx]
-                    )
-                    step_data["is_first"][:, i] = np.ones_like(step_data["is_first"][:, i])
+            # in-place ring-storage rewrite: take the sampler lock so a concurrent
+            # prefetch gather never reads a torn episode-boundary row
+            with sampler.lock:
+                for i, agent_roe in enumerate(infos["restart_on_exception"]):
+                    if agent_roe and not dones[i]:
+                        sub_rb = rb.buffer[i]
+                        last_inserted_idx = (sub_rb._pos - 1) % sub_rb.buffer_size
+                        sub_rb["terminated"][last_inserted_idx] = np.zeros_like(
+                            sub_rb["terminated"][last_inserted_idx]
+                        )
+                        sub_rb["truncated"][last_inserted_idx] = np.ones_like(
+                            sub_rb["truncated"][last_inserted_idx]
+                        )
+                        sub_rb["is_first"][last_inserted_idx] = np.zeros_like(
+                            sub_rb["is_first"][last_inserted_idx]
+                        )
+                        step_data["is_first"][:, i] = np.ones_like(step_data["is_first"][:, i])
 
         ep_info = infos.get("final_info", infos)
         if cfg.metric.log_level > 0 and "episode" in ep_info:
@@ -639,7 +665,7 @@ def run_dreamer(
             reset_data["actions"] = np.zeros((1, reset_envs, act_dim), np.float32)
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            sampler.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
             # the reset rows restart the episode in the *live* step_data
             step_data["rewards"][:, dones_idxes] = 0.0
             step_data["terminated"][:, dones_idxes] = 0.0
@@ -663,17 +689,7 @@ def run_dreamer(
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    sample = rb.sample(
-                        cfg.algo.per_rank_batch_size * world_size,
-                        sequence_length=cfg.algo.per_rank_sequence_length,
-                        n_samples=per_rank_gradient_steps,
-                    )
-                    # image keys stay uint8 across the host→device boundary (4× less
-                    # transfer); the jitted program normalizes on device
-                    data = {
-                        k: np.asarray(v) if k in cnn_keys else np.asarray(v, dtype=np.float32)
-                        for k, v in sample.items()
-                    }
+                    data = sampler.sample(per_rank_gradient_steps)
                     key, train_key = jax.random.split(key)
                     act_params, host_metrics = trainer.train(
                         data,
@@ -743,15 +759,19 @@ def run_dreamer(
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
             }
-            fabric.call(
-                "on_checkpoint_coupled",
-                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
-            )
+            # quiesce the prefetch worker so the pickled buffer (incl. its RNG
+            # state) is not a torn mid-sample snapshot
+            with sampler.lock:
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
 
     bench.finish(policy_step, trainer.sync_tree())
 
+    sampler.close()
     final_state = trainer.close()
     if pending_ckpt and final_state is not None:
         # deferred last checkpoint: the learner's final full state rode the
@@ -767,12 +787,15 @@ def run_dreamer(
             "last_log": last_log,
             "last_checkpoint": policy_step,
         }
-        fabric.call(
-            "on_checkpoint_coupled",
-            ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
-            state=ckpt_state,
-            replay_buffer=rb if cfg.buffer.checkpoint else None,
-        )
+        # quiesce the prefetch worker so the pickled buffer (incl. its RNG
+        # state) is not a torn mid-sample snapshot
+        with sampler.lock:
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:

@@ -76,6 +76,11 @@ def _warmup_train_step(fabric, cfg, train_phase, params, opt_state, observation_
         o = fabric.replicate_pytree(o)
         m = fabric.replicate_pytree(m)
         batch = jax.device_put(batch, fabric.sharding(None, "data"))
+    else:
+        # train_step donates its state args; the warmup must burn COPIES or the
+        # real params/opt_state handed to _trainer_loop would be invalidated
+        p = jax.tree_util.tree_map(jnp.array, p)
+        o = jax.tree_util.tree_map(jnp.array, o)
     out = train_phase.train_step(p, o, m, batch, jnp.asarray(0), jax.random.PRNGKey(0))
     jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
 
@@ -130,6 +135,9 @@ class _ChannelTrainer:
     loop postpones off-round checkpoints to the next round (or to close())."""
 
     defers_checkpoints = True
+    # the data plane ships HOST blocks (the two-process channel pickles them); the
+    # learner stages onto its own mesh, so the player-side sampler must not device_put
+    data_sharding = None
 
     def __init__(self, *, fabric, cfg, act, train_phase, params, opt_state, moments_state, multi_process, protocol_done):
         self.act = act

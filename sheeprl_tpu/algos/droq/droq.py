@@ -38,6 +38,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -217,7 +218,10 @@ def main(fabric, cfg: Dict[str, Any]):
     def alpha_loss_fn(log_alpha, logprobs):
         return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
 
-    @jax.jit
+    # donate_argnums: XLA reuses the params/opt-state buffers in place instead of
+    # copying the whole train state every round (callers always rebind to the
+    # returned trees, so the invalidated inputs are never read again)
+    @partial(jax.jit, donate_argnums=(0, 1))
     def train_phase(params, opt_state, critic_data, actor_data, train_key):
         """G critic updates via lax.scan (EMA folded into each step), then a single
         actor + alpha update — the whole reference train() (droq.py:30-137) as one
@@ -264,6 +268,21 @@ def main(fabric, cfg: Dict[str, Any]):
         opt_state = fabric.replicate_pytree(opt_state)
     act_params = act.view(params)
     key = act.place(key)
+
+    # replay hot path: one async prefetcher serves BOTH streams — the critic block
+    # pops G units, the actor batch is one extra unit of the same shape (identical
+    # sample kwargs), keeping the buffer RNG single-consumer and deterministic
+    sampler = make_replay_sampler(
+        rb,
+        cfg.buffer.get("prefetch"),
+        sample_kwargs=dict(
+            batch_size=cfg.algo.per_rank_batch_size * world_size,
+            sample_next_obs=sample_next_obs,
+        ),
+        uint8_keys=(),  # everything float32
+        sharding=fabric.sharding(None, "data") if world_size > 1 else None,
+        name="droq-replay-prefetch",
+    )
 
     # ---------------- main loop ----------------
     cumulative_per_rank_gradient_steps = 0
@@ -314,7 +333,7 @@ def main(fabric, cfg: Dict[str, Any]):
         if not sample_next_obs:
             step_data["next_observations"] = flat_real_next[np.newaxis]
         step_data["rewards"] = rewards[np.newaxis]
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        sampler.add(step_data, validate_args=cfg.buffer.validate_args)
 
         obs = next_obs
 
@@ -324,21 +343,10 @@ def main(fabric, cfg: Dict[str, Any]):
             per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    critic_sample = rb.sample(
-                        batch_size=cfg.algo.per_rank_batch_size * world_size,
-                        n_samples=per_rank_gradient_steps,
-                        sample_next_obs=sample_next_obs,
-                    )
-                    critic_data = {k: np.asarray(v, dtype=np.float32) for k, v in critic_sample.items()}
-                    actor_sample = rb.sample(
-                        batch_size=cfg.algo.per_rank_batch_size * world_size,
-                        n_samples=1,
-                        sample_next_obs=sample_next_obs,
-                    )
-                    actor_data = {k: np.asarray(v[0], dtype=np.float32) for k, v in actor_sample.items()}
-                    if world_size > 1:
-                        critic_data = jax.device_put(critic_data, fabric.sharding(None, "data"))
-                        actor_data = jax.device_put(actor_data, fabric.sharding("data"))
+                    critic_data = sampler.sample(per_rank_gradient_steps)
+                    # actor batch: one more unit of the same stream; slicing the
+                    # [1, B, ...] block keeps the batch-axis sharding
+                    actor_data = jax.tree_util.tree_map(lambda v: v[0], sampler.sample(1))
                     key, train_key = jax.random.split(key)
                     params, opt_state, mean_losses = train_phase(
                         params, opt_state, critic_data, actor_data, np.asarray(train_key)
@@ -391,13 +399,17 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
             }
-            fabric.call(
-                "on_checkpoint_coupled",
-                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
-            )
+            # quiesce the prefetch worker so the pickled buffer (incl. its RNG
+            # state) is not a torn mid-sample snapshot
+            with sampler.lock:
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
 
+    sampler.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(actor.apply, params["actor"], fabric, cfg, log_dir)

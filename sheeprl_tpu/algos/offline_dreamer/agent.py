@@ -371,7 +371,9 @@ def build_agent(
         "world_model": wm_params,
         "actor": actor_params,
         "critic": critic_params,
-        "target_critic": jax.tree_util.tree_map(lambda x: x, critic_params),
+        # a REAL copy: the donated train program must never see the same buffer in
+        # two leaves (XLA rejects f(donate(a), donate(a)))
+        "target_critic": jax.tree_util.tree_map(jnp.copy, critic_params),
     }
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)

@@ -33,6 +33,7 @@ from sheeprl_tpu.algos.p2e_dv2.utils import prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv3.agent import EnsembleHeads
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.utils.distribution import MSEDistribution
 from sheeprl_tpu.utils.env import make_env
@@ -180,7 +181,10 @@ def make_train_phase(agent: DV2Agent, ensembles: EnsembleHeads, cfg, txs: Dict[s
         lp = _normal1_logprob(pred, jax.lax.stop_gradient(lambda_values), 1)
         return -jnp.mean(discount[:-1, ..., 0] * lp)
 
-    @jax.jit
+    # donate_argnums: XLA reuses the train-state buffers in place instead of
+    # copying them every gradient step (drivers always rebind to the returned
+    # trees, so the invalidated inputs are never read again)
+    @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, batch, cum, k):
         k_world, k_expl, k_task = jax.random.split(jnp.asarray(k), 3)
 
@@ -418,6 +422,21 @@ def main(fabric, cfg: Dict[str, Any]):
     if state is not None:
         ratio.load_state_dict(state["ratio"])
 
+    # replay hot path: async prefetcher (sampling + sharded staging off-thread) or the
+    # exact inline path when buffer.prefetch.enabled=false. Built AFTER the resume
+    # block above so a restored batch size shapes the staged units.
+    sampler = make_replay_sampler(
+        rb,
+        cfg.buffer.get("prefetch"),
+        sample_kwargs=dict(
+            batch_size=cfg.algo.per_rank_batch_size * world_size,
+            sequence_length=cfg.algo.per_rank_sequence_length,
+        ),
+        uint8_keys=cnn_keys,
+        sharding=fabric.sharding(None, None, "data") if world_size > 1 else None,
+        name="p2e-dv2-exp-replay-prefetch",
+    )
+
     if cfg.checkpoint.every % policy_steps_per_iter != 0:
         warnings.warn(
             f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
@@ -474,7 +493,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
 
             step_data["actions"] = actions.reshape((1, num_envs, -1)).astype(np.float32)
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            sampler.add(step_data, validate_args=cfg.buffer.validate_args)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -520,7 +539,7 @@ def main(fabric, cfg: Dict[str, Any]):
             reset_data["actions"] = np.zeros((1, reset_envs, act_dim), np.float32)
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            sampler.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
             step_data["rewards"][:, dones_idxes] = 0.0
             step_data["terminated"][:, dones_idxes] = 0.0
             step_data["truncated"][:, dones_idxes] = 0.0
@@ -532,17 +551,7 @@ def main(fabric, cfg: Dict[str, Any]):
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    sample = rb.sample(
-                        cfg.algo.per_rank_batch_size * world_size,
-                        sequence_length=cfg.algo.per_rank_sequence_length,
-                        n_samples=per_rank_gradient_steps,
-                    )
-                    data = {
-                        k: np.asarray(v) if k in cnn_keys else np.asarray(v, dtype=np.float32)
-                        for k, v in sample.items()
-                    }
-                    if world_size > 1:
-                        data = jax.device_put(data, fabric.sharding(None, None, "data"))
+                    data = sampler.sample(per_rank_gradient_steps)
                     key, train_key = jax.random.split(key)
                     params, opt_state, metrics = train_phase(
                         params,
@@ -601,13 +610,17 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
             }
-            fabric.call(
-                "on_checkpoint_coupled",
-                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
-            )
+            # quiesce the prefetch worker so the pickled buffer (incl. its RNG
+            # state) is not a torn mid-sample snapshot
+            with sampler.lock:
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
 
+    sampler.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(player, act_params, fabric, cfg, log_dir, greedy=False)

@@ -32,12 +32,13 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import ActPlacement, Ratio, save_configs
+from sheeprl_tpu.utils.utils import ActPlacement, BenchWindow, Ratio, save_configs
 
 
 @register_algorithm()
@@ -203,7 +204,10 @@ def main(fabric, cfg: Dict[str, Any]):
     def alpha_loss_fn(log_alpha, logprobs):
         return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
 
-    @jax.jit
+    # donate_argnums: XLA reuses the params/opt-state buffers in place instead of
+    # copying the whole train state every round (callers always rebind to the
+    # returned trees, so the invalidated inputs are never read again)
+    @partial(jax.jit, donate_argnums=(0, 1))
     def train_phase(params, opt_state, data, iter_num, train_key):
         """scan over the [G, B, ...] gradient-step axis: critic -> EMA -> actor -> alpha
         (one fused device program per iteration; reference train(), sac.py:32-81)."""
@@ -253,12 +257,30 @@ def main(fabric, cfg: Dict[str, Any]):
     act_params = act.view(params)
     key = act.place(key)
 
+    # replay hot path: async prefetcher (sampling + sharded staging off-thread) or
+    # the exact inline path when buffer.prefetch.enabled=false
+    sampler = make_replay_sampler(
+        rb,
+        cfg.buffer.get("prefetch"),
+        sample_kwargs=dict(
+            batch_size=cfg.algo.per_rank_batch_size * world_size,
+            sample_next_obs=sample_next_obs,
+        ),
+        uint8_keys=(),  # everything float32
+        sharding=fabric.sharding(None, "data") if world_size > 1 else None,
+        name="sac-replay-prefetch",
+    )
+
     # ---------------- main loop ----------------
     cumulative_per_rank_gradient_steps = 0
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
 
+    # Optional steady-state measurement window for bench.py (see bench.py docstring)
+    bench = BenchWindow()
+
     for iter_num in range(start_iter, total_iters + 1):
+        bench.maybe_start(policy_step, params)
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time"):
@@ -303,7 +325,7 @@ def main(fabric, cfg: Dict[str, Any]):
         if not sample_next_obs:
             step_data["next_observations"] = flat_real_next[np.newaxis]
         step_data["rewards"] = rewards[np.newaxis]
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        sampler.add(step_data, validate_args=cfg.buffer.validate_args)
 
         obs = next_obs
 
@@ -312,14 +334,7 @@ def main(fabric, cfg: Dict[str, Any]):
             per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    sample = rb.sample(
-                        batch_size=cfg.algo.per_rank_batch_size * world_size,
-                        n_samples=per_rank_gradient_steps,
-                        sample_next_obs=sample_next_obs,
-                    )
-                    data = {k: np.asarray(v, dtype=np.float32) for k, v in sample.items()}
-                    if world_size > 1:
-                        data = jax.device_put(data, fabric.sharding(None, "data"))
+                    data = sampler.sample(per_rank_gradient_steps)
                     key, train_key = jax.random.split(key)
                     params, opt_state, mean_losses = train_phase(
                         params, opt_state, data, jnp.asarray(iter_num), np.asarray(train_key)
@@ -372,13 +387,18 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
             }
-            fabric.call(
-                "on_checkpoint_coupled",
-                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
-            )
+            # quiesce the prefetch worker so the pickled buffer (incl. its RNG
+            # state) is not a torn mid-sample snapshot
+            with sampler.lock:
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
 
+    bench.finish(policy_step, params)
+    sampler.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(actor.apply, params["actor"], fabric, cfg, log_dir)

@@ -12,6 +12,7 @@ broadcast (sac_decoupled.py:266-272)."""
 from __future__ import annotations
 
 import os
+from functools import partial
 import queue
 import threading
 import warnings
@@ -28,6 +29,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.parallel.distributed import BroadcastChannel, ChannelError, replicated_to_host
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -90,7 +92,10 @@ def _trainer_loop(
         def alpha_loss_fn(log_alpha, logprobs):
             return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
 
-        @jax.jit
+        # donate_argnums: XLA reuses the params/opt-state buffers in place instead
+        # of copying the whole train state every round (the loop always rebinds to
+        # the returned trees, so the invalidated inputs are never read again)
+        @partial(jax.jit, donate_argnums=(0, 1))
         def train_phase(params, opt_state, data, iter_num, train_key):
             do_ema = (iter_num % target_period) == 0
 
@@ -374,6 +379,21 @@ def main(fabric, cfg: Dict[str, Any]):
 
         act_params = act.view(params)
         params_host = jax.tree_util.tree_map(np.asarray, params)
+
+        # replay hot path: the prefetcher overlaps host sampling with env stepping
+        # and the learner's round; staging stays host-side (sharding=None) because
+        # the data plane ships pickled host blocks the learner stages itself
+        sampler = make_replay_sampler(
+            rb,
+            cfg.buffer.get("prefetch"),
+            sample_kwargs=dict(
+                batch_size=cfg.algo.per_rank_batch_size * world_size,
+                sample_next_obs=sample_next_obs,
+            ),
+            uint8_keys=(),  # everything float32
+            sharding=None,
+            name="sac-dec-replay-prefetch",
+        )
         opt_state_host: Optional[Any] = None
         key = act.place(key)
 
@@ -428,7 +448,7 @@ def main(fabric, cfg: Dict[str, Any]):
             if not sample_next_obs:
                 step_data["next_observations"] = flat_real_next[np.newaxis]
             step_data["rewards"] = rewards[np.newaxis]
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            sampler.add(step_data, validate_args=cfg.buffer.validate_args)
 
             obs = next_obs
 
@@ -436,12 +456,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
                 if per_rank_gradient_steps > 0:
                     with timer("Time/train_time"):
-                        sample = rb.sample(
-                            batch_size=cfg.algo.per_rank_batch_size * world_size,
-                            n_samples=per_rank_gradient_steps,
-                            sample_next_obs=sample_next_obs,
-                        )
-                        data = {k: np.asarray(v, dtype=np.float32) for k, v in sample.items()}
+                        data = sampler.sample(per_rank_gradient_steps)
                         # data plane: ship the replay block to the learner (reference
                         # scatter, sac_decoupled.py:243-257) and BLOCK on the weight plane
                         want_opt_state = bool(
@@ -511,13 +526,17 @@ def main(fabric, cfg: Dict[str, Any]):
                     "last_log": last_log,
                     "last_checkpoint": last_checkpoint,
                 }
-                fabric.call(
-                    "on_checkpoint_player",
-                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
-                    state=ckpt_state,
-                    replay_buffer=rb if cfg.buffer.checkpoint else None,
-                )
+                # quiesce the prefetch worker so the pickled buffer (incl. its RNG
+                # state) is not a torn mid-sample snapshot
+                with sampler.lock:
+                    fabric.call(
+                        "on_checkpoint_player",
+                        ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                        state=ckpt_state,
+                        replay_buffer=rb if cfg.buffer.checkpoint else None,
+                    )
 
+        sampler.close()
         data_q.put(None)
         if trainer is not None:
             trainer.join(timeout=60)

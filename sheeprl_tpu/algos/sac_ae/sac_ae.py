@@ -28,6 +28,7 @@ from sheeprl_tpu.algos.sac_ae.agent import build_agent
 from sheeprl_tpu.algos.sac_ae.utils import prepare_obs, preprocess_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -258,7 +259,10 @@ def main(fabric, cfg: Dict[str, Any]):
             loss = loss + jnp.mean(jnp.square(batch[k] - recon[k]))
         return loss
 
-    @jax.jit
+    # donate_argnums: XLA reuses the params/opt-state buffers in place instead of
+    # copying the whole train state every round (callers always rebind to the
+    # returned trees, so the invalidated inputs are never read again)
+    @partial(jax.jit, donate_argnums=(0, 1))
     def train_phase(params, opt_state, data, cum_steps, train_key):
         G = data["rewards"].shape[0]
         keys = jax.random.split(jnp.asarray(train_key), G)
@@ -331,6 +335,17 @@ def main(fabric, cfg: Dict[str, Any]):
     act_params = act.view(params)
     key = act.place(key)
 
+    # replay hot path: async prefetcher (sampling + sharded staging off-thread) or
+    # the exact inline path when buffer.prefetch.enabled=false
+    sampler = make_replay_sampler(
+        rb,
+        cfg.buffer.get("prefetch"),
+        sample_kwargs=dict(batch_size=cfg.algo.per_rank_batch_size * world_size),
+        uint8_keys=cnn_keys,
+        sharding=fabric.sharding(None, "data") if world_size > 1 else None,
+        name="sac-ae-replay-prefetch",
+    )
+
     # ---------------- main loop ----------------
     cumulative_per_rank_gradient_steps = 0
     step_data: Dict[str, np.ndarray] = {}
@@ -377,7 +392,7 @@ def main(fabric, cfg: Dict[str, Any]):
         step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, total_num_envs, -1)
         step_data["actions"] = np.asarray(actions, np.float32).reshape(1, total_num_envs, -1)
         step_data["rewards"] = rewards[np.newaxis]
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        sampler.add(step_data, validate_args=cfg.buffer.validate_args)
 
         obs = next_obs
 
@@ -385,20 +400,7 @@ def main(fabric, cfg: Dict[str, Any]):
             per_rank_gradient_steps = ratio((policy_step - prefill_steps * policy_steps_per_iter) / world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    sample = rb.sample(
-                        batch_size=cfg.algo.per_rank_batch_size * world_size,
-                        n_samples=per_rank_gradient_steps,
-                    )
-                    data = {
-                        k: (
-                            np.asarray(v)
-                            if any(k.endswith(ck) for ck in cnn_keys)
-                            else np.asarray(v, dtype=np.float32)
-                        )
-                        for k, v in sample.items()
-                    }
-                    if world_size > 1:
-                        data = jax.device_put(data, fabric.sharding(None, "data"))
+                    data = sampler.sample(per_rank_gradient_steps)
                     key, train_key = jax.random.split(key)
                     params, opt_state, mean_losses = train_phase(
                         params,
@@ -456,13 +458,17 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
             }
-            fabric.call(
-                "on_checkpoint_coupled",
-                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
-            )
+            # quiesce the prefetch worker so the pickled buffer (incl. its RNG
+            # state) is not a torn mid-sample snapshot
+            with sampler.lock:
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
 
+    sampler.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(agent, params, fabric, cfg, log_dir)

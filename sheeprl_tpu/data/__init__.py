@@ -5,11 +5,19 @@ from sheeprl_tpu.data.buffers import (
     SequentialReplayBuffer,
     get_tensor,
 )
+from sheeprl_tpu.data.prefetch import (
+    ReplaySamplePrefetcher,
+    SyncReplaySampler,
+    make_replay_sampler,
+)
 
 __all__ = [
     "EnvIndependentReplayBuffer",
     "EpisodeBuffer",
     "ReplayBuffer",
+    "ReplaySamplePrefetcher",
     "SequentialReplayBuffer",
+    "SyncReplaySampler",
     "get_tensor",
+    "make_replay_sampler",
 ]

@@ -247,22 +247,26 @@ class ReplayBuffer:
     def _get_samples(
         self, batch_idxes: np.ndarray, sample_next_obs: bool = False, clone: bool = False
     ) -> Dict[str, np.ndarray]:
+        """One fancy-gather per key into a preallocated output dict. The gather
+        always materializes fresh rows (never a view of the ring storage), so
+        ``clone`` is satisfied for free — no second copy is ever taken."""
         if self.empty:
             raise RuntimeError("The buffer has not been initialized; add some data first")
-        env_idxes = self._rng.integers(0, self._n_envs, size=(len(batch_idxes),), dtype=np.intp)
+        n = len(batch_idxes)
+        env_idxes = self._rng.integers(0, self._n_envs, size=(n,), dtype=np.intp)
         flat = batch_idxes * self._n_envs + env_idxes
         if sample_next_obs:
             flat_next = ((batch_idxes + 1) % self._buffer_size) * self._n_envs + env_idxes
         out: Dict[str, np.ndarray] = {}
         for k, v in self._buf.items():
             v2 = np.reshape(np.asarray(v), (-1, *v.shape[2:]))
-            out[k] = v2[flat]
-            if clone:
-                out[k] = out[k].copy()
+            dst = np.empty((n, *v2.shape[1:]), dtype=v2.dtype)
+            np.take(v2, flat, axis=0, out=dst)
+            out[k] = dst
             if sample_next_obs and k in self._obs_keys:
-                out[f"next_{k}"] = v2[flat_next]
-                if clone:
-                    out[f"next_{k}"] = out[f"next_{k}"].copy()
+                dst_next = np.empty_like(dst)
+                np.take(v2, flat_next, axis=0, out=dst_next)
+                out[f"next_{k}"] = dst_next
         return out
 
     def sample_tensors(
@@ -374,22 +378,20 @@ class SequentialReplayBuffer(ReplayBuffer):
             env_idxes = self._rng.integers(0, self._n_envs, size=(n_rows,), dtype=np.intp)
             env_idxes = np.repeat(env_idxes, sequence_length)
         flat = flat_batch_idxes * self._n_envs + env_idxes
+        # the fancy gather materializes fresh rows, so `clone` needs no extra copy
+        # (the swapaxes result is a view of the gathered copy, not of the ring)
         out: Dict[str, np.ndarray] = {}
         for k, v in self._buf.items():
             v2 = np.reshape(np.asarray(v), (-1, *v.shape[2:]))
             picked = v2[flat]
             batched = picked.reshape(n_samples, batch_size, sequence_length, *picked.shape[1:])
             out[k] = np.swapaxes(batched, 1, 2)
-            if clone:
-                out[k] = out[k].copy()
             if sample_next_obs and k in self._obs_keys:
                 picked_next = np.asarray(v)[(flat_batch_idxes + 1) % self._buffer_size, env_idxes]
                 batched_next = picked_next.reshape(
                     n_samples, batch_size, sequence_length, *picked_next.shape[1:]
                 )
                 out[f"next_{k}"] = np.swapaxes(batched_next, 1, 2)
-                if clone:
-                    out[f"next_{k}"] = out[f"next_{k}"].copy()
         return out
 
 
@@ -505,9 +507,20 @@ class EnvIndependentReplayBuffer:
             for b, bs in zip(self._buf, bs_per_buf)
             if bs > 0
         ]
-        return {
-            k: np.concatenate([s[k] for s in per_buf], axis=self._concat_along_axis) for k in per_buf[0]
-        }
+        # sub-samples are already fresh gathers: a single-env draw needs no copy at
+        # all, and multi-env draws concatenate once per key into a preallocated dst
+        if len(per_buf) == 1:
+            return per_buf[0]
+        axis = self._concat_along_axis
+        out: Dict[str, np.ndarray] = {}
+        for k in per_buf[0]:
+            parts = [s[k] for s in per_buf]
+            shape = list(parts[0].shape)
+            shape[axis] = sum(p.shape[axis] for p in parts)
+            dst = np.empty(shape, dtype=parts[0].dtype)
+            np.concatenate(parts, axis=axis, out=dst)
+            out[k] = dst
+        return out
 
     def sample_tensors(
         self,

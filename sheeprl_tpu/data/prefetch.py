@@ -1,0 +1,411 @@
+"""Async replay-sample prefetch pipeline (host→device dataflow overlap).
+
+Every off-policy loop samples its next ``[G, ...]`` replay block from the host-side
+numpy buffer and stages it on the accelerator. Done inline, that gather + `device_put`
+is serialized with both env stepping and device compute — exactly the first-order
+overlap lever the Podracer architectures (arxiv 2104.06272) and MindSpeed RL
+(arxiv 2507.19017) identify for accelerator-resident RL. This module moves it onto a
+background thread:
+
+- :class:`ReplaySamplePrefetcher` keeps a pipeline of single-gradient-step **units**
+  (``n_samples=1`` sample blocks) staged — sampled, host-cast by ``transform`` and
+  landed on the device/mesh via ``sharding`` — so the next train round's block is
+  already device-resident when the current train round retires. ``sample(G)`` pops
+  ``G`` units and concatenates them (device-side when staged sharded). The pipeline
+  length adapts to the units consumed per add-round (capped at ``_MAX_PIPELINE``) so
+  a loop that pops more than ``depth`` units per round — in one call or several —
+  never serializes on the worker, while a one-off burst can't park a huge pipeline.
+  During long no-train stretches the pipeline shrinks to one hot unit (one refresh
+  gather per ``depth + 1`` buffer writes) instead of churning blocks nobody pops.
+- :class:`SyncReplaySampler` is the ``prefetch.enabled=false`` fallback: the EXACT
+  inline code path the loops used before (one ``rb.sample(n_samples=G)`` call, host
+  cast, one ``device_put``).
+
+Bounded-staleness contract
+--------------------------
+``add()`` counts *add-rounds*. Every unit records the add-round at which its sample
+command was issued; ``add()`` evicts (and, for one hot unit, schedules the
+replacement of) any staged unit whose issue round lags the buffer by more than
+``depth`` add-rounds. Because the worker samples **at or after** the issue round and
+rounds only advance in ``add()``, every block returned by ``sample()`` was sampled
+from a buffer state **at most ``depth`` add-rounds behind** the live buffer.
+``last_sampled_rounds`` exposes the actual per-unit sample rounds for tests.
+
+Determinism
+-----------
+Sample commands are issued ONLY by the loop thread (in ``sample()`` and the eviction
+path of ``add()``) and executed in FIFO order by the single worker, so the buffer's
+RNG is consumed in a reproducible order for a fixed sequence of ``add``/``sample``
+calls. Note the prefetcher draws per-unit (``n_samples=1`` × G) while the sync path
+draws one ``n_samples=G`` block, so the two paths consume the RNG differently: they
+are distributionally identical but not index-identical on a live run. On a frozen
+buffer the prefetcher is bit-identical to the same per-unit calls run inline (see
+tests/test_data/test_prefetch.py).
+
+Thread safety: ``add()`` mutates the buffer and the worker gathers from it under the
+shared ``lock``, so a unit is never a torn read of a half-written row. Hold the same
+``lock`` around anything else that must see a quiescent buffer — the loops take it
+around replay-buffer checkpoint serialization so the pickled RNG/storage state is
+not a torn mid-sample read. Worker exceptions re-raise in the loop thread from
+``sample()``/``add()``/``close()``. The worker holds no reference to the sampler
+object itself, so an abandoned pipeline (a loop that crashed past ``close()``) is
+shut down by ``__del__`` as soon as the sampler is garbage collected.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ReplaySamplePrefetcher", "SyncReplaySampler", "make_replay_sampler"]
+
+_SENTINEL = object()
+
+# hard cap on the adaptive pipeline length: beyond this the worker keeps up by
+# producing during the round anyway, and staged blocks are device memory
+_MAX_PIPELINE = 16
+
+
+def _stage(block: Dict[str, np.ndarray], sharding: Any) -> Dict[str, Any]:
+    if sharding is None:
+        return block
+    import jax
+
+    return jax.device_put(block, sharding)
+
+
+def _concat_units(units: list, sharding: Any) -> Dict[str, Any]:
+    if len(units) == 1:
+        return units[0]
+    if sharding is None:
+        return {k: np.concatenate([u[k] for u in units], axis=0) for k in units[0]}
+    import jax.numpy as jnp
+
+    # device-side concat of identically-sharded [1, ...] units: the leading axis is
+    # unsharded in every spec the loops pass, so GSPMD keeps the unit sharding
+    return {k: jnp.concatenate([u[k] for u in units], axis=0) for k in units[0]}
+
+
+def _uint8_transform(uint8_keys: Sequence[str]) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+    """The loops' shared host cast: image keys stay uint8 across the host→device
+    boundary (4× less transfer; the jitted program normalizes on device), everything
+    else lands float32. A key matches by exact name or a ``next_<name>`` twin."""
+    keys = tuple(uint8_keys)
+
+    def cast(s: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            k: (
+                np.asarray(v)
+                if any(k == u or k.endswith(f"_{u}") for u in keys)
+                else np.asarray(v, dtype=np.float32)
+            )
+            for k, v in s.items()
+        }
+
+    return cast
+
+
+class SyncReplaySampler:
+    """``buffer.prefetch.enabled=false``: the exact pre-prefetch inline path.
+
+    One ``rb.sample(n_samples=G)`` call on the loop thread, host ``transform``, one
+    ``device_put`` when a ``sharding`` is given — byte-for-byte the code the
+    off-policy loops ran before the pipeline existed.
+    """
+
+    is_async = False
+
+    def __init__(
+        self,
+        rb: Any,
+        sample_kwargs: Optional[Mapping[str, Any]] = None,
+        transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
+        sharding: Any = None,
+        lock: Optional[threading.Lock] = None,
+        **_: Any,
+    ) -> None:
+        self._rb = rb
+        self._sample_kwargs = dict(sample_kwargs or {})
+        self._transform = transform
+        self._sharding = sharding
+        # everything runs on the loop thread; the lock exists so call sites can be
+        # written uniformly against either sampler (e.g. checkpoint serialization)
+        self.lock = lock or threading.Lock()
+
+    @property
+    def buffer(self) -> Any:
+        return self._rb
+
+    def add(self, data: Any, *args: Any, **kwargs: Any) -> None:
+        self._rb.add(data, *args, **kwargs)
+
+    def sample(self, n_samples: int) -> Dict[str, Any]:
+        block = self._rb.sample(n_samples=n_samples, **self._sample_kwargs)
+        if self._transform is not None:
+            block = self._transform(block)
+        return _stage(block, self._sharding)
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "SyncReplaySampler":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def _worker_loop(commands, ready, lock, state, rb, sample_kwargs, transform, sharding) -> None:
+    """Worker body. Deliberately a free function over plain collaborators — holding
+    no reference to the sampler object — so a sampler abandoned without close()
+    becomes garbage-collectable and its __del__ can stop this thread."""
+    try:
+        while True:
+            cmd = commands.get()
+            if cmd is _SENTINEL:
+                return
+            with lock:
+                sampled_round = state["round"]
+                unit = rb.sample(n_samples=1, **sample_kwargs)
+            if transform is not None:
+                unit = transform(unit)
+            unit = _stage(unit, sharding)
+            ready.put((unit, sampled_round))
+    except BaseException as e:  # propagate to the loop thread
+        state["error"] = e
+        ready.put(_SENTINEL)  # wake a blocked sample()
+
+
+class ReplaySamplePrefetcher:
+    """Background-thread replay sampling + sharded device staging, depth-buffered.
+
+    See the module docstring for the pipeline, staleness and determinism contracts.
+
+    Args:
+        rb: any buffer exposing ``add(data, ...)`` and
+            ``sample(n_samples=..., **sample_kwargs)``.
+        sample_kwargs: fixed kwargs of every unit sample (batch_size,
+            sequence_length, sample_next_obs, ...). ``n_samples`` is always 1.
+        transform: host-side cast applied to each unit dict before staging.
+        sharding: ``jax.sharding.Sharding`` / device for staging; None keeps units
+            host-side (the decoupled data plane ships host blocks).
+        depth: minimum staged units kept ahead (2 = double buffering, ...), and the
+            staleness bound in add-rounds; the pipeline grows to the per-round
+            consumption when that exceeds ``depth``.
+        lock: optional externally shared mutex serializing buffer writes against
+            worker gathers (pass one lock to several prefetchers over one buffer).
+    """
+
+    is_async = True
+
+    def __init__(
+        self,
+        rb: Any,
+        sample_kwargs: Optional[Mapping[str, Any]] = None,
+        transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
+        sharding: Any = None,
+        depth: int = 2,
+        lock: Optional[threading.Lock] = None,
+        name: str = "replay-prefetch",
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"'depth' must be >= 1, got {depth}")
+        self._rb = rb
+        self._sample_kwargs = dict(sample_kwargs or {})
+        self._sample_kwargs.pop("n_samples", None)
+        self._sharding = sharding
+        self.depth = int(depth)
+        self.lock = lock or threading.Lock()
+        self._commands: "queue.Queue[Any]" = queue.Queue()
+        self._ready: "queue.Queue[Any]" = queue.Queue()
+        self._issue_rounds: deque = deque()  # issue round per in-flight/staged unit, FIFO
+        # pipeline length follows the units consumed per add-round (droq pops G then
+        # 1 more between two adds; SAC pops G=4), capped so a one-off burst (a
+        # pretrain round popping 100) can't park a hundred staged blocks
+        self._consumed_since_add = 0
+        self._pending_discards = 0
+        # shared with the worker (which must not reference `self`): the add-round
+        # clock and the worker's pending exception
+        self._state: Dict[str, Any] = {"round": 0, "error": None}
+        self._closed = False
+        self.last_sampled_rounds: list = []
+        self._thread = threading.Thread(
+            target=_worker_loop,
+            args=(
+                self._commands,
+                self._ready,
+                self.lock,
+                self._state,
+                rb,
+                self._sample_kwargs,
+                transform,
+                sharding,
+            ),
+            daemon=True,
+            name=name,
+        )
+        self._thread.start()
+
+    # -- internals --------------------------------------------------------------------
+
+    def _raise_pending(self) -> None:
+        if self._state["error"] is not None:
+            err, self._state["error"] = self._state["error"], None
+            self._closed = True
+            raise RuntimeError("replay prefetch worker failed") from err
+
+    def _issue(self) -> None:
+        self._issue_rounds.append(self._state["round"])
+        self._commands.put(("produce", self._state["round"]))
+
+    def _pop_ready(self):
+        while True:
+            self._raise_pending()
+            try:
+                item = self._ready.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if item is _SENTINEL:
+                self._raise_pending()
+                raise RuntimeError("replay prefetch worker exited unexpectedly")
+            return item
+
+    # -- loop-thread API --------------------------------------------------------------
+
+    @property
+    def buffer(self) -> Any:
+        return self._rb
+
+    @property
+    def add_round(self) -> int:
+        """Add-rounds seen so far — the reference clock of the staleness contract."""
+        return self._state["round"]
+
+    def add(self, data: Any, *args: Any, **kwargs: Any) -> None:
+        """Write to the buffer (one add-round) and evict units staged too long ago.
+
+        Eviction keeps the staleness invariant: after this returns, every
+        in-flight/staged unit was issued at most ``depth`` add-rounds ago, so any
+        block later popped by ``sample()`` lags the buffer by at most ``depth``
+        add-rounds (the worker samples at or after the issue round).
+        """
+        self._raise_pending()
+        with self.lock:
+            self._rb.add(data, *args, **kwargs)
+            self._state["round"] += 1
+        self._consumed_since_add = 0
+        while self._issue_rounds and self._state["round"] - self._issue_rounds[0] > self.depth:
+            self._issue_rounds.popleft()
+            self._pending_discards += 1
+            # during a no-train stretch (consumption paused, writes landing) keep ONE
+            # hot unit staged instead of refreshing a full pipeline nobody pops —
+            # sample() restores the pipeline as soon as training resumes
+            if not self._issue_rounds:
+                self._issue()
+        # free parked memory early: drop discarded units the worker has already
+        # produced (they sit at the head of the ready stream, in FIFO command order)
+        while self._pending_discards:
+            try:
+                item = self._ready.get_nowait()
+            except queue.Empty:
+                break
+            if item is _SENTINEL:
+                self._ready.put(_SENTINEL)  # let _raise_pending surface the error
+                break
+            self._pending_discards -= 1
+
+    def sample(self, n_samples: int) -> Dict[str, Any]:
+        """Pop ``n_samples`` staged units as one ``[G, ...]`` block and refill.
+
+        Blocks only for units the worker has not finished yet (first call, or a
+        jump in ``n_samples``); the steady-state block is already staged.
+        """
+        if n_samples <= 0:
+            raise ValueError(f"'n_samples' must be > 0, got {n_samples}")
+        self._raise_pending()
+        if self._closed:
+            raise RuntimeError("sample() on a closed ReplaySamplePrefetcher")
+        # top up the logical stream so n_samples fresh units exist beyond discards
+        while len(self._issue_rounds) < n_samples:
+            self._issue()
+        # stale units evicted by add() sit at the stream head, in FIFO order
+        for _ in range(self._pending_discards):
+            self._pop_ready()
+        self._pending_discards = 0
+        units, rounds = [], []
+        for _ in range(n_samples):
+            unit, sampled_round = self._pop_ready()
+            units.append(unit)
+            rounds.append(sampled_round)
+            self._issue_rounds.popleft()
+        self.last_sampled_rounds = rounds
+        # refill the pipeline for the next round, sized to the units consumed since
+        # the last buffer write (covers multi-call rounds like droq's G + 1), capped
+        # so a one-off burst doesn't provision a pipeline nobody will drain
+        self._consumed_since_add += n_samples
+        target = max(self.depth, min(self._consumed_since_add, _MAX_PIPELINE))
+        while len(self._issue_rounds) < target:
+            self._issue()
+        return _concat_units(units, self._sharding)
+
+    def close(self) -> None:
+        """Shut the worker down and surface any pending worker exception."""
+        if self._closed:
+            return
+        self._closed = True
+        self._commands.put(_SENTINEL)
+        self._thread.join(timeout=60.0)
+        self._raise_pending()
+
+    def __enter__(self) -> "ReplaySamplePrefetcher":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        # don't mask an in-flight exception with a worker teardown error
+        try:
+            self.close()
+        except Exception:
+            if not exc or exc[0] is None:
+                raise
+
+    def __del__(self) -> None:  # abandoned pipeline: stop the (self-reference-free) worker
+        try:
+            if not self._closed:
+                self._closed = True
+                self._commands.put(_SENTINEL)
+        except Exception:
+            pass
+
+
+def make_replay_sampler(
+    rb: Any,
+    prefetch_cfg: Optional[Mapping[str, Any]] = None,
+    *,
+    sample_kwargs: Optional[Mapping[str, Any]] = None,
+    transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
+    uint8_keys: Optional[Sequence[str]] = None,
+    sharding: Any = None,
+    lock: Optional[threading.Lock] = None,
+    name: str = "replay-prefetch",
+):
+    """Build the hot-path replay sampler from the ``buffer.prefetch`` config group:
+    a :class:`ReplaySamplePrefetcher` when ``enabled`` (the default), else the
+    :class:`SyncReplaySampler` that restores the exact inline code path.
+
+    ``uint8_keys`` is a shorthand for the loops' standard cast (those keys — and
+    their ``next_`` twins — stay uint8, the rest goes float32); pass ``transform``
+    instead for anything custom. Without either, samples pass through unchanged.
+    """
+    if transform is None and uint8_keys is not None:
+        transform = _uint8_transform(uint8_keys)
+    enabled = bool(prefetch_cfg.get("enabled", False)) if prefetch_cfg else False
+    if not enabled:
+        return SyncReplaySampler(rb, sample_kwargs, transform=transform, sharding=sharding, lock=lock)
+    depth = int(prefetch_cfg.get("depth", 2))  # depth<1 rejected by the constructor
+    return ReplaySamplePrefetcher(
+        rb, sample_kwargs, transform=transform, sharding=sharding, depth=depth, lock=lock, name=name
+    )

@@ -98,11 +98,18 @@ def ring_sequence_scan(
 
     in_specs = (P(), P(axis))
     out_specs = (P(), P(axis))
-    # check_vma off: bodies may contain ops without varying-axis types (e.g. a
-    # pallas_call's out_shape); the ring's collectives are explicitly paired here
-    shmapped = shard_map(
-        _local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
-    )
+    # relaxed body checking: bodies may contain ops without varying-axis types
+    # (e.g. a pallas_call's out_shape); the ring's collectives are explicitly
+    # paired here. The kwarg is `check_vma` on new jax and `check_rep` before it —
+    # probe in that order so both APIs work.
+    try:
+        shmapped = shard_map(
+            _local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:
+        shmapped = shard_map(
+            _local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
     return shmapped(init, xs)
 
 

@@ -1,0 +1,74 @@
+"""Marker-scoped CI smoke for the async replay prefetch pipeline: multiple REAL
+train rounds (not dry_run's single iteration) through the coupled loops with
+``buffer.prefetch.enabled=true`` on the CPU backend. Two-plus consecutive rounds
+also regress the donated-buffer aliasing of the fused train programs end-to-end
+(round 2 would read donated-away buffers if a loop kept a stale reference).
+
+Scoped with the ``prefetch`` marker (run alone via ``pytest -m prefetch``); not
+``slow``, so the tier-1 suite includes it.
+"""
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+pytestmark = pytest.mark.prefetch
+
+_BASE = [
+    "dry_run=False",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "metric.log_level=0",
+    "checkpoint.save_last=False",
+    "buffer.memmap=False",
+    "buffer.size=512",
+    "buffer.prefetch.enabled=true",
+    "env.num_envs=2",
+    "algo.learning_starts=0",
+    "algo.run_test=False",
+]
+
+_DV3_TINY = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=1",
+    "algo.replay_ratio=1",
+    "algo.horizon=8",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.cnn_keys.decoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.mlp_keys.decoder=[state]",
+]
+
+
+@pytest.mark.timeout(280)
+def test_dreamer_v3_two_train_rounds_with_prefetch():
+    """3 iterations × replay_ratio 1 → >=2 train rounds through the prefetcher."""
+    run(_BASE + _DV3_TINY + ["algo.total_steps=6"])
+
+
+@pytest.mark.timeout(240)
+def test_sac_two_train_rounds_with_prefetch():
+    """4 iterations, training every iteration → >=2 train rounds + donation reuse."""
+    run(
+        _BASE
+        + [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.per_rank_batch_size=4",
+            "algo.total_steps=8",
+        ]
+    )

@@ -1,0 +1,184 @@
+"""ReplaySamplePrefetcher contract tests: bounded staleness, worker-exception
+propagation, clean shutdown, and bit-for-bit parity of the (sharded) staged blocks
+with the same sample calls run synchronously on the loop thread."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import ReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import (
+    ReplaySamplePrefetcher,
+    SyncReplaySampler,
+    make_replay_sampler,
+)
+
+N_ENVS = 2
+FEAT = 3
+
+
+def _step_block(rng, steps=1):
+    return {
+        "observations": rng.normal(size=(steps, N_ENVS, FEAT)).astype(np.float32),
+        "rewards": rng.normal(size=(steps, N_ENVS, 1)).astype(np.float32),
+    }
+
+
+def _make_rb(seed=7, fill=32, cls=ReplayBuffer):
+    rb = cls(64, N_ENVS, obs_keys=("observations",))
+    rng = np.random.default_rng(0)
+    rb.add(_step_block(rng, steps=fill))
+    rb.seed(seed)
+    return rb
+
+
+def _sync_units(rb, n, **kwargs):
+    """The synchronous reference: the same per-unit sample calls, inline."""
+    units = [rb.sample(n_samples=1, **kwargs) for _ in range(n)]
+    return {k: np.concatenate([u[k] for u in units], axis=0) for k in units[0]}
+
+
+def _assert_tree_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+def test_prefetched_blocks_bit_identical_to_sync_path():
+    """Frozen buffer: the prefetcher's consumption stream equals the identical
+    per-unit sample calls run synchronously (same seed ⇒ same RNG draw order)."""
+    rb_a = _make_rb(seed=123)
+    rb_b = _make_rb(seed=123)
+    with ReplaySamplePrefetcher(rb_a, dict(batch_size=4), depth=2) as pf:
+        got1 = pf.sample(3)
+        got2 = pf.sample(2)
+    # the prefetcher issues commands in consumption order: 3 popped + refills, then 2
+    want1 = _sync_units(rb_b, 3, batch_size=4)
+    want2 = _sync_units(rb_b, 2, batch_size=4)
+    _assert_tree_equal(got1, want1)
+    _assert_tree_equal(got2, want2)
+
+
+def test_prefetched_sequential_blocks_with_transform():
+    rb_a = _make_rb(seed=5, cls=SequentialReplayBuffer)
+    rb_b = _make_rb(seed=5, cls=SequentialReplayBuffer)
+    cast = lambda s: {k: np.asarray(v, dtype=np.float32) for k, v in s.items()}  # noqa: E731
+    kwargs = dict(batch_size=2, sequence_length=4)
+    with ReplaySamplePrefetcher(rb_a, kwargs, transform=cast, depth=3) as pf:
+        got = pf.sample(2)
+    want = cast(_sync_units(rb_b, 2, **kwargs))
+    assert got["observations"].shape == (2, 4, 2, FEAT)  # [G, T, B, feat]
+    _assert_tree_equal(got, want)
+
+
+def test_sharded_staging_matches_sync_path_bit_for_bit():
+    """Mesh-sharded staging off-thread lands the same bytes (and an equivalent
+    batch-axis sharding) as the synchronous device_put of the same blocks."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices("cpu")
+    if len(devices) < 2:
+        pytest.skip("needs >=2 (virtual) devices")
+    sharding = NamedSharding(Mesh(np.asarray(devices[:2]), ("data",)), P(None, "data"))
+    rb_a = _make_rb(seed=11)
+    rb_b = _make_rb(seed=11)
+    with ReplaySamplePrefetcher(rb_a, dict(batch_size=4), sharding=sharding, depth=2) as pf:
+        got = pf.sample(2)
+    want = jax.device_put(_sync_units(rb_b, 2, batch_size=4), sharding)
+    for k in want:
+        assert isinstance(got[k], jax.Array)
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(want[k]))
+        assert got[k].sharding.is_equivalent_to(want[k].sharding, want[k].ndim)
+
+
+def test_staleness_bound_honored():
+    """Blocks consumed after a long no-train stretch were still sampled within
+    `depth` add-rounds of the live buffer (evicted + resampled by the worker)."""
+    depth = 2
+    rb = _make_rb(seed=3)
+    rng = np.random.default_rng(99)
+    with ReplaySamplePrefetcher(rb, dict(batch_size=4), depth=depth) as pf:
+        pf.sample(1)  # warm the pipeline to `depth` staged units
+        for _ in range(10):  # G=0 stretch: adds keep landing, nothing is consumed
+            pf.add(_step_block(rng))
+        block = pf.sample(depth + 1)
+        assert block["observations"].shape[0] == depth + 1
+        assert len(pf.last_sampled_rounds) == depth + 1
+        for sampled_round in pf.last_sampled_rounds:
+            assert pf.add_round - sampled_round <= depth, (
+                f"unit sampled at add-round {sampled_round}, consumed at "
+                f"{pf.add_round}: staleness bound {depth} violated"
+            )
+
+
+def test_worker_exception_surfaces_in_main_thread():
+    empty = ReplayBuffer(16, N_ENVS, obs_keys=("observations",))
+    pf = ReplaySamplePrefetcher(empty, dict(batch_size=4), depth=2)
+    with pytest.raises(RuntimeError, match="replay prefetch worker failed") as exc_info:
+        pf.sample(1)  # the worker's rb.sample raises on the empty buffer
+    assert isinstance(exc_info.value.__cause__, ValueError)
+    with pytest.raises(RuntimeError):
+        pf.sample(1)  # the pipeline is closed after a worker failure
+
+
+def test_mid_run_worker_exception_surfaces_from_add():
+    class _Boom(ReplayBuffer):
+        fail = False
+
+        def sample(self, *a, **k):
+            if self.fail:
+                raise RuntimeError("boom")
+            return super().sample(*a, **k)
+
+    rb = _make_rb(cls=_Boom)
+    rng = np.random.default_rng(1)
+    pf = ReplaySamplePrefetcher(rb, dict(batch_size=4), depth=1)
+    pf.sample(1)
+    rb.fail = True
+    with pytest.raises(RuntimeError, match="replay prefetch worker failed"):
+        # the eviction refresh (or any later call) trips over the worker error
+        for _ in range(10):
+            pf.add(_step_block(rng))
+            pf.sample(1)
+
+
+def test_clean_shutdown_leaves_no_dangling_thread():
+    rb = _make_rb()
+    pf = ReplaySamplePrefetcher(rb, dict(batch_size=4), depth=3, name="prefetch-shutdown-test")
+    pf.sample(2)
+    pf.close()
+    pf.close()  # idempotent
+    assert not pf._thread.is_alive()
+    assert not [t for t in threading.enumerate() if t.name == "prefetch-shutdown-test"]
+    with pytest.raises(RuntimeError):
+        pf.sample(1)
+
+
+def test_factory_routes_on_config():
+    rb = _make_rb()
+    assert isinstance(make_replay_sampler(rb, None, sample_kwargs={}), SyncReplaySampler)
+    assert isinstance(
+        make_replay_sampler(rb, {"enabled": False, "depth": 2}, sample_kwargs={}),
+        SyncReplaySampler,
+    )
+    pf = make_replay_sampler(rb, {"enabled": True, "depth": 3}, sample_kwargs=dict(batch_size=4))
+    assert isinstance(pf, ReplaySamplePrefetcher)
+    assert pf.depth == 3
+    pf.close()
+
+
+def test_sync_sampler_is_exact_old_path():
+    """Disabled prefetch = the pre-pipeline inline code path: one n_samples=G call."""
+    rb_a = _make_rb(seed=21)
+    rb_b = _make_rb(seed=21)
+    sync = SyncReplaySampler(rb_a, dict(batch_size=4))
+    got = sync.sample(3)
+    want = rb_b.sample(batch_size=4, n_samples=3)
+    _assert_tree_equal(got, want)
+    rng = np.random.default_rng(2)
+    sync.add(_step_block(rng))  # passthrough write
+    assert sync.sample(1)["observations"].shape == (1, 4, FEAT)

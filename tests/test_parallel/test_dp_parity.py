@@ -137,7 +137,16 @@ def test_dreamer_v3_train_phase_dp_parity():
     cum = jnp.asarray(1)  # skip the cum==0 hard target sync so the EMA path is exercised
     train_key = np.asarray(jax.random.PRNGKey(3))
 
-    p1, _, m1, metrics1 = train_phase(params, opt_state, init_moments(), data, cum, train_key)
+    # train_phase donates params/opt_state/moments: burn copies on the first call so
+    # the originals stay alive for the devices=2 replication below
+    p1, _, m1, metrics1 = train_phase(
+        jax.tree_util.tree_map(jnp.array, params),
+        jax.tree_util.tree_map(jnp.array, opt_state),
+        init_moments(),
+        data,
+        cum,
+        train_key,
+    )
 
     fabric2 = Fabric(devices=2, accelerator="cpu")
     fabric2._setup()
