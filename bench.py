@@ -180,25 +180,74 @@ def _accelerator_probe_cached(timeout: int = 90) -> dict:
     return result
 
 
+def _peak_memory() -> dict:
+    """Peak memory of THIS workload process: device HBM peak when the backend
+    reports allocator stats (TPU/GPU), host peak RSS always — so every BENCH
+    JSON tracks memory alongside throughput."""
+    out = {}
+    try:
+        import jax
+
+        from sheeprl_tpu.obs.telemetry import device_memory
+
+        mem = device_memory(jax.local_devices()[0])
+        if mem and mem.get("peak_bytes"):
+            out["hbm_peak_bytes"] = int(mem["peak_bytes"])
+    except Exception:
+        pass
+    try:
+        from sheeprl_tpu.obs.telemetry import rss_peak_bytes
+
+        rss = rss_peak_bytes()
+        if rss is not None:
+            out["rss_peak_bytes"] = rss
+    except Exception:
+        pass
+    return out
+
+
 def _steady_window_run(args: list, steady_start: int) -> dict:
-    """One training run with the BenchWindow active; returns its {steps, seconds}."""
+    """One training run with the BenchWindow active; returns its {steps, seconds}
+    plus the run's final telemetry summary event under "telemetry" (the loops
+    stream sps/compile/prefetch/memory gauges to a JSONL sink — see
+    howto/observability.md — so the bench reads them back without re-measuring)."""
     from sheeprl_tpu.cli import run
 
     with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
         steady_file = f.name
+    with tempfile.NamedTemporaryFile(suffix=".telemetry.jsonl", delete=False) as f:
+        telemetry_file = f.name
     os.environ["SHEEPRL_BENCH_STEADY_FILE"] = steady_file
     os.environ["SHEEPRL_BENCH_STEADY_START"] = str(steady_start)
     try:
-        run(args)
+        run(
+            args
+            + [
+                "metric.telemetry.enabled=true",
+                f"metric.telemetry.jsonl_path={telemetry_file}",
+            ]
+        )
         with open(steady_file) as f:
-            return json.load(f)
+            steady = json.load(f)
+        try:
+            from sheeprl_tpu.obs.jsonl import read_events
+
+            summaries = [e for e in read_events(telemetry_file) if e.get("event") == "summary"]
+            if summaries:
+                steady["telemetry"] = {
+                    k: v for k, v in summaries[-1].items() if k not in ("event", "time")
+                }
+        except Exception:
+            pass
+        return steady
     finally:
         os.environ.pop("SHEEPRL_BENCH_STEADY_FILE", None)
         os.environ.pop("SHEEPRL_BENCH_STEADY_START", None)
-        try:
-            os.unlink(steady_file)
-        except OSError:
-            pass
+        for p in (steady_file, telemetry_file):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 def _prefetch_ab_enabled(algo: str) -> bool:
@@ -226,18 +275,23 @@ def _steady_ab_result(
         off_sps = steady_off["steps"] / steady_off["seconds"]
         prefetch_cond["disabled_sps"] = round(off_sps, 2)
         prefetch_cond["speedup"] = round(sps / off_sps, 3) if off_sps > 0 else None
+    conditions = {
+        "steady_window_steps": steady["steps"],
+        "steady_window_seconds": round(steady["seconds"], 2),
+        "total_steps": total,
+        "baseline_sps": round(baseline_sps, 2),
+        "prefetch": prefetch_cond,
+    }
+    if "telemetry" in steady:
+        # the prefetch-ON run's final telemetry summary: whole-run sps, compile
+        # count/seconds, prefetch wait totals, peak memory — measured in-loop
+        conditions["telemetry"] = steady["telemetry"]
     return {
         "metric": metric,
         "value": round(sps, 2),
         "unit": "env-steps/sec (steady-state)",
         "vs_baseline": round(sps / baseline_sps, 3),
-        "conditions": {
-            "steady_window_steps": steady["steps"],
-            "steady_window_seconds": round(steady["seconds"], 2),
-            "total_steps": total,
-            "baseline_sps": round(baseline_sps, 2),
-            "prefetch": prefetch_cond,
-        },
+        "conditions": conditions,
     }
 
 
@@ -431,12 +485,17 @@ def _bench_dv3_mfu_flagship(size: str = "S") -> dict:
 
 def _bench(algo: str) -> dict:
     if algo == "dreamer_v3_mfu":
-        return _bench_dv3_mfu_flagship()
-    if algo == "sac_steady":
-        return _bench_sac_steady()
-    if algo.startswith("dreamer_v"):
-        return _bench_dreamer_steady(algo)
-    return _bench_wallclock(algo)
+        result = _bench_dv3_mfu_flagship()
+    elif algo == "sac_steady":
+        result = _bench_sac_steady()
+    elif algo.startswith("dreamer_v"):
+        result = _bench_dreamer_steady(algo)
+    else:
+        result = _bench_wallclock(algo)
+    # every workload records its peak memory so the BENCH_*.json trajectory
+    # tracks memory alongside throughput (HBM peak on a live chip, RSS on CPU)
+    result.setdefault("conditions", {})["peak_memory"] = _peak_memory()
+    return result
 
 
 class BenchTimeout(RuntimeError):

@@ -24,6 +24,7 @@ from sheeprl_tpu.algos.ppo.agent import build_agent, policy_output
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import build_telemetry
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -45,6 +46,7 @@ def main(fabric, cfg: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict())
     fabric.print(f"Log dir: {log_dir}")
+    telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
 
     total_num_envs = int(cfg.env.num_envs * world_size)
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -260,10 +262,16 @@ def main(fabric, cfg: Dict[str, Any]):
                 data = jax.device_put(data, fabric.sharding(None, "data"))
             params, opt_state, metrics = train_phase(params, opt_state, data, next_values)
             act_params = act.view(params)
+            telemetry.observe_train(1, metrics)
+            if telemetry.wants_program("train_phase"):
+                telemetry.register_program(
+                    "train_phase", train_phase, (params, opt_state, data, next_values), units=1
+                )
             if aggregator and not aggregator.disabled:
                 aggregator.update("Loss/policy_loss", np.asarray(metrics["pg"]))
                 aggregator.update("Loss/value_loss", np.asarray(metrics["vl"]))
 
+        telemetry.step(policy_step)
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
@@ -295,6 +303,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 state=ckpt_state,
             )
 
+    telemetry.close(policy_step)
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(agent.apply, params, fabric, cfg, log_dir)

@@ -39,6 +39,8 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.obs import build_telemetry
+from sheeprl_tpu.utils.mfu import unit_avals
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -206,6 +208,8 @@ def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx):
     def train_phase(params, opt_state, data, cum_steps, train_key):
         return foreach_gradient_step(train_step, (params, opt_state), data, train_key, cum_steps)
 
+    # the compiled unit, exposed for FLOPs/MFU accounting (utils/mfu.py, obs/)
+    train_phase.train_step = train_step
     return train_phase
 
 
@@ -224,6 +228,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict())
     fabric.print(f"Log dir: {log_dir}")
+    telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
 
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     num_envs = int(cfg.env.num_envs)
@@ -368,6 +373,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
         sharding=fabric.sharding(None, None, "data") if world_size > 1 else None,
         name="dv2-replay-prefetch",
     )
+    telemetry.attach_sampler(sampler)
 
     if cfg.checkpoint.every % policy_steps_per_iter != 0:
         warnings.warn(
@@ -506,10 +512,26 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
                     act_params = act.view(params)
+                    telemetry.observe_train(per_rank_gradient_steps, metrics)
+                    if telemetry.wants_program("train_step"):
+                        batch_avals = unit_avals(data)
+                        telemetry.register_program(
+                            "train_step",
+                            train_phase.train_step,
+                            (
+                                params,
+                                opt_state,
+                                batch_avals,
+                                jnp.asarray(cumulative_per_rank_gradient_steps),
+                                jnp.asarray(train_key),
+                            ),
+                            units=1,
+                        )
                     if aggregator and not aggregator.disabled:
                         for mk, mv in metrics.items():
                             aggregator.update(mk, float(np.asarray(mv)))
 
+        telemetry.step(policy_step)
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
@@ -564,6 +586,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                 )
 
     bench.finish(policy_step, params)
+    telemetry.close(policy_step)
 
     sampler.close()
     envs.close()

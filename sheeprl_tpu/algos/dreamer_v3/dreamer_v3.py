@@ -42,6 +42,8 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.obs import build_telemetry
+from sheeprl_tpu.utils.mfu import unit_avals
 from sheeprl_tpu.utils.distribution import (
     BernoulliSafeMode,
     Independent,
@@ -333,6 +335,9 @@ class _InlineTrainer:
             jnp.asarray(cum_steps),
             np.asarray(train_key),
         )
+        # fresh output buffers (never donated), held for the telemetry health
+        # guard — which only syncs them at window boundaries, off the hot path
+        self.last_metrics = metrics
         host_metrics = packed_device_get(metrics) if want_metrics else None
         return self.act.view(self.params), host_metrics
 
@@ -390,6 +395,7 @@ def run_dreamer(
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict())
     fabric.print(f"Log dir: {log_dir}")
+    telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
 
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     num_envs = int(cfg.env.num_envs)
@@ -540,6 +546,7 @@ def run_dreamer(
         sharding=trainer.data_sharding,
         name="dv3-replay-prefetch",
     )
+    telemetry.attach_sampler(sampler)
 
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
         warnings.warn(
@@ -701,11 +708,35 @@ def run_dreamer(
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
                     trained_this_iter = True
+                    telemetry.observe_train(
+                        per_rank_gradient_steps,
+                        host_metrics if host_metrics is not None else getattr(trainer, "last_metrics", None),
+                    )
+                    if telemetry.wants_program("train_step") and getattr(trainer, "params", None) is not None:
+                        # the compiled unit is the single fused gradient step the
+                        # host G-loop drives; its batch aval is one [T, B] slice of
+                        # the staged [G, T, B] block (metadata only, no device op;
+                        # sharding preserved so the lowering matches the live program)
+                        batch_avals = unit_avals(data)
+                        telemetry.register_program(
+                            "train_step",
+                            trainer.train_phase.train_step,
+                            (
+                                trainer.params,
+                                trainer.opt_state,
+                                trainer.moments_state,
+                                batch_avals,
+                                jnp.asarray(cumulative_per_rank_gradient_steps),
+                                jnp.asarray(train_key),
+                            ),
+                            units=1,
+                        )
                     if host_metrics is not None and aggregator and not aggregator.disabled:
                         for mk, mv in host_metrics.items():
                             aggregator.update(mk, float(mv))
 
         # log
+        telemetry.step(policy_step)
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
@@ -771,6 +802,7 @@ def run_dreamer(
 
     bench.finish(policy_step, trainer.sync_tree())
 
+    telemetry.close(policy_step)
     sampler.close()
     final_state = trainer.close()
     if pending_ckpt and final_state is not None:

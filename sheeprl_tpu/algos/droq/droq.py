@@ -39,6 +39,7 @@ from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
+from sheeprl_tpu.obs import build_telemetry
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -64,6 +65,7 @@ def main(fabric, cfg: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict())
     fabric.print(f"Log dir: {log_dir}")
+    telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
 
     total_num_envs = int(cfg.env.num_envs * world_size)
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -283,6 +285,7 @@ def main(fabric, cfg: Dict[str, Any]):
         sharding=fabric.sharding(None, "data") if world_size > 1 else None,
         name="droq-replay-prefetch",
     )
+    telemetry.attach_sampler(sampler)
 
     # ---------------- main loop ----------------
     cumulative_per_rank_gradient_steps = 0
@@ -353,12 +356,21 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     act_params = act.view(params)
+                    telemetry.observe_train(per_rank_gradient_steps, mean_losses)
+                    if telemetry.wants_program("train_phase"):
+                        telemetry.register_program(
+                            "train_phase",
+                            train_phase,
+                            (params, opt_state, critic_data, actor_data, np.asarray(train_key)),
+                            units=per_rank_gradient_steps,
+                        )
                     if aggregator and not aggregator.disabled:
                         losses_np = np.asarray(mean_losses)
                         aggregator.update("Loss/value_loss", losses_np[0])
                         aggregator.update("Loss/policy_loss", losses_np[1])
                         aggregator.update("Loss/alpha_loss", losses_np[2])
 
+        telemetry.step(policy_step)
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
@@ -409,6 +421,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     replay_buffer=rb if cfg.buffer.checkpoint else None,
                 )
 
+    telemetry.close(policy_step)
     sampler.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:

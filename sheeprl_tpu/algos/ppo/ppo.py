@@ -30,6 +30,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import build_telemetry
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -166,6 +167,7 @@ def main(fabric, cfg: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict())
     fabric.print(f"Log dir: {log_dir}")
+    telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
 
     # ranks = mesh devices: the controller drives num_envs * world_size envs
     total_num_envs = int(cfg.env.num_envs * world_size)
@@ -399,6 +401,14 @@ def main(fabric, cfg: Dict[str, Any]):
             params, opt_state, mean_losses = train_phase(
                 params, opt_state, data, next_values, np.asarray(train_key), clip_coef, ent_coef
             )
+            telemetry.observe_train(1, mean_losses)
+            if telemetry.wants_program("train_phase"):
+                telemetry.register_program(
+                    "train_phase",
+                    train_phase,
+                    (params, opt_state, data, next_values, np.asarray(train_key), clip_coef, ent_coef),
+                    units=1,
+                )
             if aggregator and not aggregator.disabled:
                 losses_np = np.asarray(mean_losses)
                 aggregator.update("Loss/policy_loss", losses_np[0])
@@ -406,6 +416,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Loss/entropy_loss", losses_np[2])
             act_params = act.view(params)
 
+        telemetry.step(policy_step)
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run):
             metrics_dict = aggregator.compute() if aggregator else {}
             if logger is not None:
@@ -459,6 +470,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 state=ckpt_state,
             )
 
+    telemetry.close(policy_step)
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(agent.apply, params, fabric, cfg, log_dir)

@@ -43,6 +43,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, space_actions_info, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import build_telemetry
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -315,6 +316,7 @@ def main(fabric, cfg: Dict[str, Any]):
         if logger is not None:
             logger.log_hyperparams(cfg.as_dict())
         fabric.print(f"Log dir: {log_dir}")
+        telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
 
         total_num_envs = int(cfg.env.num_envs * world_size)
         vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -526,11 +528,13 @@ def main(fabric, cfg: Dict[str, Any]):
                     break
                 params_host, opt_state_host, mean_losses = msg
                 act_params = act.view(params_host)
+                telemetry.observe_train(1, mean_losses)
                 if aggregator and not aggregator.disabled:
                     aggregator.update("Loss/policy_loss", float(mean_losses[0]))
                     aggregator.update("Loss/value_loss", float(mean_losses[1]))
                     aggregator.update("Loss/entropy_loss", float(mean_losses[2]))
 
+            telemetry.step(policy_step)
             if cfg.metric.log_level > 0 and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
             ):
@@ -596,6 +600,7 @@ def main(fabric, cfg: Dict[str, Any]):
         if "exc" in error:
             raise error["exc"]
 
+        telemetry.close(policy_step)
         envs.close()
         if fabric.is_global_zero and cfg.algo.run_test:
             test(agent.apply, jax.tree_util.tree_map(jnp.asarray, act_params), fabric, cfg, log_dir)

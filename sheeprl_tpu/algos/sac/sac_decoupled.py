@@ -31,6 +31,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.parallel.distributed import BroadcastChannel, ChannelError, replicated_to_host
+from sheeprl_tpu.obs import build_telemetry
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -277,6 +278,7 @@ def main(fabric, cfg: Dict[str, Any]):
         if logger is not None:
             logger.log_hyperparams(cfg.as_dict())
         fabric.print(f"Log dir: {log_dir}")
+        telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
 
         total_num_envs = int(cfg.env.num_envs * world_size)
         vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -394,6 +396,7 @@ def main(fabric, cfg: Dict[str, Any]):
             sharding=None,
             name="sac-dec-replay-prefetch",
         )
+        telemetry.attach_sampler(sampler)
         opt_state_host: Optional[Any] = None
         key = act.place(key)
 
@@ -481,11 +484,13 @@ def main(fabric, cfg: Dict[str, Any]):
                         params_host, opt_state_host, mean_losses = msg
                         act_params = act.view(params_host)
                         cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                        telemetry.observe_train(per_rank_gradient_steps, mean_losses)
                         if aggregator and not aggregator.disabled:
                             aggregator.update("Loss/value_loss", float(mean_losses[0]))
                             aggregator.update("Loss/policy_loss", float(mean_losses[1]))
                             aggregator.update("Loss/alpha_loss", float(mean_losses[2]))
 
+            telemetry.step(policy_step)
             if cfg.metric.log_level > 0 and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
             ):
@@ -536,6 +541,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         replay_buffer=rb if cfg.buffer.checkpoint else None,
                     )
 
+        telemetry.close(policy_step)
         sampler.close()
         data_q.put(None)
         if trainer is not None:

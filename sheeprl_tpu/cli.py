@@ -111,6 +111,12 @@ def check_configs(cfg: dotdict) -> None:
             )
             cfg.model_manager.disabled = True
 
+    # observability config sanity: resolve (and thereby validate) the profiler
+    # mode — an invalid metric.profiler.mode must fail before the run launches
+    from sheeprl_tpu.obs import resolve_profiler_config
+
+    resolve_profiler_config(cfg.metric)
+
     # value sanity (reference cli.py:341-344)
     learning_starts = cfg.algo.get("learning_starts")
     if learning_starts is not None and int(learning_starts) < 0:
@@ -182,10 +188,15 @@ def run_algorithm(cfg: dotdict) -> None:
             }
         )
     if cfg.metric.log_level == 0 or cfg.metric.disable_timer:
-        timer.disabled = True
+        # telemetry needs the Time/* spans for its train-seconds/MFU accounting
+        # and is documented as independent of log_level, so an enabled telemetry
+        # keeps the timers alive (two perf_counter calls per span — noise even
+        # for bench runs, which enable telemetry with logging off)
+        timer.disabled = not bool((cfg.metric.get("telemetry") or {}).get("enabled", False))
     from sheeprl_tpu.utils.metric import MetricAggregator
 
     MetricAggregator.disabled = cfg.metric.log_level == 0
+    MetricAggregator.warn_device_values = cfg.metric.log_level >= 1
 
     kwargs: Dict[str, Any] = {}
     if "finetuning" in cfg.algo.name and "p2e" in entry["module"]:
@@ -232,17 +243,21 @@ def run_algorithm(cfg: dotdict) -> None:
     )
 
     # Optional XLA trace capture (SURVEY §5.1's TPU equivalent of the reference's
-    # profiling story): metric.profiler=True wraps the launched entrypoint in a
-    # jax.profiler trace whose dump lands under the run's log tree, viewable in
-    # TensorBoard's profile plugin / Perfetto. Meant for short diagnostic runs —
-    # a full-length training run produces a very large trace. The trace starts
-    # INSIDE the launch, after fabric._setup has pinned the platform:
-    # jax.profiler.start_trace initializes the backend, and doing that before the
-    # pin would touch the accelerator even for accelerator=cpu runs.
-    if cfg.metric.get("profiler", False):
+    # profiling story). metric.profiler.mode=run wraps the launched entrypoint in
+    # a jax.profiler trace whose dump lands under the run's log tree, viewable in
+    # TensorBoard's profile plugin / Perfetto — meant for short diagnostic runs
+    # (a full-length training run produces a very large trace; use mode=window,
+    # handled by the in-loop RunTelemetry, for a bounded steady-state capture).
+    # The trace starts INSIDE the launch, after fabric._setup has pinned the
+    # platform: jax.profiler.start_trace initializes the backend, and doing that
+    # before the pin would touch the accelerator even for accelerator=cpu runs.
+    from sheeprl_tpu.obs import resolve_profiler_config
+
+    profiler_cfg = resolve_profiler_config(cfg.metric)
+    if profiler_cfg["mode"] == "run":
         from sheeprl_tpu.utils.logger import run_base_dir
 
-        profiler_dir = cfg.metric.get("profiler_dir") or str(
+        profiler_dir = profiler_cfg["dir"] or str(
             run_base_dir(cfg.root_dir, cfg.run_name) / "profiler"
         )
         inner_main = main

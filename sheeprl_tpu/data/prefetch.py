@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Dict, Mapping, Optional, Sequence
 
@@ -135,6 +136,13 @@ class SyncReplaySampler:
         # everything runs on the loop thread; the lock exists so call sites can be
         # written uniformly against either sampler (e.g. checkpoint serialization)
         self.lock = lock or threading.Lock()
+        # telemetry counters (same schema as the prefetcher's): with the sync path
+        # the consumer is blocked for the WHOLE gather+cast+stage, so that full
+        # duration is the honest "wait" — it is exactly what the async pipeline
+        # overlaps away, which makes the on/off A/B legible from telemetry alone
+        self._tele_wait_seconds = 0.0
+        self._tele_sample_calls = 0
+        self._tele_units = 0
 
     @property
     def buffer(self) -> Any:
@@ -144,10 +152,28 @@ class SyncReplaySampler:
         self._rb.add(data, *args, **kwargs)
 
     def sample(self, n_samples: int) -> Dict[str, Any]:
+        t0 = time.perf_counter()
         block = self._rb.sample(n_samples=n_samples, **self._sample_kwargs)
         if self._transform is not None:
             block = self._transform(block)
-        return _stage(block, self._sharding)
+        staged = _stage(block, self._sharding)
+        self._tele_wait_seconds += time.perf_counter() - t0
+        self._tele_sample_calls += 1
+        self._tele_units += int(n_samples)
+        return staged
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """Cumulative consumer-side counters (see ReplaySamplePrefetcher's)."""
+        return {
+            "is_async": False,
+            "wait_seconds": self._tele_wait_seconds,
+            "sample_calls": self._tele_sample_calls,
+            "units": self._tele_units,
+            "occupancy_sum": 0.0,
+            "staleness_sum": 0.0,
+            "pipeline_len": 0,
+            "depth": 0,
+        }
 
     def close(self) -> None:
         pass
@@ -233,6 +259,16 @@ class ReplaySamplePrefetcher:
         self._state: Dict[str, Any] = {"round": 0, "error": None}
         self._closed = False
         self.last_sampled_rounds: list = []
+        # telemetry counters, loop-thread only (read via telemetry_snapshot):
+        # wait_seconds = time sample() spent blocked before its units were popped
+        # (a starved pipeline shows up here), occupancy_sum = ready-queue depth
+        # summed per sample() call, staleness_sum = add-rounds of lag summed per
+        # popped unit (bounded by `depth` per the staleness contract)
+        self._tele_wait_seconds = 0.0
+        self._tele_sample_calls = 0
+        self._tele_units = 0
+        self._tele_occupancy_sum = 0.0
+        self._tele_staleness_sum = 0.0
         self._thread = threading.Thread(
             target=_worker_loop,
             args=(
@@ -329,6 +365,8 @@ class ReplaySamplePrefetcher:
         self._raise_pending()
         if self._closed:
             raise RuntimeError("sample() on a closed ReplaySamplePrefetcher")
+        t0 = time.perf_counter()
+        self._tele_occupancy_sum += self._ready.qsize()
         # top up the logical stream so n_samples fresh units exist beyond discards
         while len(self._issue_rounds) < n_samples:
             self._issue()
@@ -343,6 +381,11 @@ class ReplaySamplePrefetcher:
             rounds.append(sampled_round)
             self._issue_rounds.popleft()
         self.last_sampled_rounds = rounds
+        live_round = self._state["round"]
+        self._tele_wait_seconds += time.perf_counter() - t0
+        self._tele_sample_calls += 1
+        self._tele_units += n_samples
+        self._tele_staleness_sum += sum(live_round - r for r in rounds)
         # refill the pipeline for the next round, sized to the units consumed since
         # the last buffer write (covers multi-call rounds like droq's G + 1), capped
         # so a one-off burst doesn't provision a pipeline nobody will drain
@@ -351,6 +394,22 @@ class ReplaySamplePrefetcher:
         while len(self._issue_rounds) < target:
             self._issue()
         return _concat_units(units, self._sharding)
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """Cumulative consumer-side pipeline counters, diffed per telemetry
+        window by ``RunTelemetry`` into ``Time/prefetch_wait`` /
+        ``Buffer/pipeline_occupancy`` / ``Buffer/pipeline_staleness``. Loop-thread
+        only (like ``sample``/``add``); ``qsize`` is the usual approximation."""
+        return {
+            "is_async": True,
+            "wait_seconds": self._tele_wait_seconds,
+            "sample_calls": self._tele_sample_calls,
+            "units": self._tele_units,
+            "occupancy_sum": self._tele_occupancy_sum,
+            "staleness_sum": self._tele_staleness_sum,
+            "pipeline_len": len(self._issue_rounds),
+            "depth": self.depth,
+        }
 
     def close(self) -> None:
         """Shut the worker down and surface any pending worker exception."""

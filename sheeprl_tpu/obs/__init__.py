@@ -1,0 +1,36 @@
+"""Run telemetry subsystem (TPU-first observability).
+
+The reference framework's observability story is timer spans + TensorBoard
+(sheeprl/utils/timer.py, logger.py); on TPU the failure modes that actually cost
+throughput — silent recompilation from shape churn, HBM creep, a starved replay
+prefetch pipeline, sub-peak MFU — are invisible to wall-clocks. This package adds
+the step-level telemetry layer the Podracer-style throughput work calls for
+(PAPERS.md: "Podracer architectures", "EnvPool"):
+
+- :func:`build_telemetry` / :class:`RunTelemetry` — the per-run facade every
+  training loop threads through its iteration, train and shutdown hooks;
+- :mod:`~sheeprl_tpu.obs.compile_monitor` — process-global XLA compile
+  count/seconds accounting via ``jax.monitoring``;
+- :mod:`~sheeprl_tpu.obs.profiler` — windowed ``jax.profiler`` trace capture
+  (``metric.profiler.mode=window``) bounded to a configured policy-step window;
+- :mod:`~sheeprl_tpu.obs.jsonl` — the structured ``telemetry.jsonl`` event sink
+  consumed by ``bench.py`` (``conditions.telemetry``) and offline tooling.
+
+See ``howto/observability.md`` for the config keys and the JSONL schema.
+"""
+
+from sheeprl_tpu.obs.compile_monitor import compile_snapshot, install_compile_monitor
+from sheeprl_tpu.obs.jsonl import JsonlEventSink
+from sheeprl_tpu.obs.profiler import ProfilerWindow, resolve_profiler_config
+from sheeprl_tpu.obs.telemetry import NullTelemetry, RunTelemetry, build_telemetry
+
+__all__ = [
+    "JsonlEventSink",
+    "NullTelemetry",
+    "ProfilerWindow",
+    "RunTelemetry",
+    "build_telemetry",
+    "compile_snapshot",
+    "install_compile_monitor",
+    "resolve_profiler_config",
+]

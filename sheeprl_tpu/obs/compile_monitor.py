@@ -1,0 +1,52 @@
+"""Process-global XLA compile accounting via ``jax.monitoring``.
+
+Every backend compile (first trace of a jitted program, or a RECOMPILE from shape
+churn) fires the ``/jax/core/compile/backend_compile_duration`` monitoring event.
+A single listener — installed once per process; ``jax.monitoring`` has no
+per-listener removal — accumulates count and wall seconds into a module-global
+struct, and :func:`compile_snapshot` reads it. :class:`RunTelemetry` diffs
+snapshots per log window to drive the ``Compile/count`` / ``Compile/seconds``
+gauges and the unexpected-recompile warning.
+
+On remote TPU backends a compile is minutes, not milliseconds (TPU_PROBE_LOG.md:
+>9 min cold for the Dreamer-V3 train program), so an unnoticed steady-state
+recompile loop is the single most expensive silent failure this repo has; this
+counter is what makes it visible.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_state: Dict[str, float] = {"count": 0, "seconds": 0.0}
+_installed = False
+
+
+def _listener(event: str, duration_secs: float, **_kwargs) -> None:
+    if event != _BACKEND_COMPILE_EVENT:
+        return
+    with _lock:
+        _state["count"] += 1
+        _state["seconds"] += float(duration_secs)
+
+
+def install_compile_monitor() -> None:
+    """Idempotently register the backend-compile duration listener."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+
+
+def compile_snapshot() -> Dict[str, float]:
+    """Cumulative ``{"count", "seconds"}`` of backend compiles seen so far."""
+    with _lock:
+        return {"count": int(_state["count"]), "seconds": float(_state["seconds"])}

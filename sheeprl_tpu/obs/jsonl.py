@@ -1,0 +1,86 @@
+"""Structured JSONL event sink: one JSON object per line, flushed per event.
+
+``telemetry.jsonl`` is the machine-readable face of the run telemetry: window
+events (sps / mfu / hbm / compile / prefetch gauges), health events from the
+loss-finiteness guard, one program event per introspected compiled program, and
+a final summary event. ``bench.py`` reads the summary back into
+``conditions.telemetry`` without re-measuring, and offline tooling can tail the
+file on a live run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort conversion: numpy scalars/arrays and other non-JSON leaves
+    become plain Python values (or ``repr`` as a last resort)."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    item = getattr(value, "item", None)
+    if callable(item):
+        try:
+            return _jsonable(item())
+        except Exception:
+            pass
+    tolist = getattr(value, "tolist", None)
+    if callable(tolist):
+        try:
+            return _jsonable(tolist())
+        except Exception:
+            pass
+    return repr(value)
+
+
+class JsonlEventSink:
+    """Append-mode JSONL writer. Every event gets ``event`` (type), ``step`` and
+    a wall-clock ``time`` stamp; the rest of the payload is passed through
+    :func:`_jsonable`. Lines are flushed as written so a crashed or abandoned run
+    still leaves a readable stream."""
+
+    def __init__(self, path: str) -> None:
+        self.path = str(path)
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh = open(self.path, "a", buffering=1)
+
+    def emit(self, event: str, step: Optional[int] = None, **fields: Any) -> None:
+        if self._fh is None:
+            return
+        payload: Dict[str, Any] = {"event": str(event), "time": round(time.time(), 3)}
+        if step is not None:
+            payload["step"] = int(step)
+        for k, v in fields.items():
+            payload[k] = _jsonable(v)
+        self._fh.write(json.dumps(payload) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Parse a telemetry JSONL file back into a list of event dicts (skipping
+    torn trailing lines from an interrupted run)."""
+    events: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return events

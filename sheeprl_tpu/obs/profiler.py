@@ -1,0 +1,116 @@
+"""Windowed ``jax.profiler`` trace capture.
+
+The whole-run trace wrapper (now ``metric.profiler.mode=run``, sheeprl_tpu/cli.py)
+is unusable on long runs — traces of a full training run are huge. ``mode=window``
+instead starts the trace at the first loop iteration whose policy step reaches
+``start_step`` and stops it once ``num_steps`` policy steps have elapsed, so a
+production-length run can capture a bounded steady-state window (past compile and
+warmup) and nothing else. The dump lands under the run's log tree (or
+``metric.profiler.dir``), viewable in TensorBoard's profile plugin / Perfetto.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, Mapping, Optional
+
+_MODES = ("off", "run", "window")
+
+
+def _normalize_mode(value: Any) -> str:
+    """Map config spellings onto {off, run, window}. YAML 1.1 parses a bare
+    ``off`` as False and legacy configs used ``profiler: True`` for the
+    whole-run wrapper, so booleans are accepted."""
+    if value is None or value is False:
+        return "off"
+    if value is True:
+        return "run"
+    mode = str(value).strip().lower()
+    if mode in ("false", "none", ""):
+        return "off"
+    if mode == "true":
+        return "run"
+    if mode not in _MODES:
+        raise ValueError(f"metric.profiler.mode must be one of {_MODES}, got {value!r}")
+    return mode
+
+
+def resolve_profiler_config(metric_cfg: Mapping[str, Any]) -> Dict[str, Any]:
+    """Normalize ``metric.profiler`` into ``{mode, start_step, num_steps, dir}``.
+
+    Accepts the current group form (``profiler.mode/start_step/num_steps/dir``)
+    and the legacy scalar form (``profiler: true`` + ``profiler_dir``), which maps
+    onto ``mode=run``.
+    """
+    raw = metric_cfg.get("profiler", None)
+    legacy_dir = metric_cfg.get("profiler_dir", None)
+    if isinstance(raw, Mapping):
+        return {
+            "mode": _normalize_mode(raw.get("mode", "off")),
+            "start_step": int(raw.get("start_step") or 0),
+            "num_steps": int(raw.get("num_steps") or 0),
+            "dir": raw.get("dir") or legacy_dir,
+        }
+    return {
+        "mode": _normalize_mode(raw),
+        "start_step": 0,
+        "num_steps": 0,
+        "dir": legacy_dir,
+    }
+
+
+class ProfilerWindow:
+    """Policy-step-driven trace window. ``on_step(policy_step)`` is called once
+    per loop iteration (two int compares when idle); the trace starts at the
+    first call with ``policy_step >= start_step`` and stops at the first call at
+    least ``num_steps`` policy steps later (``num_steps <= 0`` captures a single
+    iteration). ``close()`` stops a window left open at loop exit so the dump is
+    always finalized."""
+
+    def __init__(self, mode: str, start_step: int, num_steps: int, dump_dir: str) -> None:
+        self.mode = mode
+        self.start_step = int(start_step)
+        self.num_steps = int(num_steps)
+        self.dump_dir = str(dump_dir)
+        self.started_at: Optional[int] = None
+        self.stopped_at: Optional[int] = None
+
+    @property
+    def active(self) -> bool:
+        return self.started_at is not None and self.stopped_at is None
+
+    def on_step(self, policy_step: int) -> None:
+        if self.mode != "window" or self.stopped_at is not None:
+            return
+        if self.started_at is None:
+            if policy_step >= self.start_step:
+                self._start(policy_step)
+            return
+        if policy_step - self.started_at >= self.num_steps:
+            self._stop(policy_step)
+
+    def _start(self, policy_step: int) -> None:
+        import jax
+
+        os.makedirs(self.dump_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self.dump_dir)
+        except Exception as exc:  # a failed trace must never kill the run
+            warnings.warn(f"jax.profiler.start_trace failed: {exc!r}; window capture disabled")
+            self.stopped_at = policy_step
+            return
+        self.started_at = policy_step
+
+    def _stop(self, policy_step: int) -> None:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            warnings.warn(f"jax.profiler.stop_trace failed: {exc!r}")
+        self.stopped_at = policy_step
+
+    def close(self, policy_step: Optional[int] = None) -> None:
+        if self.active:
+            self._stop(policy_step if policy_step is not None else self.started_at)

@@ -1,0 +1,602 @@
+"""``RunTelemetry``: the per-run observability facade every training loop threads.
+
+One instance is built per run (``build_telemetry``, from the ``metric.telemetry``
+config group) and driven by four hooks, each a no-op when the feature is off:
+
+- ``attach_sampler(sampler)`` — once, after the replay sampler exists; wires the
+  prefetch pipeline gauges (``Time/prefetch_wait``, ``Buffer/pipeline_occupancy``,
+  ``Buffer/pipeline_staleness``).
+- ``observe_train(units, losses)`` — after each train round; accumulates the
+  gradient-step count that scales the in-loop MFU and keeps the latest host/device
+  losses for the periodic loss-finiteness health guard.
+- ``register_program(name, fn, args, units=...)`` — once (guard with
+  ``wants_program``) with the live fused train program; lowers it from avals
+  (no execution, donation-safe) to read XLA's own FLOPs/memory numbers.
+- ``step(policy_step)`` — once per loop iteration; drives the windowed profiler
+  capture and, every ``telemetry.every`` policy steps, emits one telemetry window:
+  TensorBoard gauges (``Mem/*``, ``Compile/*``, ``Perf/mfu``, ``Time/prefetch_*``,
+  ``Buffer/pipeline_*``, ``Perf/sps``) plus one JSONL ``window`` event.
+- ``close(policy_step)`` — at loop exit; flushes the final window, writes the
+  ``summary`` event ``bench.py`` attaches to BENCH JSONs, and stops an open
+  profiler window.
+
+Telemetry is rank-0-only and fully decoupled from ``metric.log_level``: a bench
+run with logging off still produces ``telemetry.jsonl``. With
+``metric.telemetry.enabled=false`` (the default) and ``metric.profiler.mode`` not
+``window``, :func:`build_telemetry` returns the :class:`NullTelemetry` no-op and
+the loops behave byte-for-byte as before.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import warnings
+from typing import Any, Dict, Mapping, Optional, Sequence
+
+import numpy as np
+
+from sheeprl_tpu.obs.compile_monitor import compile_snapshot, install_compile_monitor
+from sheeprl_tpu.obs.jsonl import JsonlEventSink
+from sheeprl_tpu.obs.profiler import ProfilerWindow, resolve_profiler_config
+from sheeprl_tpu.utils.mfu import peak_flops, program_analysis
+from sheeprl_tpu.utils.timer import timer
+
+# cumulative counter keys of a sampler telemetry snapshot (diffed per window)
+_PREFETCH_COUNTERS = ("wait_seconds", "sample_calls", "units", "occupancy_sum", "staleness_sum")
+
+
+class NullTelemetry:
+    """The disabled facade: every hook is an attribute-cheap no-op so call sites
+    never branch on whether telemetry is configured."""
+
+    enabled = False
+
+    def attach_sampler(self, sampler: Any) -> None:
+        pass
+
+    def wants_program(self, name: str) -> bool:
+        return False
+
+    def register_program(self, name: str, fn: Any, args: Sequence[Any], **_: Any) -> None:
+        pass
+
+    def observe_train(self, units: int, losses: Any = None) -> None:
+        pass
+
+    def step(self, policy_step: int) -> None:
+        pass
+
+    def close(self, policy_step: Optional[int] = None) -> None:
+        pass
+
+
+def _rss_bytes() -> Optional[int]:
+    """Current resident set size of this process (Linux /proc, cheap)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            return int(fh.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        return None
+
+
+def rss_peak_bytes() -> Optional[int]:
+    """Peak RSS (ru_maxrss is KiB on Linux) — the CPU stand-in for peak HBM."""
+    try:
+        import resource
+
+        return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+    except Exception:
+        return None
+
+
+def device_memory(device: Any) -> Optional[Dict[str, int]]:
+    """``{bytes_in_use, peak_bytes}`` from ``device.memory_stats()`` (TPU/GPU),
+    or None on backends without allocator stats (host CPU)."""
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out: Dict[str, int] = {}
+    if "bytes_in_use" in stats:
+        out["bytes_in_use"] = int(stats["bytes_in_use"])
+    if "peak_bytes_in_use" in stats:
+        out["peak_bytes"] = int(stats["peak_bytes_in_use"])
+    for extra in ("largest_alloc_size", "bytes_limit", "num_allocs"):
+        if extra in stats:
+            out[extra] = int(stats[extra])
+    return out or None
+
+
+def _nonfinite_losses(losses: Any) -> list:
+    """Names of non-finite entries in the latest observed losses. Accepts the
+    loops' two shapes: a metrics mapping (dreamer host metrics) or an array of
+    stacked losses (sac-family ``mean_losses``). Device arrays sync here — the
+    guard runs once per telemetry window, not on the hot path."""
+    bad = []
+    if isinstance(losses, Mapping):
+        for k, v in losses.items():
+            try:
+                if not np.all(np.isfinite(np.asarray(v))):
+                    bad.append(str(k))
+            except TypeError:
+                continue
+        return bad
+    arr = np.asarray(losses)
+    if arr.ndim == 0:
+        return [] if np.isfinite(arr) else ["loss"]
+    flat = arr.reshape(-1)
+    return [f"loss[{i}]" for i in range(flat.shape[0]) if not np.isfinite(flat[i])]
+
+
+class RunTelemetry:
+    """See the module docstring for the hook contract. Construct via
+    :func:`build_telemetry` (which handles rank gating and the disabled path)."""
+
+    def __init__(
+        self,
+        fabric: Any,
+        cfg: Any,
+        log_dir: Optional[str],
+        logger: Any = None,
+        *,
+        enabled: bool = True,
+        profiler_cfg: Optional[Mapping[str, Any]] = None,
+    ) -> None:
+        metric_cfg = cfg.metric
+        tcfg = dict(metric_cfg.get("telemetry") or {})
+        self.enabled = bool(enabled)
+        self._logger = logger
+        self._log_dir = log_dir
+
+        pcfg = dict(profiler_cfg or resolve_profiler_config(metric_cfg))
+        dump_dir = pcfg.get("dir") or (os.path.join(log_dir, "profiler") if log_dir else "profiler")
+        self.profiler = ProfilerWindow(
+            pcfg.get("mode", "off"), pcfg.get("start_step", 0), pcfg.get("num_steps", 0), dump_dir
+        )
+
+        self.every = int(tcfg.get("every") or metric_cfg.get("log_every") or 5000)
+        self.health_every = max(1, int(tcfg.get("health_every") or 1))
+        self.abort_on_nonfinite = bool(tcfg.get("abort_on_nonfinite", False))
+        self.compile_warmup_steps = int(tcfg.get("compile_warmup_steps") or 0)
+        self._program_analysis = bool(tcfg.get("program_analysis", True))
+
+        self._sink: Optional[JsonlEventSink] = None
+        if self.enabled and bool(tcfg.get("jsonl", True)):
+            path = tcfg.get("jsonl_path") or (
+                os.path.join(log_dir, "telemetry.jsonl") if log_dir else "telemetry.jsonl"
+            )
+            self._sink = JsonlEventSink(path)
+
+        self._device = getattr(fabric, "device", None)
+        self._peak_flops = peak_flops(self._device) if self._device is not None else None
+        self._world_size = int(getattr(fabric, "world_size", 1) or 1)
+
+        # window state
+        self._anchor_step: Optional[int] = None
+        self._anchor_time = 0.0
+        self._start_step: Optional[int] = None
+        self._start_time = 0.0
+        self._timer_last: Dict[str, tuple] = {}  # name -> (total, reset generation)
+        self._window_train_seconds = 0.0
+        self._window_env_seconds = 0.0
+        self._window_idx = 0
+        self._window_train_units = 0
+        self._total_train_units = 0
+        self._total_train_seconds = 0.0
+        self._last_losses: Any = None
+        self._health_status = "unknown"
+        self._sampler: Any = None
+        self._prefetch_last: Optional[Dict[str, float]] = None
+        self._prefetch_total: Dict[str, float] = {}
+        self._programs: Dict[str, Dict[str, Any]] = {}
+        self._mfu_flops_per_unit: Optional[float] = None
+        self._compile_base = {"count": 0, "seconds": 0.0}
+        self._compile_last = {"count": 0, "seconds": 0.0}
+        self._last_mfu: Optional[float] = None
+        self._peak_hbm = 0
+
+        if self.enabled:
+            install_compile_monitor()
+            self._compile_base = compile_snapshot()
+            self._compile_last = dict(self._compile_base)
+            if self._sink is not None:
+                dev = self._device
+                self._sink.emit(
+                    "start",
+                    step=None,
+                    platform=getattr(dev, "platform", None),
+                    device_kind=getattr(dev, "device_kind", None),
+                    world_size=self._world_size,
+                    peak_flops=self._peak_flops,
+                    every=self.every,
+                    profiler=dict(pcfg),
+                )
+
+    # -- wiring ------------------------------------------------------------------
+
+    def attach_sampler(self, sampler: Any) -> None:
+        """Wire the replay sampler's pipeline gauges (any object exposing
+        ``telemetry_snapshot()``; others are ignored)."""
+        if self.enabled and hasattr(sampler, "telemetry_snapshot"):
+            self._sampler = sampler
+            self._prefetch_last = None
+
+    def wants_program(self, name: str) -> bool:
+        """Cheap per-iteration guard: True until ``name`` has been registered."""
+        return self.enabled and self._program_analysis and name not in self._programs
+
+    def register_program(
+        self,
+        name: str,
+        fn: Any,
+        args: Sequence[Any],
+        kwargs: Optional[Mapping[str, Any]] = None,
+        *,
+        units: int = 1,
+    ) -> None:
+        """Introspect a live jitted program once: lower from avals (no execution,
+        donation-safe), read XLA's FLOPs / bytes-accessed / memory_analysis, and
+        emit a ``program`` event. ``units`` is how many logical gradient steps one
+        call performs (a ``[G, ...]``-scanned program registers units=G) so MFU
+        accounting is per gradient step regardless of fusion shape. The first
+        registered program with FLOPs drives ``Perf/mfu``."""
+        if not self.wants_program(name):
+            return
+        # record before analyzing: a failing analysis must not retry every round
+        info: Dict[str, Any] = {"units": int(max(units, 1))}
+        self._programs[name] = info
+        # The memory_analysis() half needs a backend compile. The loop's first
+        # real call just compiled the same HLO, so with the persistent compile
+        # cache on (cli._setup_xla_env default) the AOT compile is a cache hit;
+        # without it (SHEEPRL_JAX_CACHE=0) a remote-TPU compile would be a cold
+        # multi-minute stall, so only the CPU backend compiles then — FLOPs
+        # still come from the pre-compile lowering either way.
+        import jax
+
+        do_compile = bool(jax.config.jax_compilation_cache_dir) or (
+            getattr(self._device, "platform", "cpu") == "cpu"
+        )
+        t0 = time.perf_counter()
+        compiles_before = compile_snapshot()
+        try:
+            analysis = program_analysis(fn, args, kwargs, compile=do_compile)
+        except Exception as exc:
+            info["error"] = repr(exc)[:300]
+            warnings.warn(f"telemetry: program analysis of {name!r} failed: {exc!r}")
+            if self._sink is not None:
+                self._sink.emit("program", name=name, error=info["error"])
+            return
+        finally:
+            # the analysis must not pollute the run's own gauges: shift the open
+            # Time/train_time span (the loops register inside it) past the
+            # analysis, and credit its compile events out of the Compile/* base
+            spent = time.perf_counter() - t0
+            span = timer.timers.get("Time/train_time")
+            if span is not None and span._start is not None:
+                span._start += spent
+            compiles_after = compile_snapshot()
+            for key in ("count", "seconds"):
+                own = compiles_after[key] - compiles_before[key]
+                self._compile_base[key] += own
+                self._compile_last[key] += own
+        info.update(analysis)
+        flops = analysis.get("flops")
+        if flops:
+            info["flops_per_unit"] = float(flops) / info["units"]
+            if self._mfu_flops_per_unit is None:
+                self._mfu_flops_per_unit = info["flops_per_unit"]
+        if self._sink is not None:
+            self._sink.emit("program", name=name, **info)
+
+    # -- per-iteration hooks -----------------------------------------------------
+
+    def observe_train(self, units: int, losses: Any = None) -> None:
+        """Account ``units`` gradient steps for this window's MFU and keep the
+        latest losses for the health guard (device arrays are fine — they are
+        only synced at window boundaries)."""
+        if not self.enabled:
+            return
+        self._window_train_units += int(units)
+        self._total_train_units += int(units)
+        if losses is not None:
+            self._last_losses = losses
+
+    def step(self, policy_step: int) -> None:
+        """Once per loop iteration: advance the profiler window and emit a
+        telemetry window every ``every`` policy steps. Idle cost is two int
+        compares plus a method call."""
+        was_started, was_stopped = self.profiler.started_at, self.profiler.stopped_at
+        self.profiler.on_step(policy_step)
+        if self._sink is not None:
+            if self.profiler.started_at is not None and was_started is None:
+                self._sink.emit("profiler", step=policy_step, action="start", dir=self.profiler.dump_dir)
+            if (
+                self.profiler.stopped_at is not None
+                and was_stopped is None
+                and self.profiler.started_at is not None  # a failed start never opened a trace
+            ):
+                self._sink.emit(
+                    "profiler",
+                    step=policy_step,
+                    action="stop",
+                    covered_steps=self.profiler.stopped_at - self.profiler.started_at,
+                )
+        if not self.enabled:
+            return
+        if self._anchor_step is None:
+            now = time.perf_counter()
+            self._anchor_step = self._start_step = policy_step
+            self._anchor_time = self._start_time = now
+            # baseline the non-monotonic sources so window 0 diffs cleanly
+            self._harvest_timers()
+            self._window_train_seconds = self._window_env_seconds = 0.0
+            self._prefetch_delta()
+            return
+        # harvest EVERY iteration, not just at window boundaries: the metric log
+        # sites reset the timer registry on their own (log_every) cadence, and a
+        # reset between two windows would otherwise drop everything accrued
+        # before it. The loops call step() right before the log block, so the
+        # read always lands ahead of the reset.
+        self._harvest_timers()
+        if policy_step - self._anchor_step >= self.every:
+            self._emit_window(policy_step)
+
+    def close(self, policy_step: Optional[int] = None) -> None:
+        """Flush the last partial window, write the run ``summary`` event and
+        finalize the profiler/JSONL artifacts."""
+        window_truncated = self.profiler.active
+        self.profiler.close(policy_step)
+        if window_truncated and self._sink is not None and self.profiler.started_at is not None:
+            # pair the earlier 'start': a window still open at loop exit is
+            # finalized here, so consumers always see a start/stop pair
+            self._sink.emit(
+                "profiler",
+                step=policy_step,
+                action="stop",
+                covered_steps=(self.profiler.stopped_at or self.profiler.started_at)
+                - self.profiler.started_at,
+                truncated=True,
+            )
+        if not self.enabled:
+            return
+        if (
+            policy_step is not None
+            and self._anchor_step is not None
+            and policy_step > self._anchor_step
+        ):
+            self._emit_window(policy_step, final=True)
+        if self._sink is not None:
+            total_steps = (
+                (policy_step - self._start_step)
+                if (policy_step is not None and self._start_step is not None)
+                else 0
+            )
+            wall = time.perf_counter() - self._start_time if self._start_step is not None else 0.0
+            snap = compile_snapshot()
+            hbm = device_memory(self._device) if self._device is not None else None
+            peak_hbm = max(self._peak_hbm, (hbm or {}).get("peak_bytes", 0)) or None
+            overall_mfu = None
+            if (
+                self._mfu_flops_per_unit
+                and self._peak_flops
+                and self._total_train_seconds > 0
+                and self._total_train_units > 0
+            ):
+                overall_mfu = (
+                    self._mfu_flops_per_unit * self._total_train_units / self._total_train_seconds
+                ) / self._peak_flops
+            self._sink.emit(
+                "summary",
+                step=policy_step,
+                windows=self._window_idx,
+                total_steps=total_steps,
+                wall_seconds=round(wall, 3),
+                sps=round(total_steps / wall, 3) if wall > 0 else None,
+                train_units=self._total_train_units,
+                train_seconds=round(self._total_train_seconds, 3),
+                mfu=overall_mfu,
+                compile={
+                    "count": snap["count"] - self._compile_base["count"],
+                    "seconds": round(snap["seconds"] - self._compile_base["seconds"], 3),
+                },
+                hbm_peak_bytes=peak_hbm,
+                rss_peak_bytes=rss_peak_bytes(),
+                prefetch=self._prefetch_total or None,
+                health=self._health_status,
+                programs={k: v for k, v in self._programs.items()},
+            )
+            self._sink.close()
+            self._sink = None
+        self.enabled = False
+
+    # -- internals ---------------------------------------------------------------
+
+    def _timer_delta(self, name: str) -> float:
+        """Non-destructive delta of a named timer's accumulated seconds since the
+        last harvest, exact across the log sites' ``to_dict(reset=True)``: the
+        timer's reset generation tells a reset apart from plain accrual (a
+        magnitude heuristic would miss a reset whose post-reset accrual already
+        caught up with the pre-reset total, e.g. log_every <= steps-per-iter)."""
+        t = timer.timers.get(name)
+        if t is None:
+            return 0.0
+        cur, resets = float(t._total), t._resets
+        last, last_resets = self._timer_last.get(name, (0.0, resets))
+        # after a reset the whole current total is fresh accrual; harvesting
+        # every step() (right before the loops' log block, the only reset site)
+        # makes the pre-reset remainder since the last harvest zero
+        delta = cur if resets != last_resets else cur - last
+        self._timer_last[name] = (cur, resets)
+        return max(delta, 0.0)
+
+    def _harvest_timers(self) -> None:
+        """Accumulate the named timers' fresh seconds into the current window."""
+        self._window_train_seconds += self._timer_delta("Time/train_time")
+        self._window_env_seconds += self._timer_delta("Time/env_interaction_time")
+
+    def _prefetch_delta(self) -> Optional[Dict[str, Any]]:
+        if self._sampler is None:
+            return None
+        try:
+            snap = self._sampler.telemetry_snapshot()
+        except Exception:
+            return None
+        last = self._prefetch_last or {}
+        delta = {k: float(snap.get(k, 0.0)) - float(last.get(k, 0.0)) for k in _PREFETCH_COUNTERS}
+        self._prefetch_last = {k: float(snap.get(k, 0.0)) for k in _PREFETCH_COUNTERS}
+        for k, v in delta.items():
+            self._prefetch_total[k] = self._prefetch_total.get(k, 0.0) + v
+        calls = max(delta["sample_calls"], 1.0)
+        units = max(delta["units"], 1.0)
+        return {
+            "wait_seconds": delta["wait_seconds"],
+            "sample_calls": int(delta["sample_calls"]),
+            "units": int(delta["units"]),
+            "occupancy": delta["occupancy_sum"] / calls,
+            "staleness": delta["staleness_sum"] / units,
+            "pipeline_len": int(snap.get("pipeline_len", 0)),
+            "is_async": bool(snap.get("is_async", False)),
+        }
+
+    def _check_health(self, policy_step: int) -> Optional[Dict[str, Any]]:
+        if self._window_idx % self.health_every != 0:
+            return None
+        if self._last_losses is None:
+            self._health_status = "no-train"
+            return {"status": "no-train"}
+        bad = _nonfinite_losses(self._last_losses)
+        self._health_status = "nonfinite" if bad else "ok"
+        event = {"status": self._health_status}
+        if bad:
+            event["nonfinite"] = bad
+        return event
+
+    def _emit_window(self, policy_step: int, final: bool = False) -> None:
+        now = time.perf_counter()
+        steps = policy_step - (self._anchor_step or 0)
+        wall = max(now - self._anchor_time, 1e-9)
+        sps = steps / wall
+
+        self._harvest_timers()  # pick up anything accrued since the last step()
+        train_seconds = self._window_train_seconds
+        env_seconds = self._window_env_seconds
+        self._total_train_seconds += train_seconds
+
+        snap = compile_snapshot()
+        window_compiles = snap["count"] - self._compile_last["count"]
+        window_compile_seconds = snap["seconds"] - self._compile_last["seconds"]
+        self._compile_last = dict(snap)
+        total_compiles = snap["count"] - self._compile_base["count"]
+        total_compile_seconds = snap["seconds"] - self._compile_base["seconds"]
+        if (
+            window_compiles > 0
+            and self.compile_warmup_steps > 0
+            and policy_step > self.compile_warmup_steps
+        ):
+            warnings.warn(
+                f"telemetry: {window_compiles} unexpected XLA recompile(s) "
+                f"({window_compile_seconds:.1f}s) after warmup (policy step {policy_step}) — "
+                "look for shape churn (varying gradient-step counts, env batch changes)"
+            )
+
+        hbm = device_memory(self._device) if self._device is not None else None
+        if hbm and hbm.get("peak_bytes"):
+            self._peak_hbm = max(self._peak_hbm, hbm["peak_bytes"])
+        rss = _rss_bytes()
+        rss_peak = rss_peak_bytes()
+
+        mfu = None
+        if (
+            self._mfu_flops_per_unit
+            and self._peak_flops
+            and train_seconds > 0
+            and self._window_train_units > 0
+        ):
+            mfu = (self._mfu_flops_per_unit * self._window_train_units / train_seconds) / self._peak_flops
+        self._last_mfu = mfu
+
+        prefetch = self._prefetch_delta()
+        health = self._check_health(policy_step)
+
+        if self._logger is not None:
+            gauges: Dict[str, float] = {
+                "Perf/sps": sps,
+                "Compile/count": float(total_compiles),
+                "Compile/seconds": float(total_compile_seconds),
+            }
+            if hbm is not None:
+                if "bytes_in_use" in hbm:
+                    gauges["Mem/hbm_bytes_in_use"] = float(hbm["bytes_in_use"])
+                if "peak_bytes" in hbm:
+                    gauges["Mem/hbm_peak"] = float(hbm["peak_bytes"])
+            if rss is not None:
+                gauges["Mem/host_rss_bytes"] = float(rss)
+            if rss_peak is not None:
+                gauges["Mem/host_rss_peak"] = float(rss_peak)
+            if mfu is not None:
+                gauges["Perf/mfu"] = float(mfu)
+            if prefetch is not None:
+                gauges["Time/prefetch_wait"] = float(prefetch["wait_seconds"])
+                gauges["Buffer/pipeline_occupancy"] = float(prefetch["occupancy"])
+                gauges["Buffer/pipeline_staleness"] = float(prefetch["staleness"])
+            self._logger.log_metrics(gauges, policy_step)
+
+        if self._sink is not None:
+            self._sink.emit(
+                "window",
+                step=policy_step,
+                window=self._window_idx,
+                final=bool(final),
+                steps=steps,
+                wall_seconds=round(wall, 4),
+                sps=round(sps, 3),
+                train_units=self._window_train_units,
+                train_seconds=round(train_seconds, 4),
+                env_seconds=round(env_seconds, 4),
+                mfu=mfu,
+                hbm=hbm,
+                rss_bytes=rss,
+                rss_peak_bytes=rss_peak,
+                compile={
+                    "count": total_compiles,
+                    "seconds": round(total_compile_seconds, 3),
+                    "window_count": window_compiles,
+                    "window_seconds": round(window_compile_seconds, 3),
+                },
+                prefetch=prefetch,
+            )
+            if health is not None:
+                self._sink.emit("health", step=policy_step, **health)
+
+        self._window_idx += 1
+        self._window_train_units = 0
+        self._window_train_seconds = 0.0
+        self._window_env_seconds = 0.0
+        self._anchor_step = policy_step
+        self._anchor_time = now
+
+        if health is not None and health.get("nonfinite") and self.abort_on_nonfinite:
+            raise RuntimeError(
+                f"telemetry.abort_on_nonfinite: non-finite training losses at policy step "
+                f"{policy_step}: {health['nonfinite']}"
+            )
+
+
+def build_telemetry(fabric: Any, cfg: Any, log_dir: Optional[str], logger: Any = None):
+    """Build the run's telemetry facade from the ``metric.telemetry`` +
+    ``metric.profiler`` config groups. Rank-0-only (SPMD: one controller process
+    observes the whole mesh; MPMD roles build their own). Returns the
+    :class:`NullTelemetry` no-op when neither full telemetry nor a windowed
+    profiler capture is configured — the zero-overhead off path."""
+    if not getattr(fabric, "is_global_zero", True):
+        return NullTelemetry()
+    metric_cfg = cfg.metric
+    tcfg = metric_cfg.get("telemetry") or {}
+    enabled = bool(tcfg.get("enabled", False))
+    pcfg = resolve_profiler_config(metric_cfg)
+    if not enabled and pcfg["mode"] != "window":
+        return NullTelemetry()
+    return RunTelemetry(fabric, cfg, log_dir, logger, enabled=enabled, profiler_cfg=pcfg)

@@ -10,11 +10,21 @@ device sync on the hot path, so callers pass in numpy/float values they already 
 from __future__ import annotations
 
 import math
+import sys
+import warnings
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
 
 Number = Union[int, float, np.ndarray]
+
+
+def _is_device_array(value: Any) -> bool:
+    """True for a live ``jax.Array`` — WITHOUT importing jax (the aggregator must
+    stay importable in jax-free tooling, and an un-imported jax means no caller
+    could have produced one anyway)."""
+    jax_mod = sys.modules.get("jax")
+    return jax_mod is not None and isinstance(value, jax_mod.Array)
 
 
 def _to_float(value: Any) -> float:
@@ -131,6 +141,11 @@ class MetricAggregator:
     (mirrors sheeprl/utils/metric.py:17-146)."""
 
     disabled: bool = False
+    # one-time-per-metric warning when a hot-path update is handed a device array
+    # (np.asarray on a jax.Array blocks on the device — callers should pass host
+    # values they already have). Set from cfg.metric.log_level in cli.run_algorithm.
+    warn_device_values: bool = True
+    _device_value_warned: set = set()
 
     def __init__(self, metrics: Optional[Dict[str, Any]] = None, raise_on_missing: bool = False) -> None:
         self.metrics: Dict[str, Metric] = {}
@@ -163,6 +178,18 @@ class MetricAggregator:
             if self.raise_on_missing:
                 raise KeyError(name)
             return
+        if (
+            MetricAggregator.warn_device_values
+            and name not in MetricAggregator._device_value_warned
+            and _is_device_array(value)
+        ):
+            MetricAggregator._device_value_warned.add(name)
+            warnings.warn(
+                f"MetricAggregator.update({name!r}) received a jax.Array: converting it "
+                "forces a blocking device sync on the training hot path. Pass a host "
+                "value (np.asarray the batch of metrics once, or use packed_device_get).",
+                stacklevel=2,
+            )
         metric.update(value)
 
     def compute(self) -> Dict[str, float]:

@@ -29,6 +29,10 @@ class timer(ContextDecorator):
         self._total = 0.0
         self._count = 0
         self._start: Optional[float] = None
+        # reset generation, bumped by reset(): lets non-destructive readers (the
+        # telemetry window accounting) distinguish "total shrank because of a
+        # reset" from "total grew past my last sample" exactly, not heuristically
+        self._resets = 0
 
     def __init__(self, name: str, **kwargs: Any) -> None:
         # __new__ handles registry; nothing to do (kwargs accepted for reference parity)
@@ -50,9 +54,13 @@ class timer(ContextDecorator):
         return self._total
 
     def reset(self) -> None:
+        """Zero the accumulated totals. An in-flight span (entered but not yet
+        exited — e.g. a log boundary landing inside ``with timer(...)``) keeps
+        its ``_start``, so ``__exit__`` still accounts it into the new window
+        instead of silently dropping the whole span."""
         self._total = 0.0
         self._count = 0
-        self._start = None
+        self._resets += 1
 
     @classmethod
     def to_dict(cls, reset: bool = True) -> Dict[str, float]:

@@ -64,10 +64,17 @@ def test_model_manager_downgraded_without_mlflow(monkeypatch):
     assert cfg.model_manager.disabled is True
 
 
+def test_invalid_profiler_mode_fails():
+    cfg = _cfg(["metric.profiler.mode=sometimes"])
+    with pytest.raises(ValueError, match="profiler.mode"):
+        check_configs(cfg)
+
+
 @pytest.mark.timeout(180)
-def test_profiler_trace_hook(standard_args, tmp_path):
-    """metric.profiler=True wraps the launch in a jax.profiler trace whose dump
-    lands in the configured directory (SURVEY §5.1 tracing equivalence)."""
+def test_profiler_trace_hook_mode_run(standard_args, tmp_path):
+    """metric.profiler.mode=run wraps the launch in a jax.profiler trace whose dump
+    lands in the configured directory (SURVEY §5.1 tracing equivalence) — the
+    pre-telemetry whole-run behavior, preserved."""
     trace_dir = str(tmp_path / "profiler")
     run(
         standard_args
@@ -75,11 +82,81 @@ def test_profiler_trace_hook(standard_args, tmp_path):
             "exp=ppo",
             "env=dummy",
             "env.id=discrete_dummy",
-            "metric.profiler=True",
-            f"metric.profiler_dir={trace_dir}",
+            "metric.profiler.mode=run",
+            f"metric.profiler.dir={trace_dir}",
             "root_dir=test_profiler",
             "run_name=trace",
         ]
     )
     dumps = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
     assert any(os.path.isfile(p) for p in dumps), f"no trace files written under {trace_dir}"
+
+
+@pytest.mark.timeout(180)
+def test_profiler_trace_hook_legacy_bool(standard_args, tmp_path):
+    """The legacy scalar form (metric.profiler=True + metric.profiler_dir) still
+    maps onto mode=run, so pre-group configs keep working."""
+    trace_dir = str(tmp_path / "profiler-legacy")
+    run(
+        standard_args
+        + [
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "metric.profiler=True",
+            f"+metric.profiler_dir={trace_dir}",
+            "root_dir=test_profiler",
+            "run_name=trace-legacy",
+        ]
+    )
+    dumps = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(p) for p in dumps), f"no trace files written under {trace_dir}"
+
+
+@pytest.mark.timeout(240)
+def test_profiler_trace_mode_window_bounded(tmp_path):
+    """metric.profiler.mode=window captures ONLY the configured policy-step window:
+    the trace dump exists and the telemetry stream records start/stop steps whose
+    span covers num_steps (quantized up to one loop iteration of 2 policy steps)."""
+    import json
+
+    trace_dir = str(tmp_path / "profiler-window")
+    run(
+        [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "dry_run=False",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "buffer.memmap=False",
+            "buffer.size=256",
+            "env.num_envs=2",
+            "algo.learning_starts=4",
+            "algo.run_test=False",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.per_rank_batch_size=4",
+            "algo.total_steps=40",
+            "metric.telemetry.enabled=true",
+            "metric.profiler.mode=window",
+            "metric.profiler.start_step=16",
+            "metric.profiler.num_steps=8",
+            f"metric.profiler.dir={trace_dir}",
+            "root_dir=test_profiler",
+            "run_name=window",
+        ]
+    )
+    dumps = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(p) for p in dumps), f"no trace files written under {trace_dir}"
+    jsonl = glob.glob("logs/runs/test_profiler/window/version_*/telemetry.jsonl")
+    assert jsonl, "telemetry.jsonl missing"
+    events = [json.loads(line) for line in open(jsonl[0])]
+    prof = {e["action"]: e for e in events if e["event"] == "profiler"}
+    assert prof["start"]["step"] >= 16, "trace started before the configured window"
+    # stop lands at the first iteration boundary past start+num_steps: the window
+    # is bounded, not whole-run (40 total steps)
+    assert 8 <= prof["stop"]["covered_steps"] <= 8 + 2
+    assert prof["stop"]["step"] < 40

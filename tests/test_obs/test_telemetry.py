@@ -1,0 +1,389 @@
+"""Unit tests for the run telemetry subsystem (sheeprl_tpu/obs)."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config import dotdict
+from sheeprl_tpu.obs import (
+    JsonlEventSink,
+    build_telemetry,
+    compile_snapshot,
+    install_compile_monitor,
+    resolve_profiler_config,
+)
+from sheeprl_tpu.obs.jsonl import read_events
+from sheeprl_tpu.obs.telemetry import NullTelemetry, _nonfinite_losses
+
+
+class FakeFabric:
+    is_global_zero = True
+    world_size = 1
+
+    def __init__(self):
+        self.device = jax.devices("cpu")[0]
+
+
+class FakeLogger:
+    def __init__(self):
+        self.metrics = []
+
+    def log_metrics(self, metrics, step=None):
+        self.metrics.append((step, dict(metrics)))
+
+
+def _cfg(telemetry=None, profiler=None, log_every=100):
+    return dotdict(
+        {
+            "metric": {
+                "log_every": log_every,
+                "telemetry": telemetry or {},
+                "profiler": profiler or {"mode": "off"},
+            }
+        }
+    )
+
+
+# ---------------------------------------------------------------------------------
+# JSONL sink
+# ---------------------------------------------------------------------------------
+def test_jsonl_sink_round_trip(tmp_path):
+    sink = JsonlEventSink(str(tmp_path / "t.jsonl"))
+    sink.emit("window", step=10, sps=np.float32(1.5), arr=np.arange(3), none=None)
+    sink.close()
+    events = read_events(str(tmp_path / "t.jsonl"))
+    assert len(events) == 1
+    e = events[0]
+    assert e["event"] == "window" and e["step"] == 10
+    assert e["sps"] == 1.5 and e["arr"] == [0, 1, 2] and e["none"] is None
+    json.dumps(e)  # round-trips as strict JSON
+
+
+# ---------------------------------------------------------------------------------
+# profiler config resolution
+# ---------------------------------------------------------------------------------
+def test_profiler_config_legacy_and_group_forms():
+    assert resolve_profiler_config({"profiler": True})["mode"] == "run"
+    assert resolve_profiler_config({"profiler": False})["mode"] == "off"
+    assert resolve_profiler_config({"profiler": None})["mode"] == "off"
+    # YAML 1.1 parses a bare `off` as False inside the group too
+    assert resolve_profiler_config({"profiler": {"mode": False}})["mode"] == "off"
+    got = resolve_profiler_config(
+        {"profiler": {"mode": "window", "start_step": 5, "num_steps": 7, "dir": "/tmp/d"}}
+    )
+    assert got == {"mode": "window", "start_step": 5, "num_steps": 7, "dir": "/tmp/d"}
+    with pytest.raises(ValueError, match="profiler.mode"):
+        resolve_profiler_config({"profiler": {"mode": "sometimes"}})
+
+
+# ---------------------------------------------------------------------------------
+# build_telemetry gating
+# ---------------------------------------------------------------------------------
+def test_disabled_telemetry_is_null():
+    t = build_telemetry(FakeFabric(), _cfg(), None)
+    assert isinstance(t, NullTelemetry)
+    # the whole hook surface is a no-op
+    t.attach_sampler(object())
+    t.observe_train(3, np.ones(2))
+    t.step(100)
+    t.close(100)
+    assert not t.wants_program("train")
+
+
+def test_non_zero_rank_is_null():
+    fabric = FakeFabric()
+    fabric.is_global_zero = False
+    t = build_telemetry(fabric, _cfg(telemetry={"enabled": True}), None)
+    assert isinstance(t, NullTelemetry)
+
+
+# ---------------------------------------------------------------------------------
+# window emission
+# ---------------------------------------------------------------------------------
+def test_window_events_and_gauges(tmp_path):
+    logger = FakeLogger()
+    cfg = _cfg(telemetry={"enabled": True, "compile_warmup_steps": 0}, log_every=100)
+    t = build_telemetry(FakeFabric(), cfg, str(tmp_path), logger=logger)
+    assert t.enabled and t.every == 100
+
+    t.step(0)  # anchors
+    t.observe_train(4, np.asarray([0.5, 0.25]))
+    t.step(50)  # below the window boundary: no event
+    t.observe_train(4, np.asarray([0.5, 0.25]))
+    t.step(100)  # window 0
+    t.close(160)  # final partial window + summary
+
+    events = read_events(str(tmp_path / "telemetry.jsonl"))
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "start" and kinds[-1] == "summary"
+    windows = [e for e in events if e["event"] == "window"]
+    assert [w["step"] for w in windows] == [100, 160]
+    assert windows[0]["train_units"] == 8 and windows[0]["sps"] > 0
+    assert windows[0]["mfu"] is None  # CPU: no chip peak
+    healths = [e for e in events if e["event"] == "health"]
+    assert healths and healths[0]["status"] == "ok"
+    summary = events[-1]
+    assert summary["train_units"] == 8 and summary["total_steps"] == 160
+
+    # TB gauges carry the new metric families (Mem/* via host RSS on CPU)
+    gauges = logger.metrics[0][1]
+    assert "Perf/sps" in gauges and "Compile/count" in gauges and "Compile/seconds" in gauges
+    assert any(k.startswith("Mem/") for k in gauges)
+    assert "Perf/mfu" not in gauges  # TPU-only
+
+
+def test_window_train_seconds_survive_log_site_resets(tmp_path):
+    """The metric log sites call timer.to_dict(reset=True) on their own cadence
+    (log_every), generally misaligned with telemetry windows. Because step()
+    harvests the timer registry every iteration — and the loops call it right
+    before the log block — a mid-window reset must not drop the already-accrued
+    train seconds (regression: the window used to read only post-reset time)."""
+    import time as _time
+
+    from sheeprl_tpu.utils.timer import timer as t
+
+    saved, t.timers = t.timers, {}
+    saved_disabled, t.disabled = t.disabled, False
+    try:
+        cfg = _cfg(telemetry={"enabled": True}, log_every=100)
+        tel = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+        tel.step(0)
+        for step in (25, 50, 75, 100):
+            with t("Time/train_time"):
+                _time.sleep(0.01)
+            tel.step(step)  # harvest happens here, before the "log site"
+            if step == 50:
+                t.to_dict(reset=True)  # a log boundary inside the window
+        tel.close(100)
+        window = [e for e in read_events(str(tmp_path / "telemetry.jsonl")) if e["event"] == "window"][0]
+        # all four sleeps must be accounted, not just the two after the reset
+        assert window["train_seconds"] >= 0.035, window["train_seconds"]
+    finally:
+        t.timers = saved
+        t.disabled = saved_disabled
+
+
+def test_window_train_seconds_exact_with_per_iteration_resets(tmp_path):
+    """log_every <= policy_steps_per_iter (or dry_run) resets the timers EVERY
+    iteration; the reset-generation check must still account every span exactly
+    (regression: a magnitude heuristic returned cur-last when the fresh accrual
+    caught up with the pre-reset total, dropping nearly the whole span)."""
+    import time as _time
+
+    from sheeprl_tpu.utils.timer import timer as t
+
+    saved, t.timers = t.timers, {}
+    saved_disabled, t.disabled = t.disabled, False
+    try:
+        cfg = _cfg(telemetry={"enabled": True}, log_every=100)
+        tel = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+        tel.step(0)
+        for step in (25, 50, 75, 100):
+            with t("Time/train_time"):
+                _time.sleep(0.01)  # equal spans: cur always catches up with last
+            tel.step(step)
+            t.to_dict(reset=True)  # per-iteration log site
+        tel.close(100)
+        window = [e for e in read_events(str(tmp_path / "telemetry.jsonl")) if e["event"] == "window"][0]
+        assert window["train_seconds"] >= 0.035, window["train_seconds"]
+    finally:
+        t.timers = saved
+        t.disabled = saved_disabled
+
+
+def test_unit_avals_preserve_sharding():
+    """The dreamer-family register path abstracts one [T, B] slice of the staged
+    [G, T, B] block; on a dp mesh the slice must keep its batch-axis sharding or
+    program_analysis lowers a replicated variant (wrong FLOPs, cache miss)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from sheeprl_tpu.utils.mfu import unit_avals
+
+    devices = np.array(jax.devices("cpu")[:4])
+    mesh = Mesh(devices, ("data",))
+    sharding = NamedSharding(mesh, PartitionSpec(None, None, "data"))
+    block = jax.device_put(np.ones((2, 3, 8, 5), np.float32), sharding)
+    avals = unit_avals({"x": block, "host": np.ones((2, 4), np.float32)})
+    x = avals["x"]
+    assert x.shape == (3, 8, 5)
+    assert isinstance(x.sharding, NamedSharding)
+    assert tuple(x.sharding.spec) == (None, "data")
+    assert avals["host"].shape == (4,) and not hasattr(avals["host"], "mesh")
+
+
+def test_profiler_window_truncated_by_run_end(tmp_path):
+    """A window still open at loop exit is finalized by close() WITH a paired
+    jsonl stop event (truncated=True), so start events are never orphaned."""
+    import jax.numpy as jnp
+
+    cfg = _cfg(
+        telemetry={"enabled": True},
+        profiler={"mode": "window", "start_step": 0, "num_steps": 10_000, "dir": str(tmp_path / "p")},
+        log_every=1000,
+    )
+    t = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+    jnp.ones(4).block_until_ready()
+    t.step(0)
+    t.step(50)
+    t.close(50)  # run ends long before num_steps
+    prof = {e["action"]: e for e in read_events(str(tmp_path / "telemetry.jsonl")) if e["event"] == "profiler"}
+    assert "start" in prof and "stop" in prof
+    assert prof["stop"]["truncated"] is True and prof["stop"]["covered_steps"] == 50
+
+
+def test_health_nonfinite_and_abort(tmp_path):
+    cfg = _cfg(telemetry={"enabled": True, "abort_on_nonfinite": True}, log_every=10)
+    t = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+    t.step(0)
+    t.observe_train(1, np.asarray([1.0, math.nan]))
+    with pytest.raises(RuntimeError, match="abort_on_nonfinite"):
+        t.step(10)
+    events = read_events(str(tmp_path / "telemetry.jsonl"))
+    health = [e for e in events if e["event"] == "health"][0]
+    assert health["status"] == "nonfinite" and health["nonfinite"] == ["loss[1]"]
+
+
+def test_nonfinite_losses_shapes():
+    assert _nonfinite_losses(np.asarray([1.0, 2.0])) == []
+    assert _nonfinite_losses({"Loss/a": 1.0, "Loss/b": float("inf")}) == ["Loss/b"]
+    assert _nonfinite_losses(jnp.asarray(float("nan"))) == ["loss"]
+
+
+# ---------------------------------------------------------------------------------
+# compile monitor + program analysis
+# ---------------------------------------------------------------------------------
+def test_compile_monitor_counts_backend_compiles():
+    install_compile_monitor()
+    before = compile_snapshot()
+
+    @jax.jit
+    def f(x):
+        return x * 3.1 + 1
+
+    f(jnp.ones(7)).block_until_ready()
+    after = compile_snapshot()
+    assert after["count"] >= before["count"] + 1
+    assert after["seconds"] >= before["seconds"]
+
+
+def test_register_program_reads_flops_donation_safe(tmp_path):
+    cfg = _cfg(telemetry={"enabled": True}, log_every=10)
+    t = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train(params, batch):
+        return params + batch @ batch.T, jnp.sum(batch)
+
+    params = jnp.zeros((4, 4))
+    batch = jnp.ones((4, 8))
+    params, _ = train(params, batch)  # params donated and rebound, like the loops
+    assert t.wants_program("train")
+    t.register_program("train", train, (params, batch), units=2)
+    assert not t.wants_program("train")  # one-shot
+    t.register_program("train", train, (params, batch), units=2)  # no-op, no error
+    t.close(0)
+    progs = [e for e in read_events(str(tmp_path / "telemetry.jsonl")) if e["event"] == "program"]
+    assert len(progs) == 1
+    assert progs[0]["name"] == "train" and progs[0]["flops"] > 0
+    assert progs[0]["flops_per_unit"] == pytest.approx(progs[0]["flops"] / 2)
+
+
+# ---------------------------------------------------------------------------------
+# prefetch gauges
+# ---------------------------------------------------------------------------------
+def _tiny_buffer():
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    rb = ReplayBuffer(64, 2, obs_keys=("observations",))
+    data = {
+        "observations": np.ones((1, 2, 3), np.float32),
+        "rewards": np.zeros((1, 2, 1), np.float32),
+        "terminated": np.zeros((1, 2, 1), np.float32),
+        "truncated": np.zeros((1, 2, 1), np.float32),
+        "actions": np.zeros((1, 2, 2), np.float32),
+    }
+    for _ in range(8):
+        rb.add(data)
+    return rb, data
+
+
+def test_prefetcher_telemetry_snapshot():
+    from sheeprl_tpu.data.prefetch import ReplaySamplePrefetcher
+
+    rb, data = _tiny_buffer()
+    with ReplaySamplePrefetcher(rb, {"batch_size": 2}, depth=2) as sampler:
+        sampler.sample(2)
+        sampler.add(data)
+        sampler.sample(2)
+        snap = sampler.telemetry_snapshot()
+    assert snap["is_async"] is True
+    assert snap["sample_calls"] == 2 and snap["units"] == 4
+    assert snap["wait_seconds"] > 0
+    assert snap["pipeline_len"] >= 1 and snap["depth"] == 2
+    # the staleness counter respects the bounded-staleness contract
+    assert 0 <= snap["staleness_sum"] <= snap["units"] * sampler.depth
+
+
+def test_sync_sampler_telemetry_snapshot():
+    from sheeprl_tpu.data.prefetch import SyncReplaySampler
+
+    rb, _ = _tiny_buffer()
+    sampler = SyncReplaySampler(rb, {"batch_size": 2})
+    sampler.sample(3)
+    snap = sampler.telemetry_snapshot()
+    assert snap["is_async"] is False
+    assert snap["sample_calls"] == 1 and snap["units"] == 3
+    assert snap["wait_seconds"] > 0 and snap["pipeline_len"] == 0
+
+
+def test_window_prefetch_gauges(tmp_path):
+    from sheeprl_tpu.data.prefetch import ReplaySamplePrefetcher
+
+    logger = FakeLogger()
+    cfg = _cfg(telemetry={"enabled": True}, log_every=10)
+    t = build_telemetry(FakeFabric(), cfg, str(tmp_path), logger=logger)
+    rb, data = _tiny_buffer()
+    with ReplaySamplePrefetcher(rb, {"batch_size": 2}, depth=2) as sampler:
+        t.attach_sampler(sampler)
+        t.step(0)
+        sampler.sample(2)
+        sampler.add(data)
+        t.step(10)
+    t.close(10)
+    window = [e for e in read_events(str(tmp_path / "telemetry.jsonl")) if e["event"] == "window"][0]
+    assert window["prefetch"]["sample_calls"] == 1 and window["prefetch"]["units"] == 2
+    assert window["prefetch"]["is_async"] is True
+    gauges = logger.metrics[0][1]
+    assert "Time/prefetch_wait" in gauges
+    assert "Buffer/pipeline_occupancy" in gauges and "Buffer/pipeline_staleness" in gauges
+
+
+# ---------------------------------------------------------------------------------
+# profiler window (unit level; the CLI-driven e2e lives in test_algos/test_cli.py)
+# ---------------------------------------------------------------------------------
+def test_profiler_window_bounds(tmp_path):
+    cfg = _cfg(
+        telemetry={"enabled": False},
+        profiler={"mode": "window", "start_step": 8, "num_steps": 4, "dir": str(tmp_path / "prof")},
+    )
+    t = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+    # profiler-only telemetry: not Null, but no JSONL machinery
+    assert not t.enabled and t.profiler.mode == "window"
+    for step in (0, 4, 8, 10, 12, 16):
+        # keep some device work inside the would-be window
+        jnp.ones(4).block_until_ready()
+        t.step(step)
+    t.close(16)
+    assert t.profiler.started_at == 8
+    assert t.profiler.stopped_at == 12  # first step >= start + num_steps
+    dumped = list((tmp_path / "prof").rglob("*"))
+    assert any(p.is_file() for p in dumped), "no trace files written"

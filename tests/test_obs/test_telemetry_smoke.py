@@ -1,0 +1,152 @@
+"""Marker-scoped CI smoke for the run telemetry subsystem: short REAL training
+loops (sac + dreamer_v3, the acceptance pair) on the CPU backend with
+``metric.telemetry.enabled=true``, asserting the emitted ``telemetry.jsonl``
+parses and carries the window (sps/compile/prefetch), health and summary events.
+
+Scoped with the ``telemetry`` marker (run alone via ``pytest -m telemetry``); not
+``slow``, so the tier-1 suite includes it.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+pytestmark = pytest.mark.telemetry
+
+_BASE = [
+    "dry_run=False",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "metric.log_level=0",
+    "checkpoint.save_last=False",
+    "buffer.memmap=False",
+    "buffer.size=512",
+    "env.num_envs=2",
+    "algo.learning_starts=4",
+    "algo.run_test=False",
+    "metric.telemetry.enabled=true",
+    "metric.telemetry.every=8",
+    "metric.telemetry.compile_warmup_steps=0",
+]
+
+_DV3_TINY = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=1",
+    "algo.replay_ratio=1",
+    "algo.horizon=8",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.cnn_keys.decoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.mlp_keys.decoder=[state]",
+]
+
+
+def _read_telemetry(root_dir: str, run_name: str):
+    paths = glob.glob(f"logs/runs/{root_dir}/{run_name}/version_*/telemetry.jsonl")
+    assert paths, f"telemetry.jsonl missing for {root_dir}/{run_name}"
+    events = [json.loads(line) for line in open(paths[0])]
+    assert events, "telemetry.jsonl is empty"
+    return events
+
+
+def _assert_stream_shape(events, expect_train: bool):
+    kinds = {e["event"] for e in events}
+    assert {"start", "window", "health", "summary"} <= kinds
+    windows = [e for e in events if e["event"] == "window"]
+    assert all(w["sps"] > 0 for w in windows)
+    # compile accounting: the jitted act/train programs compiled during the run
+    summary = [e for e in events if e["event"] == "summary"][-1]
+    assert summary["compile"]["count"] > 0 and summary["compile"]["seconds"] > 0
+    assert summary["total_steps"] > 0 and summary["sps"] > 0
+    healths = [e for e in events if e["event"] == "health"]
+    assert all(h["status"] in ("ok", "no-train") for h in healths)
+    if expect_train:
+        assert summary["train_units"] > 0
+        # telemetry is independent of log_level: these smokes run at log_level=0,
+        # where cli re-enables the timers because telemetry needs the Time/* spans
+        assert summary["train_seconds"] > 0
+        assert any(h["status"] == "ok" for h in healths)
+        # prefetch gauges rode along (prefetch defaults on for off-policy loops)
+        assert summary["prefetch"] is not None and summary["prefetch"]["units"] > 0
+        # the live fused train program was introspected for FLOPs
+        progs = [e for e in events if e["event"] == "program"]
+        assert progs and (progs[0].get("flops") or progs[0].get("error"))
+    # mfu is honest: null on CPU (no chip peak), never a bogus number
+    assert all(w["mfu"] is None for w in windows)
+
+
+@pytest.mark.timeout(240)
+def test_sac_telemetry_jsonl_smoke():
+    run(
+        _BASE
+        + [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.per_rank_batch_size=4",
+            "algo.total_steps=32",
+            "root_dir=ttel",
+            "run_name=sac",
+        ]
+    )
+    _assert_stream_shape(_read_telemetry("ttel", "sac"), expect_train=True)
+
+
+@pytest.mark.timeout(280)
+def test_dreamer_v3_telemetry_jsonl_smoke():
+    run(
+        _BASE
+        + _DV3_TINY
+        + [
+            "algo.total_steps=12",
+            "metric.telemetry.every=4",
+            "root_dir=ttel",
+            "run_name=dv3",
+        ]
+    )
+    _assert_stream_shape(_read_telemetry("ttel", "dv3"), expect_train=True)
+
+
+@pytest.mark.timeout(240)
+def test_telemetry_off_leaves_no_artifacts():
+    """metric.telemetry.enabled=false (the default) must reproduce today's run
+    artifacts: no telemetry.jsonl, no profiler dir."""
+    run(
+        [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "dry_run=True",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "buffer.memmap=False",
+            "env.num_envs=2",
+            "algo.mlp_keys.encoder=[state]",
+            "root_dir=ttel",
+            "run_name=tel-off",
+        ]
+    )
+    assert glob.glob("logs/runs/ttel/tel-off/version_*"), "run dir missing"
+    assert not glob.glob("logs/runs/ttel/tel-off/version_*/telemetry.jsonl")
+    assert not glob.glob("logs/runs/ttel/tel-off/version_*/profiler")

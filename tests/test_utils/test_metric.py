@@ -1,0 +1,67 @@
+"""MetricAggregator hot-path guard tests (sheeprl_tpu/utils/metric.py)."""
+
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.metric import MeanMetric, MetricAggregator
+
+
+@pytest.fixture(autouse=True)
+def _fresh_warning_state():
+    saved = set(MetricAggregator._device_value_warned)
+    saved_flag = MetricAggregator.warn_device_values
+    saved_disabled = MetricAggregator.disabled  # CLI-driven tests leave this True
+    MetricAggregator._device_value_warned = set()
+    MetricAggregator.warn_device_values = True
+    MetricAggregator.disabled = False
+    yield
+    MetricAggregator._device_value_warned = saved
+    MetricAggregator.warn_device_values = saved_flag
+    MetricAggregator.disabled = saved_disabled
+
+
+def _agg():
+    return MetricAggregator({"Loss/value_loss": MeanMetric(), "Loss/policy_loss": MeanMetric()})
+
+
+def test_device_array_update_warns_once_naming_metric():
+    agg = _agg()
+    with pytest.warns(UserWarning, match="Loss/value_loss"):
+        agg.update("Loss/value_loss", jnp.asarray(1.0))
+    # the value still lands (converted), and the warning does not repeat
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        agg.update("Loss/value_loss", jnp.asarray(3.0))
+    assert agg.compute()["Loss/value_loss"] == pytest.approx(2.0)
+
+
+def test_each_metric_warns_independently():
+    agg = _agg()
+    with pytest.warns(UserWarning, match="Loss/value_loss"):
+        agg.update("Loss/value_loss", jnp.asarray(1.0))
+    with pytest.warns(UserWarning, match="Loss/policy_loss"):
+        agg.update("Loss/policy_loss", jnp.asarray(1.0))
+
+
+def test_host_values_do_not_warn():
+    agg = _agg()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        agg.update("Loss/value_loss", 1.0)
+        agg.update("Loss/value_loss", np.float32(2.0))
+        agg.update("Loss/value_loss", np.asarray([3.0]))
+    assert agg.compute()["Loss/value_loss"] == pytest.approx(2.0)
+
+
+def test_warning_suppressed_at_log_level_zero():
+    MetricAggregator.warn_device_values = False
+    agg = _agg()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        agg.update("Loss/value_loss", jnp.asarray(1.0))
+    assert agg.compute()["Loss/value_loss"] == pytest.approx(1.0)

@@ -1,0 +1,54 @@
+"""Regression tests for the named-span timer (sheeprl_tpu/utils/timer.py)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from sheeprl_tpu.utils.timer import timer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    saved, timer.timers = timer.timers, {}
+    saved_disabled, timer.disabled = timer.disabled, False
+    yield
+    timer.timers = saved
+    timer.disabled = saved_disabled
+
+
+def test_accumulates_and_resets():
+    with timer("t"):
+        time.sleep(0.01)
+    assert timer("t").compute() > 0
+    assert "t" in timer.to_dict(reset=True)
+    assert timer.to_dict(reset=False) == {}  # count reset → excluded
+
+
+def test_reset_preserves_in_flight_span():
+    """A log boundary (to_dict(reset=True)) landing INSIDE an open span must not
+    drop the span: __exit__ still accounts it into the new window."""
+    t = timer("span")
+    with t:
+        time.sleep(0.005)
+        timer.to_dict(reset=True)  # the log site's reset, mid-span
+        time.sleep(0.005)
+    assert t.compute() >= 0.005, "open span was dropped by reset()"
+    out = timer.to_dict(reset=True)
+    assert out["span"] >= 0.005
+
+
+def test_explicit_reset_mid_span():
+    t = timer("span2")
+    with t:
+        time.sleep(0.002)
+        t.reset()
+    assert t.compute() > 0
+
+
+def test_disabled_timer_records_nothing():
+    timer.disabled = True
+    with timer("off"):
+        time.sleep(0.002)
+    assert timer.to_dict(reset=True) == {}
