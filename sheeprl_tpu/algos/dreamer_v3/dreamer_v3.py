@@ -43,6 +43,8 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialRepla
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.obs import build_telemetry
+from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.mfu import unit_avals
 from sheeprl_tpu.utils.distribution import (
     BernoulliSafeMode,
@@ -396,6 +398,7 @@ def run_dreamer(
         logger.log_hyperparams(cfg.as_dict())
     fabric.print(f"Log dir: {log_dir}")
     telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
+    resilience = build_resilience(fabric, cfg, log_dir, telemetry=telemetry)
 
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     num_envs = int(cfg.env.num_envs)
@@ -616,6 +619,9 @@ def run_dreamer(
 
         step_data["is_first"] = np.zeros_like(step_data["terminated"])
         if "restart_on_exception" in infos:
+            # surface the crash-restart (previously invisible): Health/env_restarts
+            # gauge + an immediate health event in telemetry.jsonl
+            telemetry.observe_env_restart(int(np.sum(infos["restart_on_exception"])))
             # in-place ring-storage rewrite: take the sampler lock so a concurrent
             # prefetch gather never reads a torn episode-boundary row
             with sampler.lock:
@@ -682,8 +688,12 @@ def run_dreamer(
 
         # checkpoint due? (computed BEFORE the train round so a channel trainer can
         # ship the full state with it; a deferring trainer postpones off-round
-        # checkpoints to the next train round)
-        pending_ckpt = pending_ckpt or (
+        # checkpoints to the next train round). A preemption forces an
+        # out-of-cadence emergency checkpoint through the same path; the flag is
+        # snapshotted once per iteration so the save and the loop-exit break can
+        # never disagree about it.
+        preempted = resilience.preempt_requested()
+        pending_ckpt = pending_ckpt or preempted or (
             (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
             or cfg.dry_run
             or (iter_num == total_iters and cfg.checkpoint.save_last)
@@ -737,6 +747,7 @@ def run_dreamer(
 
         # log
         telemetry.step(policy_step)
+        resilience.step(policy_step)
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
@@ -790,15 +801,23 @@ def run_dreamer(
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
             }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             # quiesce the prefetch worker so the pickled buffer (incl. its RNG
             # state) is not a torn mid-sample snapshot
             with sampler.lock:
                 fabric.call(
                     "on_checkpoint_coupled",
-                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    ckpt_path=ckpt_path,
                     state=ckpt_state,
                     replay_buffer=rb if cfg.buffer.checkpoint else None,
                 )
+            resilience.observe_checkpoint(ckpt_path, policy_step, preempted=preempted)
+        if preempted:
+            # still-pending emergency checkpoint (a deferring trainer without a
+            # train round this iteration) is flushed by the close() path below;
+            # breaking — rather than raising — runs the normal teardown, which
+            # forwards the shutdown to channel trainer ranks
+            break
 
     bench.finish(policy_step, trainer.sync_tree())
 
@@ -814,23 +833,29 @@ def run_dreamer(
             "opt_state": ckpt_opt,
             "moments": ckpt_moments,
             "ratio": ratio.state_dict(),
-            "iter_num": total_iters * world_size,
+            # iter_num (not total_iters): a preempt-break flushes here BEFORE the
+            # run finished, and a resumed run must not think it completed
+            "iter_num": iter_num * world_size,
             "batch_size": cfg.algo.per_rank_batch_size * world_size,
             "last_log": last_log,
             "last_checkpoint": policy_step,
         }
+        ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
         # quiesce the prefetch worker so the pickled buffer (incl. its RNG
         # state) is not a torn mid-sample snapshot
         with sampler.lock:
             fabric.call(
                 "on_checkpoint_coupled",
-                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                ckpt_path=ckpt_path,
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
+        resilience.observe_checkpoint(ckpt_path, policy_step, preempted=preempted)
 
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    # an in-flight async (orbax) checkpoint write must land before teardown
+    wait_for_checkpoint()
+    if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
         test_fn(player, act_params, fabric, cfg, log_dir, greedy=False)
     if logger is not None:
         logger.finalize()

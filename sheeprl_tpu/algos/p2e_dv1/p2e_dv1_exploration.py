@@ -33,6 +33,8 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialRepla
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.obs import build_telemetry
+from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.mfu import unit_avals
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -253,6 +255,7 @@ def main(fabric, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict())
     fabric.print(f"Log dir: {log_dir}")
     telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
+    resilience = build_resilience(fabric, cfg, log_dir, telemetry=telemetry)
 
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     num_envs = int(cfg.env.num_envs)
@@ -451,6 +454,9 @@ def main(fabric, cfg: Dict[str, Any]):
             dones = np.logical_or(terminated, truncated).astype(np.uint8)
 
         step_data["is_first"] = np.zeros_like(step_data["terminated"])
+        if "restart_on_exception" in infos:
+            # surface the RestartOnException crash-restart (previously invisible)
+            telemetry.observe_env_restart(int(np.sum(infos["restart_on_exception"])))
 
         ep_info = infos.get("final_info", infos)
         if cfg.metric.log_level > 0 and "episode" in ep_info:
@@ -523,6 +529,7 @@ def main(fabric, cfg: Dict[str, Any]):
                             aggregator.update(mk, float(np.asarray(mv)))
 
         telemetry.step(policy_step)
+        resilience.step(policy_step)
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
@@ -551,10 +558,14 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
+        # a preemption forces an out-of-cadence emergency checkpoint through the
+        # same callback path, then exits the loop
+        preempted = resilience.preempt_requested()
         if (
             (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
             or cfg.dry_run
             or (iter_num == total_iters and cfg.checkpoint.save_last)
+            or preempted
         ):
             last_checkpoint = policy_step
             ckpt_state = {
@@ -566,20 +577,26 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
             }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             # quiesce the prefetch worker so the pickled buffer (incl. its RNG
             # state) is not a torn mid-sample snapshot
             with sampler.lock:
                 fabric.call(
                     "on_checkpoint_coupled",
-                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    ckpt_path=ckpt_path,
                     state=ckpt_state,
                     replay_buffer=rb if cfg.buffer.checkpoint else None,
                 )
+            resilience.observe_checkpoint(ckpt_path, policy_step, preempted=preempted)
+        if preempted:
+            break
 
     telemetry.close(policy_step)
     sampler.close()
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    # an in-flight async (orbax) checkpoint write must land before teardown
+    wait_for_checkpoint()
+    if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
         test(player, act_params, fabric, cfg, log_dir, greedy=False)
     if logger is not None:
         logger.finalize()

@@ -44,6 +44,8 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, space_actions_info, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import build_telemetry
+from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -317,6 +319,7 @@ def main(fabric, cfg: Dict[str, Any]):
             logger.log_hyperparams(cfg.as_dict())
         fabric.print(f"Log dir: {log_dir}")
         telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
+        resilience = build_resilience(fabric, cfg, log_dir, telemetry=telemetry)
 
         total_num_envs = int(cfg.env.num_envs * world_size)
         vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -504,6 +507,11 @@ def main(fabric, cfg: Dict[str, Any]):
             data = {k: np.asarray(rb[k]) for k in rb.buffer.keys()}
             flat = jax.tree_util.tree_map(np.asarray, gae_fn(data, next_values))
 
+            # one preemption snapshot per iteration: the want_opt_state request,
+            # the checkpoint block and the loop-exit break must agree on it (the
+            # emergency checkpoint needs the opt state riding the weight plane)
+            preempted = resilience.preempt_requested()
+
             with timer("Time/train_time"):
                 # ask the learner for its opt_state only when this iteration will write a
                 # checkpoint (the weight plane otherwise carries params alone)
@@ -511,6 +519,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
                     or cfg.dry_run
                     or (iter_num == total_iters and cfg.checkpoint.save_last)
+                    or preempted
                 )
                 data_q.put((flat, clip_coef, ent_coef, want_opt_state))
                 # weight plane: BLOCK until the learner finishes (reference :302)
@@ -535,6 +544,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     aggregator.update("Loss/entropy_loss", float(mean_losses[2]))
 
             telemetry.step(policy_step)
+            resilience.step(policy_step)
             if cfg.metric.log_level > 0 and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
             ):
@@ -569,10 +579,14 @@ def main(fabric, cfg: Dict[str, Any]):
                     iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
                 )
 
+            # a preemption forces an out-of-cadence emergency checkpoint through
+            # the same callback path, then exits the loop; the sentinel below
+            # forwards the shutdown to the trainer ranks over the data plane
             if (
                 (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
                 or cfg.dry_run
                 or (iter_num == total_iters and cfg.checkpoint.save_last)
+                or preempted
             ):
                 last_checkpoint = policy_step
                 ckpt_state = {
@@ -583,11 +597,15 @@ def main(fabric, cfg: Dict[str, Any]):
                     "last_log": last_log,
                     "last_checkpoint": last_checkpoint,
                 }
+                ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
                 fabric.call(
                     "on_checkpoint_player",
-                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    ckpt_path=ckpt_path,
                     state=ckpt_state,
                 )
+                resilience.observe_checkpoint(ckpt_path, policy_step, preempted=preempted)
+            if preempted:
+                break
 
         # sentinel → learner exits (reference :344)
         data_q.put(None)
@@ -602,7 +620,9 @@ def main(fabric, cfg: Dict[str, Any]):
 
         telemetry.close(policy_step)
         envs.close()
-        if fabric.is_global_zero and cfg.algo.run_test:
+        # an in-flight async (orbax) checkpoint write must land before teardown
+        wait_for_checkpoint()
+        if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
             test(agent.apply, jax.tree_util.tree_map(jnp.asarray, act_params), fabric, cfg, log_dir)
         if logger is not None:
             logger.finalize()

@@ -30,6 +30,8 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.obs import build_telemetry
+from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -65,6 +67,7 @@ def main(fabric, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict())
     fabric.print(f"Log dir: {log_dir}")
     telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
+    resilience = build_resilience(fabric, cfg, log_dir, telemetry=telemetry)
 
     total_num_envs = int(cfg.env.num_envs * world_size)
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -436,6 +439,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         aggregator.update("Loss/reconstruction_loss", losses_np[3])
 
         telemetry.step(policy_step)
+        resilience.step(policy_step)
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
@@ -461,10 +465,14 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.reset()
             last_log = policy_step
 
+        # a preemption forces an out-of-cadence emergency checkpoint through the
+        # same callback path, then exits the loop
+        preempted = resilience.preempt_requested()
         if (
             (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
             or cfg.dry_run
             or (iter_num == total_iters and cfg.checkpoint.save_last)
+            or preempted
         ):
             last_checkpoint = policy_step
             ckpt_state = {
@@ -476,20 +484,26 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
             }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             # quiesce the prefetch worker so the pickled buffer (incl. its RNG
             # state) is not a torn mid-sample snapshot
             with sampler.lock:
                 fabric.call(
                     "on_checkpoint_coupled",
-                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                    ckpt_path=ckpt_path,
                     state=ckpt_state,
                     replay_buffer=rb if cfg.buffer.checkpoint else None,
                 )
+            resilience.observe_checkpoint(ckpt_path, policy_step, preempted=preempted)
+        if preempted:
+            break
 
     telemetry.close(policy_step)
     sampler.close()
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    # an in-flight async (orbax) checkpoint write must land before teardown
+    wait_for_checkpoint()
+    if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
         test(agent, params, fabric, cfg, log_dir)
     if logger is not None:
         logger.finalize()

@@ -23,20 +23,29 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import print_config
 
 # config keys that must not be taken from the old config on resume (reference cli.py:23-56)
+# `resilience` is runtime-operational state like `metric`: the saved config may
+# carry a supervisor/fault setup that must not silently override this launch's
 _NON_RESUMABLE_KEYS = (
     "checkpoint",
     "exp_name",
     "run_name",
     "root_dir",
     "metric",
+    "resilience",
 )
 
 
 def resume_from_checkpoint(cfg: dotdict) -> dotdict:
     """Force-merge the checkpoint's config over the current one, keeping the
-    non-resumable keys, and hard-validate env/algo identity (reference cli.py:23-56)."""
+    non-resumable keys, and hard-validate env/algo identity (reference cli.py:23-56).
+    ``checkpoint.resume_from=latest`` resolves to the newest valid checkpoint under
+    this experiment's log tree first (shared with the supervisor's discovery)."""
     import yaml
 
+    if str(cfg.checkpoint.resume_from).strip().lower() == "latest":
+        from sheeprl_tpu.resilience.discovery import resolve_latest
+
+        cfg.checkpoint.resume_from = resolve_latest(cfg)
     ckpt_path = Path(cfg.checkpoint.resume_from)
     old_cfg_path = ckpt_path.parent.parent / "config.yaml"
     if not old_cfg_path.is_file():
@@ -116,6 +125,20 @@ def check_configs(cfg: dotdict) -> None:
     from sheeprl_tpu.obs import resolve_profiler_config
 
     resolve_profiler_config(cfg.metric)
+
+    # resilience config sanity (same fail-before-launch policy)
+    from sheeprl_tpu.resilience import normalize_fault_cfg
+
+    rcfg = cfg.get("resilience") or {}
+    fault = normalize_fault_cfg(rcfg)  # raises on an unknown fault kind
+    if fault is not None and fault["at"] < 0:
+        raise ValueError("resilience.fault.at_policy_step must be >= 0")
+    supervisor_cfg = rcfg.get("supervisor") or {}
+    if int(supervisor_cfg.get("max_restarts", 3) or 0) < 0:
+        raise ValueError("resilience.supervisor.max_restarts must be >= 0")
+    watchdog_cfg = rcfg.get("watchdog") or {}
+    if bool(watchdog_cfg.get("enabled", False)) and float(watchdog_cfg.get("timeout") or 0) <= 0:
+        raise ValueError("resilience.watchdog.timeout must be > 0 when the watchdog is enabled")
 
     # value sanity (reference cli.py:341-344)
     learning_starts = cfg.algo.get("learning_starts")
@@ -282,8 +305,25 @@ def run_algorithm(cfg: dotdict) -> None:
 
 
 def run(args: Optional[Sequence[str]] = None) -> None:
-    """Entry point: ``python -m sheeprl_tpu exp=ppo env=gym ...``."""
+    """Entry point: ``python -m sheeprl_tpu exp=ppo env=gym ...``.
+
+    Resilience wiring (sheeprl_tpu/resilience, howto/fault_tolerance.md): the
+    cooperative SIGTERM/SIGINT preemption handler is installed around the launch
+    (``resilience.handler``, default on) — the loops poll it at iteration
+    boundaries and write an emergency checkpoint before exiting, and a preempted
+    run exits with the distinct :data:`PREEMPTED_EXIT_CODE`. With
+    ``resilience.supervisor.enabled`` the launch runs under the bounded-restart
+    supervisor, auto-resuming from the newest valid checkpoint on crash or
+    preemption."""
     import sheeprl_tpu  # ensure registries are populated
+
+    from sheeprl_tpu.resilience import (
+        PREEMPTED_EXIT_CODE,
+        install_preemption_handler,
+        preemption_requested,
+        supervisor_enabled,
+        uninstall_preemption_handler,
+    )
 
     overrides = list(args if args is not None else sys.argv[1:])
     cfg = compose(overrides)
@@ -295,7 +335,28 @@ def run(args: Optional[Sequence[str]] = None) -> None:
     _apply_hydra_cfg(cfg)
     if cfg.metric.log_level > 0:
         print_config(cfg)
-    run_algorithm(cfg)
+
+    handler_installed = False
+    if bool((cfg.get("resilience") or {}).get("handler", True)):
+        handler_installed = install_preemption_handler()
+    try:
+        if supervisor_enabled(cfg):
+            from sheeprl_tpu.resilience.supervisor import supervise
+
+            outcome = supervise(cfg, run_algorithm, resume_from_checkpoint)
+        else:
+            run_algorithm(cfg)
+            outcome = "preempted" if preemption_requested() else "completed"
+    finally:
+        # a crash that unwound past the loop's finalize() leaves its watchdog
+        # running (an abort-mode one would os._exit a later in-process run)
+        from sheeprl_tpu.resilience.watchdog import stop_all_watchdogs
+
+        stop_all_watchdogs()
+        if handler_installed:
+            uninstall_preemption_handler()
+    if outcome == "preempted":
+        raise SystemExit(PREEMPTED_EXIT_CODE)
 
 
 def check_configs_evaluation(cfg: dotdict) -> None:

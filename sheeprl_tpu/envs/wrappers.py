@@ -71,6 +71,23 @@ class ActionRepeat(gym.Wrapper):
         return obs, total_reward, terminated, truncated, info
 
 
+class InjectedEnvFault(gym.Wrapper):
+    """One-shot ``env.step`` exception driven by ``resilience.fault=env_step``
+    (sheeprl_tpu/resilience/faults.py): under :class:`RestartOnException` it
+    exercises the crash-restart path, elsewhere an ordinary run crash. The armed
+    flag is process-global, so it reaches sync (in-process) vector envs; async
+    vector-env subprocesses never observe it."""
+
+    def step(self, action):
+        from sheeprl_tpu.resilience.faults import InjectedFaultError, consume_env_fault
+
+        if consume_env_fault():
+            raise InjectedFaultError(
+                "resilience.fault=env_step: injected exception in env.step"
+            )
+        return self.env.step(action)
+
+
 class RestartOnException(gym.Wrapper):
     """Rebuild a crashed env in place, with at most ``maxfails`` failures per
     ``window`` seconds (reference sheeprl/envs/wrappers.py:74-124). Dreamer-V3 wraps

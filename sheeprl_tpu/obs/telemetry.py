@@ -64,6 +64,12 @@ class NullTelemetry:
     def observe_train(self, units: int, losses: Any = None) -> None:
         pass
 
+    def observe_env_restart(self, count: int = 1) -> None:
+        pass
+
+    def emit_event(self, event: str, step: Optional[int] = None, **fields: Any) -> bool:
+        return False
+
     def step(self, policy_step: int) -> None:
         pass
 
@@ -187,6 +193,7 @@ class RunTelemetry:
         self._total_train_units = 0
         self._total_train_seconds = 0.0
         self._last_losses: Any = None
+        self._env_restarts = 0
         self._health_status = "unknown"
         self._sampler: Any = None
         self._prefetch_last: Optional[Dict[str, float]] = None
@@ -304,6 +311,27 @@ class RunTelemetry:
         if losses is not None:
             self._last_losses = losses
 
+    def observe_env_restart(self, count: int = 1) -> None:
+        """Account ``RestartOnException`` env restarts (previously invisible):
+        a ``Health/env_restarts`` gauge plus an immediate ``health`` event — a
+        flapping env is an operational signal, not noise to average away."""
+        if not self.enabled or count <= 0:
+            return
+        self._env_restarts += int(count)
+        if self._sink is not None:
+            self._sink.emit(
+                "health", status="env_restart", restarts=int(count), total=self._env_restarts
+            )
+
+    def emit_event(self, event: str, step: Optional[int] = None, **fields: Any) -> bool:
+        """Write an arbitrary event to the run's JSONL stream (used by the
+        resilience subsystem for preempt/checkpoint/stall events). Returns False
+        when no sink is open so the caller can fall back to its own."""
+        if self._sink is None:
+            return False
+        self._sink.emit(event, step=step, **fields)
+        return True
+
     def step(self, policy_step: int) -> None:
         """Once per loop iteration: advance the profiler window and emit a
         telemetry window every ``every`` policy steps. Idle cost is two int
@@ -405,6 +433,7 @@ class RunTelemetry:
                 hbm_peak_bytes=peak_hbm,
                 rss_peak_bytes=rss_peak_bytes(),
                 prefetch=self._prefetch_total or None,
+                env_restarts=self._env_restarts,
                 health=self._health_status,
                 programs={k: v for k, v in self._programs.items()},
             )
@@ -542,6 +571,8 @@ class RunTelemetry:
                 gauges["Time/prefetch_wait"] = float(prefetch["wait_seconds"])
                 gauges["Buffer/pipeline_occupancy"] = float(prefetch["occupancy"])
                 gauges["Buffer/pipeline_staleness"] = float(prefetch["staleness"])
+            if self._env_restarts > 0:
+                gauges["Health/env_restarts"] = float(self._env_restarts)
             self._logger.log_metrics(gauges, policy_step)
 
         if self._sink is not None:

@@ -1,0 +1,72 @@
+"""Resilience subsystem: preemption-safe checkpointing, crash supervision,
+fault injection and a progress watchdog.
+
+A TPU-native port runs on hardware where preemption is the norm (Podracer-style
+pod deployments assume workers die and resume — PAPERS.md); the reference has no
+signal handling, no auto-resume and no stall detection. This package is the
+operational layer that *drives* the crash-atomic checkpoint serialization
+(``utils/checkpoint.py``) and the telemetry event stream (``obs/``) already in
+the tree:
+
+- :mod:`~sheeprl_tpu.resilience.signals` — cooperative SIGTERM/SIGINT preemption
+  handler (installed by the CLI) + the distinct preempted exit code;
+- :mod:`~sheeprl_tpu.resilience.monitor` — :func:`build_resilience` /
+  :class:`ResilienceMonitor`, the per-run facade every training loop threads
+  (watchdog feed, fault trigger, preempt poll → emergency checkpoint);
+- :mod:`~sheeprl_tpu.resilience.supervisor` — bounded-restart run supervisor
+  with latest-valid-checkpoint auto-resume;
+- :mod:`~sheeprl_tpu.resilience.discovery` — checkpoint enumeration/validation
+  shared by the supervisor and ``checkpoint.resume_from=latest``;
+- :mod:`~sheeprl_tpu.resilience.faults` — deterministic config-driven fault
+  injection so the whole recovery path is testable on CPU in tier-1;
+- :mod:`~sheeprl_tpu.resilience.watchdog` — progress watchdog dumping all-thread
+  stacks into ``telemetry.jsonl`` on a stall, with optional abort.
+
+See ``howto/fault_tolerance.md`` for the config keys and operational semantics.
+"""
+
+from sheeprl_tpu.resilience.discovery import (
+    find_latest_checkpoint,
+    is_valid_checkpoint,
+    iter_checkpoints,
+    resolve_latest,
+)
+from sheeprl_tpu.resilience.faults import FAULT_KINDS, InjectedFaultError, normalize_fault_cfg, reset_faults
+from sheeprl_tpu.resilience.monitor import NullResilience, ResilienceMonitor, build_resilience
+from sheeprl_tpu.resilience.signals import (
+    PREEMPTED_EXIT_CODE,
+    WATCHDOG_EXIT_CODE,
+    install_preemption_handler,
+    preemption_requested,
+    request_preemption,
+    reset_preemption,
+    uninstall_preemption_handler,
+)
+from sheeprl_tpu.resilience.supervisor import supervise, supervisor_enabled
+from sheeprl_tpu.resilience.watchdog import ProgressWatchdog, WatchdogError, dump_all_stacks
+
+__all__ = [
+    "FAULT_KINDS",
+    "InjectedFaultError",
+    "NullResilience",
+    "PREEMPTED_EXIT_CODE",
+    "ProgressWatchdog",
+    "ResilienceMonitor",
+    "WATCHDOG_EXIT_CODE",
+    "WatchdogError",
+    "build_resilience",
+    "dump_all_stacks",
+    "find_latest_checkpoint",
+    "install_preemption_handler",
+    "is_valid_checkpoint",
+    "iter_checkpoints",
+    "normalize_fault_cfg",
+    "preemption_requested",
+    "request_preemption",
+    "reset_faults",
+    "reset_preemption",
+    "resolve_latest",
+    "supervise",
+    "supervisor_enabled",
+    "uninstall_preemption_handler",
+]

@@ -1,0 +1,112 @@
+"""Latest-valid-checkpoint discovery (shared by ``checkpoint.resume_from=latest``
+and the crash supervisor's auto-resume).
+
+A run's checkpoints live at ``<base>/<run_name>/version_N/checkpoint/ckpt_{step}_{rank}.ckpt``
+in one of two on-disk formats (utils/checkpoint.py): a single pickle FILE
+(written crash-atomically via tmp+rename, so existence implies completeness) or
+an orbax DIRECTORY paired with a ``.extras.pkl`` sidecar. The sharded writer's
+in-place overwrite protocol additionally leaves crash-window variants the loader
+understands: a ``<path>.old`` directory displaced before the new write committed,
+and a ``<path>.old.extras.pkl`` sidecar whose directory rename never happened.
+Discovery enumerates all of these, validates each candidate the same way
+``load_checkpoint`` would resolve it, and orders by (mtime, parsed step) so a
+restarted run resumes from the newest state that is actually loadable —
+skipping torn ``.tmp`` files and orbax directories whose sidecar is missing.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import List, Optional
+
+_STEP_RE = re.compile(r"ckpt_(\d+)(?:_\d+)?\.ckpt$")
+
+
+def checkpoint_step(path: str) -> int:
+    """Policy step parsed from a ``ckpt_{step}_{rank}.ckpt`` name (-1 if foreign)."""
+    m = _STEP_RE.search(os.path.basename(str(path)).replace(".old", ""))
+    return int(m.group(1)) if m else -1
+
+
+def is_valid_checkpoint(path: str) -> bool:
+    """Would ``load_checkpoint(path)`` find a complete state at ``path``?
+
+    - pickle file: committed atomically (tmp+``os.replace``), so a non-empty
+      ``.ckpt`` file is complete by construction;
+    - orbax directory: needs its sidecar — at ``<path>.extras.pkl`` or, in the
+      mid-displacement crash window, ``<path>.old.extras.pkl``;
+    - missing path with a ``<path>.old`` directory: the in-place-overwrite crash
+      window; valid when the displaced directory still pairs with a sidecar.
+    """
+    path = str(path)
+    if os.path.isfile(path):
+        try:
+            return os.path.getsize(path) > 0
+        except OSError:
+            return False
+    if os.path.isdir(path):
+        return os.path.isfile(path + ".extras.pkl") or os.path.isfile(path + ".old.extras.pkl")
+    old = path + ".old"
+    if os.path.isdir(old):
+        return os.path.isfile(old + ".extras.pkl")
+    return False
+
+
+def iter_checkpoints(search_dir: str) -> List[str]:
+    """All checkpoint candidates under ``search_dir`` (any depth), as the paths
+    ``load_checkpoint`` should be handed — i.e. ``.old`` crash-window survivors
+    are reported under their base (pre-displacement) path."""
+    search_dir = str(search_dir)
+    if not os.path.isdir(search_dir):
+        return []
+    candidates = set(glob.glob(os.path.join(search_dir, "**", "*.ckpt"), recursive=True))
+    for old in glob.glob(os.path.join(search_dir, "**", "*.ckpt.old"), recursive=True):
+        base = old[: -len(".old")]
+        if not os.path.exists(base):
+            candidates.add(base)
+    return sorted(candidates)
+
+
+def _candidate_mtime(path: str) -> float:
+    for probe in (path, path + ".old", path + ".extras.pkl", path + ".old.extras.pkl"):
+        try:
+            return os.path.getmtime(probe)
+        except OSError:
+            continue
+    return 0.0
+
+
+def find_latest_checkpoint(search_dir: str) -> Optional[str]:
+    """Newest valid checkpoint under ``search_dir`` (None when there is none).
+    Ordered by mtime with the parsed policy step as tiebreak — step counts are
+    only comparable within one run, mtime orders across restarts and runs."""
+    valid = [c for c in iter_checkpoints(search_dir) if is_valid_checkpoint(c)]
+    if not valid:
+        return None
+    return max(valid, key=lambda c: (_candidate_mtime(c), checkpoint_step(c)))
+
+
+def resolve_latest(cfg) -> str:
+    """Resolve ``checkpoint.resume_from=latest`` for the CLI: newest valid
+    checkpoint across every run under this experiment's ``root_dir`` (honoring a
+    ``hydra.run.dir`` override, where the runs of one experiment share a base)."""
+    from pathlib import Path
+
+    from sheeprl_tpu.utils.logger import run_base_dir
+
+    # the CLI resolves `latest` before `_apply_hydra_cfg` runs, so honor a
+    # hydra.run.dir override from the config directly
+    hydra_dir = ((cfg.get("hydra") or {}).get("run") or {}).get("dir")
+    base = Path(hydra_dir) if hydra_dir else run_base_dir(cfg.root_dir, cfg.run_name)
+    # without an override the per-run dir is <logs/runs/root_dir>/<run_name>; the
+    # CURRENT run_name is freshly timestamped, so search the whole experiment
+    search = base if base.is_dir() else base.parent
+    found = find_latest_checkpoint(str(search))
+    if found is None:
+        raise ValueError(
+            f"checkpoint.resume_from=latest: no valid checkpoint found under {search} "
+            "(nothing to resume; pass an explicit path or start a fresh run)"
+        )
+    return found

@@ -1,0 +1,136 @@
+"""Deterministic, config-driven fault injection (``resilience.fault``).
+
+The whole recovery path — emergency checkpoint on preemption, supervisor
+auto-resume, crash-window checkpoint fallbacks, env crash-restart — is only
+trustworthy if it is *exercised*, and real preemptions/crashes are neither
+deterministic nor CPU-reproducible. ``resilience.fault={kind, at_policy_step}``
+injects exactly one fault at a configured policy step so tier-1 CPU tests drive
+end-to-end recovery (MindSpeed RL makes restartable dataflow a tested
+first-class requirement for the same reason):
+
+- ``crash``      — raise :class:`InjectedFaultError` from the loop's resilience
+                   hook: an uncaught hard crash mid-training;
+- ``sigterm``    — deliver a real SIGTERM to this process: the cooperative
+                   preemption path (handler → flag → emergency checkpoint →
+                   preempted exit), exactly as a pod reclaim would;
+- ``env_step``   — arm a one-shot exception inside ``env.step`` (the env fault
+                   wrapper in utils/env.py): exercises ``RestartOnException``
+                   where present, an ordinary crash elsewhere;
+- ``ckpt_kill``  — raise from *inside* the next checkpoint write, at the exact
+                   point where a kill would leave the crash-window on-disk state
+                   (pickle: tmp written, not yet renamed; sharded: sidecar
+                   committed, orbax directory not): recovery must skip the torn
+                   artifacts and fall back to the previous valid checkpoint.
+
+Every fault fires at most once per process (the in-process supervisor restarts
+within the same process, so a resumed attempt replaying policy steps below
+``at_policy_step`` must not re-trigger); the supervisor additionally strips the
+fault from retry configs, covering cross-process restarts.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from sheeprl_tpu.resilience import signals
+
+FAULT_KINDS = ("crash", "sigterm", "env_step", "ckpt_kill")
+
+
+class InjectedFaultError(RuntimeError):
+    """The deterministic stand-in for a hard crash."""
+
+
+_lock = threading.Lock()
+_fired: Dict[tuple, int] = {}  # (kind, at_policy_step) -> policy step it fired at
+_env_fault_armed = threading.Event()
+
+
+def normalize_fault_cfg(resilience_cfg: Any) -> Optional[Dict[str, int]]:
+    """``{kind, at}`` from ``cfg.resilience.fault``, or None when off. Raises on
+    an unknown kind so config policing fails before the run launches."""
+    fault = (resilience_cfg or {}).get("fault") or {}
+    kind = fault.get("kind")
+    if kind is None or str(kind).lower() in ("none", "null", "off", "false"):
+        return None
+    kind = str(kind).lower()
+    if kind not in FAULT_KINDS:
+        raise ValueError(
+            f"unknown resilience.fault.kind {kind!r}; available: none, " + ", ".join(FAULT_KINDS)
+        )
+    return {"kind": kind, "at": int(fault.get("at_policy_step") or 0)}
+
+
+def has_fired() -> bool:
+    with _lock:
+        return bool(_fired)
+
+
+def reset_faults() -> None:
+    """Forget fired faults and disarm pending ones (test isolation helper)."""
+    with _lock:
+        _fired.clear()
+    _env_fault_armed.clear()
+    from sheeprl_tpu.utils import checkpoint
+
+    if checkpoint._fault_hook is _ckpt_kill_hook:
+        checkpoint._fault_hook = None
+
+
+def consume_env_fault() -> bool:
+    """One-shot poll the env fault wrapper runs per ``step()`` call. Process-
+    global, so it reaches in-process (sync) vector envs; subprocess (async)
+    vector envs never see the armed flag — documented in howto/fault_tolerance."""
+    if _env_fault_armed.is_set():
+        _env_fault_armed.clear()
+        return True
+    return False
+
+
+def _ckpt_kill_hook(stage: str, path: str) -> None:
+    from sheeprl_tpu.utils import checkpoint
+
+    checkpoint._fault_hook = None  # one shot
+    raise InjectedFaultError(
+        f"resilience.fault=ckpt_kill: injected kill during checkpoint write "
+        f"(stage={stage}, path={path})"
+    )
+
+
+class FaultPlan:
+    """The armed fault a :class:`ResilienceMonitor` drives from its per-iteration
+    hook. ``maybe_fire`` is idempotent across restarts (process-global ledger)."""
+
+    def __init__(self, kind: str, at_policy_step: int) -> None:
+        self.kind = kind
+        self.at = int(at_policy_step)
+
+    def maybe_fire(self, policy_step: int, emit: Callable[..., None]) -> None:
+        if policy_step < self.at:
+            return
+        key = (self.kind, self.at)
+        with _lock:
+            if key in _fired:
+                return
+            _fired[key] = int(policy_step)
+        emit("fault", step=policy_step, kind=self.kind, at_policy_step=self.at)
+        if self.kind == "crash":
+            raise InjectedFaultError(
+                f"resilience.fault=crash: injected hard crash at policy step {policy_step}"
+            )
+        if self.kind == "sigterm":
+            signals.request_preemption()
+        elif self.kind == "env_step":
+            _env_fault_armed.set()
+        elif self.kind == "ckpt_kill":
+            from sheeprl_tpu.utils import checkpoint
+
+            checkpoint._fault_hook = _ckpt_kill_hook
+
+
+def build_fault_plan(resilience_cfg: Any) -> Optional[FaultPlan]:
+    spec = normalize_fault_cfg(resilience_cfg)
+    if spec is None:
+        return None
+    return FaultPlan(spec["kind"], spec["at"])

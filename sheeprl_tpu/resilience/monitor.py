@@ -1,0 +1,221 @@
+"""``ResilienceMonitor``: the per-run resilience facade every training loop threads.
+
+Shape-parity with the telemetry facade (``obs/telemetry.py``): one instance per
+run (``build_resilience``, from the ``resilience`` config group), four hooks the
+loops drive, each an attribute-cheap no-op when the feature is off:
+
+- ``step(policy_step)`` — once per loop iteration, next to ``telemetry.step``:
+  feeds the progress watchdog, fires a due injected fault, and emits the one-shot
+  ``preempt`` event when the signal flag is first observed.
+- ``preempt_requested()`` — the poll the loops fold into their checkpoint
+  condition (forcing the out-of-cadence emergency checkpoint through the
+  existing ``on_checkpoint_*`` path) and their loop-exit ``break``.
+- ``observe_checkpoint(ckpt_path, policy_step)`` — right after each checkpoint
+  write: a ``checkpoint`` event (``reason=preempt`` for the emergency one) and a
+  watchdog feed (a long sharded write is not a stall).
+- ``finalize(policy_step)`` — at loop exit, gating the final test: stops the
+  watchdog, emits ``preempt_exit``, returns whether the run was preempted.
+
+Events ride the run telemetry's JSONL sink when telemetry is enabled; otherwise
+critical events (preempt/stall/fault) lazily open their own sink on the same
+``telemetry.jsonl`` path, so a preempted default-config run still leaves an
+audit trail — while an uneventful run with telemetry off leaves no new artifact.
+The supervisor pins ``metric.telemetry.jsonl_path`` to a run-base path shared by
+every restart, so the preempt → checkpoint → restart → resume sequence is one
+ordered stream across attempts.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from sheeprl_tpu.obs.jsonl import JsonlEventSink
+from sheeprl_tpu.resilience import signals
+from sheeprl_tpu.resilience.faults import build_fault_plan
+from sheeprl_tpu.resilience.watchdog import ProgressWatchdog, stop_all_watchdogs
+
+
+class NullResilience:
+    """The disabled facade: loops never branch on whether resilience is on."""
+
+    enabled = False
+
+    def step(self, policy_step: int) -> None:
+        pass
+
+    def preempt_requested(self) -> bool:
+        return False
+
+    def observe_checkpoint(
+        self, ckpt_path: str, policy_step: int, preempted: Optional[bool] = None
+    ) -> None:
+        pass
+
+    def finalize(self, policy_step: Optional[int] = None) -> bool:
+        return False
+
+
+class PollResilience(NullResilience):
+    """Non-rank-0 facade for multi-process SPMD: no events, faults or watchdog
+    (rank-0 concerns), but the preemption poll is LIVE. Every rank folds the
+    same flag into its checkpoint condition and loop-exit break — a hard-coded
+    False would be rank-divergent, and ``fabric.save`` barriers across
+    processes, so rank 0 would hang in its emergency checkpoint while the other
+    ranks sail past the block (external launchers deliver the reclaim SIGTERM to
+    every process, so the process-local flags agree)."""
+
+    enabled = True
+
+    def preempt_requested(self) -> bool:
+        return signals.preemption_requested()
+
+    def finalize(self, policy_step: Optional[int] = None) -> bool:
+        return signals.preemption_requested()
+
+
+class ResilienceMonitor:
+    """See the module docstring for the hook contract. Construct via
+    :func:`build_resilience` (rank gating and the all-off path)."""
+
+    enabled = True
+
+    def __init__(self, fabric: Any, cfg: Any, log_dir: Optional[str], telemetry: Any = None) -> None:
+        # a previous in-process attempt that died on an exception path never ran
+        # finalize(): stop its watchdog before starting this run's (an orphaned
+        # abort-mode watchdog would os._exit the healthy restarted run)
+        stop_all_watchdogs()
+        rcfg = cfg.get("resilience") or {}
+        tcfg = (cfg.get("metric") or {}).get("telemetry") or {}
+        self._fabric = fabric
+        self._telemetry = telemetry
+        self._fault = build_fault_plan(rcfg)
+        self._preempt_seen = False
+        self._emit_lock = threading.Lock()
+        self._own_sink: Optional[JsonlEventSink] = None
+        # metric.telemetry.jsonl=false disables the JSONL stream outright —
+        # resilience events honor it too (no lazy sink behind the user's back)
+        self._jsonl_enabled = bool(tcfg.get("jsonl", True))
+        self._sink_path = str(
+            tcfg.get("jsonl_path")
+            or (f"{log_dir}/telemetry.jsonl" if log_dir else "telemetry.jsonl")
+        )
+        # with the supervisor (or full telemetry) on, every lifecycle event is
+        # recorded; otherwise only critical events open the lazy sink, keeping
+        # default-run artifacts unchanged
+        self._eager = bool((rcfg.get("supervisor") or {}).get("enabled", False)) or bool(
+            getattr(telemetry, "enabled", False)
+        )
+
+        wcfg = rcfg.get("watchdog") or {}
+        self.watchdog: Optional[ProgressWatchdog] = None
+        if bool(wcfg.get("enabled", False)):
+            self.watchdog = ProgressWatchdog(
+                float(wcfg.get("timeout") or 300.0),
+                lambda event, **fields: self._emit(event, critical=True, **fields),
+                abort=bool(wcfg.get("abort", False)),
+                grace=float(wcfg.get("grace") or 30.0),
+            ).start()
+
+        if cfg.get("checkpoint", {}).get("resume_from"):
+            self._emit("resume", resume_from=str(cfg.checkpoint.resume_from))
+
+    # -- hooks -------------------------------------------------------------------
+
+    def step(self, policy_step: int) -> None:
+        if self.watchdog is not None:
+            self.watchdog.feed(policy_step)
+        if self._fault is not None:
+            self._fault.maybe_fire(policy_step, self._emit_critical)
+        if not self._preempt_seen and signals.preemption_requested():
+            self._preempt_seen = True
+            self._emit(
+                "preempt",
+                step=policy_step,
+                signum=signals.preempt_signum(),
+                critical=True,
+            )
+            self._fabric.print(
+                f"[sheeprl-resilience] preemption requested at policy step {policy_step}: "
+                "writing emergency checkpoint and shutting down"
+            )
+
+    def preempt_requested(self) -> bool:
+        return signals.preemption_requested()
+
+    def observe_checkpoint(
+        self, ckpt_path: str, policy_step: int, preempted: Optional[bool] = None
+    ) -> None:
+        # the loops pass their per-iteration snapshot — the one that actually
+        # gated this save; re-polling here would mislabel a cadence-driven
+        # checkpoint as reason=preempt when the signal lands mid-write (and
+        # spuriously open the lazy sink for it)
+        preempt = signals.preemption_requested() if preempted is None else bool(preempted)
+        self._emit(
+            "checkpoint",
+            step=policy_step,
+            path=str(ckpt_path),
+            reason="preempt" if preempt else "periodic",
+            critical=preempt,
+        )
+        if self.watchdog is not None:
+            self.watchdog.feed(policy_step)
+
+    def finalize(self, policy_step: Optional[int] = None) -> bool:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
+        preempted = signals.preemption_requested()
+        if preempted:
+            self._emit(
+                "preempt_exit",
+                step=policy_step,
+                exit_code=signals.PREEMPTED_EXIT_CODE,
+                grace_spent_seconds=signals.preempt_age_seconds(),
+                critical=True,
+            )
+        if self._own_sink is not None:
+            self._own_sink.close()
+            self._own_sink = None
+        return preempted
+
+    # -- internals ---------------------------------------------------------------
+
+    def _emit_critical(self, event: str, **fields: Any) -> None:
+        self._emit(event, critical=True, **fields)
+
+    def _emit(self, event: str, step: Optional[int] = None, critical: bool = False, **fields: Any) -> None:
+        with self._emit_lock:
+            if self._telemetry is not None and self._telemetry.emit_event(event, step=step, **fields):
+                return
+            if self._own_sink is None:
+                if not self._jsonl_enabled or not (self._eager or critical):
+                    return
+                try:
+                    self._own_sink = JsonlEventSink(self._sink_path)
+                except OSError:
+                    return
+            self._own_sink.emit(event, step=step, **fields)
+
+
+def build_resilience(fabric: Any, cfg: Any, log_dir: Optional[str] = None, telemetry: Any = None):
+    """Build the run's resilience facade from the ``resilience`` config group.
+    Rank-0-only (one controller process observes the run; MPMD trainer roles are
+    reached through the channel shutdown protocol, not their own monitor).
+    Returns :class:`NullResilience` when every feature is off — the loops then
+    behave byte-for-byte as before."""
+    rcfg = cfg.get("resilience") or {}
+    if not getattr(fabric, "is_global_zero", True):
+        # non-rank-0 SPMD processes: the preemption poll must stay live so the
+        # per-rank checkpoint conditions (and fabric.save's cross-process
+        # barrier) cannot diverge on a pod-wide SIGTERM
+        return PollResilience() if bool(rcfg.get("handler", True)) else NullResilience()
+    handler = bool(rcfg.get("handler", True))
+    # single source of truth for "is a fault configured" (check_configs already
+    # validated, so an unknown kind cannot raise here)
+    fault_on = build_fault_plan(rcfg) is not None
+    watchdog_on = bool((rcfg.get("watchdog") or {}).get("enabled", False))
+    supervised = bool((rcfg.get("supervisor") or {}).get("enabled", False))
+    if not (handler or fault_on or watchdog_on or supervised):
+        return NullResilience()
+    return ResilienceMonitor(fabric, cfg, log_dir, telemetry=telemetry)

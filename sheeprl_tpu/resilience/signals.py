@@ -1,0 +1,147 @@
+"""Cooperative preemption handling (SIGTERM/SIGINT → poll flag → graceful exit).
+
+TPU pods are preemptible infrastructure: maintenance events and spot reclaims
+deliver SIGTERM with a short grace window (PAPERS: "Podracer architectures" runs
+everything on this assumption). The reference has no signal handling at all — a
+SIGTERM between two ``checkpoint.every`` boundaries silently loses everything
+since the last checkpoint. Here the CLI installs a process-level handler that
+only *records* the signal; the training loops poll :func:`preemption_requested`
+at iteration boundaries, write an out-of-cadence emergency checkpoint through
+their existing ``on_checkpoint_*`` path, tear down cleanly (the decoupled player
+forwards the shutdown over the data channel, so trainer ranks exit too) and the
+CLI exits with :data:`PREEMPTED_EXIT_CODE` so external supervisors can tell a
+preemption from a crash. A second signal while the flag is set restores the
+previous handler and re-raises — the escape hatch when the cooperative path is
+itself stuck.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+# Distinct "preempted" exit status (EX_TEMPFAIL: transient, retry later) — not
+# 128+signum, which any abnormal SIGTERM death would also produce. External
+# supervisors (and the in-process one) key restart policy on this.
+PREEMPTED_EXIT_CODE = 75
+# Watchdog abort escalation exit status (see resilience/watchdog.py).
+WATCHDOG_EXIT_CODE = 76
+
+_DEFAULT_SIGNALS: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)
+
+_state_lock = threading.Lock()
+_flag = threading.Event()
+_signum: Optional[int] = None
+_received_at: Optional[float] = None
+_prev_handlers: Dict[int, object] = {}
+
+
+def _handler(signum, frame) -> None:
+    global _signum, _received_at
+    if _flag.is_set():
+        # second signal: the cooperative path did not exit in time — restore the
+        # previous disposition and re-deliver so the default behavior (or the
+        # caller's original handler) takes over immediately
+        prev = _prev_handlers.get(signum, signal.SIG_DFL)
+        try:
+            signal.signal(signum, prev if callable(prev) or prev in (signal.SIG_DFL, signal.SIG_IGN) else signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+        signal.raise_signal(signum)
+        return
+    _signum = int(signum)
+    _received_at = time.monotonic()
+    _flag.set()
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:
+        name = str(signum)
+    print(
+        f"[sheeprl-resilience] caught {name}: requesting cooperative preemption — "
+        "emergency checkpoint at the next iteration boundary (send again to force exit)",
+        file=sys.stderr,
+        flush=True,
+    )
+
+
+def install_preemption_handler(signums: Tuple[int, ...] = _DEFAULT_SIGNALS) -> bool:
+    """Install the preemption handler (idempotent; resets a stale flag). Returns
+    False — and installs nothing — off the main thread, where CPython forbids
+    ``signal.signal`` (e.g. a loop launched from a test worker thread)."""
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    with _state_lock:
+        reset_preemption()
+        installed = []
+        for signum in signums:
+            prev = signal.getsignal(signum)
+            try:
+                signal.signal(signum, _handler)
+            except (ValueError, OSError):
+                # partial install must unwind: the caller records "not
+                # installed" and would never uninstall the ones already bound
+                for done, done_prev in installed:
+                    try:
+                        signal.signal(done, done_prev)
+                    except (ValueError, OSError, TypeError):
+                        pass
+                    _prev_handlers.pop(done, None)
+                return False
+            if prev is not _handler:
+                _prev_handlers[signum] = prev
+                installed.append((signum, prev))
+    return True
+
+
+def uninstall_preemption_handler() -> None:
+    """Restore the dispositions saved by :func:`install_preemption_handler`."""
+    if threading.current_thread() is not threading.main_thread():
+        return
+    with _state_lock:
+        for signum, prev in list(_prev_handlers.items()):
+            try:
+                if signal.getsignal(signum) is _handler:
+                    signal.signal(signum, prev)
+            except (ValueError, OSError, TypeError):
+                pass
+            _prev_handlers.pop(signum, None)
+
+
+def preemption_requested() -> bool:
+    """The poll the training loops run at iteration boundaries."""
+    return _flag.is_set()
+
+
+def preempt_signum() -> Optional[int]:
+    return _signum if _flag.is_set() else None
+
+
+def preempt_age_seconds() -> Optional[float]:
+    """Seconds since the preemption signal landed (None when not preempted) —
+    how much of the grace window the emergency checkpoint has already spent."""
+    if not _flag.is_set() or _received_at is None:
+        return None
+    return time.monotonic() - _received_at
+
+
+def reset_preemption() -> None:
+    """Clear the flag (the in-process supervisor calls this between attempts)."""
+    global _signum, _received_at
+    _flag.clear()
+    _signum = None
+    _received_at = None
+
+
+def request_preemption(signum: Optional[int] = None) -> None:
+    """Programmatic preemption (fault injection / watchdog): raise the real
+    signal when a handler is installed so the full path is exercised, otherwise
+    set the flag directly."""
+    target = signal.SIGTERM if signum is None else signum
+    if signal.getsignal(target) is _handler:
+        os.kill(os.getpid(), target)
+    else:
+        _handler(target, None)

@@ -1,0 +1,172 @@
+"""Run supervisor: bounded auto-restart with checkpoint auto-resume.
+
+Wraps the algo entrypoint launch in ``cli.py`` (``resilience.supervisor.enabled``,
+off by default). On a crash — or a cooperative preemption, when
+``restart_on_preempt`` — it resolves the newest *valid* checkpoint in the run's
+log dir (``discovery.py``: both pickle and orbax formats, including the
+``.old``/sidecar crash-window variants ``load_checkpoint`` understands), rebuilds
+the attempt config through the CLI's resume merge (identity validation + config
+restore, with the emergency checkpoint as ``resume_from``), sleeps an exponential
+backoff, and re-enters the loop — the single-process analogue of a Podracer pod
+controller rescheduling a dead worker. Restarts are bounded by ``max_restarts``;
+when the budget is exhausted a crash re-raises and a preemption exits with the
+preempted code. Each decision lands as a ``restart``/``giveup`` event in the
+run-base ``telemetry.jsonl`` shared by every attempt (the supervisor pins
+``metric.telemetry.jsonl_path`` there), so the whole
+preempt → checkpoint → restart → resume history is one ordered stream.
+
+Scope: the in-process supervisor drives single-process topologies (SPMD or the
+threaded decoupled trainers). Multi-process MPMD roles are restarted by the
+external launcher — restarting one role in-process would desync the stateful
+channel planes — so the supervisor steps aside with a warning there.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+import warnings
+from typing import Any, Callable, Optional
+
+from sheeprl_tpu.config import dotdict
+from sheeprl_tpu.obs.jsonl import JsonlEventSink
+from sheeprl_tpu.resilience import faults, signals
+from sheeprl_tpu.resilience.discovery import find_latest_checkpoint
+from sheeprl_tpu.resilience.watchdog import stop_all_watchdogs
+
+
+def supervisor_enabled(cfg: Any) -> bool:
+    return bool(((cfg.get("resilience") or {}).get("supervisor") or {}).get("enabled", False))
+
+
+def _strip_fired_fault(cfg: dotdict) -> None:
+    """A fault that already fired must not ride into the retry config (the saved
+    run config — merged back on resume — still carries it)."""
+    if faults.has_fired():
+        fault = (cfg.get("resilience") or {}).get("fault")
+        if fault:
+            fault["kind"] = None
+
+
+def supervise(
+    cfg: dotdict,
+    run_fn: Callable[[dotdict], Any],
+    resume_merge: Callable[[dotdict], dotdict],
+) -> str:
+    """Run ``run_fn(cfg)`` under restart supervision. Returns ``"completed"`` or
+    ``"preempted"`` (the CLI maps the latter to the preempted exit code);
+    a crash that exhausts the restart budget re-raises."""
+    from sheeprl_tpu.parallel import distributed
+    from sheeprl_tpu.utils.logger import run_base_dir
+
+    if distributed.process_count() > 1:
+        warnings.warn(
+            "resilience.supervisor: multi-process (MPMD/multi-host) topologies are "
+            "restarted by the external launcher; in-process supervision is disabled "
+            "for this run (the preemption handler and emergency checkpoint still apply)."
+        )
+        run_fn(cfg)
+        return "preempted" if signals.preemption_requested() else "completed"
+
+    scfg = cfg.resilience.supervisor
+    max_restarts = int(scfg.get("max_restarts", 3))
+    backoff = float(scfg.get("backoff", 1.0))
+    backoff_cap = float(scfg.get("backoff_cap", 60.0))
+    restart_on_preempt = bool(scfg.get("restart_on_preempt", True))
+
+    run_base = run_base_dir(cfg.root_dir, cfg.run_name)
+    # one event stream across attempts: every restart appends to the same file.
+    # metric.telemetry.jsonl=false disables the stream — supervisor events too.
+    cfg.metric.setdefault("telemetry", dotdict({}))
+    jsonl_enabled = bool(cfg.metric.telemetry.get("jsonl", True))
+    if jsonl_enabled and not cfg.metric.telemetry.get("jsonl_path"):
+        cfg.metric.telemetry.jsonl_path = str(run_base / "telemetry.jsonl")
+
+    sink: Optional[JsonlEventSink] = None
+
+    def emit(event: str, **fields: Any) -> None:
+        nonlocal sink
+        if not jsonl_enabled:
+            return
+        if sink is None:
+            try:
+                sink = JsonlEventSink(cfg.metric.telemetry.jsonl_path)
+            except OSError:
+                return
+        sink.emit(event, **fields)
+
+    original = dotdict(copy.deepcopy(cfg.as_dict()))
+    current = cfg
+    attempt = 0
+    try:
+        while True:
+            # a SIGTERM that landed BETWEEN attempts (e.g. during the backoff
+            # sleep) is a real reclaim: blindly resetting it would relaunch a
+            # full attempt on a dying node. Honor the same policy as an in-run
+            # preemption — restart only when restart_on_preempt says so.
+            if signals.preemption_requested() and not restart_on_preempt:
+                emit("supervisor", status="preempted", attempts=attempt, between_attempts=True)
+                return "preempted"
+            signals.reset_preemption()
+            error: Optional[BaseException] = None
+            try:
+                run_fn(current)
+            except Exception as e:  # SystemExit/KeyboardInterrupt propagate
+                error = e
+                # an exception skipped the loop's finalize(): stop any orphaned
+                # watchdog NOW — an abort-mode one is in its grace countdown
+                # toward os._exit and would kill the restarted attempt
+                stop_all_watchdogs()
+            preempted = signals.preemption_requested() and error is None
+            if error is None and not preempted:
+                if attempt > 0:
+                    emit("supervisor", status="completed", attempts=attempt)
+                return "completed"
+
+            reason = "crash" if error is not None else "preempt"
+            if reason == "preempt" and not restart_on_preempt:
+                emit("supervisor", status="preempted", attempts=attempt)
+                return "preempted"
+            attempt += 1
+            if attempt > max_restarts:
+                emit(
+                    "giveup",
+                    reason=reason,
+                    attempts=attempt - 1,
+                    max_restarts=max_restarts,
+                    error=repr(error) if error is not None else None,
+                )
+                if error is not None:
+                    raise error
+                return "preempted"
+
+            # nothing in THIS run's dir yet (crash before the first checkpoint)
+            # must not discard a resume checkpoint the user originally launched
+            # with — fall back to it rather than silently starting from scratch
+            resume_from = find_latest_checkpoint(str(run_base)) or (
+                original.checkpoint.get("resume_from") or None
+            )
+            delay = min(backoff * (2.0 ** (attempt - 1)), backoff_cap) if backoff > 0 else 0.0
+            emit(
+                "restart",
+                attempt=attempt,
+                reason=reason,
+                resume_from=resume_from,
+                backoff_seconds=round(delay, 3),
+                error=repr(error)[:500] if error is not None else None,
+            )
+            if delay > 0:
+                time.sleep(delay)
+
+            retry = dotdict(copy.deepcopy(original.as_dict()))
+            _strip_fired_fault(retry)
+            if resume_from is not None:
+                retry.checkpoint.resume_from = resume_from
+                retry = resume_merge(retry)
+            else:
+                # crash before any checkpoint landed: restart from scratch
+                retry.checkpoint.resume_from = None
+            current = retry
+    finally:
+        if sink is not None:
+            sink.close()

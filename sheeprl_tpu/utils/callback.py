@@ -25,15 +25,21 @@ class CheckpointCallback:
         state: Dict[str, Any],
         replay_buffer=None,
     ) -> None:
-        if replay_buffer is not None:
-            true_dones = self._ckpt_rb(replay_buffer)
-            state["rb"] = replay_buffer
-        fabric.save(ckpt_path, state)
-        if replay_buffer is not None:
-            self._experiment_consistent_rb(replay_buffer, true_dones)
-            state.pop("rb", None)
-        if fabric.is_global_zero:
-            self._delete_old_checkpoints(os.path.dirname(ckpt_path), live=ckpt_path)
+        from sheeprl_tpu.resilience.watchdog import watchdogs_paused
+
+        # the write blocks the loop for as long as the state is big (a large
+        # synchronous orbax save can exceed any sane stall timeout) — that is
+        # progress, not a hang, so the progress watchdog must not trip on it
+        with watchdogs_paused():
+            if replay_buffer is not None:
+                true_dones = self._ckpt_rb(replay_buffer)
+                state["rb"] = replay_buffer
+            fabric.save(ckpt_path, state)
+            if replay_buffer is not None:
+                self._experiment_consistent_rb(replay_buffer, true_dones)
+                state.pop("rb", None)
+            if fabric.is_global_zero:
+                self._delete_old_checkpoints(os.path.dirname(ckpt_path), live=ckpt_path)
 
     def on_checkpoint_player(self, fabric, ckpt_path: str, state: Dict[str, Any], replay_buffer=None) -> None:
         # decoupled topology: the player holds the buffer, the trainer sent the weights

@@ -12,10 +12,21 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Dict
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
+
+# Fault-injection hook (resilience/faults.py): called at the exact points where a
+# process kill would leave the crash-window on-disk states the loaders/discovery
+# must recover from — after the pickle tmp write but before its commit rename,
+# and after the sharded sidecar commit but before the orbax directory commit.
+_fault_hook: Optional[Callable[[str, str], None]] = None
+
+
+def _maybe_fault(stage: str, path: str) -> None:
+    if _fault_hook is not None:
+        _fault_hook(stage, path)
 
 
 def _to_host(tree: Any) -> Any:
@@ -33,6 +44,7 @@ def save_checkpoint(path: str, state: Dict[str, Any]) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+    _maybe_fault("pickle_commit", path)
     os.replace(tmp, path)
 
 
@@ -145,6 +157,7 @@ def save_checkpoint_sharded(path: str, state: Dict[str, Any], async_save: bool =
     with open(tmp, "wb") as f:
         pickle.dump(sidecar, f, protocol=pickle.HIGHEST_PROTOCOL)
     os.replace(tmp, path + ".extras.pkl")
+    _maybe_fault("sharded_commit", path)
     checkpointer.save(path, {"leaves": arrays})
     if not async_save:
         _gc_displaced()

@@ -24,6 +24,7 @@ from sheeprl_tpu.envs.wrappers import (
     ActionsAsObservationWrapper,
     FrameStack,
     GrayscaleRenderWrapper,
+    InjectedEnvFault,
     MaskVelocityWrapper,
     RewardAsObservationWrapper,
 )
@@ -218,6 +219,13 @@ def make_env(
 
         if cfg.env.reward_as_observation:
             env = RewardAsObservationWrapper(env)
+
+        # resilience fault injection: the env_step fault raises from inside step()
+        # — wrapped late so RestartOnException (applied by the dreamer loops
+        # around make_env's thunk) sees and restarts through it
+        fault = (cfg.get("resilience") or {}).get("fault") or {}
+        if str(fault.get("kind") or "").lower() == "env_step":
+            env = InjectedEnvFault(env)
 
         env.action_space.seed(seed)
         env.observation_space.seed(seed)

@@ -1,0 +1,331 @@
+"""Marker-scoped CI smokes for end-to-end recovery on the CPU backend: short REAL
+training runs (sac + dreamer_v3, the acceptance pair) with deterministic fault
+injection, asserting the supervisor auto-resumes to the configured
+``algo.total_steps`` with counter/buffer state intact and that the
+preempt → emergency-checkpoint → restart → resume sequence is visible as ordered
+events in the run's ``telemetry.jsonl``.
+
+Scoped with the ``resilience`` marker (run alone via ``pytest -m resilience``);
+not ``slow``, so the tier-1 suite includes it.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.resilience import PREEMPTED_EXIT_CODE, reset_faults, reset_preemption
+from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+pytestmark = pytest.mark.resilience
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    reset_preemption()
+    reset_faults()
+    yield
+    reset_preemption()
+    reset_faults()
+
+
+_SAC_TOTAL = 32
+_SAC = [
+    "exp=sac",
+    "env=dummy",
+    "env.id=continuous_dummy",
+    "dry_run=False",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "metric.log_level=0",
+    "buffer.memmap=False",
+    "buffer.size=512",
+    "buffer.checkpoint=True",
+    "env.num_envs=2",
+    "algo.learning_starts=4",
+    "algo.run_test=False",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.per_rank_batch_size=4",
+    f"algo.total_steps={_SAC_TOTAL}",
+    "checkpoint.every=8",
+    "checkpoint.save_last=True",
+]
+
+_DV3_TOTAL = 16
+_DV3 = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "dry_run=False",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "metric.log_level=0",
+    "buffer.memmap=False",
+    "buffer.size=512",
+    "env.num_envs=2",
+    "algo.learning_starts=4",
+    "algo.run_test=False",
+    f"algo.total_steps={_DV3_TOTAL}",
+    "checkpoint.every=4",
+    "checkpoint.save_last=True",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=1",
+    "algo.replay_ratio=1",
+    "algo.horizon=8",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.cnn_keys.decoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.mlp_keys.decoder=[state]",
+]
+
+_SUPERVISED = [
+    "resilience.supervisor.enabled=true",
+    "resilience.supervisor.backoff=0.05",
+]
+
+
+def _events(root_dir: str, run_name: str):
+    path = f"logs/runs/{root_dir}/{run_name}/telemetry.jsonl"
+    assert os.path.isfile(path), f"unified telemetry.jsonl missing at {path}"
+    return [json.loads(line) for line in open(path)]
+
+
+def _assert_ordered(events, sequence):
+    """Each (event, predicate) of ``sequence`` must match in order."""
+    idx = 0
+    for want, pred in sequence:
+        while idx < len(events) and not (
+            events[idx]["event"] == want and (pred is None or pred(events[idx]))
+        ):
+            idx += 1
+        assert idx < len(events), f"event {want!r} missing (or out of order) in {events}"
+        idx += 1
+
+
+def _final_state(root_dir: str, run_name: str):
+    ckpts = sorted(
+        glob.glob(f"logs/runs/{root_dir}/{run_name}/version_*/checkpoint/*.ckpt"),
+        key=os.path.getmtime,
+    )
+    assert ckpts, "no checkpoint written"
+    return load_checkpoint(ckpts[-1])
+
+
+@pytest.mark.timeout(240)
+def test_sac_sigterm_preempt_auto_resume():
+    """SIGTERM mid-run: emergency checkpoint → supervisor restart → resume →
+    completes to total_steps with counters and the replay buffer carried over."""
+    run(
+        _SAC
+        + _SUPERVISED
+        + [
+            "resilience.fault.kind=sigterm",
+            "resilience.fault.at_policy_step=14",
+            "root_dir=tres",
+            "run_name=sac-sigterm",
+        ]
+    )
+    events = _events("tres", "sac-sigterm")
+    _assert_ordered(
+        events,
+        [
+            ("fault", lambda e: e["kind"] == "sigterm"),
+            ("preempt", None),
+            ("checkpoint", lambda e: e["reason"] == "preempt"),
+            ("preempt_exit", None),
+            ("restart", lambda e: e["reason"] == "preempt" and e["resume_from"]),
+            ("resume", None),
+            ("checkpoint", lambda e: e["reason"] == "periodic" and e["step"] == _SAC_TOTAL),
+            ("supervisor", lambda e: e["status"] == "completed"),
+        ],
+    )
+    state = _final_state("tres", "sac-sigterm")
+    # iter_num is stored ×world_size (=1); ×num_envs (=2) gives policy steps
+    assert state["iter_num"] * 2 == _SAC_TOTAL
+    # the buffer rode the emergency checkpoint: one row per iteration from BOTH
+    # halves of the run, not just the post-restart stretch
+    assert state["rb"]._pos == _SAC_TOTAL // 2
+
+
+@pytest.mark.timeout(240)
+def test_sac_hard_crash_auto_resume():
+    """An uncaught mid-training crash: the supervisor resumes from the latest
+    periodic checkpoint and the run still completes to total_steps."""
+    run(
+        _SAC
+        + _SUPERVISED
+        + [
+            "resilience.fault.kind=crash",
+            "resilience.fault.at_policy_step=14",
+            "root_dir=tres",
+            "run_name=sac-crash",
+        ]
+    )
+    events = _events("tres", "sac-crash")
+    _assert_ordered(
+        events,
+        [
+            ("fault", lambda e: e["kind"] == "crash"),
+            ("restart", lambda e: e["reason"] == "crash" and e["resume_from"]),
+            ("resume", None),
+            ("checkpoint", lambda e: e["step"] == _SAC_TOTAL),
+            ("supervisor", lambda e: e["status"] == "completed"),
+        ],
+    )
+    assert not any(e["event"] == "preempt" for e in events)
+    assert _final_state("tres", "sac-crash")["iter_num"] * 2 == _SAC_TOTAL
+
+
+@pytest.mark.timeout(240)
+def test_sac_kill_during_checkpoint_write_auto_resume():
+    """The injected kill lands between the pickle tmp write and its commit
+    rename: discovery must skip the torn .tmp and resume from the previous
+    valid checkpoint."""
+    run(
+        _SAC
+        + _SUPERVISED
+        + [
+            "resilience.fault.kind=ckpt_kill",
+            "resilience.fault.at_policy_step=14",
+            "root_dir=tres",
+            "run_name=sac-ckptkill",
+        ]
+    )
+    events = _events("tres", "sac-ckptkill")
+    _assert_ordered(
+        events,
+        [
+            ("fault", lambda e: e["kind"] == "ckpt_kill"),
+            ("restart", lambda e: e["reason"] == "crash" and e["resume_from"].endswith("ckpt_8_0.ckpt")),
+            ("supervisor", lambda e: e["status"] == "completed"),
+        ],
+    )
+    assert _final_state("tres", "sac-ckptkill")["iter_num"] * 2 == _SAC_TOTAL
+
+
+@pytest.mark.timeout(240)
+def test_sac_preempt_without_supervisor_exits_preempted_and_latest_resumes():
+    """Without the supervisor, a preemption still writes the emergency
+    checkpoint and exits with the distinct preempted code; a follow-up launch
+    with checkpoint.resume_from=latest completes the run."""
+    args = _SAC + [
+        "resilience.fault.kind=sigterm",
+        "resilience.fault.at_policy_step=14",
+        "root_dir=tres",
+        "run_name=sac-preonly",
+    ]
+    with pytest.raises(SystemExit) as exc:
+        run(args)
+    assert exc.value.code == PREEMPTED_EXIT_CODE
+    # the emergency checkpoint is on disk even though telemetry was off
+    ckpts = glob.glob("logs/runs/tres/sac-preonly/version_0/checkpoint/*.ckpt")
+    assert ckpts
+    reset_preemption()
+    run(
+        _SAC
+        + [
+            "checkpoint.resume_from=latest",
+            "root_dir=tres",
+            "run_name=sac-preonly",
+        ]
+    )
+    assert _final_state("tres", "sac-preonly")["iter_num"] * 2 == _SAC_TOTAL
+
+
+@pytest.mark.timeout(280)
+def test_dreamer_v3_sigterm_preempt_auto_resume():
+    run(
+        _DV3
+        + _SUPERVISED
+        + [
+            "resilience.fault.kind=sigterm",
+            "resilience.fault.at_policy_step=8",
+            "root_dir=tres",
+            "run_name=dv3-sigterm",
+        ]
+    )
+    events = _events("tres", "dv3-sigterm")
+    _assert_ordered(
+        events,
+        [
+            ("fault", lambda e: e["kind"] == "sigterm"),
+            ("preempt", None),
+            ("checkpoint", lambda e: e["reason"] == "preempt"),
+            ("preempt_exit", None),
+            ("restart", lambda e: e["reason"] == "preempt" and e["resume_from"]),
+            ("resume", None),
+            ("checkpoint", lambda e: e["step"] == _DV3_TOTAL),
+            ("supervisor", lambda e: e["status"] == "completed"),
+        ],
+    )
+    assert _final_state("tres", "dv3-sigterm")["iter_num"] * 2 == _DV3_TOTAL
+
+
+@pytest.mark.timeout(280)
+def test_dreamer_v3_hard_crash_auto_resume():
+    run(
+        _DV3
+        + _SUPERVISED
+        + [
+            "resilience.fault.kind=crash",
+            "resilience.fault.at_policy_step=8",
+            "root_dir=tres",
+            "run_name=dv3-crash",
+        ]
+    )
+    events = _events("tres", "dv3-crash")
+    _assert_ordered(
+        events,
+        [
+            ("fault", lambda e: e["kind"] == "crash"),
+            ("restart", lambda e: e["reason"] == "crash" and e["resume_from"]),
+            ("resume", None),
+            ("checkpoint", lambda e: e["step"] == _DV3_TOTAL),
+            ("supervisor", lambda e: e["status"] == "completed"),
+        ],
+    )
+    assert _final_state("tres", "dv3-crash")["iter_num"] * 2 == _DV3_TOTAL
+
+
+@pytest.mark.timeout(240)
+def test_env_step_fault_restarts_and_is_surfaced_in_telemetry(monkeypatch):
+    """dreamer_v3 wraps every env in RestartOnException: the injected env.step
+    exception is absorbed by a crash-restart and surfaced as a health event
+    (Health/env_restarts), without killing the run."""
+    # skip RestartOnException's 20s post-crash backoff (sync in-process envs)
+    import sheeprl_tpu.envs.wrappers as wrappers_mod
+
+    monkeypatch.setattr(wrappers_mod.time, "sleep", lambda s: None)
+    run(
+        _DV3
+        + [
+            "metric.telemetry.enabled=true",
+            "metric.telemetry.every=4",
+            "resilience.fault.kind=env_step",
+            "resilience.fault.at_policy_step=8",
+            "root_dir=tres",
+            "run_name=dv3-envfault",
+        ]
+    )
+    paths = glob.glob("logs/runs/tres/dv3-envfault/version_0/telemetry.jsonl")
+    assert paths
+    events = [json.loads(line) for line in open(paths[0])]
+    restarts = [e for e in events if e["event"] == "health" and e.get("status") == "env_restart"]
+    assert restarts and restarts[0]["total"] >= 1
+    summary = [e for e in events if e["event"] == "summary"][-1]
+    assert summary["env_restarts"] >= 1
+    assert _final_state("tres", "dv3-envfault")["iter_num"] * 2 == _DV3_TOTAL

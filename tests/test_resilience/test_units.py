@@ -1,0 +1,391 @@
+"""Unit coverage for the resilience subsystem building blocks: signal flag
+semantics, checkpoint discovery/validation, the progress watchdog, fault
+normalization + the kill-during-checkpoint-write hook, and the monitor facade's
+event/sink gating."""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config import dotdict
+from sheeprl_tpu.resilience import (
+    InjectedFaultError,
+    NullResilience,
+    build_resilience,
+    find_latest_checkpoint,
+    install_preemption_handler,
+    is_valid_checkpoint,
+    iter_checkpoints,
+    normalize_fault_cfg,
+    preemption_requested,
+    request_preemption,
+    reset_faults,
+    reset_preemption,
+    uninstall_preemption_handler,
+)
+from sheeprl_tpu.resilience.watchdog import ProgressWatchdog, WatchdogError
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    reset_preemption()
+    reset_faults()
+    yield
+    reset_preemption()
+    reset_faults()
+    uninstall_preemption_handler()
+
+
+# -- signals ------------------------------------------------------------------------
+
+
+def test_preemption_flag_via_real_signal():
+    assert install_preemption_handler()
+    assert not preemption_requested()
+    os.kill(os.getpid(), signal.SIGTERM)
+    # CPython delivers the handler at the next bytecode boundary
+    for _ in range(100):
+        if preemption_requested():
+            break
+        time.sleep(0.01)
+    assert preemption_requested()
+    reset_preemption()
+    assert not preemption_requested()
+
+
+def test_install_is_idempotent_and_resets_stale_flag():
+    assert install_preemption_handler()
+    request_preemption()
+    assert preemption_requested()
+    assert install_preemption_handler()  # reinstall clears the stale flag
+    assert not preemption_requested()
+
+
+def test_uninstall_restores_previous_disposition():
+    prev = signal.getsignal(signal.SIGTERM)
+    install_preemption_handler()
+    assert signal.getsignal(signal.SIGTERM) is not prev
+    uninstall_preemption_handler()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_request_preemption_without_handler_sets_flag():
+    request_preemption()
+    assert preemption_requested()
+
+
+# -- discovery ----------------------------------------------------------------------
+
+
+def _touch(path, content=b"x"):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def test_discovery_pickle_and_torn_tmp(tmp_path):
+    good = str(tmp_path / "checkpoint" / "ckpt_100_0.ckpt")
+    _touch(good, pickle.dumps({"iter_num": 1}))
+    _touch(str(tmp_path / "checkpoint" / "ckpt_200_0.ckpt.tmp"))  # torn write
+    assert is_valid_checkpoint(good)
+    assert iter_checkpoints(str(tmp_path)) == [good]
+    assert find_latest_checkpoint(str(tmp_path)) == good
+
+
+def test_discovery_orbax_requires_sidecar(tmp_path):
+    no_sidecar = tmp_path / "checkpoint" / "ckpt_100_0.ckpt"
+    no_sidecar.mkdir(parents=True)
+    paired = tmp_path / "checkpoint" / "ckpt_50_0.ckpt"
+    paired.mkdir()
+    _touch(str(paired) + ".extras.pkl")
+    assert not is_valid_checkpoint(str(no_sidecar))
+    assert is_valid_checkpoint(str(paired))
+    # the valid-but-older pair wins over the newer torn directory
+    assert find_latest_checkpoint(str(tmp_path)) == str(paired)
+
+
+def test_discovery_old_directory_crash_window(tmp_path):
+    """Crash after displacement: only <path>.old survives; discovery reports the
+    BASE path (what load_checkpoint's fallback expects)."""
+    base = str(tmp_path / "checkpoint" / "ckpt_100_0.ckpt")
+    old = base + ".old"
+    os.makedirs(old)
+    _touch(old + ".extras.pkl")
+    assert is_valid_checkpoint(base)
+    assert find_latest_checkpoint(str(tmp_path)) == base
+
+
+def test_discovery_displaced_sidecar_pairing(tmp_path):
+    """Crash mid-displacement: sidecar renamed to .old.extras.pkl, directory
+    rename never happened — the live directory still pairs with the old sidecar."""
+    base = tmp_path / "checkpoint" / "ckpt_100_0.ckpt"
+    base.mkdir(parents=True)
+    _touch(str(base) + ".old.extras.pkl")
+    assert is_valid_checkpoint(str(base))
+
+
+def test_discovery_orders_by_mtime_then_step(tmp_path):
+    older = str(tmp_path / "checkpoint" / "ckpt_300_0.ckpt")
+    newer = str(tmp_path / "checkpoint" / "ckpt_100_0.ckpt")
+    _touch(older)
+    _touch(newer)
+    past = time.time() - 100
+    os.utime(older, (past, past))
+    # a later restart resumes from lower step counts: mtime must win
+    assert find_latest_checkpoint(str(tmp_path)) == newer
+
+
+def test_discovery_empty(tmp_path):
+    assert find_latest_checkpoint(str(tmp_path)) is None
+    assert find_latest_checkpoint(str(tmp_path / "missing")) is None
+
+
+# -- faults -------------------------------------------------------------------------
+
+
+def test_normalize_fault_cfg():
+    assert normalize_fault_cfg({}) is None
+    assert normalize_fault_cfg({"fault": {"kind": None}}) is None
+    assert normalize_fault_cfg({"fault": {"kind": "none"}}) is None
+    spec = normalize_fault_cfg({"fault": {"kind": "crash", "at_policy_step": 7}})
+    assert spec == {"kind": "crash", "at": 7}
+    with pytest.raises(ValueError, match="unknown resilience.fault.kind"):
+        normalize_fault_cfg({"fault": {"kind": "explode"}})
+
+
+def test_fault_fires_once_per_process():
+    from sheeprl_tpu.resilience.faults import build_fault_plan
+
+    events = []
+    plan = build_fault_plan({"fault": {"kind": "crash", "at_policy_step": 10}})
+    plan.maybe_fire(5, lambda *a, **k: events.append(a))  # below threshold
+    with pytest.raises(InjectedFaultError):
+        plan.maybe_fire(10, lambda *a, **k: events.append(a))
+    # replaying earlier/equal steps after a (supervised, in-process) restart
+    # must not re-fire
+    plan.maybe_fire(10, lambda *a, **k: events.append(a))
+    plan.maybe_fire(50, lambda *a, **k: events.append(a))
+    assert len(events) == 1
+
+
+def test_ckpt_kill_leaves_pickle_crash_window(tmp_path):
+    """The injected kill lands between the tmp write and the commit rename: the
+    previous checkpoint file survives, the torn .tmp is not a valid candidate."""
+    from sheeprl_tpu.resilience.faults import build_fault_plan
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    path = str(tmp_path / "checkpoint" / "ckpt_10_0.ckpt")
+    save_checkpoint(path, {"iter_num": 1})
+    plan = build_fault_plan({"fault": {"kind": "ckpt_kill", "at_policy_step": 0}})
+    plan.maybe_fire(0, lambda *a, **k: None)  # arms the checkpoint write hook
+    with pytest.raises(InjectedFaultError):
+        save_checkpoint(path, {"iter_num": 2})
+    assert os.path.exists(path + ".tmp")
+    assert load_checkpoint(path)["iter_num"] == 1  # old state intact
+    assert find_latest_checkpoint(str(tmp_path)) == path
+    # the hook is one-shot: the next save commits normally
+    save_checkpoint(path, {"iter_num": 3})
+    assert load_checkpoint(path)["iter_num"] == 3
+
+
+def test_env_step_fault_raises_through_wrapper():
+    import gymnasium as gym
+
+    from sheeprl_tpu.envs.wrappers import InjectedEnvFault
+    from sheeprl_tpu.resilience.faults import build_fault_plan
+
+    env = InjectedEnvFault(gym.make("CartPole-v1"))
+    env.reset(seed=0)
+    env.step(env.action_space.sample())  # unarmed: passes through
+    plan = build_fault_plan({"fault": {"kind": "env_step", "at_policy_step": 0}})
+    plan.maybe_fire(0, lambda *a, **k: None)
+    with pytest.raises(InjectedFaultError):
+        env.step(env.action_space.sample())
+    env.step(env.action_space.sample())  # one-shot: armed flag consumed
+    env.close()
+
+
+# -- watchdog -----------------------------------------------------------------------
+
+
+def test_watchdog_quiet_while_fed():
+    events = []
+    dog = ProgressWatchdog(0.5, lambda e, **f: events.append((e, f))).start()
+    for _ in range(8):
+        dog.feed(1)
+        time.sleep(0.1)
+    dog.stop()
+    assert events == []
+
+
+def test_watchdog_emits_stall_with_stacks_once_per_episode():
+    events = []
+    dog = ProgressWatchdog(0.2, lambda e, **f: events.append((e, f))).start()
+    time.sleep(1.0)  # stall >> timeout: exactly one event until the next feed
+    dog.stop()
+    assert len(events) == 1
+    event, fields = events[0]
+    assert event == "health" and fields["status"] == "stalled"
+    assert any("MainThread" in name for name in fields["stacks"])
+    assert fields["stall_seconds"] >= 0.2
+
+
+def test_watchdog_abort_raises_in_main_thread():
+    events = []
+    dog = ProgressWatchdog(
+        0.3, lambda e, **f: events.append(e), abort=True, grace=30.0
+    ).start()
+    with pytest.raises(WatchdogError):
+        deadline = time.time() + 10
+        while time.time() < deadline:  # cooperative Python-level stall
+            time.sleep(0.01)
+        pytest.fail("watchdog abort never arrived")
+    dog.stop()
+    assert events == ["health"]
+
+
+def test_watchdog_pause_suspends_stall_detection():
+    from sheeprl_tpu.resilience.watchdog import watchdogs_paused
+
+    events = []
+    dog = ProgressWatchdog(0.2, lambda e, **f: events.append(e)).start()
+    with watchdogs_paused():
+        time.sleep(0.8)  # well past the timeout: a checkpoint write, not a hang
+    assert events == []
+    time.sleep(0.8)  # unpaused silence of the same length IS a stall
+    dog.stop()
+    assert events == ["health"]
+
+
+def test_stale_watchdogs_stopped_by_registry():
+    """An exception unwinding past finalize() leaves the watchdog alive; the
+    crash handlers (supervisor / cli / next monitor build) must stop it before
+    its abort grace countdown can os._exit a healthy restarted run."""
+    from sheeprl_tpu.resilience.watchdog import _active, stop_all_watchdogs
+
+    dog = ProgressWatchdog(60.0, lambda e, **f: None).start()
+    assert dog in _active
+    stop_all_watchdogs()
+    assert dog not in _active and dog._thread is None
+    # and a fresh monitor build performs the same cleanup
+    stale = ProgressWatchdog(60.0, lambda e, **f: None).start()
+    build_resilience(_FabricStub(), _cfg(), None)
+    assert stale._thread is None
+
+
+def test_watchdog_abort_escalates_to_exit_when_main_never_unwinds():
+    exited = []
+    dog = ProgressWatchdog(
+        0.2,
+        lambda e, **f: None,
+        abort=True,
+        grace=0.3,
+        _exit=lambda code: exited.append(code),
+    )
+    # drive the monitor body directly on this thread (the async-raise targets the
+    # main thread, which in this test IS us — swallow it and keep "hanging")
+    dog._thread = None
+    try:
+        dog.start()
+        deadline = time.time() + 10
+        while not exited and time.time() < deadline:
+            try:
+                time.sleep(0.02)
+            except WatchdogError:
+                continue  # simulate a main thread that never unwinds
+    finally:
+        dog.stop()
+    from sheeprl_tpu.resilience.signals import WATCHDOG_EXIT_CODE
+
+    assert exited and exited[0] == WATCHDOG_EXIT_CODE
+
+
+# -- monitor facade -----------------------------------------------------------------
+
+
+class _FabricStub:
+    is_global_zero = True
+
+    def print(self, *a, **k):
+        pass
+
+
+def _cfg(**resilience):
+    return dotdict(
+        {
+            "checkpoint": {"resume_from": None},
+            "metric": {"telemetry": {"enabled": False, "jsonl_path": None}},
+            "resilience": {
+                "handler": True,
+                "supervisor": {"enabled": False},
+                "fault": {"kind": None, "at_policy_step": 0},
+                "watchdog": {"enabled": False},
+                **resilience,
+            },
+        }
+    )
+
+
+def test_build_resilience_null_when_everything_off():
+    cfg = _cfg(handler=False)
+    assert isinstance(build_resilience(_FabricStub(), cfg, None), NullResilience)
+
+
+def test_build_resilience_off_rank_zero_keeps_preempt_poll_live():
+    """Non-rank-0 SPMD processes must poll the real flag: a hard-coded False
+    would desync the per-rank checkpoint conditions (and fabric.save's
+    cross-process barrier) on a pod-wide SIGTERM."""
+    from sheeprl_tpu.resilience.monitor import PollResilience
+
+    class NonZero(_FabricStub):
+        is_global_zero = False
+
+    monitor = build_resilience(NonZero(), _cfg(), None)
+    assert isinstance(monitor, PollResilience)
+    assert not monitor.preempt_requested()
+    request_preemption()
+    assert monitor.preempt_requested()
+    assert monitor.finalize(1) is True
+    # with the handler disabled there is nothing to poll: plain Null
+    assert type(build_resilience(NonZero(), _cfg(handler=False), None)) is NullResilience
+
+
+def test_monitor_critical_event_opens_lazy_sink(tmp_path):
+    monitor = build_resilience(_FabricStub(), _cfg(), str(tmp_path))
+    monitor.step(4)
+    assert not os.path.exists(tmp_path / "telemetry.jsonl")  # quiet run: no artifact
+    request_preemption()
+    monitor.step(8)
+    assert monitor.preempt_requested()
+    monitor.observe_checkpoint(str(tmp_path / "ckpt_8_0.ckpt"), 8)
+    assert monitor.finalize(8) is True
+    events = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    kinds = [e["event"] for e in events]
+    assert kinds == ["preempt", "checkpoint", "preempt_exit"]
+    assert events[1]["reason"] == "preempt"
+
+
+def test_monitor_periodic_checkpoints_silent_without_supervisor(tmp_path):
+    monitor = build_resilience(_FabricStub(), _cfg(), str(tmp_path))
+    monitor.step(4)
+    monitor.observe_checkpoint(str(tmp_path / "ckpt_4_0.ckpt"), 4)
+    assert monitor.finalize(4) is False
+    assert not os.path.exists(tmp_path / "telemetry.jsonl")
+
+
+def test_monitor_eager_events_with_supervisor(tmp_path):
+    cfg = _cfg(supervisor={"enabled": True})
+    cfg.checkpoint.resume_from = str(tmp_path / "ckpt_1_0.ckpt")
+    monitor = build_resilience(_FabricStub(), cfg, str(tmp_path))
+    monitor.observe_checkpoint(str(tmp_path / "ckpt_4_0.ckpt"), 4)
+    monitor.finalize(4)
+    events = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    assert [e["event"] for e in events] == ["resume", "checkpoint"]
+    assert events[1]["reason"] == "periodic"

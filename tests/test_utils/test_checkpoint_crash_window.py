@@ -1,0 +1,131 @@
+"""Crash-window fallbacks of ``load_checkpoint`` (utils/checkpoint.py) — the
+states an interrupted in-place overwrite can leave on disk, none exercised by
+tests before the resilience PR:
+
+- ``.old`` directory fallback: the live checkpoint was displaced to ``<path>.old``
+  and the crash hit before the new orbax directory committed;
+- displaced-sidecar pairing: the sidecar was renamed to ``<path>.old.extras.pkl``
+  but the directory rename never happened, so the directory still at ``<path>``
+  pairs with the ``.old`` sidecar;
+- orphan-sidecar GC: a sidecar whose orbax directory never committed is swept by
+  the checkpoint callback's keep_last pass (while live pairs survive).
+
+Plus the injected mid-write faults (resilience ``ckpt_kill``) proving each
+window is reproducible through the real writers.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sheeprl_tpu.utils.checkpoint as ckpt_mod
+from sheeprl_tpu.utils.callback import CheckpointCallback
+from sheeprl_tpu.utils.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+    save_checkpoint_sharded,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_hook():
+    yield
+    ckpt_mod._fault_hook = None
+
+
+def test_old_directory_fallback(tmp_path):
+    """Path missing, <path>.old present: load falls back to the displaced copy."""
+    path = str(tmp_path / "ckpt_10_0.ckpt")
+    save_checkpoint_sharded(path, {"w": jnp.zeros(3), "step": 1})
+    # simulate the displacement half of an overwrite whose new write never ran
+    os.replace(path, path + ".old")
+    os.replace(path + ".extras.pkl", path + ".old.extras.pkl")
+    restored = load_checkpoint(path)
+    np.testing.assert_array_equal(restored["w"], np.zeros(3))
+    assert restored["step"] == 1
+
+
+def test_displaced_sidecar_pairing(tmp_path):
+    """Directory still live, sidecar already displaced: the dir at <path> must
+    pair with <path>.old.extras.pkl."""
+    path = str(tmp_path / "ckpt_10_0.ckpt")
+    save_checkpoint_sharded(path, {"w": jnp.ones(3), "step": 2})
+    os.replace(path + ".extras.pkl", path + ".old.extras.pkl")
+    restored = load_checkpoint(path)
+    np.testing.assert_array_equal(restored["w"], np.ones(3))
+    assert restored["step"] == 2
+
+
+def test_sharded_commit_crash_window_via_injected_fault(tmp_path):
+    """A crash injected at the sharded writer's commit point (sidecar landed,
+    orbax directory not) leaves exactly the displaced-.old window, and load
+    still returns the PREVIOUS state."""
+    path = str(tmp_path / "ckpt_10_0.ckpt")
+    save_checkpoint_sharded(path, {"w": jnp.zeros(2), "step": 1})
+
+    class Boom(RuntimeError):
+        pass
+
+    def hook(stage, p):
+        ckpt_mod._fault_hook = None
+        raise Boom(stage)
+
+    ckpt_mod._fault_hook = hook
+    with pytest.raises(Boom, match="sharded_commit"):
+        save_checkpoint_sharded(path, {"w": jnp.ones(2), "step": 2})
+    # crash after displacement + new sidecar, before the orbax commit: only the
+    # .old directory survives, paired with its .old sidecar
+    assert not os.path.isdir(path) and os.path.isdir(path + ".old")
+    restored = load_checkpoint(path)
+    np.testing.assert_array_equal(restored["w"], np.zeros(2))
+    assert restored["step"] == 1
+
+
+def test_pickle_commit_crash_window_via_injected_fault(tmp_path):
+    path = str(tmp_path / "ckpt_10_0.ckpt")
+    save_checkpoint(path, {"step": 1})
+
+    def hook(stage, p):
+        ckpt_mod._fault_hook = None
+        raise RuntimeError(stage)
+
+    ckpt_mod._fault_hook = hook
+    with pytest.raises(RuntimeError, match="pickle_commit"):
+        save_checkpoint(path, {"step": 2})
+    assert os.path.exists(path + ".tmp")
+    assert load_checkpoint(path)["step"] == 1  # atomic: old file untouched
+
+
+def test_orphan_sidecar_gc_spares_live_pairs(tmp_path):
+    """The keep_last sweep collects sidecars whose directory never committed but
+    must not touch a complete directory+sidecar pair (or recent checkpoints)."""
+    live = str(tmp_path / "ckpt_20_0.ckpt")
+    save_checkpoint_sharded(live, {"w": jnp.zeros(2)})
+    orphan = str(tmp_path / "ckpt_10_0.ckpt.extras.pkl")
+    with open(orphan, "wb") as f:
+        f.write(b"orphan")
+    CheckpointCallback(keep_last=5)._delete_old_checkpoints(str(tmp_path), live=live)
+    assert not os.path.exists(orphan), "orphan sidecar must be collected"
+    assert os.path.isdir(live) and os.path.isfile(live + ".extras.pkl")
+    assert load_checkpoint(live)
+
+
+def test_keep_last_sweeps_sharded_directories(tmp_path):
+    """keep_last removes stale orbax DIRECTORIES (with their sidecars), not just
+    pickle files."""
+    paths = []
+    for step in (10, 20, 30):
+        p = str(tmp_path / f"ckpt_{step}_0.ckpt")
+        save_checkpoint_sharded(p, {"step": step})
+        os.utime(p, (1_000_000 + step, 1_000_000 + step))
+        paths.append(p)
+    CheckpointCallback(keep_last=2)._delete_old_checkpoints(str(tmp_path), live=paths[-1])
+    assert not os.path.exists(paths[0]) and not os.path.exists(paths[0] + ".extras.pkl")
+    for keep in paths[1:]:
+        assert os.path.isdir(keep) and os.path.isfile(keep + ".extras.pkl")
+    shutil.rmtree(tmp_path, ignore_errors=True)
