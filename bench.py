@@ -230,13 +230,26 @@ def _steady_window_run(args: list, steady_start: int) -> dict:
         with open(steady_file) as f:
             steady = json.load(f)
         try:
+            from sheeprl_tpu.obs.diagnose import diagnose_events
             from sheeprl_tpu.obs.jsonl import read_events
 
-            summaries = [e for e in read_events(telemetry_file) if e.get("event") == "summary"]
+            events = read_events(telemetry_file)
+            summaries = [e for e in events if e.get("event") == "summary"]
             if summaries:
                 steady["telemetry"] = {
                     k: v for k, v in summaries[-1].items() if k not in ("event", "time")
                 }
+            # run the diagnosis detectors over the run's stream so BENCH JSONs
+            # are regression-gateable on CAUSES (recompile storm, starved
+            # pipeline, checkpoint-heavy windows), not just on env-steps/sec
+            diag = diagnose_events(events)
+            steady["diagnosis"] = {
+                "findings": [
+                    {k: f[k] for k in ("detector", "severity", "summary")}
+                    for f in diag["findings"]
+                ],
+                "attribution": (diag["attribution"] or {}).get("named_fraction"),
+            }
         except Exception:
             pass
         return steady
@@ -286,6 +299,10 @@ def _steady_ab_result(
         # the prefetch-ON run's final telemetry summary: whole-run sps, compile
         # count/seconds, prefetch wait totals, peak memory — measured in-loop
         conditions["telemetry"] = steady["telemetry"]
+    if "diagnosis" in steady:
+        # the diagnose verdicts for the same run: detector findings + the share
+        # of steady wall time attributed to named phases (obs/diagnose.py)
+        conditions["diagnosis"] = steady["diagnosis"]
     return {
         "metric": metric,
         "value": round(sps, 2),

@@ -1,7 +1,16 @@
 """Root launcher for no-install source checkouts (role of reference sheeprl.py):
-``python sheeprl.py exp=ppo env=gym env.id=CartPole-v1``."""
+``python sheeprl.py exp=ppo env=gym env.id=CartPole-v1``.
 
-from sheeprl_tpu.cli import run
+Also hosts the offline telemetry tooling:
+``python sheeprl.py diagnose <run_dir>`` merges a run's telemetry.jsonl
+stream(s) and prints a rule-based bottleneck report (howto/observability.md).
+"""
+
+import sys
+
+from sheeprl_tpu.cli import diagnose, run
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "diagnose":
+        raise SystemExit(diagnose(sys.argv[2:]))
     run()

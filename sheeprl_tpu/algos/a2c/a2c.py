@@ -279,12 +279,13 @@ def main(fabric, cfg: Dict[str, Any]):
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
-            metrics_dict = aggregator.compute() if aggregator else {}
-            if logger is not None:
-                logger.log_metrics(metrics_dict, policy_step)
-            timer.to_dict(reset=True)
-            if aggregator:
-                aggregator.reset()
+            with timer("Time/logging_time"):
+                metrics_dict = aggregator.compute() if aggregator else {}
+                if logger is not None:
+                    logger.log_metrics(metrics_dict, policy_step)
+                timer.to_dict(reset=True)
+                if aggregator:
+                    aggregator.reset()
             last_log = policy_step
 
         # a preemption forces an out-of-cadence emergency checkpoint through the
@@ -306,20 +307,25 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_checkpoint": last_checkpoint,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
-            fabric.call(
-                "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
-            )
+            with timer("Time/checkpoint_time"):
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                )
             resilience.observe_checkpoint(ckpt_path, policy_step, preempted=preempted)
         if preempted:
             break
 
-    telemetry.close(policy_step)
     envs.close()
     # an in-flight async (orbax) checkpoint write must land before teardown
     wait_for_checkpoint()
     if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
-        test(agent.apply, params, fabric, cfg, log_dir)
+        with timer("Time/test_time"):
+            test(agent.apply, params, fabric, cfg, log_dir)
+    # closed AFTER the final test so the summary phases include eval time; an
+    # exception path that skips this is flushed by cli.run_algorithm with
+    # clean_exit=False
+    telemetry.close(policy_step)
     if logger is not None:
         logger.finalize()

@@ -475,28 +475,29 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
-            metrics_dict = aggregator.compute() if aggregator else {}
-            if logger is not None:
-                logger.log_metrics(metrics_dict, policy_step)
-                timers = timer.to_dict(reset=False)
-                if timers.get("Time/train_time", 0) > 0:
-                    logger.log_metrics(
-                        {"Time/sps_train": (train_step - last_train) / max(timers["Time/train_time"], 1e-9)},
-                        policy_step,
-                    )
-                if timers.get("Time/env_interaction_time", 0) > 0:
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (
-                                (policy_step - last_log) / world_size * cfg.env.action_repeat
-                            )
-                            / max(timers["Time/env_interaction_time"], 1e-9)
-                        },
-                        policy_step,
-                    )
-            timer.to_dict(reset=True)
-            if aggregator:
-                aggregator.reset()
+            with timer("Time/logging_time"):
+                metrics_dict = aggregator.compute() if aggregator else {}
+                if logger is not None:
+                    logger.log_metrics(metrics_dict, policy_step)
+                    timers = timer.to_dict(reset=False)
+                    if timers.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / max(timers["Time/train_time"], 1e-9)},
+                            policy_step,
+                        )
+                    if timers.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / max(timers["Time/env_interaction_time"], 1e-9)
+                            },
+                            policy_step,
+                        )
+                timer.to_dict(reset=True)
+                if aggregator:
+                    aggregator.reset()
             last_log = policy_step
             last_train = train_step
 
@@ -522,7 +523,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             # quiesce the prefetch worker so the pickled buffer (incl. its RNG
             # state) is not a torn mid-sample snapshot
-            with sampler.lock:
+            with sampler.lock, timer("Time/checkpoint_time"):
                 fabric.call(
                     "on_checkpoint_coupled",
                     ckpt_path=ckpt_path,
@@ -534,13 +535,17 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             break
 
     bench.finish(policy_step, params)
-    telemetry.close(policy_step)
 
     sampler.close()
     envs.close()
     # an in-flight async (orbax) checkpoint write must land before teardown
     wait_for_checkpoint()
     if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
-        test(player, act_params, fabric, cfg, log_dir, greedy=False)
+        with timer("Time/test_time"):
+            test(player, act_params, fabric, cfg, log_dir, greedy=False)
+    # closed AFTER the final test so the summary phases include eval time; an
+    # exception path that skips this is flushed by cli.run_algorithm with
+    # clean_exit=False
+    telemetry.close(policy_step)
     if logger is not None:
         logger.finalize()

@@ -85,39 +85,58 @@ def _warmup_train_step(fabric, cfg, train_phase, params, opt_state, observation_
     jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
 
 
-def _trainer_loop(fabric, cfg, train_phase, params, opt_state, moments_state, data_q, params_q, error):
+def _trainer_loop(fabric, cfg, train_phase, params, opt_state, moments_state, data_q, params_q, error, telemetry=None):
     """Learner role: consume replay blocks, run the fused per-gradient-step program
     over them, publish the act view (full state on request). The shutdown sentinel
     is answered with the FINAL full state so the player can flush a deferred last
-    checkpoint."""
+    checkpoint.
+
+    ``telemetry``: the learner role's own stream (two-process topology only —
+    the threaded trainer shares the player's process, whose telemetry already
+    observes it; a second writer would also race the shared timer registry).
+    Its step axis is cumulative gradient steps (the only counter the learner
+    sees), not policy steps."""
+    from contextlib import nullcontext
+
+    from sheeprl_tpu.obs import NullTelemetry
+    from sheeprl_tpu.utils.timer import timer
+
+    telemetry = telemetry if telemetry is not None else NullTelemetry()
+    train_span = timer("Time/train_time") if telemetry.enabled else nullcontext()
     try:
         mesh_size = fabric.world_size
         if mesh_size > 1:
             params = fabric.replicate_pytree(params)
             opt_state = fabric.replicate_pytree(opt_state)
             moments_state = fabric.replicate_pytree(moments_state)
+        last_step = 0
         while True:
             msg = data_q.get()
             if msg is None:
+                telemetry.close(last_step)
                 params_q.put(_full_state_host(params, opt_state, moments_state))
                 return
             data, cum_steps, train_key, want_full, want_metrics = msg
-            if mesh_size > 1:
-                # every learner process holds the full broadcast block; this forms
-                # the global array sharded over the slice mesh (batch axis). The
-                # host G-loop inside train_phase slices global arrays eagerly,
-                # which all slice members execute in lockstep.
-                data = jax.device_put(data, fabric.sharding(None, None, "data"))
-            params, opt_state, moments_state, metrics = train_phase(
-                params, opt_state, moments_state, data, jnp.asarray(cum_steps), np.asarray(train_key)
-            )
-            params_q.put(
-                (
+            units = int(data["rewards"].shape[0])
+            with train_span:
+                if mesh_size > 1:
+                    # every learner process holds the full broadcast block; this forms
+                    # the global array sharded over the slice mesh (batch axis). The
+                    # host G-loop inside train_phase slices global arrays eagerly,
+                    # which all slice members execute in lockstep.
+                    data = jax.device_put(data, fabric.sharding(None, None, "data"))
+                params, opt_state, moments_state, metrics = train_phase(
+                    params, opt_state, moments_state, data, jnp.asarray(cum_steps), np.asarray(train_key)
+                )
+                reply = (
                     replicated_to_host(_act_select(params)),
                     _full_state_host(params, opt_state, moments_state) if want_full else None,
                     replicated_to_host(metrics) if want_metrics else None,
                 )
-            )
+            params_q.put(reply)
+            last_step = int(cum_steps) + units
+            telemetry.observe_train(units, reply[2])
+            telemetry.step(last_step)
     except BaseException as e:  # surface learner crashes to the player
         error["exc"] = e
         # a crash inside a channel collective leaves the plane desynced: further
@@ -271,8 +290,21 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
         geometry["player_world_size"],
     )
     coordination_barrier("dv3_decoupled_warmup")
+    # the learner slice's own telemetry stream (telemetry.learner.jsonl next to
+    # the player's — obs/streams.py merges them); one writer per slice
+    from sheeprl_tpu.obs import build_role_telemetry
+    from sheeprl_tpu.parallel import distributed
+
+    telemetry = build_role_telemetry(
+        fabric, cfg, "learner",
+        rank=distributed.process_index(),
+        leader=distributed.process_index() == 1,
+    )
     error: Dict[str, Any] = {}
-    _trainer_loop(fabric, cfg, train_phase, params, opt_state, moments_state, data_q, params_q, error)
+    _trainer_loop(
+        fabric, cfg, train_phase, params, opt_state, moments_state, data_q, params_q, error,
+        telemetry=telemetry,
+    )
     if "exc" in error:
         # pair the player's final sentinel — unless the crash WAS the channel,
         # whose collectives are desynced and would hang instead of pairing

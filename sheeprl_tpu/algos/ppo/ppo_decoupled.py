@@ -43,7 +43,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, space_actions_info, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.obs import build_telemetry
+from sheeprl_tpu.obs import NullTelemetry, build_role_telemetry, build_telemetry
 from sheeprl_tpu.resilience import build_resilience
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -64,6 +64,7 @@ def _trainer_loop(
     error: Dict[str, Any],
     geometry: Optional[Dict[str, int]] = None,
     resume_state: Optional[Dict[str, Any]] = None,
+    telemetry=None,
 ):
     """Learner role (reference trainer(), ppo_decoupled.py:368-620): consume rollout
     blocks, run the fused epochs×minibatches program on the mesh, publish params.
@@ -72,7 +73,15 @@ def _trainer_loop(
     topology, where the roles may own different device counts); None derives them
     locally (threaded topology: both roles share one fabric). ``resume_state``
     restores params/optimizer/batch-size from a checkpoint (reference trainer
-    resume, ppo_decoupled.py:406-437)."""
+    resume, ppo_decoupled.py:406-437).
+
+    ``telemetry``: the learner role's own stream (two-process topology only —
+    the threaded trainer shares the player's process, whose telemetry already
+    observes it; a second writer would also race the shared timer registry)."""
+    from contextlib import nullcontext
+
+    telemetry = telemetry if telemetry is not None else NullTelemetry()
+    train_span = timer("Time/train_time") if telemetry.enabled else nullcontext()
     try:
         world_size = fabric.world_size
         if geometry is not None:
@@ -167,31 +176,36 @@ def _trainer_loop(
             opt_state = fabric.replicate_pytree(opt_state)
 
         key = jax.random.PRNGKey(cfg.seed + 1)
+        rounds = 0
         while True:
             msg = data_q.get()
             if msg is None:  # sentinel (reference :344: scatter of -1)
+                telemetry.close(rounds * policy_steps_per_iter)
                 params_q.put(None)
                 return
             flat, clip_coef, ent_coef, want_opt_state = msg
-            if mesh_size > 1:
-                # every learner process holds the full broadcast block, so this
-                # device_put forms the GLOBAL sharded array across the slice mesh
-                flat = jax.device_put(flat, fabric.data_sharding)
-            key, train_key = jax.random.split(key)
-            params, opt_state, mean_losses = train_phase(
-                params, opt_state, flat, np.asarray(train_key), clip_coef, ent_coef
-            )
-            # weight plane: the player needs the full agent each round (it predicts
-            # values during the rollout); opt_state only crosses when a checkpoint
-            # is due. replicated_to_host handles the multi-process slice mesh, where
-            # np.asarray refuses non-addressable (but replicated) outputs.
-            params_q.put(
-                (
+            with train_span:
+                if mesh_size > 1:
+                    # every learner process holds the full broadcast block, so this
+                    # device_put forms the GLOBAL sharded array across the slice mesh
+                    flat = jax.device_put(flat, fabric.data_sharding)
+                key, train_key = jax.random.split(key)
+                params, opt_state, mean_losses = train_phase(
+                    params, opt_state, flat, np.asarray(train_key), clip_coef, ent_coef
+                )
+                # weight plane: the player needs the full agent each round (it predicts
+                # values during the rollout); opt_state only crosses when a checkpoint
+                # is due. replicated_to_host handles the multi-process slice mesh, where
+                # np.asarray refuses non-addressable (but replicated) outputs.
+                reply = (
                     replicated_to_host(params),
                     replicated_to_host(opt_state) if want_opt_state else None,
                     replicated_to_host(mean_losses),
                 )
-            )
+            params_q.put(reply)
+            rounds += 1
+            telemetry.observe_train(1, reply[2])
+            telemetry.step(rounds * policy_steps_per_iter)
     except BaseException as e:  # surface learner crashes to the player
         error["exc"] = e
         # If the crash came from a channel collective the broadcast plane is
@@ -250,9 +264,19 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
             except _ChannelError:
                 pass
             raise
+    # the learner slice's own telemetry stream (telemetry.learner.jsonl next to
+    # the player's — obs/streams.py merges them); one writer per slice
+    from sheeprl_tpu.parallel import distributed
+
+    telemetry = build_role_telemetry(
+        fabric, cfg, "learner",
+        rank=distributed.process_index(),
+        leader=distributed.process_index() == 1,
+    )
     error: Dict[str, Any] = {}
     _trainer_loop(
-        fabric, cfg, agent, params, data_q, params_q, error, geometry=geometry, resume_state=resume_state
+        fabric, cfg, agent, params, data_q, params_q, error, geometry=geometry,
+        resume_state=resume_state, telemetry=telemetry,
     )
     if "exc" in error:
         # the player is (or will be) blocked sending its final sentinel — consume
@@ -548,26 +572,27 @@ def main(fabric, cfg: Dict[str, Any]):
             if cfg.metric.log_level > 0 and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
             ):
-                metrics_dict = aggregator.compute() if aggregator else {}
-                if logger is not None:
-                    logger.log_metrics(metrics_dict, policy_step)
-                    timers = timer.to_dict(reset=False)
-                    if timers.get("Time/train_time", 0) > 0:
-                        logger.log_metrics(
-                            {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
-                            policy_step,
-                        )
-                    if timers.get("Time/env_interaction_time", 0) > 0:
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (policy_step - last_log)
-                                / max(timers["Time/env_interaction_time"], 1e-9)
-                            },
-                            policy_step,
-                        )
-                timer.to_dict(reset=True)
-                if aggregator:
-                    aggregator.reset()
+                with timer("Time/logging_time"):
+                    metrics_dict = aggregator.compute() if aggregator else {}
+                    if logger is not None:
+                        logger.log_metrics(metrics_dict, policy_step)
+                        timers = timer.to_dict(reset=False)
+                        if timers.get("Time/train_time", 0) > 0:
+                            logger.log_metrics(
+                                {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                                policy_step,
+                            )
+                        if timers.get("Time/env_interaction_time", 0) > 0:
+                            logger.log_metrics(
+                                {
+                                    "Time/sps_env_interaction": (policy_step - last_log)
+                                    / max(timers["Time/env_interaction_time"], 1e-9)
+                                },
+                                policy_step,
+                            )
+                    timer.to_dict(reset=True)
+                    if aggregator:
+                        aggregator.reset()
                 last_log = policy_step
 
             if cfg.algo.anneal_clip_coef:
@@ -598,11 +623,12 @@ def main(fabric, cfg: Dict[str, Any]):
                     "last_checkpoint": last_checkpoint,
                 }
                 ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
-                fabric.call(
-                    "on_checkpoint_player",
-                    ckpt_path=ckpt_path,
-                    state=ckpt_state,
-                )
+                with timer("Time/checkpoint_time"):
+                    fabric.call(
+                        "on_checkpoint_player",
+                        ckpt_path=ckpt_path,
+                        state=ckpt_state,
+                    )
                 resilience.observe_checkpoint(ckpt_path, policy_step, preempted=preempted)
             if preempted:
                 break
@@ -618,12 +644,16 @@ def main(fabric, cfg: Dict[str, Any]):
         if "exc" in error:
             raise error["exc"]
 
-        telemetry.close(policy_step)
         envs.close()
         # an in-flight async (orbax) checkpoint write must land before teardown
         wait_for_checkpoint()
         if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
-            test(agent.apply, jax.tree_util.tree_map(jnp.asarray, act_params), fabric, cfg, log_dir)
+            with timer("Time/test_time"):
+                test(agent.apply, jax.tree_util.tree_map(jnp.asarray, act_params), fabric, cfg, log_dir)
+        # closed AFTER the final test so the summary phases include eval time; an
+        # exception path that skips this is flushed by cli.run_algorithm with
+        # clean_exit=False
+        telemetry.close(policy_step)
         if logger is not None:
             logger.finalize()
     except BaseException as e:

@@ -442,26 +442,27 @@ def main(fabric, cfg: Dict[str, Any]):
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
-            metrics_dict = aggregator.compute() if aggregator else {}
-            if logger is not None:
-                logger.log_metrics(metrics_dict, policy_step)
-                timers = timer.to_dict(reset=False)
-                if timers.get("Time/train_time", 0) > 0:
-                    logger.log_metrics(
-                        {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
-                        policy_step,
-                    )
-                if timers.get("Time/env_interaction_time", 0) > 0:
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (policy_step - last_log)
-                            / max(timers["Time/env_interaction_time"], 1e-9)
-                        },
-                        policy_step,
-                    )
-            timer.to_dict(reset=True)
-            if aggregator:
-                aggregator.reset()
+            with timer("Time/logging_time"):
+                metrics_dict = aggregator.compute() if aggregator else {}
+                if logger is not None:
+                    logger.log_metrics(metrics_dict, policy_step)
+                    timers = timer.to_dict(reset=False)
+                    if timers.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                            policy_step,
+                        )
+                    if timers.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (policy_step - last_log)
+                                / max(timers["Time/env_interaction_time"], 1e-9)
+                            },
+                            policy_step,
+                        )
+                timer.to_dict(reset=True)
+                if aggregator:
+                    aggregator.reset()
             last_log = policy_step
 
         if cfg.algo.anneal_clip_coef:
@@ -492,20 +493,25 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_checkpoint": last_checkpoint,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
-            fabric.call(
-                "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
-            )
+            with timer("Time/checkpoint_time"):
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                )
             resilience.observe_checkpoint(ckpt_path, policy_step, preempted=preempted)
         if preempted:
             break
 
-    telemetry.close(policy_step)
     envs.close()
     # an in-flight async (orbax) checkpoint write must land before teardown
     wait_for_checkpoint()
     if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
-        test(agent, params, fabric, cfg, log_dir)
+        with timer("Time/test_time"):
+            test(agent, params, fabric, cfg, log_dir)
+    # closed AFTER the final test so the summary phases include eval time; an
+    # exception path that skips this is flushed by cli.run_algorithm with
+    # clean_exit=False
+    telemetry.close(policy_step)
     if logger is not None:
         logger.finalize()

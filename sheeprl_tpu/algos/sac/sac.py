@@ -310,28 +310,31 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
                 aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
-        # real next obs for done envs (reference sac.py:281-289)
-        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
-        final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
-        if final_obs_arr is not None:
-            for idx in range(total_num_envs):
-                if final_obs_arr[idx] is not None:
-                    for k in mlp_keys:
-                        real_next_obs[k][idx] = np.asarray(final_obs_arr[idx][k])
-        flat_real_next = np.concatenate(
-            [real_next_obs[k].reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
-        ).astype(np.float32)
+        # real next obs for done envs (reference sac.py:281-289); the transition
+        # assembly + buffer add is rollout work — timed as env interaction like
+        # the dreamer loops, so phase attribution has no unnamed rollout gap
+        with timer("Time/env_interaction_time"):
+            real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
+            final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
+            if final_obs_arr is not None:
+                for idx in range(total_num_envs):
+                    if final_obs_arr[idx] is not None:
+                        for k in mlp_keys:
+                            real_next_obs[k][idx] = np.asarray(final_obs_arr[idx][k])
+            flat_real_next = np.concatenate(
+                [real_next_obs[k].reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
+            ).astype(np.float32)
 
-        step_data["terminated"] = np.asarray(terminated).reshape(1, total_num_envs, -1).astype(np.float32)
-        step_data["truncated"] = np.asarray(truncated).reshape(1, total_num_envs, -1).astype(np.float32)
-        step_data["actions"] = actions.reshape(1, total_num_envs, -1).astype(np.float32)
-        step_data["observations"] = np.concatenate(
-            [np.asarray(obs[k]).reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
-        ).astype(np.float32)[np.newaxis]
-        if not sample_next_obs:
-            step_data["next_observations"] = flat_real_next[np.newaxis]
-        step_data["rewards"] = rewards[np.newaxis]
-        sampler.add(step_data, validate_args=cfg.buffer.validate_args)
+            step_data["terminated"] = np.asarray(terminated).reshape(1, total_num_envs, -1).astype(np.float32)
+            step_data["truncated"] = np.asarray(truncated).reshape(1, total_num_envs, -1).astype(np.float32)
+            step_data["actions"] = actions.reshape(1, total_num_envs, -1).astype(np.float32)
+            step_data["observations"] = np.concatenate(
+                [np.asarray(obs[k]).reshape(total_num_envs, -1) for k in mlp_keys], axis=-1
+            ).astype(np.float32)[np.newaxis]
+            if not sample_next_obs:
+                step_data["next_observations"] = flat_real_next[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis]
+            sampler.add(step_data, validate_args=cfg.buffer.validate_args)
 
         obs = next_obs
 
@@ -369,26 +372,27 @@ def main(fabric, cfg: Dict[str, Any]):
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
         ):
-            metrics_dict = aggregator.compute() if aggregator else {}
-            if logger is not None:
-                logger.log_metrics(metrics_dict, policy_step)
-                timers = timer.to_dict(reset=False)
-                if timers.get("Time/train_time", 0) > 0:
-                    logger.log_metrics(
-                        {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
-                        policy_step,
-                    )
-                if timers.get("Time/env_interaction_time", 0) > 0:
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (policy_step - last_log)
-                            / max(timers["Time/env_interaction_time"], 1e-9)
-                        },
-                        policy_step,
-                    )
-            timer.to_dict(reset=True)
-            if aggregator:
-                aggregator.reset()
+            with timer("Time/logging_time"):
+                metrics_dict = aggregator.compute() if aggregator else {}
+                if logger is not None:
+                    logger.log_metrics(metrics_dict, policy_step)
+                    timers = timer.to_dict(reset=False)
+                    if timers.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                            policy_step,
+                        )
+                    if timers.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (policy_step - last_log)
+                                / max(timers["Time/env_interaction_time"], 1e-9)
+                            },
+                            policy_step,
+                        )
+                timer.to_dict(reset=True)
+                if aggregator:
+                    aggregator.reset()
             last_log = policy_step
 
         # a preemption forces an out-of-cadence emergency checkpoint through the
@@ -414,7 +418,7 @@ def main(fabric, cfg: Dict[str, Any]):
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
             # quiesce the prefetch worker so the pickled buffer (incl. its RNG
             # state) is not a torn mid-sample snapshot
-            with sampler.lock:
+            with sampler.lock, timer("Time/checkpoint_time"):
                 fabric.call(
                     "on_checkpoint_coupled",
                     ckpt_path=ckpt_path,
@@ -426,12 +430,16 @@ def main(fabric, cfg: Dict[str, Any]):
             break
 
     bench.finish(policy_step, params)
-    telemetry.close(policy_step)
     sampler.close()
     envs.close()
     # an in-flight async (orbax) checkpoint write must land before teardown
     wait_for_checkpoint()
     if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
-        test(actor.apply, params["actor"], fabric, cfg, log_dir)
+        with timer("Time/test_time"):
+            test(actor.apply, params["actor"], fabric, cfg, log_dir)
+    # closed AFTER the final test so the summary phases include eval time; an
+    # exception path that skips this is flushed by cli.run_algorithm with
+    # clean_exit=False
+    telemetry.close(policy_step)
     if logger is not None:
         logger.finalize()

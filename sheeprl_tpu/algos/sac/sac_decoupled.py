@@ -31,7 +31,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.parallel.distributed import BroadcastChannel, ChannelError, replicated_to_host
-from sheeprl_tpu.obs import build_telemetry
+from sheeprl_tpu.obs import NullTelemetry, build_role_telemetry, build_telemetry
 from sheeprl_tpu.resilience import build_resilience
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -44,8 +44,15 @@ from sheeprl_tpu.utils.utils import ActPlacement, Ratio, save_configs
 
 def _trainer_loop(
     fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error, geometry=None,
-    resume_state=None,
+    resume_state=None, telemetry=None,
 ):
+    # ``telemetry``: the learner role's own stream (two-process topology only —
+    # the threaded trainer shares the player's process, whose telemetry already
+    # observes it; a second writer would also race the shared timer registry)
+    from contextlib import nullcontext
+
+    telemetry = telemetry if telemetry is not None else NullTelemetry()
+    train_span = timer("Time/train_time") if telemetry.enabled else nullcontext()
     try:
         # two-process topology: batch/EMA-period math follows the PLAYER's device
         # count (the roles may own different meshes)
@@ -141,32 +148,38 @@ def _trainer_loop(
             opt_state = fabric.replicate_pytree(opt_state)
 
         key = jax.random.PRNGKey(cfg.seed + 1)
+        last_step = 0
         while True:
             msg = data_q.get()
             if msg is None:
+                telemetry.close(last_step)
                 params_q.put(None)
                 return
             data, iter_num, want_opt_state = msg
-            if mesh_size > 1:
-                # every learner process holds the full broadcast block; sharding the
-                # batch axis over the slice mesh forms the global array (the G-scan
-                # leading axis stays unsharded)
-                data = jax.device_put(data, fabric.sharding(None, "data"))
-            key, train_key = jax.random.split(key)
-            params, opt_state, mean_losses = train_phase(
-                params, opt_state, data, jnp.asarray(iter_num), np.asarray(train_key)
-            )
-            # opt_state only crosses when the player is about to checkpoint
-            # (reference parity with the PPO weight plane's want_opt_state).
-            # replicated_to_host handles the multi-process slice mesh, where
-            # np.asarray refuses non-addressable (but replicated) outputs.
-            params_q.put(
-                (
+            units = int(data["rewards"].shape[0])
+            with train_span:
+                if mesh_size > 1:
+                    # every learner process holds the full broadcast block; sharding the
+                    # batch axis over the slice mesh forms the global array (the G-scan
+                    # leading axis stays unsharded)
+                    data = jax.device_put(data, fabric.sharding(None, "data"))
+                key, train_key = jax.random.split(key)
+                params, opt_state, mean_losses = train_phase(
+                    params, opt_state, data, jnp.asarray(iter_num), np.asarray(train_key)
+                )
+                # opt_state only crosses when the player is about to checkpoint
+                # (reference parity with the PPO weight plane's want_opt_state).
+                # replicated_to_host handles the multi-process slice mesh, where
+                # np.asarray refuses non-addressable (but replicated) outputs.
+                reply = (
                     replicated_to_host(params),
                     replicated_to_host(opt_state) if want_opt_state else None,
                     replicated_to_host(mean_losses),
                 )
-            )
+            params_q.put(reply)
+            last_step = int(iter_num) * policy_steps_per_iter
+            telemetry.observe_train(units, reply[2])
+            telemetry.step(last_step)
     except BaseException as e:
         error["exc"] = e
         # If the crash came from a channel collective the broadcast plane is
@@ -184,6 +197,8 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     sac_decoupled.py:356-545): one process of the learner SLICE, whose DP mesh
     spans every learner process's devices; replay blocks in, updated params out,
     over the host channels (all slice members run this same program)."""
+    from sheeprl_tpu.parallel import distributed
+
     env = make_env(cfg, cfg.seed, 0, None, "learner")()
     observation_space = env.observation_space
     action_space = env.action_space
@@ -215,10 +230,17 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
         # the slice only needs params + opt_state; drop the (potentially
         # GB-sized) replay buffer the player-side state carries
         resume_state.pop("rb", None)
+    # the learner slice's own telemetry stream (telemetry.learner.jsonl next to
+    # the player's — obs/streams.py merges them); one writer per slice
+    telemetry = build_role_telemetry(
+        fabric, cfg, "learner",
+        rank=distributed.process_index(),
+        leader=distributed.process_index() == 1,
+    )
     error: Dict[str, Any] = {}
     _trainer_loop(
         fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error,
-        geometry=geometry, resume_state=resume_state,
+        geometry=geometry, resume_state=resume_state, telemetry=telemetry,
     )
     if "exc" in error:
         # pair the player's final sentinel — unless the crash WAS the channel,
@@ -504,26 +526,27 @@ def main(fabric, cfg: Dict[str, Any]):
             if cfg.metric.log_level > 0 and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
             ):
-                metrics_dict = aggregator.compute() if aggregator else {}
-                if logger is not None:
-                    logger.log_metrics(metrics_dict, policy_step)
-                    timers = timer.to_dict(reset=False)
-                    if timers.get("Time/train_time", 0) > 0:
-                        logger.log_metrics(
-                            {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
-                            policy_step,
-                        )
-                    if timers.get("Time/env_interaction_time", 0) > 0:
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (policy_step - last_log)
-                                / max(timers["Time/env_interaction_time"], 1e-9)
-                            },
-                            policy_step,
-                        )
-                timer.to_dict(reset=True)
-                if aggregator:
-                    aggregator.reset()
+                with timer("Time/logging_time"):
+                    metrics_dict = aggregator.compute() if aggregator else {}
+                    if logger is not None:
+                        logger.log_metrics(metrics_dict, policy_step)
+                        timers = timer.to_dict(reset=False)
+                        if timers.get("Time/train_time", 0) > 0:
+                            logger.log_metrics(
+                                {"Time/sps_train": (policy_step - last_log) / max(timers["Time/train_time"], 1e-9)},
+                                policy_step,
+                            )
+                        if timers.get("Time/env_interaction_time", 0) > 0:
+                            logger.log_metrics(
+                                {
+                                    "Time/sps_env_interaction": (policy_step - last_log)
+                                    / max(timers["Time/env_interaction_time"], 1e-9)
+                                },
+                                policy_step,
+                            )
+                    timer.to_dict(reset=True)
+                    if aggregator:
+                        aggregator.reset()
                 last_log = policy_step
 
             # a preemption forces an out-of-cadence emergency checkpoint through
@@ -548,7 +571,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
                 # quiesce the prefetch worker so the pickled buffer (incl. its RNG
                 # state) is not a torn mid-sample snapshot
-                with sampler.lock:
+                with sampler.lock, timer("Time/checkpoint_time"):
                     fabric.call(
                         "on_checkpoint_player",
                         ckpt_path=ckpt_path,
@@ -559,7 +582,6 @@ def main(fabric, cfg: Dict[str, Any]):
             if preempted:
                 break
 
-        telemetry.close(policy_step)
         sampler.close()
         data_q.put(None)
         if trainer is not None:
@@ -574,7 +596,12 @@ def main(fabric, cfg: Dict[str, Any]):
         # an in-flight async (orbax) checkpoint write must land before teardown
         wait_for_checkpoint()
         if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
-            test(actor.apply, jax.tree_util.tree_map(jnp.asarray, params_host["actor"]), fabric, cfg, log_dir)
+            with timer("Time/test_time"):
+                test(actor.apply, jax.tree_util.tree_map(jnp.asarray, params_host["actor"]), fabric, cfg, log_dir)
+        # closed AFTER the final test so the summary phases include eval time; an
+        # exception path that skips this is flushed by cli.run_algorithm with
+        # clean_exit=False
+        telemetry.close(policy_step)
         if logger is not None:
             logger.finalize()
     except BaseException as e:

@@ -298,6 +298,13 @@ def run_algorithm(cfg: dotdict) -> None:
     try:
         fabric.launch(main, cfg, **kwargs)
     finally:
+        # an exception that unwound past the loop skipped its telemetry.close():
+        # flush the summary (clean_exit=False) so crashed/preempted attempts
+        # still leave end-of-attempt state in telemetry.jsonl — the loops close
+        # their own instance on the normal path, making this a no-op there
+        from sheeprl_tpu.obs.telemetry import close_all_live_telemetry
+
+        close_all_live_telemetry(clean_exit=False)
         if fabric.checkpoint_async:
             from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 
@@ -357,6 +364,17 @@ def run(args: Optional[Sequence[str]] = None) -> None:
             uninstall_preemption_handler()
     if outcome == "preempted":
         raise SystemExit(PREEMPTED_EXIT_CODE)
+
+
+def diagnose(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py diagnose <run_dir>`` — merge the run's telemetry
+    stream(s) (per-process files of decoupled topologies, supervisor attempts)
+    and print a rule-based bottleneck report, writing machine-readable
+    ``diagnosis.json`` next to the streams. See ``howto/observability.md``
+    ("Diagnosing a run") for the detector catalog."""
+    from sheeprl_tpu.obs.diagnose import main as diagnose_main
+
+    return diagnose_main(list(args if args is not None else sys.argv[1:]))
 
 
 def check_configs_evaluation(cfg: dotdict) -> None:

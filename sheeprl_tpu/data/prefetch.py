@@ -171,6 +171,7 @@ class SyncReplaySampler:
             "units": self._tele_units,
             "occupancy_sum": 0.0,
             "staleness_sum": 0.0,
+            "empty_waits": 0,
             "pipeline_len": 0,
             "depth": 0,
         }
@@ -269,6 +270,7 @@ class ReplaySamplePrefetcher:
         self._tele_units = 0
         self._tele_occupancy_sum = 0.0
         self._tele_staleness_sum = 0.0
+        self._tele_empty_waits = 0
         self._thread = threading.Thread(
             target=_worker_loop,
             args=(
@@ -366,7 +368,13 @@ class ReplaySamplePrefetcher:
         if self._closed:
             raise RuntimeError("sample() on a closed ReplaySamplePrefetcher")
         t0 = time.perf_counter()
-        self._tele_occupancy_sum += self._ready.qsize()
+        occupancy = self._ready.qsize()
+        self._tele_occupancy_sum += occupancy
+        if occupancy == 0:
+            # hard-starvation event: the consumer arrived and NOTHING was staged
+            # (the diagnosis engine's prefetch_starvation detector reads this —
+            # wait_seconds alone cannot tell many tiny waits from full stalls)
+            self._tele_empty_waits += 1
         # top up the logical stream so n_samples fresh units exist beyond discards
         while len(self._issue_rounds) < n_samples:
             self._issue()
@@ -407,6 +415,7 @@ class ReplaySamplePrefetcher:
             "units": self._tele_units,
             "occupancy_sum": self._tele_occupancy_sum,
             "staleness_sum": self._tele_staleness_sum,
+            "empty_waits": self._tele_empty_waits,
             "pipeline_len": len(self._issue_rounds),
             "depth": self.depth,
         }

@@ -14,23 +14,43 @@ the step-level telemetry layer the Podracer-style throughput work calls for
 - :mod:`~sheeprl_tpu.obs.profiler` — windowed ``jax.profiler`` trace capture
   (``metric.profiler.mode=window``) bounded to a configured policy-step window;
 - :mod:`~sheeprl_tpu.obs.jsonl` — the structured ``telemetry.jsonl`` event sink
-  consumed by ``bench.py`` (``conditions.telemetry``) and offline tooling.
+  consumed by ``bench.py`` (``conditions.telemetry``) and offline tooling;
+- :mod:`~sheeprl_tpu.obs.streams` — discovery + ordered merge of a run's
+  per-process / per-attempt streams (decoupled topologies, supervisor restarts);
+- :mod:`~sheeprl_tpu.obs.diagnose` — the rule-based diagnosis engine over merged
+  streams (``python sheeprl.py diagnose <run_dir>``), also run in-loop at window
+  cadence and by ``bench.py`` (``conditions.diagnosis``).
 
-See ``howto/observability.md`` for the config keys and the JSONL schema.
+See ``howto/observability.md`` for the config keys, the JSONL schema and the
+detector catalog.
 """
 
 from sheeprl_tpu.obs.compile_monitor import compile_snapshot, install_compile_monitor
+from sheeprl_tpu.obs.diagnose import diagnose_events, diagnose_run, run_detectors
 from sheeprl_tpu.obs.jsonl import JsonlEventSink
 from sheeprl_tpu.obs.profiler import ProfilerWindow, resolve_profiler_config
-from sheeprl_tpu.obs.telemetry import NullTelemetry, RunTelemetry, build_telemetry
+from sheeprl_tpu.obs.streams import discover_streams, merge_streams, merged_events
+from sheeprl_tpu.obs.telemetry import (
+    NullTelemetry,
+    RunTelemetry,
+    build_role_telemetry,
+    build_telemetry,
+)
 
 __all__ = [
     "JsonlEventSink",
     "NullTelemetry",
     "ProfilerWindow",
     "RunTelemetry",
+    "build_role_telemetry",
     "build_telemetry",
     "compile_snapshot",
+    "diagnose_events",
+    "diagnose_run",
+    "discover_streams",
     "install_compile_monitor",
+    "merge_streams",
+    "merged_events",
     "resolve_profiler_config",
+    "run_detectors",
 ]

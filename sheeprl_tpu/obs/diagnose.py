@@ -1,0 +1,652 @@
+"""Rule-based diagnosis over telemetry streams: "why is this run slow/sick?".
+
+PR 2/3 made every run *emit* a structured event stream (``telemetry.jsonl``:
+window gauges, health events, resilience lifecycle); this module is the
+*consumer*. A catalog of detectors walks the merged, ordered stream
+(``obs/streams.py``) and turns raw gauges into findings — each with a severity,
+the evidence events that triggered it, and the config knob most likely to fix
+it. Exposed three ways:
+
+- ``python sheeprl.py diagnose <run_dir>`` — human bottleneck report on stdout
+  plus machine-readable ``diagnosis.json`` in the run dir;
+- in-loop: ``RunTelemetry`` runs the same detectors over its own window history
+  at window cadence and emits live ``health`` events (``status=diagnosis``);
+- ``bench.py`` attaches the verdicts of each steady-window run under
+  ``conditions.diagnosis``, so BENCH JSONs are regression-gateable on *causes*
+  (a recompile storm, a starved pipeline), not just on env-steps/sec.
+
+Detector catalog (see ``howto/observability.md`` for the full reference):
+
+==================  ============================================================
+recompile_storm     XLA recompiles in windows after the first trained window
+                    (shape churn: varying gradient-step counts, env batch drift)
+prefetch_starvation replay/prefetch wait is a large fraction of train time
+mfu_collapse        windows whose MFU falls far below the run median
+hbm_creep           device memory marching toward the HBM capacity limit
+checkpoint_heavy    checkpoint writes eat a material share of wall time
+env_instability     env crash-restart clusters and watchdog stall events
+interruptions       preempt / crash-restart / giveup lifecycle events
+nonfinite_loss      the loss-finiteness health guard tripped
+unattributed_time   the phases breakdown leaves too much wall time unnamed
+==================  ============================================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+Finding = Dict[str, Any]
+Events = Sequence[Dict[str, Any]]
+
+_SEVERITY_RANK = {"critical": 0, "warning": 1, "info": 2}
+
+# thresholds (module constants so tests and operators can reason about them)
+PREFETCH_WAIT_WARNING = 0.25  # replay wait as a fraction of train time
+PREFETCH_WAIT_CRITICAL = 0.50
+MFU_COLLAPSE_RATIO = 0.5  # window MFU below this fraction of the run median
+MFU_MIN_WINDOWS = 4
+HBM_NEAR_LIMIT = 0.92  # bytes_in_use / bytes_limit
+HBM_CREEP_GROWTH = 0.2  # relative in-use growth over the run that flags a creep
+HBM_MIN_WINDOWS = 4
+CHECKPOINT_WARNING = 0.10  # checkpoint seconds as a fraction of wall time
+CHECKPOINT_CRITICAL = 0.25
+ENV_RESTART_CLUSTER = 3  # restarts within ENV_RESTART_CLUSTER_SECONDS
+ENV_RESTART_CLUSTER_SECONDS = 120.0
+UNATTRIBUTED_FRACTION = 0.10  # >10% of steady wall time unnamed
+UNATTRIBUTED_MIN_WALL_SECONDS = 5.0  # ignore micro-runs where noise dominates
+RECOMPILE_STORM_WINDOWS = 3  # affected windows that escalate to critical
+
+
+def _ref(event: Dict[str, Any]) -> Dict[str, Any]:
+    """Compact evidence pointer back into the merged stream."""
+    ref = {"seq": event.get("seq"), "step": event.get("step")}
+    if event.get("stream") is not None:
+        ref["stream"] = event["stream"]
+    if event.get("attempt"):
+        ref["attempt"] = event["attempt"]
+    return ref
+
+
+def _finding(
+    detector: str,
+    severity: str,
+    summary: str,
+    evidence: Events,
+    suggestion: str,
+    **metrics: Any,
+) -> Finding:
+    return {
+        "detector": detector,
+        "severity": severity,
+        "summary": summary,
+        "evidence": [_ref(e) for e in list(evidence)[:8]],
+        "suggestion": suggestion,
+        "metrics": metrics,
+    }
+
+
+def _windows(events: Events, steady: bool = True) -> List[Dict[str, Any]]:
+    return [
+        e
+        for e in events
+        if e.get("event") == "window" and not (steady and e.get("final"))
+    ]
+
+
+def _phase(window: Dict[str, Any], name: str) -> float:
+    phases = window.get("phases") or {}
+    try:
+        return float(phases.get(name) or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+# ---------------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------------
+def detect_recompile_storm(events: Events) -> List[Finding]:
+    windows = _windows(events, steady=False)
+    # warmup = everything up to and including the first window that trained (the
+    # act/train programs legitimately compile there), extended by the run's own
+    # compile_warmup_steps (the start event carries it) — auxiliary programs
+    # (imagination/test heads) legitimately trickle in behind the first round
+    first_trained = next(
+        (i for i, w in enumerate(windows) if (w.get("train_units") or 0) > 0), None
+    )
+    if first_trained is None:
+        return []
+    warmup_steps = max(
+        (
+            int(e.get("compile_warmup_steps") or 0)
+            for e in events
+            if e.get("event") == "start"
+        ),
+        default=0,
+    )
+    affected = [
+        w
+        for w in windows[first_trained + 1 :]
+        if ((w.get("compile") or {}).get("window_count") or 0) > 0
+        and (w.get("step") or 0) > warmup_steps
+    ]
+    if not affected:
+        return []
+    count = sum(int(w["compile"]["window_count"]) for w in affected)
+    seconds = sum(float(w["compile"].get("window_seconds") or 0.0) for w in affected)
+    severity = "critical" if len(affected) >= RECOMPILE_STORM_WINDOWS else "warning"
+    return [
+        _finding(
+            "recompile_storm",
+            severity,
+            f"{count} XLA recompile(s) ({seconds:.1f}s) across {len(affected)} "
+            "window(s) after warmup — the train/act programs should compile once",
+            affected,
+            "hunt for shape churn (varying per-round gradient-step counts, env batch "
+            "drift); pin shapes, or pre-warm with sheeprl-compile and keep the "
+            "persistent compile cache on (SHEEPRL_JAX_CACHE)",
+            recompiles=count,
+            compile_seconds=round(seconds, 3),
+            windows=len(affected),
+        )
+    ]
+
+
+def detect_prefetch_starvation(events: Events) -> List[Finding]:
+    windows = [
+        w
+        for w in _windows(events)
+        if (w.get("train_seconds") or 0) > 0 and (w.get("prefetch") or {}).get("wait_seconds") is not None
+    ]
+    if not windows:
+        return []
+    wait = sum(float(w["prefetch"]["wait_seconds"]) for w in windows)
+    train = sum(float(w["train_seconds"]) for w in windows)
+    if train <= 0:
+        return []
+    frac = wait / train
+    if frac < PREFETCH_WAIT_WARNING:
+        return []
+    severity = "critical" if frac >= PREFETCH_WAIT_CRITICAL else "warning"
+    worst = sorted(
+        windows,
+        key=lambda w: float(w["prefetch"]["wait_seconds"]) / max(float(w["train_seconds"]), 1e-9),
+        reverse=True,
+    )
+    is_async = bool((worst[0].get("prefetch") or {}).get("is_async", False))
+    empty_waits = sum(int((w.get("prefetch") or {}).get("empty_waits") or 0) for w in windows)
+    if is_async:
+        depth = (worst[0].get("prefetch") or {}).get("depth")
+        suggestion = (
+            "increase buffer.prefetch.depth"
+            + (f" (currently {depth})" if depth else "")
+            + ", check host sampling throughput (memmap IO, batch assembly), or "
+            "shrink the per-round gradient-step burst"
+        )
+    else:
+        # the sync sampler's "wait" IS the full inline gather — deepening a
+        # pipeline that does not exist cannot help
+        suggestion = "enable the async replay pipeline: buffer.prefetch.enabled=true"
+    return [
+        _finding(
+            "prefetch_starvation",
+            severity,
+            f"the train loop spent {frac:.0%} of its train time waiting on replay "
+            "sampling — the device is starved by the host pipeline"
+            + (f" ({empty_waits} sample call(s) found nothing staged)" if is_async and empty_waits else ""),
+            worst,
+            suggestion,
+            wait_fraction=round(frac, 4),
+            wait_seconds=round(wait, 3),
+            train_seconds=round(train, 3),
+            is_async=is_async,
+            empty_waits=empty_waits,
+        )
+    ]
+
+
+def detect_mfu_collapse(events: Events) -> List[Finding]:
+    windows = [w for w in _windows(events) if w.get("mfu") is not None]
+    if len(windows) < MFU_MIN_WINDOWS:
+        return []
+    values = sorted(float(w["mfu"]) for w in windows)
+    median = values[len(values) // 2]
+    if median <= 0:
+        return []
+    affected = [w for w in windows if float(w["mfu"]) < MFU_COLLAPSE_RATIO * median]
+    if not affected:
+        return []
+    worst = min(float(w["mfu"]) for w in affected)
+    severity = "critical" if float(windows[-1]["mfu"]) < MFU_COLLAPSE_RATIO * median else "warning"
+    return [
+        _finding(
+            "mfu_collapse",
+            severity,
+            f"{len(affected)} window(s) ran at MFU {worst:.3f} vs a run median of "
+            f"{median:.3f} — the device went quiet mid-run",
+            affected,
+            "capture a bounded trace around the slow stretch "
+            "(metric.profiler.mode=window metric.profiler.start_step=<step>) and "
+            "check the same windows for recompiles / prefetch waits / checkpoint time",
+            median_mfu=round(median, 4),
+            worst_mfu=round(worst, 4),
+            windows=len(affected),
+        )
+    ]
+
+
+def detect_hbm_creep(events: Events) -> List[Finding]:
+    windows = [
+        w for w in _windows(events, steady=False) if (w.get("hbm") or {}).get("bytes_in_use")
+    ]
+    if not windows:
+        return []
+    last = windows[-1]
+    in_use = float(last["hbm"]["bytes_in_use"])
+    limit = float(last["hbm"].get("bytes_limit") or 0.0)
+    if limit > 0 and in_use / limit >= HBM_NEAR_LIMIT:
+        return [
+            _finding(
+                "hbm_creep",
+                "critical",
+                f"device memory is at {in_use / limit:.0%} of HBM capacity "
+                f"({in_use / 2**30:.2f} GiB of {limit / 2**30:.2f} GiB) — the next "
+                "allocation spike can OOM the run",
+                [last],
+                "shrink per-rank batch/sequence sizes, verify train-state donation is "
+                "active (howto/performance.md), or shard over more devices",
+                bytes_in_use=int(in_use),
+                bytes_limit=int(limit),
+                fraction=round(in_use / limit, 4),
+            )
+        ]
+    if len(windows) < HBM_MIN_WINDOWS:
+        return []
+    series = [float(w["hbm"]["bytes_in_use"]) for w in windows]
+    first = series[0]
+    growing = all(b >= a for a, b in zip(series, series[1:])) and series[-1] > series[0]
+    if first > 0 and growing and (series[-1] - first) / first >= HBM_CREEP_GROWTH:
+        return [
+            _finding(
+                "hbm_creep",
+                "warning",
+                f"device memory grew monotonically {first / 2**30:.2f} → "
+                f"{series[-1] / 2**30:.2f} GiB across {len(windows)} windows — "
+                "something is accumulating on-device",
+                windows[-3:],
+                "look for device arrays retained across iterations (host-side lists "
+                "of jax arrays, un-donated train state, growing replay staging)",
+                first_bytes=int(first),
+                last_bytes=int(series[-1]),
+                growth=round((series[-1] - first) / first, 4),
+            )
+        ]
+    return []
+
+
+def detect_checkpoint_heavy(events: Events) -> List[Finding]:
+    windows = [w for w in _windows(events) if w.get("phases")]
+    wall = sum(float(w.get("wall_seconds") or 0.0) for w in windows)
+    if wall <= 0:
+        return []
+    ckpt = sum(_phase(w, "checkpoint") for w in windows)
+    frac = ckpt / wall
+    if frac < CHECKPOINT_WARNING:
+        return []
+    severity = "critical" if frac >= CHECKPOINT_CRITICAL else "warning"
+    affected = sorted(windows, key=lambda w: _phase(w, "checkpoint"), reverse=True)
+    return [
+        _finding(
+            "checkpoint_heavy",
+            severity,
+            f"checkpoint writes took {frac:.0%} of steady wall time "
+            f"({ckpt:.1f}s of {wall:.1f}s)",
+            affected,
+            "enable async checkpointing (checkpoint.async_save=true with the orbax "
+            "backend), raise checkpoint.every, or drop the replay buffer from the "
+            "checkpoint (buffer.checkpoint=false) if resume-refill is acceptable",
+            checkpoint_seconds=round(ckpt, 3),
+            wall_seconds=round(wall, 3),
+            fraction=round(frac, 4),
+        )
+    ]
+
+
+def detect_env_instability(events: Events) -> List[Finding]:
+    findings: List[Finding] = []
+    restarts = [
+        e for e in events if e.get("event") == "health" and e.get("status") == "env_restart"
+    ]
+    if restarts:
+        total = max(int(e.get("total") or 1) for e in restarts)
+        clustered = False
+        times = [float(e.get("time") or 0.0) for e in restarts]
+        for i in range(len(times)):
+            j = i + ENV_RESTART_CLUSTER - 1
+            if j < len(times) and times[j] - times[i] <= ENV_RESTART_CLUSTER_SECONDS:
+                clustered = True
+                break
+        findings.append(
+            _finding(
+                "env_instability",
+                "critical" if clustered else "warning",
+                f"{total} env crash-restart(s)"
+                + (
+                    f" including {ENV_RESTART_CLUSTER}+ within "
+                    f"{ENV_RESTART_CLUSTER_SECONDS:.0f}s — the env is flapping"
+                    if clustered
+                    else " absorbed by RestartOnException"
+                ),
+                restarts,
+                "inspect the env worker logs; a deterministic crash at the same step "
+                "usually means a bad transition/asset, a flapping env usually means "
+                "resource exhaustion in the env process",
+                restarts=total,
+                clustered=clustered,
+            )
+        )
+    stalls = [
+        e for e in events if e.get("event") == "health" and e.get("status") == "stalled"
+    ]
+    if stalls:
+        worst = max(float(e.get("stall_seconds") or 0.0) for e in stalls)
+        findings.append(
+            _finding(
+                "env_instability",
+                "critical",
+                f"the progress watchdog tripped {len(stalls)} time(s) (worst stall "
+                f"{worst:.0f}s) — the loop stopped making progress without dying",
+                stalls,
+                "read the stack dump in the stall event; common culprits are a "
+                "deadlocked env subprocess and a wedged device transfer "
+                "(resilience.watchdog.abort=true turns stalls into supervised restarts)",
+                stalls=len(stalls),
+                worst_stall_seconds=round(worst, 1),
+            )
+        )
+    return findings
+
+
+def detect_interruptions(events: Events) -> List[Finding]:
+    findings: List[Finding] = []
+    preempts = [e for e in events if e.get("event") == "preempt"]
+    crash_restarts = [
+        e for e in events if e.get("event") == "restart" and e.get("reason") == "crash"
+    ]
+    preempt_restarts = [
+        e for e in events if e.get("event") == "restart" and e.get("reason") == "preempt"
+    ]
+    giveups = [e for e in events if e.get("event") == "giveup"]
+    if preempts:
+        findings.append(
+            _finding(
+                "interruptions",
+                "info",
+                f"{len(preempts)} cooperative preemption(s) (SIGTERM reclaim) — "
+                "emergency checkpoints were written"
+                + (f"; {len(preempt_restarts)} supervised resume(s)" if preempt_restarts else ""),
+                preempts + preempt_restarts,
+                "expected on preemptible capacity; tighten checkpoint.every if the "
+                "re-done work between checkpoint and preempt is material",
+                preempts=len(preempts),
+                resumed=len(preempt_restarts),
+            )
+        )
+    if crash_restarts:
+        last_error = next(
+            (e.get("error") for e in reversed(crash_restarts) if e.get("error")), None
+        )
+        findings.append(
+            _finding(
+                "interruptions",
+                "warning",
+                f"the run crashed and was auto-restarted {len(crash_restarts)} time(s)"
+                + (f" (last error: {str(last_error)[:120]})" if last_error else ""),
+                crash_restarts,
+                "read the restart events' error fields; recurring crashes at the same "
+                "step are a code/data bug, not flakiness — the supervisor is masking it",
+                restarts=len(crash_restarts),
+            )
+        )
+    if giveups:
+        findings.append(
+            _finding(
+                "interruptions",
+                "critical",
+                "the supervisor exhausted its restart budget and gave up",
+                giveups,
+                "fix the underlying crash (see the giveup event's error) or raise "
+                "resilience.supervisor.max_restarts if the failures are environmental",
+                giveups=len(giveups),
+            )
+        )
+    return findings
+
+
+def detect_nonfinite_loss(events: Events) -> List[Finding]:
+    bad = [
+        e for e in events if e.get("event") == "health" and e.get("status") == "nonfinite"
+    ]
+    if not bad:
+        return []
+    names = sorted({str(n) for e in bad for n in (e.get("nonfinite") or [])})
+    return [
+        _finding(
+            "nonfinite_loss",
+            "critical",
+            f"training losses went non-finite ({', '.join(names) or 'unnamed'}) in "
+            f"{len(bad)} health check(s)",
+            bad,
+            "lower the learning rate / loosen gradient clipping, and consider "
+            "metric.telemetry.abort_on_nonfinite=true so a diverged run fails fast",
+            checks=len(bad),
+            losses=names,
+        )
+    ]
+
+
+def detect_unattributed_time(events: Events) -> List[Finding]:
+    att = attribution(events)
+    if att is None or att["wall_seconds"] < UNATTRIBUTED_MIN_WALL_SECONDS:
+        return []
+    unattributed = 1.0 - att["named_fraction"]
+    if unattributed <= UNATTRIBUTED_FRACTION:
+        return []
+    windows = [w for w in _windows(events) if w.get("phases")]
+    worst = sorted(
+        windows,
+        key=lambda w: _phase(w, "other") / max(float(w.get("wall_seconds") or 0.0), 1e-9),
+        reverse=True,
+    )
+    return [
+        _finding(
+            "unattributed_time",
+            "warning",
+            f"{unattributed:.0%} of steady wall time is not attributed to any named "
+            "phase — the attribution invariant is leaking",
+            worst,
+            "a loop phase is missing its Time/* span (env interaction, checkpoint, "
+            "logging); see howto/observability.md §phase attribution",
+            named_fraction=round(att["named_fraction"], 4),
+            wall_seconds=round(att["wall_seconds"], 3),
+        )
+    ]
+
+
+DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
+    "recompile_storm": detect_recompile_storm,
+    "prefetch_starvation": detect_prefetch_starvation,
+    "mfu_collapse": detect_mfu_collapse,
+    "hbm_creep": detect_hbm_creep,
+    "checkpoint_heavy": detect_checkpoint_heavy,
+    "env_instability": detect_env_instability,
+    "interruptions": detect_interruptions,
+    "nonfinite_loss": detect_nonfinite_loss,
+    "unattributed_time": detect_unattributed_time,
+}
+
+
+# ---------------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------------
+def _f(value: Any) -> float:
+    try:
+        return float(value or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def attribution(events: Events) -> Optional[Dict[str, Any]]:
+    """Share of steady-window wall time attributed to named phases. None when no
+    steady window carries a phases breakdown (pre-attribution recordings)."""
+    windows = [w for w in _windows(events) if isinstance(w.get("phases"), dict)]
+    wall = sum(_f(w.get("wall_seconds")) for w in windows)
+    if not windows or wall <= 0:
+        return None
+    named = sum(
+        sum(_f(v) for k, v in w["phases"].items() if k != "other") for w in windows
+    )
+    return {
+        "windows": len(windows),
+        "wall_seconds": round(wall, 3),
+        "named_seconds": round(named, 3),
+        "named_fraction": round(min(named / wall, 1.0), 4),
+    }
+
+
+def run_detectors(
+    events: Events, detectors: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Run (a subset of) the catalog over an ordered event stream; findings come
+    back most-severe first. Detectors never raise on malformed/old events —
+    anything they cannot read simply contributes no finding."""
+    findings: List[Finding] = []
+    for name in detectors or DETECTORS:
+        fn = DETECTORS[name]
+        try:
+            findings.extend(fn(events))
+        except Exception:  # a broken detector must not take diagnosis down
+            continue
+    findings.sort(key=lambda f: _SEVERITY_RANK.get(f["severity"], 3))
+    return findings
+
+
+def diagnose_events(events: Events) -> Dict[str, Any]:
+    """The full diagnosis of one ordered event stream (merged or single-file)."""
+    windows = _windows(events, steady=False)
+    summaries = [e for e in events if e.get("event") == "summary"]
+    return {
+        "findings": run_detectors(events),
+        "attribution": attribution(events),
+        "counts": {
+            "events": len(events),
+            "windows": len(windows),
+            "attempts": 1 + max((int(e.get("attempt") or 0) for e in events), default=0),
+            "streams": len({e.get("stream") for e in events if e.get("stream")}),
+            "clean_exit": bool(summaries[-1].get("clean_exit", True)) if summaries else None,
+        },
+    }
+
+
+def diagnose_run(run_dir: str, json_path: Optional[str] = None) -> Dict[str, Any]:
+    """Merge every telemetry stream under ``run_dir`` (obs/streams.py), diagnose,
+    and write ``diagnosis.json`` (to ``json_path``, or into ``run_dir``)."""
+    from sheeprl_tpu.obs.streams import discover_streams, load_stream, merge_streams
+
+    streams = discover_streams(run_dir)
+    if not streams:
+        raise FileNotFoundError(f"no telemetry*.jsonl stream found under {run_dir!r}")
+    base = run_dir if os.path.isdir(run_dir) else os.path.dirname(run_dir)
+    events = merge_streams([load_stream(p, base_dir=base) for p in streams])
+    result = diagnose_events(events)
+    result["run_dir"] = str(run_dir)
+    result["streams"] = [os.path.relpath(p, base) for p in streams]
+    out = json_path or os.path.join(base, "diagnosis.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    result["json_path"] = out
+    return result
+
+
+def format_report(result: Dict[str, Any]) -> str:
+    """Human bottleneck report for one diagnosis result."""
+    lines: List[str] = []
+    counts = result.get("counts") or {}
+    lines.append(f"Telemetry diagnosis — {result.get('run_dir', '<events>')}")
+    streams = result.get("streams")
+    if streams:
+        lines.append(f"  streams : {len(streams)} ({', '.join(streams)})")
+    lines.append(
+        "  events  : "
+        f"{counts.get('events', 0)} across {counts.get('attempts', 1)} attempt(s), "
+        f"{counts.get('windows', 0)} telemetry window(s)"
+    )
+    att = result.get("attribution")
+    if att:
+        lines.append(
+            f"  phases  : {att['named_fraction']:.1%} of {att['wall_seconds']:.1f}s "
+            f"steady wall time attributed to named phases over {att['windows']} window(s)"
+        )
+    findings = result.get("findings") or []
+    if not findings:
+        lines.append("  verdict : no findings — the run looks healthy")
+        return "\n".join(lines)
+    lines.append(f"  verdict : {len(findings)} finding(s)")
+    for f in findings:
+        lines.append("")
+        lines.append(f"[{f['severity'].upper()}] {f['detector']}")
+        lines.append(f"  {f['summary']}")
+        if f.get("evidence"):
+            refs = ", ".join(
+                "#{seq}{step}".format(
+                    seq=r.get("seq"),
+                    step=f" (step {r['step']})" if r.get("step") is not None else "",
+                )
+                for r in f["evidence"][:4]
+            )
+            lines.append(f"  evidence: events {refs}")
+        lines.append(f"  try: {f['suggestion']}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py diagnose <run_dir>`` entry: print the report, write
+    ``diagnosis.json``, exit 0 (or 1 with ``--fail-on`` when findings reach the
+    given severity — the CI/bench gating mode)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="sheeprl.py diagnose",
+        description="Diagnose a run's telemetry.jsonl stream(s): phase attribution, "
+        "bottleneck findings, suggested knobs.",
+    )
+    parser.add_argument("run_dir", help="run directory (searched recursively) or a telemetry*.jsonl file")
+    parser.add_argument("--json", dest="json_path", default=None, help="where to write diagnosis.json")
+    parser.add_argument("--quiet", action="store_true", help="suppress the human report")
+    parser.add_argument(
+        "--fail-on",
+        choices=("warning", "critical"),
+        default=None,
+        help="exit 1 when any finding is at least this severe",
+    )
+    args = parser.parse_args(list(argv) if argv is not None else sys.argv[1:])
+    try:
+        result = diagnose_run(args.run_dir, json_path=args.json_path)
+    except FileNotFoundError as exc:
+        print(f"diagnose: {exc}", file=sys.stderr)
+        return 2
+    if not args.quiet:
+        print(format_report(result))
+        print(f"\nwrote {result['json_path']}")
+    if args.fail_on:
+        gate = _SEVERITY_RANK[args.fail_on]
+        if any(_SEVERITY_RANK.get(f["severity"], 3) <= gate for f in result["findings"]):
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

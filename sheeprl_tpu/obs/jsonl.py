@@ -6,14 +6,37 @@ loss-finiteness guard, one program event per introspected compiled program, and
 a final summary event. ``bench.py`` reads the summary back into
 ``conditions.telemetry`` without re-measuring, and offline tooling can tail the
 file on a live run.
+
+Stream identity: every event carries ``rank`` (the writing process's position in
+the launch topology), ``attempt`` (supervisor restart counter, 0 for the first
+launch) and a monotonic ``seq``. ``seq`` counters are shared per *path* within a
+process, so the several writers that can append to one file (the run telemetry,
+the resilience monitor's lazy sink, the supervisor across attempts) produce one
+monotonic sequence — the ordering key ``obs/streams.py`` merges on. Old streams
+without these fields still parse; readers default them (see
+:func:`sheeprl_tpu.obs.streams.load_stream`).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
+
+# per-path monotonic sequence counters, shared by every sink of this process that
+# appends to the same file (keyed by absolute path; distinct processes write
+# distinct per-role files, so cross-process sharing is not needed)
+_SEQ_LOCK = threading.Lock()
+_SEQ: Dict[str, int] = {}
+
+
+def _next_seq(path: str) -> int:
+    with _SEQ_LOCK:
+        n = _SEQ.get(path, 0)
+        _SEQ[path] = n + 1
+        return n
 
 
 def _jsonable(value: Any) -> Any:
@@ -41,13 +64,17 @@ def _jsonable(value: Any) -> Any:
 
 
 class JsonlEventSink:
-    """Append-mode JSONL writer. Every event gets ``event`` (type), ``step`` and
-    a wall-clock ``time`` stamp; the rest of the payload is passed through
+    """Append-mode JSONL writer. Every event gets ``event`` (type), ``step``, a
+    wall-clock ``time`` stamp and the stream identity triple
+    (``rank``/``attempt``/``seq``); the rest of the payload is passed through
     :func:`_jsonable`. Lines are flushed as written so a crashed or abandoned run
     still leaves a readable stream."""
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, *, rank: int = 0, attempt: int = 0) -> None:
         self.path = str(path)
+        self.rank = int(rank)
+        self.attempt = int(attempt)
+        self._seq_key = os.path.abspath(self.path)
         parent = os.path.dirname(self.path)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -56,9 +83,17 @@ class JsonlEventSink:
     def emit(self, event: str, step: Optional[int] = None, **fields: Any) -> None:
         if self._fh is None:
             return
-        payload: Dict[str, Any] = {"event": str(event), "time": round(time.time(), 3)}
+        payload: Dict[str, Any] = {
+            "event": str(event),
+            "time": round(time.time(), 3),
+            "rank": self.rank,
+            "attempt": self.attempt,
+            "seq": _next_seq(self._seq_key),
+        }
         if step is not None:
             payload["step"] = int(step)
+        # explicit fields override the identity defaults (the supervisor stamps
+        # the per-attempt counter on its own restart/giveup events this way)
         for k, v in fields.items():
             payload[k] = _jsonable(v)
         self._fh.write(json.dumps(payload) + "\n")

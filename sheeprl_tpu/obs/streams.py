@@ -1,0 +1,98 @@
+"""Telemetry stream discovery + merge: one ordered event stream per run.
+
+A run can scatter its telemetry over several JSONL files: decoupled MPMD
+topologies (sac_decoupled / ppo_decoupled / dv3_decoupled) write one file per
+role process (the player's ``telemetry.jsonl`` plus ``telemetry.<role>.jsonl``
+for the learner slice), and the supervisor pins all restart *attempts* of a run
+onto one shared run-base file while each attempt may also leave per-version
+artifacts. The diagnosis engine (``obs/diagnose.py``) wants ONE ordered stream.
+
+Merging key: every modern event carries ``(rank, attempt, seq)`` (see
+``obs/jsonl.py``); within one file that triple is append-ordered, so a k-way
+merge that pops the earliest head by wall-clock ``time`` — with
+``(attempt, seq)`` as the tiebreak — yields a globally time-ordered stream that
+never reorders any single writer's events. All writers of one run share the
+host clock (the topologies here are single-host; multi-host pods write per-host
+run dirs), so wall-clock alignment is exact up to NTP skew; per-stream order is
+preserved regardless, which is the invariant the detectors rely on.
+
+Old streams written before the identity fields existed still merge: missing
+``rank``/``attempt`` default to 0 and ``seq`` to the line index.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from sheeprl_tpu.obs.jsonl import read_events
+
+__all__ = ["discover_streams", "load_stream", "merge_streams", "merged_events"]
+
+
+def discover_streams(run_dir: str) -> List[str]:
+    """Every ``telemetry*.jsonl`` under ``run_dir`` (recursively — per-version
+    subdirs and per-role siblings included), sorted for determinism. Accepts a
+    direct file path too, so ``diagnose`` can be pointed at a single stream."""
+    if os.path.isfile(run_dir):
+        return [run_dir]
+    found: List[str] = []
+    for root, _dirs, files in os.walk(run_dir):
+        for name in files:
+            if name.startswith("telemetry") and name.endswith(".jsonl"):
+                found.append(os.path.join(root, name))
+    return sorted(found)
+
+
+def load_stream(path: str, base_dir: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Parse one JSONL stream, annotating each event with its source ``stream``
+    (path relative to ``base_dir`` when given) and defaulting the identity
+    fields of pre-identity events (rank/attempt 0, seq = line index) so old
+    recordings merge alongside new ones."""
+    stream = os.path.relpath(path, base_dir) if base_dir else path
+    events = read_events(path)
+    for idx, event in enumerate(events):
+        event["stream"] = stream
+        event.setdefault("rank", 0)
+        event.setdefault("attempt", 0)
+        event.setdefault("seq", idx)
+    return events
+
+
+def merge_streams(
+    streams: Sequence[Sequence[Dict[str, Any]]],
+) -> List[Dict[str, Any]]:
+    """K-way merge of per-file event lists into one stream ordered by wall-clock
+    ``time`` (tiebreak: attempt, then seq, then stream index), preserving each
+    input stream's own order even across clock anomalies."""
+    heads: List[tuple] = []
+    for sidx, events in enumerate(streams):
+        if events:
+            heads.append((_key(events[0], sidx), sidx, 0))
+    heapq.heapify(heads)
+    merged: List[Dict[str, Any]] = []
+    while heads:
+        _, sidx, pos = heapq.heappop(heads)
+        merged.append(streams[sidx][pos])
+        nxt = pos + 1
+        if nxt < len(streams[sidx]):
+            heapq.heappush(heads, (_key(streams[sidx][nxt], sidx), sidx, nxt))
+    return merged
+
+
+def _key(event: Dict[str, Any], stream_idx: int) -> tuple:
+    return (
+        float(event.get("time") or 0.0),
+        int(event.get("attempt") or 0),
+        int(event.get("seq") or 0),
+        stream_idx,
+    )
+
+
+def merged_events(run_dir: str) -> List[Dict[str, Any]]:
+    """Discover + load + merge every telemetry stream of ``run_dir`` into one
+    ordered list (empty when the run left no stream)."""
+    base = run_dir if os.path.isdir(run_dir) else os.path.dirname(run_dir)
+    paths = discover_streams(run_dir)
+    return merge_streams([load_stream(p, base_dir=base) for p in paths])

@@ -16,9 +16,19 @@ config group) and driven by four hooks, each a no-op when the feature is off:
   capture and, every ``telemetry.every`` policy steps, emits one telemetry window:
   TensorBoard gauges (``Mem/*``, ``Compile/*``, ``Perf/mfu``, ``Time/prefetch_*``,
   ``Buffer/pipeline_*``, ``Perf/sps``) plus one JSONL ``window`` event.
-- ``close(policy_step)`` — at loop exit; flushes the final window, writes the
-  ``summary`` event ``bench.py`` attaches to BENCH JSONs, and stops an open
+- ``close(policy_step, clean_exit=...)`` — from the loop's ``finally`` path;
+  flushes the final window, writes the ``summary`` event ``bench.py`` attaches
+  to BENCH JSONs (``clean_exit=False`` on an exception unwind, so crashed and
+  preempted attempts leave end-of-attempt state too), and stops an open
   profiler window.
+
+Every ``window`` event carries a ``phases`` wall-time breakdown (env
+interaction, replay/prefetch wait, device train, checkpoint write, logging,
+eval/test, unattributed remainder — see ``_PHASE_TIMERS``) and every event the
+stream identity triple ``rank``/``attempt``/``seq`` (``obs/jsonl.py``). At
+window cadence the in-loop diagnosis (``metric.telemetry.diagnosis``, default
+on) runs the ``obs/diagnose.py`` detector catalog over the run's own history
+and emits live ``health`` events with ``status=diagnosis``.
 
 Telemetry is rank-0-only and fully decoupled from ``metric.log_level``: a bench
 run with logging off still produces ``telemetry.jsonl``. With
@@ -43,7 +53,52 @@ from sheeprl_tpu.utils.mfu import peak_flops, program_analysis
 from sheeprl_tpu.utils.timer import timer
 
 # cumulative counter keys of a sampler telemetry snapshot (diffed per window)
-_PREFETCH_COUNTERS = ("wait_seconds", "sample_calls", "units", "occupancy_sum", "staleness_sum")
+_PREFETCH_COUNTERS = (
+    "wait_seconds",
+    "sample_calls",
+    "units",
+    "occupancy_sum",
+    "staleness_sum",
+    "empty_waits",
+)
+
+# phase attribution: named loop phases and the Time/* span each one harvests.
+# Every window event carries a ``phases`` breakdown built from these (plus
+# ``replay_wait``, carved out of the train span from the sampler's wait counter,
+# and the ``other`` remainder) with the invariant
+# sum(phases.values()) ≈ window wall_seconds.
+_PHASE_TIMERS = {
+    "env": "Time/env_interaction_time",
+    "train": "Time/train_time",
+    "checkpoint": "Time/checkpoint_time",
+    "logging": "Time/logging_time",
+    "eval": "Time/test_time",
+}
+
+# window/health events the in-loop diagnosis keeps (bounded history)
+_HISTORY_CAP = 512
+
+# live (built, not yet closed) RunTelemetry instances of this process. The loops
+# close their own instance on the normal path; an exception that unwinds past a
+# loop leaves its instance here, and cli.run_algorithm's finally flushes it with
+# clean_exit=False — so a crashed/preempted attempt still writes its summary
+# event (the supervisor's cross-attempt history needs end-of-attempt state).
+# WeakSet: instances abandoned by unit tests drop out on GC instead of being
+# closed by an unrelated later run.
+import weakref
+
+_LIVE_TELEMETRY: "weakref.WeakSet[RunTelemetry]" = weakref.WeakSet()
+
+
+def close_all_live_telemetry(clean_exit: bool = False) -> None:
+    """Close every still-open RunTelemetry of this process (crash path; the
+    normal path leaves nothing live). Each instance flushes at the last policy
+    step its loop reported."""
+    for t in list(_LIVE_TELEMETRY):
+        try:
+            t.close(t._last_step, clean_exit=clean_exit)
+        except Exception:
+            continue
 
 
 class NullTelemetry:
@@ -73,7 +128,7 @@ class NullTelemetry:
     def step(self, policy_step: int) -> None:
         pass
 
-    def close(self, policy_step: Optional[int] = None) -> None:
+    def close(self, policy_step: Optional[int] = None, clean_exit: bool = True) -> None:
         pass
 
 
@@ -150,6 +205,8 @@ class RunTelemetry:
         *,
         enabled: bool = True,
         profiler_cfg: Optional[Mapping[str, Any]] = None,
+        jsonl_path: Optional[str] = None,
+        rank: Optional[int] = None,
     ) -> None:
         metric_cfg = cfg.metric
         tcfg = dict(metric_cfg.get("telemetry") or {})
@@ -168,13 +225,19 @@ class RunTelemetry:
         self.abort_on_nonfinite = bool(tcfg.get("abort_on_nonfinite", False))
         self.compile_warmup_steps = int(tcfg.get("compile_warmup_steps") or 0)
         self._program_analysis = bool(tcfg.get("program_analysis", True))
+        self.diagnosis = bool(tcfg.get("diagnosis", True))
+
+        # stream identity: rank = the writing process's launch-topology position
+        # (role streams override it), attempt = supervisor restart counter
+        self._rank = int(rank if rank is not None else getattr(fabric, "global_rank", 0) or 0)
+        self._attempt = int(tcfg.get("attempt") or 0)
 
         self._sink: Optional[JsonlEventSink] = None
         if self.enabled and bool(tcfg.get("jsonl", True)):
-            path = tcfg.get("jsonl_path") or (
+            path = jsonl_path or tcfg.get("jsonl_path") or (
                 os.path.join(log_dir, "telemetry.jsonl") if log_dir else "telemetry.jsonl"
             )
-            self._sink = JsonlEventSink(path)
+            self._sink = JsonlEventSink(path, rank=self._rank, attempt=self._attempt)
 
         self._device = getattr(fabric, "device", None)
         self._peak_flops = peak_flops(self._device) if self._device is not None else None
@@ -186,13 +249,19 @@ class RunTelemetry:
         self._start_step: Optional[int] = None
         self._start_time = 0.0
         self._timer_last: Dict[str, tuple] = {}  # name -> (total, reset generation)
-        self._window_train_seconds = 0.0
-        self._window_env_seconds = 0.0
+        # "analysis" has no backing timer: register_program accounts its one-shot
+        # program-introspection wall time there (it already shifts the open train
+        # span past itself, so the window would otherwise leak it into `other`)
+        self._window_phases: Dict[str, float] = {**{k: 0.0 for k in _PHASE_TIMERS}, "analysis": 0.0}
+        self._total_phases: Dict[str, float] = {}
+        self._total_wall_seconds = 0.0
         self._window_idx = 0
         self._window_train_units = 0
         self._total_train_units = 0
         self._total_train_seconds = 0.0
         self._last_losses: Any = None
+        self._history: list = []  # window/health payloads for the in-loop diagnosis
+        self._last_diagnosis_key: Any = None
         self._env_restarts = 0
         self._health_status = "unknown"
         self._sampler: Any = None
@@ -204,23 +273,28 @@ class RunTelemetry:
         self._compile_last = {"count": 0, "seconds": 0.0}
         self._last_mfu: Optional[float] = None
         self._peak_hbm = 0
+        self._last_step: Optional[int] = None
+        _LIVE_TELEMETRY.add(self)
 
         if self.enabled:
             install_compile_monitor()
             self._compile_base = compile_snapshot()
             self._compile_last = dict(self._compile_base)
+            dev = self._device
+            start_event: Dict[str, Any] = dict(
+                platform=getattr(dev, "platform", None),
+                device_kind=getattr(dev, "device_kind", None),
+                world_size=self._world_size,
+                peak_flops=self._peak_flops,
+                every=self.every,
+                compile_warmup_steps=self.compile_warmup_steps,
+                profiler=dict(pcfg),
+            )
+            # the in-loop diagnosis needs the start event too (the recompile
+            # detector reads compile_warmup_steps from it), sink or no sink
+            self._append_history("start", start_event)
             if self._sink is not None:
-                dev = self._device
-                self._sink.emit(
-                    "start",
-                    step=None,
-                    platform=getattr(dev, "platform", None),
-                    device_kind=getattr(dev, "device_kind", None),
-                    world_size=self._world_size,
-                    peak_flops=self._peak_flops,
-                    every=self.every,
-                    profiler=dict(pcfg),
-                )
+                self._sink.emit("start", step=None, **start_event)
 
     # -- wiring ------------------------------------------------------------------
 
@@ -281,6 +355,7 @@ class RunTelemetry:
             # Time/train_time span (the loops register inside it) past the
             # analysis, and credit its compile events out of the Compile/* base
             spent = time.perf_counter() - t0
+            self._window_phases["analysis"] += spent
             span = timer.timers.get("Time/train_time")
             if span is not None and span._start is not None:
                 span._start += spent
@@ -318,10 +393,10 @@ class RunTelemetry:
         if not self.enabled or count <= 0:
             return
         self._env_restarts += int(count)
+        event = {"status": "env_restart", "restarts": int(count), "total": self._env_restarts}
+        self._append_history("health", event)
         if self._sink is not None:
-            self._sink.emit(
-                "health", status="env_restart", restarts=int(count), total=self._env_restarts
-            )
+            self._sink.emit("health", **event)
 
     def emit_event(self, event: str, step: Optional[int] = None, **fields: Any) -> bool:
         """Write an arbitrary event to the run's JSONL stream (used by the
@@ -336,6 +411,7 @@ class RunTelemetry:
         """Once per loop iteration: advance the profiler window and emit a
         telemetry window every ``every`` policy steps. Idle cost is two int
         compares plus a method call."""
+        self._last_step = policy_step
         was_started, was_stopped = self.profiler.started_at, self.profiler.stopped_at
         self.profiler.on_step(policy_step)
         if self._sink is not None:
@@ -359,8 +435,11 @@ class RunTelemetry:
             self._anchor_step = self._start_step = policy_step
             self._anchor_time = self._start_time = now
             # baseline the non-monotonic sources so window 0 diffs cleanly
+            # (the one-shot analysis accumulator is kept — register_program can
+            # legitimately run before the anchor in warmup-heavy loops)
             self._harvest_timers()
-            self._window_train_seconds = self._window_env_seconds = 0.0
+            analysis = self._window_phases["analysis"]
+            self._window_phases = {**{k: 0.0 for k in _PHASE_TIMERS}, "analysis": analysis}
             self._prefetch_delta()
             return
         # harvest EVERY iteration, not just at window boundaries: the metric log
@@ -372,9 +451,14 @@ class RunTelemetry:
         if policy_step - self._anchor_step >= self.every:
             self._emit_window(policy_step)
 
-    def close(self, policy_step: Optional[int] = None) -> None:
+    def close(self, policy_step: Optional[int] = None, clean_exit: bool = True) -> None:
         """Flush the last partial window, write the run ``summary`` event and
-        finalize the profiler/JSONL artifacts."""
+        finalize the profiler/JSONL artifacts. The loops call this from a
+        ``finally`` path, so a crashed or preempted run still leaves its summary
+        — ``clean_exit=False`` marks an exception unwind (the supervisor's
+        cross-attempt history reads end-of-attempt state from it). Idempotent:
+        a second call is a no-op."""
+        _LIVE_TELEMETRY.discard(self)
         window_truncated = self.profiler.active
         self.profiler.close(policy_step)
         if window_truncated and self._sink is not None and self.profiler.started_at is not None:
@@ -416,15 +500,23 @@ class RunTelemetry:
                 overall_mfu = (
                     self._mfu_flops_per_unit * self._total_train_units / self._total_train_seconds
                 ) / self._peak_flops
+            phases_total = {k: round(v, 3) for k, v in self._total_phases.items()}
+            attributed = None
+            if self._total_wall_seconds > 0:
+                named = sum(v for k, v in self._total_phases.items() if k != "other")
+                attributed = round(min(named / self._total_wall_seconds, 1.0), 4)
             self._sink.emit(
                 "summary",
                 step=policy_step,
+                clean_exit=bool(clean_exit),
                 windows=self._window_idx,
                 total_steps=total_steps,
                 wall_seconds=round(wall, 3),
                 sps=round(total_steps / wall, 3) if wall > 0 else None,
                 train_units=self._total_train_units,
                 train_seconds=round(self._total_train_seconds, 3),
+                phases=phases_total or None,
+                attributed_fraction=attributed,
                 mfu=overall_mfu,
                 compile={
                     "count": snap["count"] - self._compile_base["count"],
@@ -462,9 +554,40 @@ class RunTelemetry:
         return max(delta, 0.0)
 
     def _harvest_timers(self) -> None:
-        """Accumulate the named timers' fresh seconds into the current window."""
-        self._window_train_seconds += self._timer_delta("Time/train_time")
-        self._window_env_seconds += self._timer_delta("Time/env_interaction_time")
+        """Accumulate the named phase timers' fresh seconds into the current
+        window (see ``_PHASE_TIMERS``; loops that lack a span simply contribute
+        zero to that phase)."""
+        for phase, name in _PHASE_TIMERS.items():
+            self._window_phases[phase] += self._timer_delta(name)
+
+    def _append_history(self, event: str, payload: Dict[str, Any]) -> None:
+        """Feed the in-loop diagnosis history (bounded; same payloads the sink
+        writes — including the wall-clock ``time`` the sink would stamp, which
+        the env-restart clustering detector reads — so the offline and live
+        detectors see the same shapes)."""
+        self._history.append({"event": event, "time": round(time.time(), 3), **payload})
+        if len(self._history) > _HISTORY_CAP:
+            del self._history[: len(self._history) - _HISTORY_CAP]
+
+    def _run_live_diagnosis(self, policy_step: int) -> None:
+        """Run the detector catalog over this run's own window/health history and
+        emit a ``health`` event (``status=diagnosis``) when the finding set
+        changes — the live half of ``obs/diagnose.py``'s offline CLI."""
+        from sheeprl_tpu.obs.diagnose import run_detectors
+
+        findings = run_detectors(self._history)
+        key = tuple(sorted((f["detector"], f["severity"]) for f in findings))
+        if findings and key != self._last_diagnosis_key and self._sink is not None:
+            self._sink.emit(
+                "health",
+                step=policy_step,
+                status="diagnosis",
+                findings=[
+                    {k: f[k] for k in ("detector", "severity", "summary", "suggestion")}
+                    for f in findings
+                ],
+            )
+        self._last_diagnosis_key = key
 
     def _prefetch_delta(self) -> Optional[Dict[str, Any]]:
         if self._sampler is None:
@@ -486,7 +609,9 @@ class RunTelemetry:
             "units": int(delta["units"]),
             "occupancy": delta["occupancy_sum"] / calls,
             "staleness": delta["staleness_sum"] / units,
+            "empty_waits": int(delta["empty_waits"]),
             "pipeline_len": int(snap.get("pipeline_len", 0)),
+            "depth": int(snap.get("depth", 0)),
             "is_async": bool(snap.get("is_async", False)),
         }
 
@@ -510,8 +635,8 @@ class RunTelemetry:
         sps = steps / wall
 
         self._harvest_timers()  # pick up anything accrued since the last step()
-        train_seconds = self._window_train_seconds
-        env_seconds = self._window_env_seconds
+        train_seconds = self._window_phases["train"]
+        env_seconds = self._window_phases["env"]
         self._total_train_seconds += train_seconds
 
         snap = compile_snapshot()
@@ -550,6 +675,29 @@ class RunTelemetry:
         prefetch = self._prefetch_delta()
         health = self._check_health(policy_step)
 
+        # phase attribution: replay/prefetch wait is carved OUT of the train span
+        # (sampler.sample runs inside `with timer("Time/train_time")` in every
+        # off-policy loop), so `train` below is pure device-train time and the
+        # named phases tile the window: sum(phases) + other ≈ wall_seconds.
+        # `train_seconds`/MFU keep the PR 2 semantics (wait included) unchanged.
+        replay_wait = 0.0
+        if prefetch is not None:
+            replay_wait = min(max(float(prefetch["wait_seconds"]), 0.0), train_seconds)
+        phases = {
+            "env": env_seconds,
+            "replay_wait": replay_wait,
+            "train": train_seconds - replay_wait,
+            "checkpoint": self._window_phases["checkpoint"],
+            "logging": self._window_phases["logging"],
+            "eval": self._window_phases["eval"],
+            "analysis": self._window_phases["analysis"],
+        }
+        phases["other"] = max(wall - sum(phases.values()), 0.0)
+        phases = {k: round(v, 4) for k, v in phases.items()}
+        for k, v in phases.items():
+            self._total_phases[k] = self._total_phases.get(k, 0.0) + v
+        self._total_wall_seconds += wall
+
         if self._logger is not None:
             gauges: Dict[str, float] = {
                 "Perf/sps": sps,
@@ -575,37 +723,41 @@ class RunTelemetry:
                 gauges["Health/env_restarts"] = float(self._env_restarts)
             self._logger.log_metrics(gauges, policy_step)
 
+        window_event: Dict[str, Any] = dict(
+            step=policy_step,
+            window=self._window_idx,
+            final=bool(final),
+            steps=steps,
+            wall_seconds=round(wall, 4),
+            sps=round(sps, 3),
+            train_units=self._window_train_units,
+            train_seconds=round(train_seconds, 4),
+            env_seconds=round(env_seconds, 4),
+            phases=phases,
+            mfu=mfu,
+            hbm=hbm,
+            rss_bytes=rss,
+            rss_peak_bytes=rss_peak,
+            compile={
+                "count": total_compiles,
+                "seconds": round(total_compile_seconds, 3),
+                "window_count": window_compiles,
+                "window_seconds": round(window_compile_seconds, 3),
+            },
+            prefetch=prefetch,
+        )
+        self._append_history("window", window_event)
         if self._sink is not None:
-            self._sink.emit(
-                "window",
-                step=policy_step,
-                window=self._window_idx,
-                final=bool(final),
-                steps=steps,
-                wall_seconds=round(wall, 4),
-                sps=round(sps, 3),
-                train_units=self._window_train_units,
-                train_seconds=round(train_seconds, 4),
-                env_seconds=round(env_seconds, 4),
-                mfu=mfu,
-                hbm=hbm,
-                rss_bytes=rss,
-                rss_peak_bytes=rss_peak,
-                compile={
-                    "count": total_compiles,
-                    "seconds": round(total_compile_seconds, 3),
-                    "window_count": window_compiles,
-                    "window_seconds": round(window_compile_seconds, 3),
-                },
-                prefetch=prefetch,
-            )
+            self._sink.emit("window", **window_event)
             if health is not None:
+                self._append_history("health", {"step": policy_step, **health})
                 self._sink.emit("health", step=policy_step, **health)
+        if self.diagnosis:
+            self._run_live_diagnosis(policy_step)
 
         self._window_idx += 1
         self._window_train_units = 0
-        self._window_train_seconds = 0.0
-        self._window_env_seconds = 0.0
+        self._window_phases = {**{k: 0.0 for k in _PHASE_TIMERS}, "analysis": 0.0}
         self._anchor_step = policy_step
         self._anchor_time = now
 
@@ -631,3 +783,40 @@ def build_telemetry(fabric: Any, cfg: Any, log_dir: Optional[str], logger: Any =
     if not enabled and pcfg["mode"] != "window":
         return NullTelemetry()
     return RunTelemetry(fabric, cfg, log_dir, logger, enabled=enabled, profiler_cfg=pcfg)
+
+
+def role_stream_path(cfg: Any, role: str) -> str:
+    """Per-role sibling of the run's main telemetry stream: the configured
+    ``jsonl_path`` with ``.<role>`` spliced in before the extension, or
+    ``telemetry.<role>.jsonl`` in the run-base dir — either way a path
+    ``obs/streams.py`` discovers next to the player's stream."""
+    tcfg = (cfg.metric.get("telemetry") or {}) if cfg.metric is not None else {}
+    base = tcfg.get("jsonl_path")
+    if base:
+        root, ext = os.path.splitext(str(base))
+        return f"{root}.{role}{ext or '.jsonl'}"
+    from sheeprl_tpu.utils.logger import run_base_dir
+
+    return str(run_base_dir(cfg.root_dir, cfg.run_name) / f"telemetry.{role}.jsonl")
+
+
+def build_role_telemetry(fabric: Any, cfg: Any, role: str, *, rank: int, leader: bool = True):
+    """Telemetry stream for a decoupled MPMD role process (the learner slice of
+    sac_decoupled / ppo_decoupled / dv3_decoupled). The player's rank-0 stream
+    cannot see learner-side train time, HBM or compiles — this gives the role
+    its own ``telemetry.<role>.jsonl`` (one per role: only the slice ``leader``
+    writes; the other slice members get the no-op), merged with the player's by
+    ``obs/streams.py``. No logger, no profiler — the JSONL stream only."""
+    tcfg = cfg.metric.get("telemetry") or {}
+    if not (bool(tcfg.get("enabled", False)) and bool(tcfg.get("jsonl", True)) and leader):
+        return NullTelemetry()
+    return RunTelemetry(
+        fabric,
+        cfg,
+        None,
+        None,
+        enabled=True,
+        profiler_cfg={"mode": "off", "start_step": 0, "num_steps": 0, "dir": None},
+        jsonl_path=role_stream_path(cfg, role),
+        rank=rank,
+    )

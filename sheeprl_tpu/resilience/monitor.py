@@ -100,6 +100,9 @@ class ResilienceMonitor:
             tcfg.get("jsonl_path")
             or (f"{log_dir}/telemetry.jsonl" if log_dir else "telemetry.jsonl")
         )
+        # stream identity for the lazy sink (matches the telemetry sink's fields)
+        self._rank = int(getattr(fabric, "global_rank", 0) or 0)
+        self._attempt = int(tcfg.get("attempt") or 0)
         # with the supervisor (or full telemetry) on, every lifecycle event is
         # recorded; otherwise only critical events open the lazy sink, keeping
         # default-run artifacts unchanged
@@ -192,7 +195,9 @@ class ResilienceMonitor:
                 if not self._jsonl_enabled or not (self._eager or critical):
                     return
                 try:
-                    self._own_sink = JsonlEventSink(self._sink_path)
+                    self._own_sink = JsonlEventSink(
+                        self._sink_path, rank=self._rank, attempt=self._attempt
+                    )
                 except OSError:
                     return
             self._own_sink.emit(event, step=step, **fields)

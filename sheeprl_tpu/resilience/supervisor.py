@@ -93,6 +93,9 @@ def supervise(
                 sink = JsonlEventSink(cfg.metric.telemetry.jsonl_path)
             except OSError:
                 return
+        # supervisor events are stamped with the attempt they decide ABOUT, not
+        # the sink's creation-time default (one sink spans every attempt)
+        fields.setdefault("attempt", attempt)
         sink.emit(event, **fields)
 
     original = dotdict(copy.deepcopy(cfg.as_dict()))
@@ -166,6 +169,11 @@ def supervise(
             else:
                 # crash before any checkpoint landed: restart from scratch
                 retry.checkpoint.resume_from = None
+            # every event the retry writes (telemetry, resilience monitor) carries
+            # its attempt number — the ordering key obs/streams.py merges on
+            # (after resume_merge: `metric` is non-resumable, so this sticks)
+            retry.metric.setdefault("telemetry", dotdict({}))
+            retry.metric.telemetry.attempt = attempt
             current = retry
     finally:
         if sink is not None:

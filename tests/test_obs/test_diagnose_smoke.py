@@ -1,0 +1,67 @@
+"""CPU acceptance smoke for the diagnosis engine: a short REAL sac training run
+with telemetry on, then ``diagnose`` over its run dir — exit 0 and ≥95% of
+steady-window wall time attributed to named phases (the phase-attribution
+invariant of this observability layer)."""
+
+from __future__ import annotations
+
+import glob
+import json
+
+import pytest
+
+from sheeprl_tpu.cli import diagnose, run
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.mark.timeout(240)
+def test_sac_run_diagnose_attributes_95_percent(tmp_path):
+    run(
+        [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "dry_run=False",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "buffer.memmap=False",
+            "buffer.size=512",
+            "env.num_envs=2",
+            "algo.learning_starts=4",
+            "algo.run_test=False",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.per_rank_batch_size=4",
+            "algo.total_steps=64",
+            "metric.telemetry.enabled=true",
+            "metric.telemetry.every=8",
+            "metric.telemetry.compile_warmup_steps=0",
+            "root_dir=tdsmk",
+            "run_name=sac",
+        ]
+    )
+    out = str(tmp_path / "diagnosis.json")
+    rc = diagnose(["logs/runs/tdsmk/sac", "--json", out, "--quiet"])
+    assert rc == 0
+    result = json.load(open(out))
+    att = result["attribution"]
+    assert att is not None and att["windows"] >= 3
+    # the acceptance invariant: named phases + remainder tile the windows, with
+    # ≥95% of steady wall time carried by NAMED phases (env / replay_wait /
+    # train / checkpoint / logging / eval / analysis)
+    assert att["named_fraction"] >= 0.95, att
+    # a healthy CPU smoke must not produce false-positive critical findings
+    assert not [f for f in result["findings"] if f["severity"] == "critical"], result["findings"]
+
+    # the per-window invariant holds in the raw stream too
+    (stream,) = glob.glob("logs/runs/tdsmk/sac/version_*/telemetry.jsonl")
+    windows = [
+        e
+        for e in (json.loads(line) for line in open(stream))
+        if e["event"] == "window" and not e["final"]
+    ]
+    for w in windows:
+        assert abs(sum(w["phases"].values()) - w["wall_seconds"]) < 0.05 * w["wall_seconds"] + 0.01

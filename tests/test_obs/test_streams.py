@@ -1,0 +1,133 @@
+"""Unit tests for stream identity (rank/attempt/seq) and the telemetry stream
+merger (sheeprl_tpu/obs/streams.py)."""
+
+from __future__ import annotations
+
+import json
+
+from sheeprl_tpu.obs.jsonl import JsonlEventSink
+from sheeprl_tpu.obs.streams import (
+    discover_streams,
+    load_stream,
+    merge_streams,
+    merged_events,
+)
+
+
+# ---------------------------------------------------------------------------------
+# sink identity
+# ---------------------------------------------------------------------------------
+def test_sink_stamps_rank_attempt_and_monotonic_seq(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    sink = JsonlEventSink(path, rank=3, attempt=1)
+    sink.emit("start")
+    sink.emit("window", step=10)
+    sink.close()
+    events = load_stream(path)
+    assert [e["rank"] for e in events] == [3, 3]
+    assert [e["attempt"] for e in events] == [1, 1]
+    assert [e["seq"] for e in events] == [0, 1]
+
+
+def test_seq_is_shared_per_path_across_sinks(tmp_path):
+    """Several writers appending to ONE file (run telemetry + resilience monitor
+    lazy sink + supervisor across attempts) must produce one monotonic seq."""
+    path = str(tmp_path / "telemetry.jsonl")
+    a = JsonlEventSink(path, rank=0, attempt=0)
+    a.emit("start")
+    b = JsonlEventSink(path, rank=0, attempt=1)
+    b.emit("restart")
+    a.emit("window", step=5)
+    a.close()
+    b.close()
+    events = load_stream(path)
+    assert [e["seq"] for e in events] == [0, 1, 2]
+    # a DIFFERENT path starts its own sequence
+    other = JsonlEventSink(str(tmp_path / "telemetry.learner.jsonl"), rank=1)
+    other.emit("start")
+    other.close()
+    assert load_stream(str(tmp_path / "telemetry.learner.jsonl"))[0]["seq"] == 0
+
+
+def test_explicit_attempt_overrides_sink_default(tmp_path):
+    """The supervisor stamps its events with the attempt they decide about."""
+    path = str(tmp_path / "telemetry.jsonl")
+    sink = JsonlEventSink(path, rank=0, attempt=0)
+    sink.emit("restart", attempt=2, reason="crash")
+    sink.close()
+    assert load_stream(path)[0]["attempt"] == 2
+
+
+# ---------------------------------------------------------------------------------
+# legacy parsing
+# ---------------------------------------------------------------------------------
+def test_old_events_without_identity_fields_still_parse(tmp_path):
+    """Pre-identity recordings (no rank/attempt/seq) default to rank/attempt 0
+    and seq = line index."""
+    path = tmp_path / "telemetry.jsonl"
+    path.write_text(
+        json.dumps({"event": "start", "time": 1.0}) + "\n"
+        + json.dumps({"event": "window", "time": 2.0, "step": 10}) + "\n"
+    )
+    events = load_stream(str(path))
+    assert [(e["rank"], e["attempt"], e["seq"]) for e in events] == [(0, 0, 0), (0, 0, 1)]
+
+
+# ---------------------------------------------------------------------------------
+# discovery + merge
+# ---------------------------------------------------------------------------------
+def _write(path, events):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+
+
+def test_discover_streams_finds_per_role_and_per_version_files(tmp_path):
+    _write(tmp_path / "telemetry.jsonl", [{"event": "start", "time": 1.0}])
+    _write(tmp_path / "telemetry.learner.jsonl", [{"event": "start", "time": 1.0}])
+    _write(tmp_path / "version_0" / "telemetry.jsonl", [{"event": "start", "time": 1.0}])
+    (tmp_path / "diagnosis.json").write_text("{}")  # not a stream
+    found = discover_streams(str(tmp_path))
+    assert len(found) == 3
+    assert all(p.endswith(".jsonl") for p in found)
+    # pointing at a single file works too
+    assert discover_streams(str(tmp_path / "telemetry.jsonl")) == [str(tmp_path / "telemetry.jsonl")]
+
+
+def test_merge_orders_by_time_across_ranks_and_attempts(tmp_path):
+    """Simulated decoupled topology + one supervised restart: the merged stream
+    is globally time-ordered while each file's own order is preserved."""
+    player = [
+        {"event": "start", "time": 10.0, "rank": 0, "attempt": 0, "seq": 0},
+        {"event": "window", "time": 20.0, "rank": 0, "attempt": 0, "seq": 1, "step": 100},
+        {"event": "restart", "time": 30.0, "rank": 0, "attempt": 1, "seq": 2},
+        {"event": "window", "time": 40.0, "rank": 0, "attempt": 1, "seq": 3, "step": 200},
+    ]
+    learner = [
+        {"event": "start", "time": 11.0, "rank": 1, "attempt": 0, "seq": 0},
+        {"event": "window", "time": 25.0, "rank": 1, "attempt": 0, "seq": 1, "step": 150},
+        {"event": "summary", "time": 41.0, "rank": 1, "attempt": 0, "seq": 2},
+    ]
+    _write(tmp_path / "telemetry.jsonl", player)
+    _write(tmp_path / "telemetry.learner.jsonl", learner)
+    merged = merged_events(str(tmp_path))
+    assert [e["time"] for e in merged] == sorted(e["time"] for e in merged)
+    assert [(e["rank"], e["seq"]) for e in merged] == [
+        (0, 0), (1, 0), (0, 1), (1, 1), (0, 2), (0, 3), (1, 2),
+    ]
+    # every merged event knows its source stream
+    assert {e["stream"] for e in merged} == {"telemetry.jsonl", "telemetry.learner.jsonl"}
+
+
+def test_merge_preserves_per_stream_order_under_clock_skew():
+    """A stream whose clock jumped backwards must never be reordered against
+    itself — per-stream order is the invariant the detectors rely on."""
+    skewed = [
+        {"event": "a", "time": 100.0, "rank": 0, "attempt": 0, "seq": 0},
+        {"event": "b", "time": 90.0, "rank": 0, "attempt": 0, "seq": 1},  # clock jump
+        {"event": "c", "time": 110.0, "rank": 0, "attempt": 0, "seq": 2},
+    ]
+    other = [{"event": "x", "time": 95.0, "rank": 1, "attempt": 0, "seq": 0}]
+    merged = merge_streams([skewed, other])
+    names = [e["event"] for e in merged]
+    assert names.index("a") < names.index("b") < names.index("c")
+    assert len(merged) == 4

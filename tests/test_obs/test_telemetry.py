@@ -197,6 +197,165 @@ def test_window_train_seconds_exact_with_per_iteration_resets(tmp_path):
         t.disabled = saved_disabled
 
 
+def test_phases_breakdown_tiles_the_window(tmp_path):
+    """Named phases (env/train/checkpoint/logging/eval + replay_wait/analysis)
+    plus the `other` remainder must sum to the window wall time."""
+    import time as _time
+
+    from sheeprl_tpu.utils.timer import timer as t
+
+    saved, t.timers = t.timers, {}
+    saved_disabled, t.disabled = t.disabled, False
+    try:
+        cfg = _cfg(telemetry={"enabled": True}, log_every=100)
+        tel = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+        tel.step(0)
+        for name in ("Time/env_interaction_time", "Time/train_time", "Time/checkpoint_time", "Time/logging_time"):
+            with t(name):
+                _time.sleep(0.02)
+        tel.step(100)
+        tel.close(100)
+        window = [e for e in read_events(str(tmp_path / "telemetry.jsonl")) if e["event"] == "window"][0]
+        phases = window["phases"]
+        assert set(phases) == {
+            "env", "replay_wait", "train", "checkpoint", "logging", "eval", "analysis", "other",
+        }
+        for name in ("env", "train", "checkpoint", "logging"):
+            assert phases[name] >= 0.015, (name, phases)
+        assert abs(sum(phases.values()) - window["wall_seconds"]) <= 0.05 * window["wall_seconds"] + 0.005
+    finally:
+        t.timers = saved
+        t.disabled = saved_disabled
+
+
+def test_replay_wait_is_carved_out_of_train_phase(tmp_path):
+    """The sampler's wait counter becomes the replay_wait phase and is
+    subtracted from the train phase (train_seconds keeps the old semantics)."""
+    import time as _time
+
+    from sheeprl_tpu.utils.timer import timer as t
+
+    class WaitySampler:
+        def __init__(self):
+            self.wait = 0.0
+            self.empty = 0
+
+        def telemetry_snapshot(self):
+            return {
+                "is_async": True,
+                "wait_seconds": self.wait,
+                "sample_calls": 1,
+                "units": 1,
+                "occupancy_sum": 0.0,
+                "staleness_sum": 0.0,
+                "empty_waits": self.empty,
+                "pipeline_len": 2,
+                "depth": 2,
+            }
+
+    saved, t.timers = t.timers, {}
+    saved_disabled, t.disabled = t.disabled, False
+    try:
+        cfg = _cfg(telemetry={"enabled": True}, log_every=100)
+        tel = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+        sampler = WaitySampler()
+        tel.attach_sampler(sampler)
+        tel.step(0)
+        with t("Time/train_time"):
+            _time.sleep(0.05)
+        sampler.wait = 0.03  # of which 30ms was replay wait
+        sampler.empty = 3
+        tel.step(100)
+        tel.close(100)
+        window = [e for e in read_events(str(tmp_path / "telemetry.jsonl")) if e["event"] == "window"][0]
+        assert window["phases"]["replay_wait"] == pytest.approx(0.03, abs=0.005)
+        assert window["phases"]["train"] == pytest.approx(window["train_seconds"] - 0.03, abs=0.01)
+        assert window["prefetch"]["empty_waits"] == 3 and window["prefetch"]["depth"] == 2
+    finally:
+        t.timers = saved
+        t.disabled = saved_disabled
+
+
+def test_crash_path_flushes_summary_with_clean_exit_false(tmp_path):
+    """An exception that unwinds past a loop skips its telemetry.close(); the
+    cli finally (close_all_live_telemetry) must flush the summary at the last
+    seen step with clean_exit=false — and a later duplicate close is a no-op."""
+    from sheeprl_tpu.obs.telemetry import close_all_live_telemetry
+
+    cfg = _cfg(telemetry={"enabled": True}, log_every=100)
+    tel = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+    tel.step(0)
+    tel.observe_train(2, np.asarray([0.5]))
+    tel.step(120)
+    close_all_live_telemetry(clean_exit=False)  # the crash path
+    tel.close(200)  # the loop's own close must now be a no-op
+    events = read_events(str(tmp_path / "telemetry.jsonl"))
+    summaries = [e for e in events if e["event"] == "summary"]
+    assert len(summaries) == 1
+    assert summaries[0]["clean_exit"] is False and summaries[0]["step"] == 120
+    # nothing left live: a second sweep emits nothing
+    close_all_live_telemetry(clean_exit=False)
+    assert len(read_events(str(tmp_path / "telemetry.jsonl"))) == len(events)
+
+
+def test_in_loop_diagnosis_emits_health_event(tmp_path):
+    """With metric.telemetry.diagnosis on (default), the detector catalog runs
+    over the run's own window history and emits status=diagnosis health events
+    when the finding set changes."""
+    import time as _time
+
+    from sheeprl_tpu.utils.timer import timer as t
+
+    class StarvedSampler:
+        def __init__(self):
+            self.wait = 0.0
+            self.calls = 0
+
+        def telemetry_snapshot(self):
+            return {
+                "is_async": True,
+                "wait_seconds": self.wait,
+                "sample_calls": self.calls,
+                "units": self.calls,
+                "occupancy_sum": 0.0,
+                "staleness_sum": 0.0,
+                "empty_waits": self.calls,
+                "pipeline_len": 2,
+                "depth": 2,
+            }
+
+    saved, t.timers = t.timers, {}
+    saved_disabled, t.disabled = t.disabled, False
+    try:
+        cfg = _cfg(telemetry={"enabled": True}, log_every=100)
+        tel = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+        sampler = StarvedSampler()
+        tel.attach_sampler(sampler)
+        tel.step(0)
+        for step in (100, 200, 300):
+            with t("Time/train_time"):
+                _time.sleep(0.02)
+            # nearly all "train" time was replay wait: hard starvation
+            sampler.wait += 0.018
+            sampler.calls += 1
+            tel.observe_train(1, np.asarray([0.1]))
+            tel.step(step)
+        tel.close(300)
+        events = read_events(str(tmp_path / "telemetry.jsonl"))
+        diags = [e for e in events if e["event"] == "health" and e.get("status") == "diagnosis"]
+        assert diags, events
+        detectors = {f["detector"] for e in diags for f in e["findings"]}
+        assert "prefetch_starvation" in detectors
+        assert all(
+            {"detector", "severity", "summary", "suggestion"} <= set(f)
+            for e in diags
+            for f in e["findings"]
+        )
+    finally:
+        t.timers = saved
+        t.disabled = saved_disabled
+
+
 def test_unit_avals_preserve_sharding():
     """The dreamer-family register path abstracts one [T, B] slice of the staged
     [G, T, B] block; on a dp mesh the slice must keep its batch-axis sharding or

@@ -18,10 +18,15 @@ import os
 import pytest
 
 from sheeprl_tpu.cli import run
+from sheeprl_tpu.obs.diagnose import diagnose_events, run_detectors
 from sheeprl_tpu.resilience import PREEMPTED_EXIT_CODE, reset_faults, reset_preemption
 from sheeprl_tpu.utils.checkpoint import load_checkpoint
 
 pytestmark = pytest.mark.resilience
+
+
+def _detectors(findings):
+    return {f["detector"] for f in findings}
 
 
 @pytest.fixture(autouse=True)
@@ -156,6 +161,13 @@ def test_sac_sigterm_preempt_auto_resume():
     state = _final_state("tres", "sac-sigterm")
     # iter_num is stored ×world_size (=1); ×num_envs (=2) gives policy steps
     assert state["iter_num"] * 2 == _SAC_TOTAL
+    # the diagnosis engine reads the same recording: a preempt+resume is an INFO
+    # interruption (expected on preemptible capacity), not a crash — and nothing
+    # implausible fires on a run that only got preempted
+    findings = run_detectors(events)
+    (interruption,) = [f for f in findings if f["detector"] == "interruptions"]
+    assert interruption["severity"] == "info" and interruption["metrics"]["resumed"] == 1
+    assert _detectors(findings) <= {"interruptions"}
     # the buffer rode the emergency checkpoint: one row per iteration from BOTH
     # halves of the run, not just the post-restart stretch
     assert state["rb"]._pos == _SAC_TOTAL // 2
@@ -215,6 +227,14 @@ def test_sac_kill_during_checkpoint_write_auto_resume():
         ],
     )
     assert _final_state("tres", "sac-ckptkill")["iter_num"] * 2 == _SAC_TOTAL
+    # diagnosis over the recording: the kill-during-write surfaces as a WARNING
+    # crash-restart interruption (the supervisor masked a real crash), and
+    # nothing implausible rides along
+    findings = run_detectors(events)
+    (interruption,) = [f for f in findings if f["detector"] == "interruptions"]
+    assert interruption["severity"] == "warning" and interruption["metrics"]["restarts"] == 1
+    assert "error" in json.dumps(interruption["summary"]).lower() or interruption["evidence"]
+    assert _detectors(findings) <= {"interruptions"}
 
 
 @pytest.mark.timeout(240)
@@ -328,4 +348,13 @@ def test_env_step_fault_restarts_and_is_surfaced_in_telemetry(monkeypatch):
     assert restarts and restarts[0]["total"] >= 1
     summary = [e for e in events if e["event"] == "summary"][-1]
     assert summary["env_restarts"] >= 1
+    assert summary["clean_exit"] is True
     assert _final_state("tres", "dv3-envfault")["iter_num"] * 2 == _DV3_TOTAL
+    # diagnosis over the recording: the injected env_step fault triggers the
+    # env-instability detector; the run neither crashed nor was preempted, so
+    # the interruptions detector stays silent
+    diag = diagnose_events(events)
+    (env_finding,) = [f for f in diag["findings"] if f["detector"] == "env_instability"]
+    assert env_finding["metrics"]["restarts"] >= 1
+    assert "interruptions" not in _detectors(diag["findings"])
+    assert "nonfinite_loss" not in _detectors(diag["findings"])
