@@ -36,6 +36,18 @@ Select a single workload with BENCH_ALGO:
 
 The dreamer_v3 extra also records the MFU of the benchmark-size train program in
 its ``conditions.train_mfu`` block (and mirrors ``mfu`` top-level).
+
+Every workload's ``conditions`` carries a ``fingerprint`` (git sha, config hash,
+device kind/count — obs/fingerprint.py), so BENCH_r*.json files are
+self-describing for the regression gate:
+
+    python bench.py --against BENCH_prev.json --fail-on regression
+
+diffs this bench against a previous one (workloads matched by metric name +
+fingerprint-compatible conditions, default 5% relative threshold, ``--threshold
+0.08`` / ``--threshold metric=0.1`` to tune), attaches ``regressions`` to the
+final JSON line, and exits non-zero when the gate trips. The same diff is
+available offline as ``python sheeprl.py bench-diff old.json new.json``.
 """
 
 from __future__ import annotations
@@ -239,6 +251,11 @@ def _steady_window_run(args: list, steady_start: int) -> dict:
                 steady["telemetry"] = {
                     k: v for k, v in summaries[-1].items() if k not in ("event", "time")
                 }
+            # the run's own fingerprint (exact resolved config + live device) —
+            # this is what bench-diff matches workloads on
+            starts = [e for e in events if e.get("event") == "start"]
+            if starts and starts[-1].get("fingerprint"):
+                steady["fingerprint"] = starts[-1]["fingerprint"]
             # run the diagnosis detectors over the run's stream so BENCH JSONs
             # are regression-gateable on CAUSES (recompile storm, starved
             # pipeline, checkpoint-heavy windows), not just on env-steps/sec
@@ -299,6 +316,8 @@ def _steady_ab_result(
         # the prefetch-ON run's final telemetry summary: whole-run sps, compile
         # count/seconds, prefetch wait totals, peak memory — measured in-loop
         conditions["telemetry"] = steady["telemetry"]
+    if "fingerprint" in steady:
+        conditions["fingerprint"] = steady["fingerprint"]
     if "diagnosis" in steady:
         # the diagnose verdicts for the same run: detector findings + the share
         # of steady wall time attributed to named phases (obs/diagnose.py)
@@ -500,6 +519,30 @@ def _bench_dv3_mfu_flagship(size: str = "S") -> dict:
     }
 
 
+def _workload_fingerprint(algo: str) -> dict | None:
+    """The run fingerprint (obs/fingerprint.py: git sha, config hash over the
+    workload's benchmark exp, device kind/count from the probe) for workloads
+    that do not produce a telemetry stream of their own (whole-run wall-clock +
+    the standalone MFU extra) — steady-window workloads take the exact
+    fingerprint from their run's telemetry start event instead."""
+    exp = {
+        "dreamer_v3_mfu": "dreamer_v3_benchmarks",
+        "sac_steady": "sac_benchmarks",
+    }.get(algo, f"{algo}_benchmarks")
+    try:
+        from sheeprl_tpu.config import compose
+        from sheeprl_tpu.obs.fingerprint import run_fingerprint
+
+        fp = run_fingerprint(compose([f"exp={exp}"]))
+        probe = _accelerator_probe_cached()
+        if probe["alive"]:
+            fp["backend"] = probe["platform"]
+            fp["device_kind"] = probe["device_kind"]
+        return fp
+    except Exception:
+        return None
+
+
 def _bench(algo: str) -> dict:
     if algo == "dreamer_v3_mfu":
         result = _bench_dv3_mfu_flagship()
@@ -510,8 +553,13 @@ def _bench(algo: str) -> dict:
     else:
         result = _bench_wallclock(algo)
     # every workload records its peak memory so the BENCH_*.json trajectory
-    # tracks memory alongside throughput (HBM peak on a live chip, RSS on CPU)
-    result.setdefault("conditions", {})["peak_memory"] = _peak_memory()
+    # tracks memory alongside throughput (HBM peak on a live chip, RSS on CPU),
+    # and its fingerprint so BENCH_r*.json files are self-describing for
+    # `sheeprl.py bench-diff` / `bench.py --against`
+    conditions = result.setdefault("conditions", {})
+    conditions["peak_memory"] = _peak_memory()
+    if not conditions.get("fingerprint"):
+        conditions["fingerprint"] = _workload_fingerprint(algo)
     return result
 
 
@@ -575,11 +623,70 @@ def _bench_subprocess(algo: str, timeout: int = 1200) -> dict:
     return json.loads(stdout.strip().splitlines()[-1])
 
 
-def main() -> None:
+def _parse_args(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="sheeprl-tpu benchmark harness; prints one JSON line per "
+        "completed stage (a parser taking the LAST JSON line gets everything).",
+    )
+    parser.add_argument(
+        "--against",
+        default=None,
+        metavar="BENCH_prev.json|dir",
+        help="regression-gate this bench against a previous BENCH JSON (a dir "
+        "picks its newest BENCH_*.json); attaches `regressions` to the final "
+        "JSON line (sheeprl_tpu/obs/compare.py bench_diff)",
+    )
+    parser.add_argument(
+        "--threshold",
+        action="append",
+        default=[],
+        metavar="PCT|metric=PCT",
+        help="relative regression threshold for --against (default 0.05); "
+        "repeatable, metric=0.1 overrides one workload",
+    )
+    parser.add_argument(
+        "--fail-on",
+        choices=("regression",),
+        default=None,
+        help="with --against: exit non-zero when any workload regressed",
+    )
+    return parser.parse_args(argv)
+
+
+def _gate_against(result: dict, args) -> int:
+    """The bench regression gate (--against): diff this bench's result against a
+    previous BENCH JSON, attach the verdicts, reprint the final line so the
+    LAST JSON line carries them, and return the exit code under --fail-on.
+    The human diff report goes to stderr — stdout stays JSON-lines only."""
+    if not args.against:
+        return 0
+    try:
+        from sheeprl_tpu.obs.compare import bench_diff, format_bench_diff, parse_threshold_args
+
+        threshold, per_metric = parse_threshold_args(args.threshold)
+        diff = bench_diff(args.against, result, threshold=threshold, per_metric=per_metric)
+    except Exception as exc:  # an unreadable baseline must not lose the bench numbers
+        result["bench_diff_error"] = repr(exc)[:300]
+        print(json.dumps(result), flush=True)
+        return 1 if args.fail_on == "regression" else 0
+    result["regressions"] = [w for w in diff["workloads"] if w.get("status") == "regression"]
+    result["bench_diff"] = {
+        k: diff[k] for k in ("threshold", "improvements", "warnings", "missing_workloads")
+    }
+    print(format_bench_diff(diff), file=sys.stderr, flush=True)
+    print(json.dumps(result), flush=True)
+    return 1 if (args.fail_on == "regression" and diff["regressions"]) else 0
+
+
+def main() -> int:
+    args = _parse_args()
     algo = os.environ.get("BENCH_ALGO")
     if algo is not None:
-        print(json.dumps(_bench(algo)), flush=True)
-        return
+        result = _bench(algo)
+        print(json.dumps(result), flush=True)
+        return _gate_against(result, args)
     # Default: PPO headline, flushed IMMEDIATELY, then the Dreamer-V3 north star as a
     # budgeted extra; the final combined line repeats the headline plus the extra.
     result = _bench_subprocess("ppo", timeout=600)
@@ -638,6 +745,7 @@ def main() -> None:
     if extras:
         result["extras"] = extras
     print(json.dumps(result), flush=True)
+    return _gate_against(result, args)
 
 
 if __name__ == "__main__":

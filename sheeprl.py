@@ -1,16 +1,30 @@
 """Root launcher for no-install source checkouts (role of reference sheeprl.py):
 ``python sheeprl.py exp=ppo env=gym env.id=CartPole-v1``.
 
-Also hosts the offline telemetry tooling:
-``python sheeprl.py diagnose <run_dir>`` merges a run's telemetry.jsonl
-stream(s) and prints a rule-based bottleneck report (howto/observability.md).
+Also hosts the offline/observability tooling (howto/observability.md):
+
+- ``python sheeprl.py diagnose <run_dir>`` — merge a run's telemetry.jsonl
+  stream(s) and print a rule-based bottleneck report;
+- ``python sheeprl.py watch <run_dir>`` — live terminal monitor that follows
+  the stream(s) of a running (or about-to-start) run and exits with its status;
+- ``python sheeprl.py compare <run_a> <run_b>`` — fingerprint-aware cross-run
+  diff with noise-aware regression findings (``comparison.json``);
+- ``python sheeprl.py bench-diff <old.json> <new.json>`` — the BENCH_*.json
+  regression gate (``--fail-on regression`` for CI).
 """
 
 import sys
 
-from sheeprl_tpu.cli import diagnose, run
+from sheeprl_tpu.cli import bench_diff, compare, diagnose, run, watch
+
+_SUBCOMMANDS = {
+    "diagnose": diagnose,
+    "watch": watch,
+    "compare": compare,
+    "bench-diff": bench_diff,
+}
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "diagnose":
-        raise SystemExit(diagnose(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] in _SUBCOMMANDS:
+        raise SystemExit(_SUBCOMMANDS[sys.argv[1]](sys.argv[2:]))
     run()

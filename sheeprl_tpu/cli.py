@@ -377,6 +377,38 @@ def diagnose(args: Optional[Sequence[str]] = None) -> int:
     return diagnose_main(list(args if args is not None else sys.argv[1:]))
 
 
+def watch(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py watch <run_dir>`` — live terminal monitor over the
+    run's telemetry stream(s) (follow mode: torn lines retried, late per-role
+    streams and supervisor attempts picked up); exits with the run's status
+    when its summary event lands. See ``howto/observability.md``
+    ("Watching a live run")."""
+    from sheeprl_tpu.obs.watch import main as watch_main
+
+    return watch_main(list(args if args is not None else sys.argv[1:]))
+
+
+def compare(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py compare <run_a> <run_b>`` — fingerprint-aware diff of
+    two run dirs: per-window distributions (median/p90) of throughput, MFU and
+    phases, compile/memory/restart totals, deltas flagged beyond the runs' own
+    window spread, written to ``comparison.json``. See
+    ``howto/observability.md`` ("Comparing runs / gating benchmarks")."""
+    from sheeprl_tpu.obs.compare import main as compare_main
+
+    return compare_main(list(args if args is not None else sys.argv[1:]))
+
+
+def bench_diff(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py bench-diff <old.json> <new.json>`` — the BENCH_*.json
+    regression gate (also available as ``bench.py --against``): workloads
+    matched by metric name + fingerprint-compatible conditions, per-metric
+    relative thresholds, ``--fail-on regression`` for CI."""
+    from sheeprl_tpu.obs.compare import bench_diff_main
+
+    return bench_diff_main(list(args if args is not None else sys.argv[1:]))
+
+
 def check_configs_evaluation(cfg: dotdict) -> None:
     if cfg.float32_matmul_precision not in ("default", "high", "highest"):
         raise ValueError(

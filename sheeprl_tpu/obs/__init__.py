@@ -19,38 +19,63 @@ the step-level telemetry layer the Podracer-style throughput work calls for
   per-process / per-attempt streams (decoupled topologies, supervisor restarts);
 - :mod:`~sheeprl_tpu.obs.diagnose` — the rule-based diagnosis engine over merged
   streams (``python sheeprl.py diagnose <run_dir>``), also run in-loop at window
-  cadence and by ``bench.py`` (``conditions.diagnosis``).
+  cadence and by ``bench.py`` (``conditions.diagnosis``);
+- :mod:`~sheeprl_tpu.obs.fingerprint` — the run fingerprint (algo, config hash,
+  code version, device/mesh shape, key shapes) stamped into telemetry ``start``
+  events and bench ``conditions``, making streams comparable-by-construction;
+- :mod:`~sheeprl_tpu.obs.watch` — live terminal monitor
+  (``python sheeprl.py watch <run_dir>``) over the follow-mode stream reader;
+- :mod:`~sheeprl_tpu.obs.compare` — cross-run diff
+  (``python sheeprl.py compare``) and the BENCH_*.json regression gate
+  (``python sheeprl.py bench-diff`` / ``bench.py --against``).
 
 See ``howto/observability.md`` for the config keys, the JSONL schema and the
 detector catalog.
 """
 
+from sheeprl_tpu.obs.compare import bench_diff, compare_runs, profile_run
 from sheeprl_tpu.obs.compile_monitor import compile_snapshot, install_compile_monitor
 from sheeprl_tpu.obs.diagnose import diagnose_events, diagnose_run, run_detectors
+from sheeprl_tpu.obs.fingerprint import fingerprint_compatible, run_fingerprint
 from sheeprl_tpu.obs.jsonl import JsonlEventSink
 from sheeprl_tpu.obs.profiler import ProfilerWindow, resolve_profiler_config
-from sheeprl_tpu.obs.streams import discover_streams, merge_streams, merged_events
+from sheeprl_tpu.obs.streams import (
+    RunFollower,
+    StreamCursor,
+    discover_streams,
+    merge_streams,
+    merged_events,
+)
 from sheeprl_tpu.obs.telemetry import (
     NullTelemetry,
     RunTelemetry,
     build_role_telemetry,
     build_telemetry,
 )
+from sheeprl_tpu.obs.watch import watch_run
 
 __all__ = [
     "JsonlEventSink",
     "NullTelemetry",
     "ProfilerWindow",
+    "RunFollower",
     "RunTelemetry",
+    "StreamCursor",
+    "bench_diff",
     "build_role_telemetry",
     "build_telemetry",
+    "compare_runs",
     "compile_snapshot",
     "diagnose_events",
     "diagnose_run",
     "discover_streams",
+    "fingerprint_compatible",
     "install_compile_monitor",
     "merge_streams",
     "merged_events",
+    "profile_run",
     "resolve_profiler_config",
     "run_detectors",
+    "run_fingerprint",
+    "watch_run",
 ]

@@ -1,0 +1,701 @@
+"""Cross-run comparison + bench regression gating: "is B slower than A, and why?".
+
+``diagnose`` (obs/diagnose.py) explains ONE run; this module answers the
+questions that span two:
+
+- ``python sheeprl.py compare <run_a> <run_b>`` — fingerprint-aware diff of two
+  run dirs' telemetry streams. Per-window distributions (median / p10 / p90) of
+  throughput, MFU and the phase breakdown, plus compile totals, peak memory and
+  env restarts, with deltas flagged only when they exceed the runs' own
+  window-distribution spread (so ordinary run-to-run noise does not page
+  anyone). Findings share the severity/evidence/suggestion shape of
+  ``diagnose``; the verdict is printed human-readable and written to
+  ``comparison.json`` (``--json`` / ``--fail-on warning|critical`` for CI).
+- ``python sheeprl.py bench-diff <old.json> <new.json>`` (also
+  ``bench.py --against``) — the BENCH_*.json regression gate: workloads matched
+  by metric name + fingerprint-compatible conditions, per-metric relative
+  thresholds (default 5%), regressions attached machine-readably and gateable
+  with ``--fail-on regression``.
+
+Both tools read the run fingerprint (``obs/fingerprint.py``) stamped into
+telemetry ``start`` events and bench ``conditions`` — a mismatch (different
+config hash, backend, device shape) downgrades the comparison to a warning
+instead of silently diffing apples against oranges; ``code_version`` is exempt
+(comparing two commits is the point).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from sheeprl_tpu.obs.fingerprint import fingerprint_compatible
+
+_SEVERITY_RANK = {"critical": 0, "warning": 1, "info": 2}
+
+# flagging thresholds (module constants, like obs/diagnose.py's)
+REL_FLOOR = 0.02  # ignore sub-2% relative deltas even when beyond noise
+CRITICAL_DROP = 0.25  # a ≥25% throughput/MFU drop escalates to critical
+PHASE_SHIFT_ABS = 0.05  # a phase must grow ≥5 points of wall share to flag
+MEMORY_GROWTH = 0.10  # ≥10% peak-memory growth flags
+COMPILE_STORM_DELTA = 3  # ≥3 extra compiles escalates to critical
+DEFAULT_BENCH_THRESHOLD = 0.05  # bench-diff per-metric relative threshold
+
+_PHASE_KEYS = ("env", "replay_wait", "train", "checkpoint", "logging", "eval", "analysis", "other")
+
+_PHASE_SUGGESTIONS = {
+    "replay_wait": "the replay pipeline got slower: check buffer.prefetch.depth and host "
+    "sampling throughput (howto/replay_prefetch.md)",
+    "checkpoint": "checkpoint writes got heavier: checkpoint.async_save=true or raise "
+    "checkpoint.every",
+    "logging": "logging got heavier: raise metric.log_every or drop metric.log_level",
+    "other": "unattributed time grew: a loop phase may have lost its Time/* span "
+    "(howto/observability.md §phase attribution)",
+    "env": "env interaction got slower: check env worker health and vectorization",
+}
+
+
+def _f(value: Any) -> float:
+    try:
+        return float(value or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _quantile(sorted_values: Sequence[float], q: float) -> float:
+    """Deterministic linear-interpolation quantile over a pre-sorted list."""
+    n = len(sorted_values)
+    if n == 1:
+        return sorted_values[0]
+    pos = q * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_values[lo] * (1.0 - frac) + sorted_values[hi] * frac
+
+
+def _dist(values: Sequence[float]) -> Optional[Dict[str, Any]]:
+    """{n, median, p10, p90} of a window-metric sample (None when empty)."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return None
+    return {
+        "n": len(vals),
+        "median": round(_quantile(vals, 0.5), 6),
+        "p10": round(_quantile(vals, 0.1), 6),
+        "p90": round(_quantile(vals, 0.9), 6),
+    }
+
+
+def _spread(dist: Optional[Mapping[str, Any]]) -> float:
+    """Half the p10→p90 span: the run's own window-to-window noise scale."""
+    if not dist:
+        return 0.0
+    return max((_f(dist.get("p90")) - _f(dist.get("p10"))) / 2.0, 0.0)
+
+
+def _finding(
+    detector: str, severity: str, summary: str, suggestion: str, **metrics: Any
+) -> Dict[str, Any]:
+    return {
+        "detector": detector,
+        "severity": severity,
+        "summary": summary,
+        "evidence": [],
+        "suggestion": suggestion,
+        "metrics": metrics,
+    }
+
+
+# ---------------------------------------------------------------------------------
+# run profiling
+# ---------------------------------------------------------------------------------
+def profile_run(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Distill one merged event stream into the comparison profile: fingerprint,
+    per-window distributions, totals. Only the run's PRIMARY stream (rank-0
+    ``telemetry.jsonl``) feeds the window distributions — per-role learner
+    windows measure a different cadence and would pollute them."""
+    from sheeprl_tpu.obs.streams import is_primary_event as _primary
+
+    windows = [
+        e
+        for e in events
+        if e.get("event") == "window" and not e.get("final") and _primary(e)
+    ]
+    starts = [e for e in events if e.get("event") == "start" and _primary(e)]
+    summaries = [e for e in events if e.get("event") == "summary" and _primary(e)]
+    summary = summaries[-1] if summaries else None
+
+    phases: Dict[str, Optional[Dict[str, Any]]] = {}
+    tiled = [w for w in windows if isinstance(w.get("phases"), dict) and _f(w.get("wall_seconds")) > 0]
+    for key in _PHASE_KEYS:
+        phases[key] = _dist(
+            [_f(w["phases"].get(key)) / _f(w["wall_seconds"]) for w in tiled]
+        )
+
+    if summary and isinstance(summary.get("compile"), dict):
+        compile_totals = {
+            "count": int(_f(summary["compile"].get("count"))),
+            "seconds": round(_f(summary["compile"].get("seconds")), 3),
+        }
+    elif windows and isinstance(windows[-1].get("compile"), dict):
+        compile_totals = {
+            "count": int(_f(windows[-1]["compile"].get("count"))),
+            "seconds": round(_f(windows[-1]["compile"].get("seconds")), 3),
+        }
+    else:
+        compile_totals = {"count": 0, "seconds": 0.0}
+
+    hbm_peak = max(
+        [_f((w.get("hbm") or {}).get("peak_bytes")) for w in windows]
+        + [_f(summary.get("hbm_peak_bytes")) if summary else 0.0]
+        + [0.0]
+    )
+    rss_peak = max(
+        [_f(w.get("rss_peak_bytes")) for w in windows]
+        + [_f(summary.get("rss_peak_bytes")) if summary else 0.0]
+        + [0.0]
+    )
+    # env restarts: the counter is a per-ATTEMPT running total (each restart
+    # attempt's telemetry starts back at 0), so take the max within each attempt
+    # and sum across attempts — max over the whole stream would under-report
+    # supervised multi-attempt runs
+    restarts_per_attempt: Dict[int, int] = {}
+    for e in events:
+        if e.get("event") == "health" and e.get("status") == "env_restart":
+            total = int(_f(e.get("total")))
+        elif e.get("event") == "summary" and _primary(e):
+            total = int(_f(e.get("env_restarts")))
+        else:
+            continue
+        att = int(e.get("attempt") or 0)
+        restarts_per_attempt[att] = max(restarts_per_attempt.get(att, 0), total)
+    env_restarts = sum(restarts_per_attempt.values())
+    return {
+        "fingerprint": (starts[-1].get("fingerprint") if starts else None),
+        "windows": len(windows),
+        "attempts": 1 + max((int(e.get("attempt") or 0) for e in events), default=0),
+        "clean_exit": bool(summary.get("clean_exit", True)) if summary else None,
+        "sps": _dist([_f(w.get("sps")) for w in windows if w.get("sps") is not None]),
+        "mfu": _dist([_f(w.get("mfu")) for w in windows if isinstance(w.get("mfu"), (int, float))]),
+        "phases": phases,
+        "compile": compile_totals,
+        "hbm_peak_bytes": int(hbm_peak) or None,
+        "rss_peak_bytes": int(rss_peak) or None,
+        "env_restarts": env_restarts,
+        "summary_sps": _f(summary.get("sps")) if summary and summary.get("sps") is not None else None,
+    }
+
+
+# ---------------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------------
+def _delta_metric(
+    a: Optional[Mapping[str, Any]],
+    b: Optional[Mapping[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """Median delta of one window-distribution metric with the noise verdict."""
+    if not a or not b:
+        return None
+    ma, mb = _f(a.get("median")), _f(b.get("median"))
+    delta = mb - ma
+    noise = max(_spread(a), _spread(b))
+    rel = (delta / ma) if ma else None
+    return {
+        "a": dict(a),
+        "b": dict(b),
+        "delta": round(delta, 6),
+        "rel": round(rel, 4) if rel is not None else None,
+        "noise": round(noise, 6),
+        "beyond_noise": abs(delta) > noise,
+    }
+
+
+def compare_profiles(
+    profile_a: Mapping[str, Any], profile_b: Mapping[str, Any]
+) -> Dict[str, Any]:
+    """The fingerprint check + noise-aware metric deltas + findings for two run
+    profiles (A = the reference/older run, B = the candidate run)."""
+    findings: List[Dict[str, Any]] = []
+    fp_a, fp_b = profile_a.get("fingerprint"), profile_b.get("fingerprint")
+    compatible, mismatches = fingerprint_compatible(fp_a, fp_b)
+    if mismatches:
+        findings.append(
+            _finding(
+                "fingerprint_mismatch",
+                "warning",
+                "the runs are not fingerprint-compatible — they differ in "
+                + ", ".join(mismatches)
+                + "; the deltas below compare different experiments/hardware",
+                "compare runs of the same exp config on the same device shape, or "
+                "read the deltas as apples-to-oranges",
+                mismatches=mismatches,
+                a={k: (fp_a or {}).get(k) for k in mismatches},
+                b={k: (fp_b or {}).get(k) for k in mismatches},
+            )
+        )
+
+    metrics: Dict[str, Any] = {}
+
+    # throughput + MFU: regressions only when the median moved beyond the spread
+    for key, label, unit in (("sps", "throughput", "env-steps/sec"), ("mfu", "MFU", "")):
+        dm = _delta_metric(profile_a.get(key), profile_b.get(key))
+        metrics[key] = dm
+        if dm is None or dm["rel"] is None:
+            continue
+        if dm["beyond_noise"] and abs(dm["rel"]) >= REL_FLOOR:
+            pct = abs(dm["rel"])
+            if dm["delta"] < 0:
+                findings.append(
+                    _finding(
+                        f"{key}_regression",
+                        "critical" if pct >= CRITICAL_DROP else "warning",
+                        f"run B's median window {label} is {pct:.1%} below run A "
+                        f"({dm['b']['median']:g} vs {dm['a']['median']:g}"
+                        + (f" {unit}" if unit else "")
+                        + ") — beyond both runs' window spread",
+                        "read the phase deltas below for where the time went, then "
+                        "`sheeprl.py diagnose` run B for the causal finding",
+                        **{k: dm[k] for k in ("delta", "rel", "noise")},
+                    )
+                )
+            else:
+                findings.append(
+                    _finding(
+                        f"{key}_improvement",
+                        "info",
+                        f"run B's median window {label} is {pct:.1%} above run A "
+                        f"({dm['b']['median']:g} vs {dm['a']['median']:g})",
+                        "nothing to fix — record it",
+                        **{k: dm[k] for k in ("delta", "rel", "noise")},
+                    )
+                )
+
+    # phase shifts: a cost phase that grew materially beyond noise
+    metrics["phases"] = {}
+    for phase in _PHASE_KEYS:
+        dm = _delta_metric(
+            (profile_a.get("phases") or {}).get(phase), (profile_b.get("phases") or {}).get(phase)
+        )
+        metrics["phases"][phase] = dm
+        if dm is None or phase == "train":
+            continue
+        if dm["beyond_noise"] and dm["delta"] >= PHASE_SHIFT_ABS:
+            findings.append(
+                _finding(
+                    "phase_shift",
+                    "warning",
+                    f"the `{phase}` phase grew from {dm['a']['median']:.1%} to "
+                    f"{dm['b']['median']:.1%} of window wall time",
+                    _PHASE_SUGGESTIONS.get(
+                        phase, f"profile the `{phase}` phase of run B (metric.profiler.mode=window)"
+                    ),
+                    phase=phase,
+                    **{k: dm[k] for k in ("delta", "noise")},
+                )
+            )
+
+    # compile totals: any extra steady compiles are shape churn, not noise
+    ca, cb = profile_a.get("compile") or {}, profile_b.get("compile") or {}
+    metrics["compile"] = {"a": dict(ca), "b": dict(cb)}
+    extra = int(_f(cb.get("count"))) - int(_f(ca.get("count")))
+    if extra > 0:
+        findings.append(
+            _finding(
+                "compile_regression",
+                "critical" if extra >= COMPILE_STORM_DELTA else "warning",
+                f"run B compiled {extra} more XLA program(s) than run A "
+                f"({int(_f(cb.get('count')))} vs {int(_f(ca.get('count')))}, "
+                f"{_f(cb.get('seconds')):.1f}s vs {_f(ca.get('seconds')):.1f}s)",
+                "hunt for new shape churn between the two code/config versions; "
+                "`sheeprl.py diagnose` run B (recompile_storm) pinpoints the windows",
+                extra_compiles=extra,
+                seconds_a=round(_f(ca.get("seconds")), 3),
+                seconds_b=round(_f(cb.get("seconds")), 3),
+            )
+        )
+
+    # peak memory: prefer HBM when both runs report it, fall back to host RSS
+    for key, label in (("hbm_peak_bytes", "HBM"), ("rss_peak_bytes", "host RSS")):
+        pa, pb = profile_a.get(key), profile_b.get(key)
+        if not pa or not pb:
+            continue
+        metrics["memory"] = {"metric": key, "a": int(pa), "b": int(pb)}
+        growth = (pb - pa) / pa
+        if growth >= MEMORY_GROWTH:
+            findings.append(
+                _finding(
+                    "memory_regression",
+                    "warning",
+                    f"run B's peak {label} grew {growth:.0%} over run A "
+                    f"({pb / 2**30:.2f} vs {pa / 2**30:.2f} GiB)",
+                    "check for lost donation / new retained device arrays "
+                    "(howto/performance.md); compare the runs' program events",
+                    growth=round(growth, 4),
+                )
+            )
+        break
+
+    # env stability
+    ra, rb = int(_f(profile_a.get("env_restarts"))), int(_f(profile_b.get("env_restarts")))
+    metrics["env_restarts"] = {"a": ra, "b": rb}
+    if rb > ra:
+        findings.append(
+            _finding(
+                "env_instability_regression",
+                "warning",
+                f"run B absorbed {rb} env crash-restart(s) vs run A's {ra}",
+                "inspect run B's env worker logs (health events with status=env_restart)",
+                a=ra,
+                b=rb,
+            )
+        )
+
+    findings.sort(key=lambda f: (_SEVERITY_RANK.get(f["severity"], 3), f["detector"]))
+    return {
+        "fingerprint": {
+            "compatible": compatible,
+            "mismatches": mismatches,
+            "a": fp_a,
+            "b": fp_b,
+        },
+        "metrics": metrics,
+        "findings": findings,
+    }
+
+
+def compare_runs(
+    run_a: str, run_b: str, json_path: Optional[str] = None
+) -> Dict[str, Any]:
+    """Merge each run dir's telemetry stream(s), profile, compare, and write
+    ``comparison.json`` (to ``json_path``, or into run B's dir)."""
+    from sheeprl_tpu.obs.streams import discover_streams, merged_events
+
+    profiles = {}
+    for label, run_dir in (("a", run_a), ("b", run_b)):
+        if not discover_streams(run_dir):
+            raise FileNotFoundError(f"no telemetry*.jsonl stream found under {run_dir!r}")
+        profiles[label] = profile_run(merged_events(run_dir))
+    result = compare_profiles(profiles["a"], profiles["b"])
+    result["run_a"] = {"dir": str(run_a), **{k: profiles["a"][k] for k in ("windows", "attempts", "clean_exit")}}
+    result["run_b"] = {"dir": str(run_b), **{k: profiles["b"][k] for k in ("windows", "attempts", "clean_exit")}}
+    base = run_b if os.path.isdir(run_b) else os.path.dirname(run_b)
+    out = json_path or os.path.join(base, "comparison.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=2)
+        fh.write("\n")
+    result["json_path"] = out
+    return result
+
+
+def format_comparison(result: Mapping[str, Any]) -> str:
+    """Human report for one comparison result."""
+    lines: List[str] = []
+    ra, rb = result.get("run_a") or {}, result.get("run_b") or {}
+    lines.append(f"Run comparison — A: {ra.get('dir', '<events>')}  vs  B: {rb.get('dir', '<events>')}")
+    fp = result.get("fingerprint") or {}
+    lines.append(
+        "  fingerprint : "
+        + ("compatible" if fp.get("compatible", True) else f"MISMATCH ({', '.join(fp.get('mismatches') or [])})")
+    )
+    code_a = ((fp.get("a") or {}).get("code_version")) or "?"
+    code_b = ((fp.get("b") or {}).get("code_version")) or "?"
+    if code_a != code_b:
+        lines.append(f"  code        : {code_a} → {code_b}")
+    metrics = result.get("metrics") or {}
+    for key, label in (("sps", "throughput"), ("mfu", "mfu")):
+        dm = metrics.get(key)
+        if dm:
+            rel = f" ({dm['rel']:+.1%})" if dm.get("rel") is not None else ""
+            flag = "  ← beyond noise" if dm.get("beyond_noise") else ""
+            lines.append(
+                f"  {label:<11} : median {dm['a']['median']:g} → {dm['b']['median']:g}{rel}"
+                f"  [p10–p90 A: {dm['a']['p10']:g}–{dm['a']['p90']:g}]{flag}"
+            )
+    compile_m = metrics.get("compile") or {}
+    if compile_m:
+        a, b = compile_m.get("a") or {}, compile_m.get("b") or {}
+        lines.append(
+            f"  compiles    : {int(_f(a.get('count')))} ({_f(a.get('seconds')):.1f}s) → "
+            f"{int(_f(b.get('count')))} ({_f(b.get('seconds')):.1f}s)"
+        )
+    findings = result.get("findings") or []
+    if not findings:
+        lines.append("  verdict     : no findings — the runs are statistically alike")
+        return "\n".join(lines)
+    lines.append(f"  verdict     : {len(findings)} finding(s)")
+    for f in findings:
+        lines.append("")
+        lines.append(f"[{f['severity'].upper()}] {f['detector']}")
+        lines.append(f"  {f['summary']}")
+        lines.append(f"  try: {f['suggestion']}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py compare <run_a> <run_b>``: print the report, write
+    ``comparison.json``, gate with ``--fail-on``. Exit codes: 0 ok, 1 gated,
+    2 when a run has no stream."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="sheeprl.py compare",
+        description="Fingerprint-aware diff of two run dirs' telemetry streams: "
+        "per-window distributions, noise-aware deltas, findings.",
+    )
+    parser.add_argument("run_a", help="reference run dir (or telemetry*.jsonl file)")
+    parser.add_argument("run_b", help="candidate run dir (or telemetry*.jsonl file)")
+    parser.add_argument("--json", dest="json_path", default=None, help="where to write comparison.json")
+    parser.add_argument("--quiet", action="store_true", help="suppress the human report")
+    parser.add_argument(
+        "--fail-on",
+        choices=("warning", "critical"),
+        default=None,
+        help="exit 1 when any finding is at least this severe",
+    )
+    args = parser.parse_args(list(argv) if argv is not None else sys.argv[1:])
+    try:
+        result = compare_runs(args.run_a, args.run_b, json_path=args.json_path)
+    except FileNotFoundError as exc:
+        print(f"compare: {exc}", file=sys.stderr)
+        return 2
+    if not args.quiet:
+        print(format_comparison(result))
+        print(f"\nwrote {result['json_path']}")
+    if args.fail_on:
+        gate = _SEVERITY_RANK[args.fail_on]
+        if any(_SEVERITY_RANK.get(f["severity"], 3) <= gate for f in result["findings"]):
+            return 1
+    return 0
+
+
+# ---------------------------------------------------------------------------------
+# bench regression gate (BENCH_*.json trajectory)
+# ---------------------------------------------------------------------------------
+def load_bench_workloads(source: Any) -> List[Dict[str, Any]]:
+    """Flatten one bench output into its workload list (headline + extras).
+
+    Accepts a path or an already-parsed object, in any of the shapes the bench
+    trajectory contains: the raw JSON-lines stdout of ``bench.py`` (the last
+    line is the cumulative result), a single combined result object, or the
+    driver wrapper ``{"tail": "<json lines>"}`` the BENCH_r*.json files use.
+    A directory picks its newest ``BENCH_*.json`` (name order).
+    """
+    obj = source
+    if isinstance(source, (str, os.PathLike)):
+        path = str(source)
+        if os.path.isdir(path):
+            import glob
+
+            candidates = sorted(glob.glob(os.path.join(path, "BENCH_*.json")))
+            if not candidates:
+                raise FileNotFoundError(f"no BENCH_*.json under {path!r}")
+            path = candidates[-1]
+        with open(path) as fh:
+            text = fh.read()
+        try:
+            obj = json.loads(text)  # one (possibly pretty-printed) JSON document
+        except json.JSONDecodeError:
+            obj = _last_json_line(text)  # raw bench stdout: JSON lines
+    if isinstance(obj, Mapping) and "tail" in obj and "metric" not in obj:
+        obj = _last_json_line(str(obj["tail"]))
+    if not isinstance(obj, Mapping) or "metric" not in obj:
+        raise ValueError(f"not a bench result: {str(source)[:120]!r}")
+    workloads = [dict(obj)] + [dict(e) for e in obj.get("extras") or [] if isinstance(e, Mapping)]
+    for w in workloads:
+        w.pop("extras", None)
+    return workloads
+
+
+def _last_json_line(text: str) -> Any:
+    last = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            last = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    if last is None:
+        raise ValueError("no JSON object line found in bench output")
+    return last
+
+
+def _lower_is_better(unit: str) -> bool:
+    unit = (unit or "").lower()
+    return unit.startswith("seconds") or "seconds/" in unit
+
+
+def bench_diff(
+    old: Any,
+    new: Any,
+    *,
+    threshold: float = DEFAULT_BENCH_THRESHOLD,
+    per_metric: Optional[Mapping[str, float]] = None,
+) -> Dict[str, Any]:
+    """Diff two bench results workload-by-workload.
+
+    Matching: by metric name, then a fingerprint-compatibility check over each
+    side's ``conditions.fingerprint`` (``code_version`` exempt) — an
+    incompatible pair is reported as a warning, never as a regression. A
+    workload regresses when its value moved against its unit's direction
+    ("seconds"-style units are lower-is-better, rates higher-is-better) by more
+    than the metric's relative threshold (``per_metric`` overrides, default
+    ``threshold``)."""
+    old_by_name = {w["metric"]: w for w in load_bench_workloads(old)}
+    new_workloads = load_bench_workloads(new)
+    per_metric = dict(per_metric or {})
+
+    rows: List[Dict[str, Any]] = []
+    regressions: List[str] = []
+    improvements: List[str] = []
+    warnings_: List[str] = []
+    for w in new_workloads:
+        name = str(w["metric"])
+        thr = float(per_metric.get(name, threshold))
+        row: Dict[str, Any] = {"metric": name, "threshold": thr, "new": w.get("value")}
+        prev = old_by_name.get(name)
+        if prev is None:
+            row["status"] = "new"
+            rows.append(row)
+            continue
+        row["old"] = prev.get("value")
+        fp_old = (prev.get("conditions") or {}).get("fingerprint")
+        fp_new = (w.get("conditions") or {}).get("fingerprint")
+        compatible, mismatches = fingerprint_compatible(fp_old, fp_new)
+        if not compatible:
+            row["status"] = "incomparable"
+            row["fingerprint_mismatches"] = mismatches
+            warnings_.append(
+                f"{name}: conditions not fingerprint-compatible ({', '.join(mismatches)}) — "
+                "delta not gated"
+            )
+            rows.append(row)
+            continue
+        try:
+            old_v, new_v = float(prev["value"]), float(w["value"])
+        except (KeyError, TypeError, ValueError):
+            row["status"] = "unreadable"
+            rows.append(row)
+            continue
+        rel = (new_v - old_v) / old_v if old_v else None
+        row["rel_change"] = round(rel, 4) if rel is not None else None
+        lower_better = _lower_is_better(str(w.get("unit") or prev.get("unit") or ""))
+        row["direction"] = "lower-is-better" if lower_better else "higher-is-better"
+        if rel is None:
+            row["status"] = "unreadable"
+        elif (rel > thr) if lower_better else (rel < -thr):
+            row["status"] = "regression"
+            regressions.append(name)
+        elif (rel < -thr) if lower_better else (rel > thr):
+            row["status"] = "improvement"
+            improvements.append(name)
+        else:
+            row["status"] = "ok"
+        # steadier signal than sps alone: surface a compile-count increase of the
+        # same workload as a warning even when throughput stayed inside threshold
+        old_compiles = (((prev.get("conditions") or {}).get("telemetry") or {}).get("compile") or {}).get("count")
+        new_compiles = (((w.get("conditions") or {}).get("telemetry") or {}).get("compile") or {}).get("count")
+        if old_compiles is not None and new_compiles is not None and int(new_compiles) > int(old_compiles):
+            row["compile_delta"] = int(new_compiles) - int(old_compiles)
+            warnings_.append(
+                f"{name}: compile count grew {int(old_compiles)} → {int(new_compiles)} "
+                "(shape churn between versions?)"
+            )
+        rows.append(row)
+
+    missing = sorted(set(old_by_name) - {w["metric"] for w in new_workloads})
+    return {
+        "threshold": threshold,
+        "workloads": rows,
+        "regressions": regressions,
+        "improvements": improvements,
+        "warnings": warnings_,
+        "missing_workloads": missing,
+    }
+
+
+def format_bench_diff(diff: Mapping[str, Any]) -> str:
+    lines = [f"Bench diff (default threshold {diff.get('threshold', 0):.0%})"]
+    for row in diff.get("workloads") or []:
+        status = row.get("status", "?")
+        rel = row.get("rel_change")
+        detail = f" {rel:+.1%}" if isinstance(rel, (int, float)) else ""
+        old_v = row.get("old")
+        arrow = f"{old_v} → {row.get('new')}" if old_v is not None else f"{row.get('new')} (new)"
+        lines.append(f"  [{status.upper():<12}] {row['metric']}: {arrow}{detail}")
+    for w in diff.get("warnings") or []:
+        lines.append(f"  warning: {w}")
+    if diff.get("missing_workloads"):
+        lines.append(f"  missing vs old: {', '.join(diff['missing_workloads'])}")
+    n = len(diff.get("regressions") or [])
+    lines.append(f"  verdict: {n} regression(s)" if n else "  verdict: no regressions")
+    return "\n".join(lines)
+
+
+def parse_threshold_args(values: Sequence[str]) -> Tuple[float, Dict[str, float]]:
+    """``--threshold`` grammar shared by bench-diff and ``bench.py --against``:
+    a bare float sets the default, ``metric=float`` sets a per-metric override;
+    repeatable."""
+    default = DEFAULT_BENCH_THRESHOLD
+    per_metric: Dict[str, float] = {}
+    for raw in values:
+        if "=" in raw:
+            name, _, value = raw.partition("=")
+            per_metric[name.strip()] = float(value)
+        else:
+            default = float(raw)
+    return default, per_metric
+
+
+def bench_diff_main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py bench-diff <old.json> <new.json>``: exit 0 clean,
+    1 under ``--fail-on regression`` with regressions, 2 on unreadable input."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="sheeprl.py bench-diff",
+        description="Regression-gate two bench JSONs (BENCH_*.json trajectory): "
+        "workloads matched by metric + fingerprint, per-metric relative thresholds.",
+    )
+    parser.add_argument("old", help="previous bench JSON (file or dir of BENCH_*.json)")
+    parser.add_argument("new", help="candidate bench JSON (file or dir of BENCH_*.json)")
+    parser.add_argument(
+        "--threshold",
+        action="append",
+        default=[],
+        metavar="PCT|metric=PCT",
+        help=f"relative regression threshold (default {DEFAULT_BENCH_THRESHOLD}); "
+        "repeatable, metric=0.1 overrides one workload",
+    )
+    parser.add_argument("--json", dest="json_path", default=None, help="write the diff JSON here")
+    parser.add_argument("--quiet", action="store_true", help="suppress the human report")
+    parser.add_argument(
+        "--fail-on",
+        choices=("regression",),
+        default=None,
+        help="exit 1 when any workload regressed beyond its threshold",
+    )
+    args = parser.parse_args(list(argv) if argv is not None else sys.argv[1:])
+    try:
+        default_thr, per_metric = parse_threshold_args(args.threshold)
+        diff = bench_diff(args.old, args.new, threshold=default_thr, per_metric=per_metric)
+    except (OSError, ValueError) as exc:
+        print(f"bench-diff: {exc}", file=sys.stderr)
+        return 2
+    if args.json_path:
+        with open(args.json_path, "w") as fh:
+            json.dump(diff, fh, indent=2)
+            fh.write("\n")
+    if not args.quiet:
+        print(format_bench_diff(diff))
+    if args.fail_on == "regression" and diff["regressions"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,29 @@ the resilience monitor's lazy sink, the supervisor across attempts) produce one
 monotonic sequence — the ordering key ``obs/streams.py`` merges on. Old streams
 without these fields still parse; readers default them (see
 :func:`sheeprl_tpu.obs.streams.load_stream`).
+
+Durability contract (what live followers may rely on):
+
+- every event is serialized to ONE line and handed to the OS in ONE
+  ``write()`` call, immediately followed by ``flush()`` — the sink is opened
+  line-buffered and never holds an event in a userspace buffer between
+  ``emit()`` calls. A same-host reader polling the file (``tail -F``,
+  ``obs/streams.py`` follow mode, ``watch``) therefore sees every event as soon
+  as ``emit()`` returns; it can never starve behind an OS-buffered writer.
+- a reader may still observe a *torn tail*: the prefix of the final line of a
+  write that is in flight (or that died mid-``write()``). Torn tails are always
+  a strict prefix of one event — never interleaved fragments of two events,
+  because appends of up-to-PIPE_BUF-sized single ``write()`` calls do not
+  interleave on POSIX filesystems. Readers must treat an unparseable final
+  line as "retry later", not as corruption (:func:`read_events` and the stream
+  follower do).
+- ``fsync`` is deliberately NOT issued per event: the contract covers readers
+  on the same host (the watch/diagnose/bench consumers), not crash-consistency
+  of the last event across a machine power loss.
+- if a writer died mid-line and a LATER writer (a supervisor restart attempt)
+  appended to the same file, the torn fragment and the next event share one
+  line; :func:`parse_stream_line` recovers the trailing complete event instead
+  of dropping both.
 """
 
 from __future__ import annotations
@@ -105,17 +128,60 @@ class JsonlEventSink:
             self._fh = None
 
 
+def parse_stream_line(line: str) -> List[Dict[str, Any]]:
+    """Parse one stream line into its event dict(s), tolerating torn writes.
+
+    The crash-window shape this recovers: a writer died mid-line and a later
+    writer of the same file — a supervisor restart attempt — appended its next
+    event, so one physical line now reads ``{"event": "wind{"event":
+    "restart", ...}`` (torn fragment + event) or ``{"event": "summary",
+    ...}{"event": "restart", ...}`` (the fragment was a COMPLETE event whose
+    only missing byte was the newline — the dying attempt's summary, exactly
+    the event ``watch``'s exit protocol needs). A plain ``json.loads`` drops
+    everything; here every complete event on the line is recovered with
+    ``raw_decode`` from each ``{"`` boundary. Recovered objects must carry an
+    ``event`` key — that is what tells a real event apart from a *nested*
+    object inside a torn fragment (``"compile": {"count": 3}``), which is
+    skipped while the scan continues behind it. A line with no complete event
+    (a plain torn tail) yields ``[]`` — the follow-mode reader keeps such a
+    tail buffered and retries on the next poll.
+    """
+    line = line.strip()
+    if not line:
+        return []
+    try:
+        obj = json.loads(line)
+        return [obj] if isinstance(obj, dict) else []
+    except json.JSONDecodeError:
+        pass
+    decoder = json.JSONDecoder()
+    events: List[Dict[str, Any]] = []
+    pos = 0
+    while True:
+        start = line.find('{"', pos)
+        if start < 0:
+            return events
+        try:
+            obj, end = decoder.raw_decode(line, start)
+        except json.JSONDecodeError:
+            pos = start + 1
+            continue
+        if isinstance(obj, dict) and "event" in obj:
+            events.append(obj)
+            pos = end
+        else:
+            # a nested object inside a torn fragment: scan on INSIDE it — the
+            # real appended event may start anywhere behind this false match
+            pos = start + 1
+
+
 def read_events(path: str) -> List[Dict[str, Any]]:
-    """Parse a telemetry JSONL file back into a list of event dicts (skipping
-    torn trailing lines from an interrupted run)."""
+    """Parse a telemetry JSONL file back into a list of event dicts. Torn lines
+    never poison the read: a trailing in-flight line is skipped (the follow-mode
+    reader retries it instead), and an event appended after a crashed writer's
+    torn fragment is recovered (see :func:`parse_stream_line`)."""
     events: List[Dict[str, Any]] = []
     with open(path) as fh:
         for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
+            events.extend(parse_stream_line(line))
     return events

@@ -18,6 +18,14 @@ preserved regardless, which is the invariant the detectors rely on.
 
 Old streams written before the identity fields existed still merge: missing
 ``rank``/``attempt`` default to 0 and ``seq`` to the line index.
+
+Besides the offline merge, this module provides the *follow mode* ``watch``
+builds on (``tail -F`` semantics): :class:`StreamCursor` incrementally reads one
+growing file — a torn final line (a write in flight, or a crashed writer's
+unfinished tail) is held back and retried on the next poll, never dropped — and
+:class:`RunFollower` re-discovers streams every poll (the learner's per-role
+file appears seconds after the player's; supervisor attempts append to the same
+run-base file) and yields each poll's new events in merge order.
 """
 
 from __future__ import annotations
@@ -26,9 +34,28 @@ import heapq
 import os
 from typing import Any, Dict, List, Optional, Sequence
 
-from sheeprl_tpu.obs.jsonl import read_events
+from sheeprl_tpu.obs.jsonl import parse_stream_line, read_events
 
-__all__ = ["discover_streams", "load_stream", "merge_streams", "merged_events"]
+__all__ = [
+    "RunFollower",
+    "StreamCursor",
+    "discover_streams",
+    "is_primary_event",
+    "load_stream",
+    "merge_streams",
+    "merged_events",
+]
+
+
+def is_primary_event(event: Dict[str, Any]) -> bool:
+    """Whether an (annotated) event belongs to the run's PRIMARY stream: the
+    rank-0 ``telemetry.jsonl`` — the player's/controller's own file, also the
+    run-base path the supervisor pins across attempts. Per-role learner streams
+    are ``telemetry.<role>.jsonl`` siblings with their own cadence and summary;
+    both ``watch``'s exit protocol and ``compare``'s window distributions key on
+    this predicate, which is why it lives here and not in either consumer."""
+    stream = str(event.get("stream") or "telemetry.jsonl")
+    return int(event.get("rank") or 0) == 0 and os.path.basename(stream) == "telemetry.jsonl"
 
 
 def discover_streams(run_dir: str) -> List[str]:
@@ -96,3 +123,91 @@ def merged_events(run_dir: str) -> List[Dict[str, Any]]:
     base = run_dir if os.path.isdir(run_dir) else os.path.dirname(run_dir)
     paths = discover_streams(run_dir)
     return merge_streams([load_stream(p, base_dir=base) for p in paths])
+
+
+# ---------------------------------------------------------------------------------
+# follow mode (tail -F semantics for live runs)
+# ---------------------------------------------------------------------------------
+class StreamCursor:
+    """Incremental reader over one growing JSONL stream.
+
+    Each :meth:`poll` reads the bytes appended since the last poll and returns
+    the newly completed events, annotated like :func:`load_stream` (``stream``
+    label, identity defaults). Two invariants make this safe against a live
+    writer:
+
+    - only newline-terminated lines are consumed; a torn final line (the sink's
+      write may be in flight) stays in the pending buffer and is RETRIED on the
+      next poll — it is never dropped and never an error;
+    - a completed line that still fails to parse (a crashed writer's torn
+      fragment with a later attempt's event appended behind it) goes through
+      :func:`~sheeprl_tpu.obs.jsonl.parse_stream_line` recovery, so the
+      follow-on event survives.
+
+    A not-yet-existing file is a valid cursor target (polls return nothing until
+    it appears) — the learner's per-role stream is created seconds after the
+    player's.
+    """
+
+    def __init__(self, path: str, stream: Optional[str] = None) -> None:
+        self.path = str(path)
+        self.stream = stream if stream is not None else self.path
+        self._offset = 0
+        self._pending = b""
+        self._events_read = 0  # seq default for pre-identity events, as in load_stream
+
+    def poll(self) -> List[Dict[str, Any]]:
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(self._offset)
+                data = fh.read()
+        except OSError:
+            return []
+        if not data:
+            return []
+        self._offset += len(data)
+        buf = self._pending + data
+        *complete, self._pending = buf.split(b"\n")
+        events: List[Dict[str, Any]] = []
+        for raw in complete:
+            for event in parse_stream_line(raw.decode("utf-8", errors="replace")):
+                event["stream"] = self.stream
+                event.setdefault("rank", 0)
+                event.setdefault("attempt", 0)
+                event.setdefault("seq", self._events_read)
+                self._events_read += 1
+                events.append(event)
+        return events
+
+
+class RunFollower:
+    """Follow every telemetry stream of a (possibly still-materializing) run dir.
+
+    Each :meth:`poll` re-discovers ``telemetry*.jsonl`` files (streams appear
+    over a run's lifetime: versioned subdirs, late per-role files), drains every
+    cursor, and returns the batch ordered by the same key the offline merge
+    uses — so per-stream order is preserved and cross-stream order is wall-clock
+    within the batch. The run dir itself may not exist yet (``watch`` is
+    typically started alongside the launch)."""
+
+    def __init__(self, run_dir: str) -> None:
+        self.run_dir = str(run_dir)
+        self._cursors: Dict[str, StreamCursor] = {}
+
+    @property
+    def streams(self) -> List[str]:
+        """Relative labels of every stream discovered so far."""
+        return sorted(c.stream for c in self._cursors.values())
+
+    def poll(self) -> List[Dict[str, Any]]:
+        if os.path.exists(self.run_dir):
+            base = self.run_dir if os.path.isdir(self.run_dir) else os.path.dirname(self.run_dir)
+            for path in discover_streams(self.run_dir):
+                if path not in self._cursors:
+                    label = os.path.relpath(path, base) if base else path
+                    self._cursors[path] = StreamCursor(path, stream=label)
+        # the batch goes through the same k-way merge as the offline path, so a
+        # stream whose clock jumped backwards is still never reordered against
+        # itself (batch sort by time alone would break that invariant)
+        per_stream = [self._cursors[path].poll() for path in sorted(self._cursors)]
+        return merge_streams([events for events in per_stream if events])

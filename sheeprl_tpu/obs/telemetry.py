@@ -281,6 +281,15 @@ class RunTelemetry:
             self._compile_base = compile_snapshot()
             self._compile_last = dict(self._compile_base)
             dev = self._device
+            # the run fingerprint makes this stream comparable-by-construction:
+            # `compare`/`bench-diff` refuse-or-warn on mismatched fingerprints
+            # instead of silently diffing different experiments (obs/fingerprint.py)
+            from sheeprl_tpu.obs.fingerprint import run_fingerprint
+
+            try:
+                fingerprint: Optional[Dict[str, Any]] = run_fingerprint(cfg, fabric)
+            except Exception:
+                fingerprint = None
             start_event: Dict[str, Any] = dict(
                 platform=getattr(dev, "platform", None),
                 device_kind=getattr(dev, "device_kind", None),
@@ -289,6 +298,7 @@ class RunTelemetry:
                 every=self.every,
                 compile_warmup_steps=self.compile_warmup_steps,
                 profiler=dict(pcfg),
+                fingerprint=fingerprint,
             )
             # the in-loop diagnosis needs the start event too (the recompile
             # detector reads compile_warmup_steps from it), sink or no sink

@@ -1,0 +1,319 @@
+"""``python sheeprl.py watch <run_dir>`` — live terminal monitor for a running run.
+
+The telemetry stream (``telemetry.jsonl``, howto/observability.md) already
+carries everything an operator tails raw JSONL for; this module renders it as a
+compact refreshing status instead. Built on ``obs/streams.py`` follow mode
+(``tail -F`` semantics: torn final lines retried, late per-role streams and
+supervisor restart attempts picked up automatically), so ``watch`` can be
+started before, alongside, or long after the launch — it follows whatever run
+dir materializes.
+
+Per refresh the monitor shows: policy step + throughput (window sps), MFU,
+the phase-attribution bar (env / replay wait / train / checkpoint / logging /
+eval / other shares of the last window), device memory (HBM when the backend
+reports it, host RSS otherwise), prefetch pipeline occupancy/staleness, the
+latest health verdict and in-loop diagnosis findings, and the attempt/restart
+state of supervised runs.
+
+Exit protocol: when the run's ``summary`` event lands (flushed even on crash or
+preemption — see ``obs/telemetry.py``), ``watch`` exits with the run's status —
+``0`` for a clean exit, ``1`` otherwise. Because a *supervised* run writes an
+end-of-attempt summary before every restart, a summary only ends the watch
+after a short grace window with no ``restart``/``resume`` following it (a
+supervisor ``giveup`` ends it immediately). ``--timeout`` bounds the whole
+watch and exits ``2`` when it expires (also when no stream ever appeared).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, TextIO
+
+from sheeprl_tpu.obs.streams import RunFollower, is_primary_event as _is_primary
+
+__all__ = ["WatchState", "main", "watch_run"]
+
+# phase → (bar glyph, short label); order matches the loop's own wall-time layout
+_PHASE_GLYPHS = (
+    ("env", "E", "env"),
+    ("replay_wait", "R", "replay"),
+    ("train", "T", "train"),
+    ("checkpoint", "C", "ckpt"),
+    ("logging", "L", "log"),
+    ("eval", "V", "eval"),
+    ("analysis", "A", "analysis"),
+    ("other", "·", "other"),
+)
+_BAR_WIDTH = 32
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if not n:
+        return "?"
+    return f"{float(n) / 2**30:.2f}G"
+
+
+class WatchState:
+    """Accumulates the followed event stream into the rendered status. Pure
+    state machine (no IO, no clock) so unit tests can drive it event-by-event."""
+
+    def __init__(self) -> None:
+        self.start: Optional[Dict[str, Any]] = None
+        self.window: Optional[Dict[str, Any]] = None
+        self.attempt = 0
+        self.restarts = 0
+        self.last_restart: Optional[Dict[str, Any]] = None
+        self.env_restarts = 0
+        self.health = "unknown"
+        self.findings: List[Dict[str, Any]] = []
+        self.preempted = False
+        self.summary: Optional[Dict[str, Any]] = None  # primary-stream summary
+        self.gave_up = False
+        self.events_seen = 0
+
+    # -- event intake ------------------------------------------------------------
+
+    def consume(self, events: Sequence[Dict[str, Any]]) -> None:
+        for event in events:
+            self.events_seen += 1
+            self.attempt = max(self.attempt, int(event.get("attempt") or 0))
+            kind = event.get("event")
+            if kind == "start" and _is_primary(event):
+                self.start = event
+            elif kind == "window" and _is_primary(event):
+                self.window = event
+            elif kind == "health":
+                self._consume_health(event)
+            elif kind == "preempt":
+                self.preempted = True
+            elif kind in ("restart", "resume"):
+                self.restarts += int(kind == "restart")
+                self.last_restart = event
+                # the attempt is being restarted: the pending summary was
+                # end-of-attempt state, not the end of the run
+                self.summary = None
+            elif kind == "giveup":
+                self.gave_up = True
+            elif kind == "summary" and _is_primary(event):
+                self.summary = event
+
+    def _consume_health(self, event: Dict[str, Any]) -> None:
+        status = event.get("status")
+        if status == "diagnosis":
+            self.findings = list(event.get("findings") or [])
+        elif status == "env_restart":
+            self.env_restarts = max(self.env_restarts, int(event.get("total") or 0))
+        elif status in ("ok", "nonfinite", "no-train"):
+            self.health = str(status)
+        elif status == "stalled":
+            self.health = "stalled"
+
+    # -- exit protocol -----------------------------------------------------------
+
+    @property
+    def finished(self) -> bool:
+        """A definitive end: supervisor giveup, or a primary summary that no
+        restart has superseded (the caller applies the grace window)."""
+        return self.gave_up or self.summary is not None
+
+    @property
+    def exit_code(self) -> int:
+        if self.gave_up:
+            return 1
+        if self.summary is not None:
+            return 0 if self.summary.get("clean_exit", True) else 1
+        return 2  # still running / never finished — the timeout path
+
+    @property
+    def status_line(self) -> str:
+        if self.gave_up:
+            return "FAILED — supervisor exhausted its restart budget"
+        if self.summary is not None:
+            clean = bool(self.summary.get("clean_exit", True))
+            sps = self.summary.get("sps")
+            return (
+                ("clean exit" if clean else "UNCLEAN exit (crash/preempt)")
+                + (f" — overall {sps:.1f} sps" if isinstance(sps, (int, float)) else "")
+                + f", {self.summary.get('windows', 0)} window(s)"
+                + (f", {self.restarts} restart(s)" if self.restarts else "")
+            )
+        return "running"
+
+    # -- rendering ---------------------------------------------------------------
+
+    def _phase_bar(self, phases: Dict[str, Any], wall: float) -> str:
+        cells: List[str] = []
+        labels: List[str] = []
+        for key, glyph, label in _PHASE_GLYPHS:
+            try:
+                frac = max(float(phases.get(key) or 0.0), 0.0) / wall if wall > 0 else 0.0
+            except (TypeError, ValueError):
+                frac = 0.0
+            cells.extend(glyph * int(round(frac * _BAR_WIDTH)))
+            if frac >= 0.005 and key != "analysis" or frac >= 0.05:
+                labels.append(f"{label} {frac:.0%}")
+        bar = "".join(cells)[:_BAR_WIDTH].ljust(_BAR_WIDTH, " ")
+        return f"[{bar}] {'  '.join(labels)}"
+
+    def render(self, run_dir: str, elapsed: float, streams: Sequence[str]) -> str:
+        lines = [
+            f"watch {run_dir} · {elapsed:.0f}s · {len(streams)} stream(s) · "
+            f"attempt {self.attempt} · {self.status_line}"
+        ]
+        if self.window is None:
+            lines.append(
+                "  waiting for the first telemetry window"
+                + ("" if streams else " (no telemetry*.jsonl yet — is telemetry enabled?)")
+            )
+        else:
+            w = self.window
+            mfu = w.get("mfu")
+            hbm = w.get("hbm") or {}
+            mem = (
+                f"hbm {_fmt_bytes(hbm.get('bytes_in_use'))}"
+                + (f"/{_fmt_bytes(hbm.get('bytes_limit'))}" if hbm.get("bytes_limit") else "")
+                if hbm.get("bytes_in_use")
+                else f"rss {_fmt_bytes(w.get('rss_bytes'))}"
+            )
+            prefetch = w.get("prefetch") or {}
+            pipe = (
+                f"   pipeline occ {prefetch.get('occupancy', 0.0):.1f}"
+                f" stale {prefetch.get('staleness', 0.0):.1f}"
+                if prefetch.get("is_async")
+                else ""
+            )
+            compile_ = w.get("compile") or {}
+            lines.append(
+                f"  step {w.get('step')}   {w.get('sps', 0.0):.1f} sps   "
+                + (f"mfu {float(mfu):.1%}   " if isinstance(mfu, (int, float)) else "")
+                + f"{mem}   compiles {compile_.get('count', 0)}"
+                + pipe
+            )
+            phases = w.get("phases")
+            if isinstance(phases, dict):
+                wall = float(w.get("wall_seconds") or 0.0)
+                lines.append(f"  {self._phase_bar(phases, wall)}")
+        health_bits = [f"health {self.health}"]
+        if self.env_restarts:
+            health_bits.append(f"{self.env_restarts} env restart(s)")
+        if self.restarts:
+            reason = (self.last_restart or {}).get("reason")
+            health_bits.append(f"{self.restarts} attempt restart(s)" + (f" ({reason})" if reason else ""))
+        if self.preempted:
+            health_bits.append("preempt requested")
+        lines.append("  " + " · ".join(health_bits))
+        for f in self.findings[:4]:
+            lines.append(
+                f"  [{str(f.get('severity', '?')).upper()}] {f.get('detector')}: {f.get('summary')}"
+            )
+        return "\n".join(lines)
+
+
+def watch_run(
+    run_dir: str,
+    *,
+    interval: float = 0.5,
+    timeout: Optional[float] = None,
+    grace: Optional[float] = None,
+    plain: Optional[bool] = None,
+    out: Optional[TextIO] = None,
+) -> int:
+    """Follow ``run_dir`` until its summary lands (exit 0/1 per the run's
+    status) or ``timeout`` seconds pass (exit 2). ``grace`` is how long a
+    summary must stand un-superseded by a restart before it ends the watch
+    (default ``max(2*interval, 2s)``); ``plain`` forces append-only output
+    (auto-detected from tty otherwise)."""
+    out = out if out is not None else sys.stdout
+    if plain is None:
+        plain = not (hasattr(out, "isatty") and out.isatty())
+    grace = grace if grace is not None else max(2.0 * interval, 2.0)
+    follower = RunFollower(run_dir)
+    state = WatchState()
+    began = time.monotonic()
+    finished_at: Optional[float] = None
+    last_frame = ""
+    while True:
+        batch = follower.poll()
+        state.consume(batch)
+        now = time.monotonic()
+        if state.gave_up:
+            break
+        if state.finished:
+            if finished_at is None:
+                finished_at = now
+            elif now - finished_at >= grace:
+                # the grace window expired with the summary standing — but drain
+                # once more before committing to the verdict: a supervisor
+                # restart flushed between the last poll and now supersedes the
+                # end-of-attempt summary and the watch keeps following
+                state.consume(follower.poll())
+                if state.finished:
+                    break
+                finished_at = None
+        else:
+            finished_at = None
+        frame = state.render(run_dir, now - began, follower.streams)
+        if plain:
+            if frame != last_frame:
+                out.write(frame + "\n\n")
+                out.flush()
+                last_frame = frame
+        else:
+            out.write("\x1b[H\x1b[2J" + frame + "\n")
+            out.flush()
+        if timeout is not None and now - began >= timeout:
+            out.write(f"watch: timed out after {timeout:.0f}s ({state.status_line})\n")
+            out.flush()
+            return 2 if not state.finished else state.exit_code
+        time.sleep(interval)
+    # the verdict is committed (the pre-break drain already ran); render it
+    out.write(
+        state.render(run_dir, time.monotonic() - began, follower.streams)
+        + f"\nwatch: run finished — {state.status_line}\n"
+    )
+    out.flush()
+    return state.exit_code
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py watch <run_dir>`` entry point."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="sheeprl.py watch",
+        description="Live terminal monitor over a run's telemetry.jsonl stream(s): "
+        "step/sps/MFU, phase-attribution bar, memory, pipeline occupancy, health "
+        "and diagnosis findings, attempt/restart state. Exits with the run's "
+        "status when its summary event lands.",
+    )
+    parser.add_argument("run_dir", help="run directory (may not exist yet) or a telemetry*.jsonl file")
+    parser.add_argument("--interval", type=float, default=0.5, help="poll/refresh period in seconds")
+    parser.add_argument(
+        "--timeout", type=float, default=None, help="give up (exit 2) after this many seconds"
+    )
+    parser.add_argument(
+        "--grace",
+        type=float,
+        default=None,
+        help="seconds a summary must stand un-superseded by a supervisor restart "
+        "before the watch ends (default: max(2*interval, 2))",
+    )
+    parser.add_argument(
+        "--plain",
+        action="store_true",
+        help="append-only output (no screen clearing); auto when stdout is not a tty",
+    )
+    args = parser.parse_args(list(argv) if argv is not None else sys.argv[1:])
+    return watch_run(
+        args.run_dir,
+        interval=args.interval,
+        timeout=args.timeout,
+        grace=args.grace,
+        plain=True if args.plain else None,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,352 @@
+"""Tests for cross-run comparison and the bench regression gate
+(sheeprl_tpu/obs/compare.py): deterministic verdicts on the two recorded run
+dirs (tests/data/recorded_run{,_b} — run B carries a deliberate compile-storm +
+throughput delta), the fingerprint-mismatch warning path, and bench-diff over
+synthetic BENCH JSONs with --fail-on exit codes."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sheeprl_tpu.obs.compare import (
+    bench_diff,
+    bench_diff_main,
+    compare_profiles,
+    compare_runs,
+    format_bench_diff,
+    format_comparison,
+    load_bench_workloads,
+    main as compare_main,
+    profile_run,
+)
+from sheeprl_tpu.obs.streams import merged_events
+
+pytestmark = pytest.mark.telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_RUN_A = os.path.join(_REPO, "tests", "data", "recorded_run")
+_RUN_B = os.path.join(_REPO, "tests", "data", "recorded_run_b")
+
+
+def _names(findings):
+    return {f["detector"] for f in findings}
+
+
+def _by(findings, name):
+    return [f for f in findings if f["detector"] == name]
+
+
+# ---------------------------------------------------------------------------------
+# profiling
+# ---------------------------------------------------------------------------------
+def test_profile_run_distills_recorded_run():
+    profile = profile_run(merged_events(_RUN_A))
+    assert profile["windows"] == 4 and profile["attempts"] == 2
+    assert profile["sps"]["median"] == pytest.approx(10.0)
+    assert profile["clean_exit"] is True
+    assert profile["env_restarts"] == 1
+    # learner windows (rank 1, per-role stream) must NOT feed the distributions
+    assert profile["sps"]["n"] == 4
+    # pre-fingerprint recording: absent, not an error
+    assert profile["fingerprint"] is None
+
+
+def test_profile_run_sums_env_restarts_across_attempts():
+    """The env-restart counter is a per-attempt running total: a supervised run
+    with restarts in two attempts must report the SUM, not the max."""
+    events = [
+        {"event": "health", "time": 1.0, "status": "env_restart", "attempt": 0, "total": 4},
+        {"event": "summary", "time": 2.0, "attempt": 0, "env_restarts": 4, "clean_exit": False},
+        {"event": "health", "time": 3.0, "status": "env_restart", "attempt": 1, "total": 3},
+        {"event": "summary", "time": 4.0, "attempt": 1, "env_restarts": 3, "clean_exit": True},
+    ]
+    assert profile_run(events)["env_restarts"] == 7
+
+
+def test_profile_run_reads_fingerprint_and_compile_storm_from_run_b():
+    profile = profile_run(merged_events(_RUN_B))
+    assert profile["fingerprint"]["config_hash"] == "c0ffee123456"
+    assert profile["compile"]["count"] == 9
+    assert profile["sps"]["median"] == pytest.approx(7.0)
+
+
+# ---------------------------------------------------------------------------------
+# run comparison
+# ---------------------------------------------------------------------------------
+def test_compare_recorded_runs_flags_throughput_and_compile_storm(tmp_path):
+    out = str(tmp_path / "comparison.json")
+    result = compare_runs(_RUN_A, _RUN_B, json_path=out)
+    names = _names(result["findings"])
+    assert {"sps_regression", "compile_regression"} <= names
+    (sps,) = _by(result["findings"], "sps_regression")
+    assert sps["severity"] == "critical"  # 10 -> 7 sps is a 30% drop
+    assert sps["metrics"]["rel"] == pytest.approx(-0.3)
+    (comp,) = _by(result["findings"], "compile_regression")
+    assert comp["severity"] == "critical" and comp["metrics"]["extra_compiles"] == 9
+    # run A has no fingerprint (old recording): absent fields never veto
+    assert result["fingerprint"]["compatible"] is True
+    # deterministic: the same comparison yields byte-identical findings
+    again = compare_runs(_RUN_A, _RUN_B, json_path=str(tmp_path / "c2.json"))
+    assert again["findings"] == result["findings"]
+    on_disk = json.load(open(out))
+    assert _names(on_disk["findings"]) == names
+    report = format_comparison(result)
+    assert "sps_regression" in report and "compile_regression" in report
+
+
+def test_compare_identical_runs_is_quiet(tmp_path):
+    result = compare_runs(_RUN_B, _RUN_B, json_path=str(tmp_path / "c.json"))
+    assert result["findings"] == []
+    assert "statistically alike" in format_comparison(result)
+
+
+def test_small_delta_inside_window_noise_is_not_flagged():
+    def _prof(median, spread):
+        return {
+            "fingerprint": None,
+            "sps": {"n": 5, "median": median, "p10": median - spread, "p90": median + spread},
+            "mfu": None,
+            "phases": {},
+            "compile": {"count": 0, "seconds": 0.0},
+            "hbm_peak_bytes": None,
+            "rss_peak_bytes": None,
+            "env_restarts": 0,
+        }
+
+    # 5% drop inside a ±10% window spread: noise, not a finding
+    result = compare_profiles(_prof(100.0, 10.0), _prof(95.0, 10.0))
+    assert not _by(result["findings"], "sps_regression")
+    # the same 5% drop with tight windows IS a finding
+    result = compare_profiles(_prof(100.0, 1.0), _prof(95.0, 1.0))
+    (f,) = _by(result["findings"], "sps_regression")
+    assert f["severity"] == "warning"
+    # an improvement is reported as info, never gated
+    result = compare_profiles(_prof(95.0, 1.0), _prof(100.0, 1.0))
+    (f,) = _by(result["findings"], "sps_improvement")
+    assert f["severity"] == "info"
+
+
+def test_fingerprint_mismatch_warning_path(tmp_path):
+    """Two streams with different config hashes: the comparison still runs but
+    leads with a fingerprint_mismatch warning, and --fail-on warning gates."""
+    for name, config_hash, sps in (("a", "aaaa00000000", 10.0), ("b", "bbbb11111111", 10.0)):
+        d = tmp_path / name
+        d.mkdir()
+        events = [
+            {"event": "start", "time": 1.0, "fingerprint": {
+                "algo": "sac", "config_hash": config_hash, "code_version": "c" * 12,
+                "backend": "cpu", "device_kind": "cpu", "device_count": 1,
+                "mesh_shape": [1], "key_shapes": {"num_envs": 4}}},
+        ] + [
+            {"event": "window", "time": 10.0 * s, "step": 100 * s, "final": False,
+             "sps": sps, "wall_seconds": 10.0}
+            for s in range(1, 4)
+        ]
+        with open(d / "telemetry.jsonl", "w") as fh:
+            for e in events:
+                fh.write(json.dumps(e) + "\n")
+    result = compare_runs(str(tmp_path / "a"), str(tmp_path / "b"))
+    assert result["fingerprint"]["compatible"] is False
+    (f,) = _by(result["findings"], "fingerprint_mismatch")
+    assert f["severity"] == "warning" and f["metrics"]["mismatches"] == ["config_hash"]
+    # default comparison.json landed in run b's dir
+    assert os.path.isfile(tmp_path / "b" / "comparison.json")
+    rc = compare_main([str(tmp_path / "a"), str(tmp_path / "b"), "--quiet", "--fail-on", "warning"])
+    assert rc == 1
+
+
+def test_compare_cli_exit_codes(tmp_path):
+    out = str(tmp_path / "comparison.json")
+    assert compare_main([_RUN_A, _RUN_B, "--json", out, "--quiet"]) == 0
+    assert compare_main([_RUN_A, _RUN_B, "--json", out, "--quiet", "--fail-on", "critical"]) == 1
+    assert compare_main([_RUN_A, str(tmp_path / "nope"), "--quiet"]) == 2
+
+
+@pytest.mark.timeout(120)
+def test_compare_cli_subprocess_end_to_end(tmp_path):
+    """``python sheeprl.py compare a b`` — the operator entry point."""
+    out = str(tmp_path / "comparison.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "sheeprl.py"), "compare", _RUN_A, _RUN_B,
+         "--json", out, "--fail-on", "critical"],
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=110,
+    )
+    assert proc.returncode == 1, proc.stderr
+    assert "Run comparison" in proc.stdout and "compile_regression" in proc.stdout
+    findings = json.load(open(out))["findings"]
+    assert all({"detector", "severity", "summary", "suggestion"} <= set(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------------
+# bench-diff
+# ---------------------------------------------------------------------------------
+_FP = {
+    "algo": "ppo", "config_hash": "1111aaaa2222", "code_version": "oldsha",
+    "backend": "cpu", "device_kind": "cpu", "device_count": 1,
+}
+
+
+def _bench_json(ppo=100.0, sac=50.0, lat=2.0, sac_compiles=5, mfu_fp=None, code="sha"):
+    return {
+        "metric": "ppo_env_steps_per_sec",
+        "value": ppo,
+        "unit": "env-steps/sec",
+        "conditions": {"fingerprint": {**_FP, "code_version": code}},
+        "extras": [
+            {
+                "metric": "sac_env_steps_per_sec",
+                "value": sac,
+                "unit": "env-steps/sec (steady-state)",
+                "conditions": {
+                    "fingerprint": {**_FP, "algo": "sac", "code_version": code},
+                    "telemetry": {"compile": {"count": sac_compiles}},
+                },
+            },
+            {
+                "metric": "dreamer_v3_S_train_mfu",
+                "value": 0.30,
+                "unit": "MFU (fraction of chip peak bf16)",
+                "conditions": {"fingerprint": mfu_fp or {**_FP, "algo": "dreamer_v3", "code_version": code}},
+            },
+            {"metric": "train_step_latency", "value": lat, "unit": "seconds/train-step"},
+        ],
+    }
+
+
+def test_bench_diff_verdicts_directions_and_fingerprint_gate():
+    old = _bench_json(code="oldsha")
+    # ppo -6% (regression at 5%), sac -2% (ok) but compile count grew (warning),
+    # mfu workload on DIFFERENT hardware (incomparable), latency +15% on a
+    # lower-is-better unit (regression)
+    new = _bench_json(
+        ppo=94.0,
+        sac=49.0,
+        lat=2.3,
+        sac_compiles=8,
+        mfu_fp={**_FP, "algo": "dreamer_v3", "device_kind": "TPU v5e", "backend": "tpu"},
+        code="newsha",
+    )
+    diff = bench_diff(old, new)
+    by_metric = {w["metric"]: w for w in diff["workloads"]}
+    assert by_metric["ppo_env_steps_per_sec"]["status"] == "regression"
+    assert by_metric["sac_env_steps_per_sec"]["status"] == "ok"
+    assert by_metric["sac_env_steps_per_sec"]["compile_delta"] == 3
+    assert by_metric["dreamer_v3_S_train_mfu"]["status"] == "incomparable"
+    assert "backend" in by_metric["dreamer_v3_S_train_mfu"]["fingerprint_mismatches"]
+    assert by_metric["train_step_latency"]["status"] == "regression"
+    assert by_metric["train_step_latency"]["direction"] == "lower-is-better"
+    assert set(diff["regressions"]) == {"ppo_env_steps_per_sec", "train_step_latency"}
+    assert any("compile count grew" in w for w in diff["warnings"])
+    assert any("fingerprint-compatible" in w for w in diff["warnings"])
+    # code_version alone never vetoes a match (comparing commits is the point)
+    assert by_metric["ppo_env_steps_per_sec"].get("fingerprint_mismatches") is None
+    report = format_bench_diff(diff)
+    assert "REGRESSION" in report and "2 regression(s)" in report
+    # per-metric threshold override clears the ppo regression
+    diff = bench_diff(old, new, per_metric={"ppo_env_steps_per_sec": 0.10})
+    assert "ppo_env_steps_per_sec" not in diff["regressions"]
+    # a global threshold above every delta clears the gate entirely
+    diff = bench_diff(old, new, threshold=0.5)
+    assert diff["regressions"] == []
+
+
+def test_bench_diff_handles_improvements_new_and_missing_workloads():
+    old = _bench_json()
+    new = {
+        "metric": "ppo_env_steps_per_sec",
+        "value": 120.0,
+        "unit": "env-steps/sec",
+        "conditions": {"fingerprint": _FP},
+        "extras": [{"metric": "brand_new_metric", "value": 1.0, "unit": "env-steps/sec"}],
+    }
+    diff = bench_diff(old, new)
+    by_metric = {w["metric"]: w for w in diff["workloads"]}
+    assert by_metric["ppo_env_steps_per_sec"]["status"] == "improvement"
+    assert by_metric["brand_new_metric"]["status"] == "new"
+    assert set(diff["missing_workloads"]) == {
+        "dreamer_v3_S_train_mfu", "sac_env_steps_per_sec", "train_step_latency",
+    }
+    assert diff["regressions"] == []
+
+
+def test_load_bench_workloads_accepts_all_trajectory_shapes(tmp_path):
+    combined = _bench_json()
+    # raw JSON-lines stdout: headline first, cumulative line last
+    lines = tmp_path / "bench.out"
+    lines.write_text(
+        json.dumps({"metric": "ppo_env_steps_per_sec", "value": 1.0, "unit": "env-steps/sec"})
+        + "\n" + json.dumps(combined) + "\n"
+    )
+    assert len(load_bench_workloads(str(lines))) == 4
+    # the driver wrapper shape the checked-in BENCH_r*.json files use
+    wrapper = tmp_path / "BENCH_r01.json"
+    wrapper.write_text(json.dumps({"n": 1, "rc": 0, "tail": json.dumps(combined) + "\n"}))
+    assert len(load_bench_workloads(str(wrapper))) == 4
+    # a directory picks its newest BENCH_*.json by name
+    newer = _bench_json(ppo=200.0)
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(newer))
+    workloads = load_bench_workloads(str(tmp_path))
+    assert workloads[0]["value"] == 200.0
+    with pytest.raises(ValueError):
+        load_bench_workloads({"not": "a bench"})
+
+
+def test_bench_diff_cli_fail_on_exit_codes(tmp_path):
+    old_path, new_path = str(tmp_path / "old.json"), str(tmp_path / "new.json")
+    with open(old_path, "w") as fh:
+        json.dump(_bench_json(), fh)
+    with open(new_path, "w") as fh:
+        json.dump(_bench_json(ppo=90.0), fh)  # -10%: regression
+    out = str(tmp_path / "diff.json")
+    assert bench_diff_main([old_path, new_path, "--quiet", "--json", out]) == 0
+    assert json.load(open(out))["regressions"] == ["ppo_env_steps_per_sec"]
+    assert bench_diff_main([old_path, new_path, "--quiet", "--fail-on", "regression"]) == 1
+    # threshold override clears the gate
+    assert bench_diff_main(
+        [old_path, new_path, "--quiet", "--fail-on", "regression", "--threshold", "0.2"]
+    ) == 0
+    # unreadable input is a clean error, not a traceback
+    assert bench_diff_main([str(tmp_path / "nope.json"), new_path, "--quiet"]) == 2
+
+
+def test_bench_py_against_gates_regression(tmp_path):
+    """bench.py's --against gate (the function the CLI path drives, tested
+    in-process — a full bench run is far too heavy here): it must attach
+    `regressions` to the final JSON line and return non-zero under
+    --fail-on regression."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", os.path.join(_REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    old_path = str(tmp_path / "old.json")
+    with open(old_path, "w") as fh:
+        json.dump(_bench_json(ppo=100.0), fh)
+    result = {"metric": "ppo_env_steps_per_sec", "value": 90.0, "unit": "env-steps/sec",
+              "conditions": {"fingerprint": {**_FP, "code_version": "newsha"}}}
+    args = bench._parse_args(["--against", old_path, "--fail-on", "regression"])
+    import contextlib, io
+
+    stdout = io.StringIO()
+    with contextlib.redirect_stdout(stdout), contextlib.redirect_stderr(io.StringIO()):
+        rc = bench._gate_against(result, args)
+    assert rc == 1
+    final = json.loads(stdout.getvalue().strip().splitlines()[-1])
+    assert final["regressions"][0]["metric"] == "ppo_env_steps_per_sec"
+    # no regression -> exit 0 and an empty regressions list on the final line
+    result["value"] = 99.0
+    stdout = io.StringIO()
+    with contextlib.redirect_stdout(stdout), contextlib.redirect_stderr(io.StringIO()):
+        rc = bench._gate_against(result, bench._parse_args(["--against", old_path, "--fail-on", "regression"]))
+    assert rc == 0
+    assert json.loads(stdout.getvalue().strip().splitlines()[-1])["regressions"] == []

@@ -1,12 +1,14 @@
-"""Unit tests for stream identity (rank/attempt/seq) and the telemetry stream
-merger (sheeprl_tpu/obs/streams.py)."""
+"""Unit tests for stream identity (rank/attempt/seq), the telemetry stream
+merger, and the follow-mode reader (sheeprl_tpu/obs/streams.py)."""
 
 from __future__ import annotations
 
 import json
 
-from sheeprl_tpu.obs.jsonl import JsonlEventSink
+from sheeprl_tpu.obs.jsonl import JsonlEventSink, parse_stream_line, read_events
 from sheeprl_tpu.obs.streams import (
+    RunFollower,
+    StreamCursor,
     discover_streams,
     load_stream,
     merge_streams,
@@ -116,6 +118,106 @@ def test_merge_orders_by_time_across_ranks_and_attempts(tmp_path):
     ]
     # every merged event knows its source stream
     assert {e["stream"] for e in merged} == {"telemetry.jsonl", "telemetry.learner.jsonl"}
+
+
+def test_torn_write_with_appended_event_is_recovered(tmp_path):
+    """The crash window the durability contract names: attempt 0 died mid-line,
+    attempt 1 (the supervisor pins one shared file) appended its next event to
+    the SAME physical line. The torn fragment is dropped, the appended event
+    must survive — offline (read_events/merged_events) and in parse_stream_line."""
+    path = tmp_path / "telemetry.jsonl"
+    torn = '{"event": "window", "time": 5.0, "rank": 0, "attempt": 0, "seq": 3, "comp'
+    appended = {"event": "restart", "time": 6.0, "rank": 0, "attempt": 1, "seq": 4, "reason": "crash"}
+    path.write_text(
+        json.dumps({"event": "start", "time": 1.0, "rank": 0, "attempt": 0, "seq": 0}) + "\n"
+        + torn
+        + json.dumps(appended) + "\n"
+    )
+    assert parse_stream_line(torn + json.dumps(appended)) == [appended]
+    events = read_events(str(path))
+    assert [e["event"] for e in events] == ["start", "restart"]
+    merged = merged_events(str(path))
+    assert [e["event"] for e in merged] == ["start", "restart"]
+    # a nested-object boundary inside the torn fragment must not fool recovery
+    tricky = '{"event": "window", "compile": {"count": 3}, "tor' + json.dumps(appended)
+    assert parse_stream_line(tricky) == [appended]
+    # the fragment may be a COMPLETE event missing only its newline — the dying
+    # attempt's summary, which carries clean_exit: BOTH events must survive
+    summary = {"event": "summary", "time": 5.5, "attempt": 0, "seq": 3, "clean_exit": False}
+    assert parse_stream_line(json.dumps(summary) + json.dumps(appended)) == [summary, appended]
+
+
+def test_read_events_skips_trailing_torn_line(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    path.write_text(
+        json.dumps({"event": "start", "time": 1.0}) + "\n" + '{"event": "window", "ti'
+    )
+    assert [e["event"] for e in read_events(str(path))] == ["start"]
+
+
+# ---------------------------------------------------------------------------------
+# follow mode
+# ---------------------------------------------------------------------------------
+def test_cursor_retries_partial_final_line_on_next_poll(tmp_path):
+    """tail -F semantics: a torn final line (a write in flight) is held back and
+    completed by a later poll — never dropped, never an error."""
+    path = tmp_path / "telemetry.jsonl"
+    cursor = StreamCursor(str(path), stream="telemetry.jsonl")
+    assert cursor.poll() == []  # file does not exist yet
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"event": "start", "time": 1.0}) + "\n")
+        fh.write('{"event": "window", "time": 2.0, "st')  # torn mid-write
+        fh.flush()
+        events = cursor.poll()
+        assert [e["event"] for e in events] == ["start"]
+        assert cursor.poll() == []  # the torn tail stays pending, not dropped
+        fh.write('ep": 100}\n')
+        fh.flush()
+        (event,) = cursor.poll()
+        assert event["event"] == "window" and event["step"] == 100
+        # identity defaults mirror load_stream: seq = running event index
+        assert (event["rank"], event["attempt"], event["seq"]) == (0, 0, 1)
+
+
+def test_cursor_follows_attempt_rollover_in_one_file(tmp_path):
+    """Supervisor restarts append attempt-1 events to the same run-base file."""
+    path = tmp_path / "telemetry.jsonl"
+    cursor = StreamCursor(str(path))
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"event": "window", "time": 1.0, "attempt": 0, "seq": 0}) + "\n")
+        fh.flush()
+        assert [e["attempt"] for e in cursor.poll()] == [0]
+        fh.write(json.dumps({"event": "restart", "time": 2.0, "attempt": 1, "seq": 1}) + "\n")
+        fh.write(json.dumps({"event": "window", "time": 3.0, "attempt": 1, "seq": 2}) + "\n")
+        fh.flush()
+        events = cursor.poll()
+        assert [(e["event"], e["attempt"]) for e in events] == [("restart", 1), ("window", 1)]
+
+
+def test_follower_discovers_streams_appearing_late(tmp_path):
+    """The learner's per-role stream (and the run dir itself) may materialize
+    well after the watch started."""
+    run_dir = tmp_path / "run"
+    follower = RunFollower(str(run_dir))
+    assert follower.poll() == [] and follower.streams == []
+    run_dir.mkdir()
+    _write(run_dir / "telemetry.jsonl", [{"event": "start", "time": 1.0, "rank": 0, "seq": 0}])
+    assert [e["event"] for e in follower.poll()] == ["start"]
+    assert follower.streams == ["telemetry.jsonl"]
+    # the learner stream appears later; already-consumed streams only yield news
+    _write(
+        run_dir / "telemetry.learner.jsonl",
+        [{"event": "start", "time": 2.0, "rank": 1, "seq": 0}],
+    )
+    with open(run_dir / "telemetry.jsonl", "a") as fh:
+        fh.write(json.dumps({"event": "window", "time": 3.0, "rank": 0, "seq": 1}) + "\n")
+    events = follower.poll()
+    assert [(e["stream"], e["event"]) for e in events] == [
+        ("telemetry.learner.jsonl", "start"),
+        ("telemetry.jsonl", "window"),
+    ]
+    assert follower.streams == ["telemetry.jsonl", "telemetry.learner.jsonl"]
+    assert follower.poll() == []
 
 
 def test_merge_preserves_per_stream_order_under_clock_skew():

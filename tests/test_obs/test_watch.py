@@ -1,0 +1,202 @@
+"""Tests for the live run monitor (sheeprl_tpu/obs/watch.py): the WatchState
+machine and watch_run exit protocol on synthetic streams, plus a CPU smoke that
+follows a REAL short sac run end-to-end and asserts watch exits with the run's
+clean_exit status."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sheeprl_tpu.obs.watch import WatchState, watch_run
+
+pytestmark = pytest.mark.telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _event(kind, t, **fields):
+    return {"event": kind, "time": t, "rank": 0, "attempt": 0, "seq": 0, "stream": "telemetry.jsonl", **fields}
+
+
+def _window(step, sps=10.0, **fields):
+    return _event(
+        "window",
+        1000.0 + step,
+        step=step,
+        sps=sps,
+        wall_seconds=10.0,
+        mfu=0.31,
+        phases={"env": 2.0, "replay_wait": 1.0, "train": 5.0, "checkpoint": 0.5,
+                "logging": 0.2, "eval": 0.0, "analysis": 0.0, "other": 1.3},
+        compile={"count": 3, "seconds": 4.0},
+        prefetch={"occupancy": 1.8, "staleness": 1.1, "is_async": True},
+        rss_bytes=2 * 2**30,
+        **fields,
+    )
+
+
+# ---------------------------------------------------------------------------------
+# WatchState
+# ---------------------------------------------------------------------------------
+def test_state_tracks_window_health_and_findings():
+    state = WatchState()
+    state.consume([_event("start", 1.0), _window(100)])
+    assert not state.finished
+    frame = state.render("run", 12.0, ["telemetry.jsonl"])
+    assert "step 100" in frame and "10.0 sps" in frame and "mfu 31.0%" in frame
+    assert "[" in frame and "train" in frame  # the phase bar renders
+    state.consume(
+        [
+            _event("health", 2.0, status="ok"),
+            _event("health", 3.0, status="env_restart", total=2),
+            _event(
+                "health",
+                4.0,
+                status="diagnosis",
+                findings=[{"detector": "prefetch_starvation", "severity": "warning", "summary": "starved"}],
+            ),
+        ]
+    )
+    frame = state.render("run", 13.0, ["telemetry.jsonl"])
+    assert "health ok" in frame and "2 env restart(s)" in frame
+    assert "[WARNING] prefetch_starvation" in frame
+
+
+def test_learner_stream_events_do_not_drive_the_primary_status():
+    state = WatchState()
+    state.consume([_window(100)])
+    learner = _window(900, sps=99.0)
+    learner["stream"] = "telemetry.learner.jsonl"
+    learner["rank"] = 1
+    learner_summary = _event("summary", 2000.0, clean_exit=True)
+    learner_summary["stream"] = "telemetry.learner.jsonl"
+    learner_summary["rank"] = 1
+    state.consume([learner, learner_summary])
+    # the learner's window/summary must neither move the step nor end the watch
+    assert state.window["step"] == 100
+    assert not state.finished
+
+
+def test_summary_finishes_with_run_status_and_restart_supersedes_it():
+    state = WatchState()
+    state.consume([_window(100), _event("summary", 2000.0, clean_exit=True, sps=9.8, windows=3)])
+    assert state.finished and state.exit_code == 0
+    assert "clean exit" in state.status_line
+    # a supervised restart after an end-of-attempt summary keeps the watch alive
+    state.consume([_event("restart", 2001.0, attempt=1, reason="crash")])
+    assert not state.finished and state.attempt == 1
+    state.consume([_event("summary", 3000.0, attempt=1, clean_exit=False)])
+    assert state.finished and state.exit_code == 1
+    state.consume([_event("giveup", 3001.0)])
+    assert state.exit_code == 1 and "restart budget" in state.status_line
+
+
+# ---------------------------------------------------------------------------------
+# watch_run on synthetic run dirs
+# ---------------------------------------------------------------------------------
+def _write_stream(path, events):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+
+
+def test_watch_run_exits_with_clean_status(tmp_path):
+    _write_stream(
+        tmp_path / "run" / "telemetry.jsonl",
+        [
+            {"event": "start", "time": 1.0},
+            {"event": "window", "time": 2.0, "step": 100, "sps": 10.0, "wall_seconds": 10.0},
+            {"event": "summary", "time": 3.0, "clean_exit": True, "sps": 10.0, "windows": 1},
+        ],
+    )
+    out = io.StringIO()
+    rc = watch_run(str(tmp_path / "run"), interval=0.02, grace=0.05, plain=True, out=out)
+    assert rc == 0
+    assert "run finished" in out.getvalue() and "clean exit" in out.getvalue()
+
+
+def test_watch_run_unclean_summary_exits_one(tmp_path):
+    _write_stream(
+        tmp_path / "run" / "telemetry.jsonl",
+        [{"event": "start", "time": 1.0}, {"event": "summary", "time": 2.0, "clean_exit": False}],
+    )
+    rc = watch_run(str(tmp_path / "run"), interval=0.02, grace=0.05, plain=True, out=io.StringIO())
+    assert rc == 1
+
+
+def test_watch_run_times_out_without_summary(tmp_path):
+    _write_stream(
+        tmp_path / "run" / "telemetry.jsonl",
+        [{"event": "start", "time": 1.0}, {"event": "window", "time": 2.0, "step": 50, "sps": 5.0}],
+    )
+    out = io.StringIO()
+    rc = watch_run(str(tmp_path / "run"), interval=0.02, timeout=0.2, plain=True, out=out)
+    assert rc == 2
+    assert "timed out" in out.getvalue()
+
+
+# ---------------------------------------------------------------------------------
+# CPU smoke: watch a LIVE sac run end-to-end
+# ---------------------------------------------------------------------------------
+@pytest.mark.timeout(240)
+def test_watch_follows_live_sac_run(tmp_path):
+    """Launch a real short sac training run (telemetry on) and follow it with
+    watch while it is still writing: watch must pick the stream up as it
+    materializes, see windows, and exit with the run's clean_exit status."""
+    root = f"twch_{os.getpid()}"
+    child = subprocess.Popen(
+        [
+            sys.executable,
+            os.path.join(_REPO, "sheeprl.py"),
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "dry_run=False",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "buffer.memmap=False",
+            "buffer.size=512",
+            "env.num_envs=2",
+            "algo.learning_starts=4",
+            "algo.run_test=False",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.per_rank_batch_size=4",
+            "algo.total_steps=64",
+            "metric.telemetry.enabled=true",
+            "metric.telemetry.every=8",
+            "metric.telemetry.compile_warmup_steps=0",
+            f"root_dir={root}",
+            "run_name=sac",
+        ],
+        cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+    )
+    out = io.StringIO()
+    try:
+        rc = watch_run(
+            os.path.join(_REPO, "logs", "runs", root, "sac"),
+            interval=0.25,
+            timeout=200,
+            plain=True,
+            out=out,
+        )
+    finally:
+        child.wait(timeout=120)
+    assert child.returncode == 0, out.getvalue()
+    # the run closed cleanly, so watch must exit with the run's status: clean
+    assert rc == 0, out.getvalue()
+    text = out.getvalue()
+    assert "run finished" in text and "clean exit" in text
+    assert "step" in text and "sps" in text  # it rendered live windows
