@@ -10,18 +10,43 @@ Also hosts the offline/observability tooling (howto/observability.md):
 - ``python sheeprl.py compare <run_a> <run_b>`` — fingerprint-aware cross-run
   diff with noise-aware regression findings (``comparison.json``);
 - ``python sheeprl.py bench-diff <old.json> <new.json>`` — the BENCH_*.json
-  regression gate (``--fail-on regression`` for CI).
+  regression gate (``--fail-on regression`` for CI);
+- ``python sheeprl.py fault-matrix`` — the resilience fault matrix on the CPU
+  mesh (single-process + rank-targeted distributed fault smokes; see
+  ``howto/fault_tolerance.md``).
 """
 
+import os
 import sys
 
-from sheeprl_tpu.cli import bench_diff, compare, diagnose, run, watch
+
+def _gang_parent_pin() -> None:
+    """Duplicated from sheeprl_tpu/__main__.py on purpose: it must run BEFORE
+    the sheeprl_tpu import below (which executes jax computations), and
+    importing anything from the package would trigger exactly that. The gang
+    SUPERVISOR never trains, so pin it to the CPU backend."""
+    if os.environ.get("SHEEPRL_GANG_RANK") or os.environ.get("SHEEPRL_GANG_PLATFORM"):
+        return
+    for arg in sys.argv[1:]:
+        if arg.startswith("resilience.distributed.gang.processes="):
+            value = arg.split("=", 1)[1].strip()
+            if value.isdigit() and int(value) >= 2:
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+            return
+
+
+_gang_parent_pin()
+
+from sheeprl_tpu.cli import bench_diff, compare, diagnose, fault_matrix, run, watch  # noqa: E402
 
 _SUBCOMMANDS = {
     "diagnose": diagnose,
     "watch": watch,
     "compare": compare,
     "bench-diff": bench_diff,
+    "fault-matrix": fault_matrix,
 }
 
 if __name__ == "__main__":

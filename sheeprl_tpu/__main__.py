@@ -1,4 +1,52 @@
-from sheeprl_tpu.cli import run
+import os
+import sys
+
+
+def _gang_parent_pin() -> None:
+    """The gang SUPERVISOR never trains: pin it to the CPU backend so the
+    registry imports below don't initialize (and hold) an accelerator the
+    children need. Must run BEFORE any sheeprl_tpu import — populating the
+    algorithm registries executes jax computations, after which the platform
+    cannot change. Argv-sniffed because composing the config requires those
+    same imports."""
+    if os.environ.get("SHEEPRL_GANG_RANK") or os.environ.get("SHEEPRL_GANG_PLATFORM"):
+        return  # a gang CHILD: its platform is the run's business, not ours
+    for arg in sys.argv[1:]:
+        if arg.startswith("resilience.distributed.gang.processes="):
+            value = arg.split("=", 1)[1].strip()
+            if value.isdigit() and int(value) >= 2:
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+            return
+
+
+def _gang_child_bringup() -> None:
+    """Gang-child jax.distributed bring-up (resilience/distributed.py's
+    supervise_gang sets the SHEEPRL_GANG_* env). Must run BEFORE any sheeprl_tpu
+    import: populating the algorithm registries executes jax computations, and
+    jax.distributed.initialize refuses to run after the first one."""
+    if os.environ.get("SHEEPRL_GANG_PLATFORM"):
+        # the supervisor pins the platform for its children (e.g. a cpu gang
+        # must never touch an accelerator backend during bring-up)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["SHEEPRL_GANG_PLATFORM"])
+    coordinator = os.environ.get("SHEEPRL_COORDINATOR")
+    if not coordinator:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator,
+        int(os.environ.get("SHEEPRL_GANG_PROCESSES", "0") or 0) or None,
+        int(os.environ.get("SHEEPRL_GANG_RANK", "0") or 0),
+    )
+
 
 if __name__ == "__main__":
+    _gang_parent_pin()
+    _gang_child_bringup()
+    from sheeprl_tpu.cli import run
+
     run()

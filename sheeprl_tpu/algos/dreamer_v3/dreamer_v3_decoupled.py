@@ -85,7 +85,10 @@ def _warmup_train_step(fabric, cfg, train_phase, params, opt_state, observation_
     jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
 
 
-def _trainer_loop(fabric, cfg, train_phase, params, opt_state, moments_state, data_q, params_q, error, telemetry=None):
+def _trainer_loop(
+    fabric, cfg, train_phase, params, opt_state, moments_state, data_q, params_q, error,
+    telemetry=None, resilience=None,
+):
     """Learner role: consume replay blocks, run the fused per-gradient-step program
     over them, publish the act view (full state on request). The shutdown sentinel
     is answered with the FINAL full state so the player can flush a deferred last
@@ -95,13 +98,17 @@ def _trainer_loop(fabric, cfg, train_phase, params, opt_state, moments_state, da
     the threaded trainer shares the player's process, whose telemetry already
     observes it; a second writer would also race the shared timer registry).
     Its step axis is cumulative gradient steps (the only counter the learner
-    sees), not policy steps."""
+    sees), not policy steps. ``resilience``: likewise the learner PROCESS's peer
+    facade (heartbeats, rank-targeted faults, preempt-request publication,
+    dead-peer aborts) — the threaded trainer leaves it to the player's monitor."""
     from contextlib import nullcontext
 
     from sheeprl_tpu.obs import NullTelemetry
+    from sheeprl_tpu.resilience import NullResilience
     from sheeprl_tpu.utils.timer import timer
 
     telemetry = telemetry if telemetry is not None else NullTelemetry()
+    resilience = resilience if resilience is not None else NullResilience()
     train_span = timer("Time/train_time") if telemetry.enabled else nullcontext()
     try:
         mesh_size = fabric.world_size
@@ -137,6 +144,9 @@ def _trainer_loop(fabric, cfg, train_phase, params, opt_state, moments_state, da
             last_step = int(cum_steps) + units
             telemetry.observe_train(units, reply[2])
             telemetry.step(last_step)
+            # publishes this rank's preempt request / heartbeat step and raises
+            # RankFailureError on a declared-dead peer (never hang on one)
+            resilience.step(last_step)
     except BaseException as e:  # surface learner crashes to the player
         error["exc"] = e
         # a crash inside a channel collective leaves the plane desynced: further
@@ -166,8 +176,11 @@ class _ChannelTrainer:
         self._thread: Optional[threading.Thread] = None
         self._multi = multi_process
         if multi_process:
-            self.data_q: Any = BroadcastChannel(src=0)
-            self.params_q: Any = BroadcastChannel(src=1)
+            from sheeprl_tpu.resilience import channel_options
+
+            opts = channel_options(cfg)
+            self.data_q: Any = BroadcastChannel(src=0, **opts)
+            self.params_q: Any = BroadcastChannel(src=1, **opts)
             # the channels are stateful (KV sequence counters): expose them so
             # main()'s crash path releases the learners through the SAME instances
             protocol_done["data_q"] = self.data_q
@@ -256,65 +269,76 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
     moments_state = init_moments()
 
-    data_q, params_q = BroadcastChannel(src=0), BroadcastChannel(src=1)
-    geometry = data_q.get()
-    if geometry is None:  # player failed before the first round
-        params_q.put(None)  # pairs the player's cleanup ack-consume
-        return
-    if cfg.checkpoint.resume_from:
-        # mirror run_dreamer's resume on the slice (same shared-path assumption
-        # as the reference's fabric.load on all ranks)
-        from sheeprl_tpu.utils.checkpoint import load_checkpoint
-
-        try:
-            state = load_checkpoint(cfg.checkpoint.resume_from)
-            params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
-            opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
-            if state.get("moments") is not None:
-                moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
-        except Exception:
-            # a load failure must not strand the player: pass the warmup barrier
-            # it is waiting at, then surface the crash on the weight plane so its
-            # first round raises 'learner crashed mid-run'
-            try:
-                coordination_barrier("dv3_decoupled_warmup")
-                params_q.put(None)
-            except Exception:
-                pass
-            raise
-        # the slice only needs params/opt_state/moments; drop the player-side
-        # replay buffer the checkpoint carries
-        state.pop("rb", None)
-    _warmup_train_step(
-        fabric, cfg, train_phase, params, opt_state, observation_space, actions_dim,
-        geometry["player_world_size"],
-    )
-    coordination_barrier("dv3_decoupled_warmup")
-    # the learner slice's own telemetry stream (telemetry.learner.jsonl next to
-    # the player's — obs/streams.py merges them); one writer per slice
+    # the learner's peer facade comes up BEFORE the first blocking channel op:
+    # its heartbeat lets the player distinguish "learner is compiling" from
+    # "learner is dead" (the warmup compile can take minutes), and its abort
+    # check breaks our own waits; the telemetry stream is the learner slice's
+    # own (telemetry.learner.jsonl next to the player's — obs/streams.py merges
+    # them), one writer per slice
     from sheeprl_tpu.obs import build_role_telemetry
     from sheeprl_tpu.parallel import distributed
+    from sheeprl_tpu.resilience import build_resilience, channel_options
 
     telemetry = build_role_telemetry(
         fabric, cfg, "learner",
         rank=distributed.process_index(),
         leader=distributed.process_index() == 1,
     )
-    error: Dict[str, Any] = {}
-    _trainer_loop(
-        fabric, cfg, train_phase, params, opt_state, moments_state, data_q, params_q, error,
-        telemetry=telemetry,
-    )
-    if "exc" in error:
-        # pair the player's final sentinel — unless the crash WAS the channel,
-        # whose collectives are desynced and would hang instead of pairing
-        if not isinstance(error["exc"], ChannelError):
+    resilience = build_resilience(fabric, cfg, None, telemetry=telemetry)
+    opts = channel_options(cfg)
+    data_q, params_q = BroadcastChannel(src=0, **opts), BroadcastChannel(src=1, **opts)
+    geometry = data_q.get()
+    if geometry is None:  # player failed before the first round
+        params_q.put(None)  # pairs the player's cleanup ack-consume
+        resilience.finalize()
+        return
+    try:
+        if cfg.checkpoint.resume_from:
+            # mirror run_dreamer's resume on the slice (same shared-path assumption
+            # as the reference's fabric.load on all ranks)
+            from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
             try:
-                data_q.get()
-                params_q.put(None)
-            except ChannelError:
-                pass
-        raise error["exc"]
+                state = load_checkpoint(cfg.checkpoint.resume_from)
+                params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+                opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+                if state.get("moments") is not None:
+                    moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
+            except Exception:
+                # a load failure must not strand the player: pass the warmup barrier
+                # it is waiting at, then surface the crash on the weight plane so its
+                # first round raises 'learner crashed mid-run'
+                try:
+                    coordination_barrier("dv3_decoupled_warmup")
+                    params_q.put(None)
+                except Exception:
+                    pass
+                raise
+            # the slice only needs params/opt_state/moments; drop the player-side
+            # replay buffer the checkpoint carries
+            state.pop("rb", None)
+        _warmup_train_step(
+            fabric, cfg, train_phase, params, opt_state, observation_space, actions_dim,
+            geometry["player_world_size"],
+        )
+        coordination_barrier("dv3_decoupled_warmup")
+        error: Dict[str, Any] = {}
+        _trainer_loop(
+            fabric, cfg, train_phase, params, opt_state, moments_state, data_q, params_q, error,
+            telemetry=telemetry, resilience=resilience,
+        )
+        if "exc" in error:
+            # pair the player's final sentinel — unless the crash WAS the channel,
+            # whose collectives are desynced and would hang instead of pairing
+            if not isinstance(error["exc"], ChannelError):
+                try:
+                    data_q.get()
+                    params_q.put(None)
+                except ChannelError:
+                    pass
+            raise error["exc"]
+    finally:
+        resilience.finalize()
 
 
 @register_algorithm(decoupled=True)
@@ -353,9 +377,12 @@ def main(fabric, cfg: Dict[str, Any]):
         # desynced and another lockstep collective would hang, not raise
         if multi_process and not protocol_done["done"] and not isinstance(e, ChannelError):
             try:
+                from sheeprl_tpu.resilience import channel_options
+
                 # reuse the live (stateful) channel instances when they exist
-                protocol_done.get("data_q", BroadcastChannel(src=0)).put(None)
-                protocol_done.get("params_q", BroadcastChannel(src=1)).get()
+                opts = channel_options(cfg)
+                protocol_done.get("data_q", BroadcastChannel(src=0, **opts)).put(None)
+                protocol_done.get("params_q", BroadcastChannel(src=1, **opts)).get()
             except Exception:
                 pass
         raise

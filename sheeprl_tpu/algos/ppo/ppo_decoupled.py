@@ -65,6 +65,7 @@ def _trainer_loop(
     geometry: Optional[Dict[str, int]] = None,
     resume_state: Optional[Dict[str, Any]] = None,
     telemetry=None,
+    resilience=None,
 ):
     """Learner role (reference trainer(), ppo_decoupled.py:368-620): consume rollout
     blocks, run the fused epochs×minibatches program on the mesh, publish params.
@@ -77,10 +78,15 @@ def _trainer_loop(
 
     ``telemetry``: the learner role's own stream (two-process topology only —
     the threaded trainer shares the player's process, whose telemetry already
-    observes it; a second writer would also race the shared timer registry)."""
+    observes it; a second writer would also race the shared timer registry).
+    ``resilience``: likewise the learner PROCESS's peer facade (heartbeats,
+    rank-targeted faults, preempt-request publication, dead-peer aborts)."""
     from contextlib import nullcontext
 
+    from sheeprl_tpu.resilience import NullResilience
+
     telemetry = telemetry if telemetry is not None else NullTelemetry()
+    resilience = resilience if resilience is not None else NullResilience()
     train_span = timer("Time/train_time") if telemetry.enabled else nullcontext()
     try:
         world_size = fabric.world_size
@@ -206,6 +212,9 @@ def _trainer_loop(
             rounds += 1
             telemetry.observe_train(1, reply[2])
             telemetry.step(rounds * policy_steps_per_iter)
+            # publishes this rank's preempt request / heartbeat step and raises
+            # RankFailureError on a declared-dead peer (never hang on one)
+            resilience.step(rounds * policy_steps_per_iter)
     except BaseException as e:  # surface learner crashes to the player
         error["exc"] = e
         # If the crash came from a channel collective the broadcast plane is
@@ -239,7 +248,20 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     key = fabric.seed_everything(cfg.seed)
     key, agent_key = jax.random.split(key)
     agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
-    data_q, params_q = _BcastChannel(src=0), _BcastChannel(src=1)
+    # the learner's peer facade comes up BEFORE the first blocking channel op:
+    # its heartbeat lets the player distinguish "learner is compiling" from
+    # "learner is dead", and its abort check breaks our own waits
+    from sheeprl_tpu.parallel import distributed
+    from sheeprl_tpu.resilience import channel_options
+
+    telemetry = build_role_telemetry(
+        fabric, cfg, "learner",
+        rank=distributed.process_index(),
+        leader=distributed.process_index() == 1,
+    )
+    resilience = build_resilience(fabric, cfg, None, telemetry=telemetry)
+    opts = channel_options(cfg)
+    data_q, params_q = _BcastChannel(src=0, **opts), _BcastChannel(src=1, **opts)
     # geometry handshake: the PLAYER's rollout shape drives the learner's minibatch
     # math — the two roles may own different device counts (env-hosts vs learner
     # slice), so deriving it from the learner's own world_size would corrupt
@@ -247,6 +269,7 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     geometry = data_q.get()
     if geometry is None:  # player failed before the first rollout
         params_q.put(None)  # pairs the player's cleanup ack-consume
+        resilience.finalize()
         return
     resume_state = None
     if cfg.checkpoint.resume_from:
@@ -264,32 +287,26 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
             except _ChannelError:
                 pass
             raise
-    # the learner slice's own telemetry stream (telemetry.learner.jsonl next to
-    # the player's — obs/streams.py merges them); one writer per slice
-    from sheeprl_tpu.parallel import distributed
-
-    telemetry = build_role_telemetry(
-        fabric, cfg, "learner",
-        rank=distributed.process_index(),
-        leader=distributed.process_index() == 1,
-    )
     error: Dict[str, Any] = {}
-    _trainer_loop(
-        fabric, cfg, agent, params, data_q, params_q, error, geometry=geometry,
-        resume_state=resume_state, telemetry=telemetry,
-    )
-    if "exc" in error:
-        # the player is (or will be) blocked sending its final sentinel — consume
-        # it and ack so the lockstep broadcasts stay paired, then surface the crash.
-        # Skip the pairing when the crash WAS the channel: its collectives are
-        # desynced and would hang instead of pairing.
-        if not isinstance(error["exc"], _ChannelError):
-            try:
-                data_q.get()
-                params_q.put(None)
-            except _ChannelError:
-                pass
-        raise error["exc"]
+    try:
+        _trainer_loop(
+            fabric, cfg, agent, params, data_q, params_q, error, geometry=geometry,
+            resume_state=resume_state, telemetry=telemetry, resilience=resilience,
+        )
+        if "exc" in error:
+            # the player is (or will be) blocked sending its final sentinel — consume
+            # it and ack so the lockstep broadcasts stay paired, then surface the crash.
+            # Skip the pairing when the crash WAS the channel: its collectives are
+            # desynced and would hang instead of pairing.
+            if not isinstance(error["exc"], _ChannelError):
+                try:
+                    data_q.get()
+                    params_q.put(None)
+                except _ChannelError:
+                    pass
+            raise error["exc"]
+    finally:
+        resilience.finalize()
 
 
 @register_algorithm(decoupled=True)
@@ -403,8 +420,11 @@ def main(fabric, cfg: Dict[str, Any]):
         # ---------------- channels + learner (thread or separate process) -----------
         error: Dict[str, Any] = {}
         if two_process:
-            data_q = _BcastChannel(src=0)
-            params_q = _BcastChannel(src=1)
+            from sheeprl_tpu.resilience import channel_options
+
+            opts = channel_options(cfg)
+            data_q = _BcastChannel(src=0, **opts)
+            params_q = _BcastChannel(src=1, **opts)
             trainer = None
             # geometry handshake, then the learner enters its data loop; a None releases
             # it if the player dies before the first rollout
@@ -665,10 +685,13 @@ def main(fabric, cfg: Dict[str, Any]):
         # but every between-collectives crash point exits both roles.
         if two_process and not _protocol_done and not isinstance(e, _ChannelError):
             try:
+                from sheeprl_tpu.resilience import channel_options
+
                 # the channels are stateful: reuse the live instances when the
                 # crash happened after their creation
-                (data_q if data_q is not None else _BcastChannel(src=0)).put(None)
-                (params_q if params_q is not None else _BcastChannel(src=1)).get()
+                opts = channel_options(cfg)
+                (data_q if data_q is not None else _BcastChannel(src=0, **opts)).put(None)
+                (params_q if params_q is not None else _BcastChannel(src=1, **opts)).get()
             except Exception:
                 pass
         raise

@@ -32,7 +32,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.parallel.distributed import BroadcastChannel, ChannelError, replicated_to_host
 from sheeprl_tpu.obs import NullTelemetry, build_role_telemetry, build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import NullResilience, build_resilience, channel_options
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -44,14 +44,18 @@ from sheeprl_tpu.utils.utils import ActPlacement, Ratio, save_configs
 
 def _trainer_loop(
     fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error, geometry=None,
-    resume_state=None, telemetry=None,
+    resume_state=None, telemetry=None, resilience=None,
 ):
     # ``telemetry``: the learner role's own stream (two-process topology only —
     # the threaded trainer shares the player's process, whose telemetry already
-    # observes it; a second writer would also race the shared timer registry)
+    # observes it; a second writer would also race the shared timer registry).
+    # ``resilience``: likewise the learner PROCESS's peer facade (heartbeats,
+    # rank-targeted faults, preempt-request publication, dead-peer aborts) —
+    # the threaded trainer leaves all of that to the player's monitor.
     from contextlib import nullcontext
 
     telemetry = telemetry if telemetry is not None else NullTelemetry()
+    resilience = resilience if resilience is not None else NullResilience()
     train_span = timer("Time/train_time") if telemetry.enabled else nullcontext()
     try:
         # two-process topology: batch/EMA-period math follows the PLAYER's device
@@ -180,6 +184,9 @@ def _trainer_loop(
             last_step = int(iter_num) * policy_steps_per_iter
             telemetry.observe_train(units, reply[2])
             telemetry.step(last_step)
+            # publishes this rank's preempt request / heartbeat step and raises
+            # RankFailureError on a declared-dead peer (never hang on one)
+            resilience.step(last_step)
     except BaseException as e:
         error["exc"] = e
         # If the crash came from a channel collective the broadcast plane is
@@ -208,10 +215,21 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     key, agent_key = jax.random.split(key)
     actor, critic, params = build_agent(fabric, cfg, observation_space, action_space, agent_key, None)
     target_entropy = -float(int(np.prod(action_space.shape)))
-    data_q, params_q = BroadcastChannel(src=0), BroadcastChannel(src=1)
+    # the learner's peer facade comes up BEFORE the first blocking channel op:
+    # its heartbeat lets the player distinguish "learner is compiling" from
+    # "learner is dead", and its abort check breaks our own waits
+    telemetry = build_role_telemetry(
+        fabric, cfg, "learner",
+        rank=distributed.process_index(),
+        leader=distributed.process_index() == 1,
+    )
+    resilience = build_resilience(fabric, cfg, None, telemetry=telemetry)
+    opts = channel_options(cfg)
+    data_q, params_q = BroadcastChannel(src=0, **opts), BroadcastChannel(src=1, **opts)
     geometry = data_q.get()
     if geometry is None:  # player failed before the first block
         params_q.put(None)  # pairs the player's cleanup ack-consume
+        resilience.finalize()
         return
     resume_state = None
     if cfg.checkpoint.resume_from:
@@ -230,28 +248,25 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
         # the slice only needs params + opt_state; drop the (potentially
         # GB-sized) replay buffer the player-side state carries
         resume_state.pop("rb", None)
-    # the learner slice's own telemetry stream (telemetry.learner.jsonl next to
-    # the player's — obs/streams.py merges them); one writer per slice
-    telemetry = build_role_telemetry(
-        fabric, cfg, "learner",
-        rank=distributed.process_index(),
-        leader=distributed.process_index() == 1,
-    )
     error: Dict[str, Any] = {}
-    _trainer_loop(
-        fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error,
-        geometry=geometry, resume_state=resume_state, telemetry=telemetry,
-    )
-    if "exc" in error:
-        # pair the player's final sentinel — unless the crash WAS the channel,
-        # whose collectives are desynced and would hang instead of pairing
-        if not isinstance(error["exc"], ChannelError):
-            try:
-                data_q.get()
-                params_q.put(None)
-            except ChannelError:
-                pass
-        raise error["exc"]
+    try:
+        _trainer_loop(
+            fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error,
+            geometry=geometry, resume_state=resume_state, telemetry=telemetry,
+            resilience=resilience,
+        )
+        if "exc" in error:
+            # pair the player's final sentinel — unless the crash WAS the channel,
+            # whose collectives are desynced and would hang instead of pairing
+            if not isinstance(error["exc"], ChannelError):
+                try:
+                    data_q.get()
+                    params_q.put(None)
+                except ChannelError:
+                    pass
+            raise error["exc"]
+    finally:
+        resilience.finalize()
 
 
 @register_algorithm(decoupled=True)
@@ -374,8 +389,9 @@ def main(fabric, cfg: Dict[str, Any]):
 
         error: Dict[str, Any] = {}
         if two_process:
-            data_q = BroadcastChannel(src=0)
-            params_q = BroadcastChannel(src=1)
+            opts = channel_options(cfg)
+            data_q = BroadcastChannel(src=0, **opts)
+            params_q = BroadcastChannel(src=1, **opts)
             trainer = None
             data_q.put({"player_world_size": world_size})  # geometry handshake
         else:
@@ -611,8 +627,9 @@ def main(fabric, cfg: Dict[str, Any]):
             try:
                 # the channels are stateful: reuse the live instances when the
                 # crash happened after their creation
-                (data_q if data_q is not None else BroadcastChannel(src=0)).put(None)
-                (params_q if params_q is not None else BroadcastChannel(src=1)).get()
+                opts = channel_options(cfg)
+                (data_q if data_q is not None else BroadcastChannel(src=0, **opts)).put(None)
+                (params_q if params_q is not None else BroadcastChannel(src=1, **opts)).get()
             except Exception:
                 pass
         raise

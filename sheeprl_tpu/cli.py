@@ -24,7 +24,11 @@ from sheeprl_tpu.utils.utils import print_config
 
 # config keys that must not be taken from the old config on resume (reference cli.py:23-56)
 # `resilience` is runtime-operational state like `metric`: the saved config may
-# carry a supervisor/fault setup that must not silently override this launch's
+# carry a supervisor/fault setup that must not silently override this launch's.
+# NOTE `hydra` stays RESUMABLE on purpose: the saved hydra.run.dir places a
+# resumed run in the original run's tree as the next version_N — the
+# continuation semantics the resume tests pin (a gang restart is unaffected:
+# it pins root_dir/run_name per attempt, so the old and new dirs coincide).
 _NON_RESUMABLE_KEYS = (
     "checkpoint",
     "exp_name",
@@ -35,11 +39,16 @@ _NON_RESUMABLE_KEYS = (
 )
 
 
-def resume_from_checkpoint(cfg: dotdict) -> dotdict:
+def resume_from_checkpoint(cfg: dotdict, overrides: Optional[Sequence[str]] = None) -> dotdict:
     """Force-merge the checkpoint's config over the current one, keeping the
     non-resumable keys, and hard-validate env/algo identity (reference cli.py:23-56).
     ``checkpoint.resume_from=latest`` resolves to the newest valid checkpoint under
-    this experiment's log tree first (shared with the supervisor's discovery)."""
+    this experiment's log tree first (shared with the supervisor's discovery).
+
+    ``overrides`` is this launch's raw CLI override list: explicit dotted values
+    the user typed (``buffer.size=N``) are re-applied AFTER the merge, so they
+    beat the checkpoint's saved config — on the first attempt and on every
+    supervisor retry (which funnels through this same merge)."""
     import yaml
 
     if str(cfg.checkpoint.resume_from).strip().lower() == "latest":
@@ -66,11 +75,41 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
             f"This experiment is run with a different algorithm from the one of the "
             f"experiment you want to restart: got {cfg.algo.name}, expected {old_cfg['algo']['name']}"
         )
-    preserved = {k: cfg[k] for k in _NON_RESUMABLE_KEYS if k in cfg}
+    non_resumable = _NON_RESUMABLE_KEYS
+    explicit: dict = {}
+    if overrides:
+        from sheeprl_tpu.config import explicit_overrides
+
+        explicit = explicit_overrides(overrides)
+    # `hydra` is resumable BY DEFAULT: the saved hydra.run.dir places a resumed
+    # run in the original run's tree as the next version_N (the continuation
+    # semantics the resume tests pin). But when THIS launch names its own run
+    # identity on the command line, its hydra layout wins — resuming another
+    # run's checkpoint under an explicit run_name must not hijack the old tree.
+    if any(
+        k in ("exp_name", "run_name", "root_dir") or k.startswith("hydra.")
+        for k in explicit
+    ):
+        non_resumable = non_resumable + ("hydra",)
+    preserved = {k: cfg[k] for k in non_resumable if k in cfg}
     merged = dict(old_cfg)
     deep_merge(merged, preserved)
     merged["checkpoint"]["resume_from"] = str(ckpt_path)
-    return dotdict(merged)
+    result = dotdict(merged)
+    if explicit:
+        from sheeprl_tpu.config import set_by_path
+
+        for key, value in explicit.items():
+            # never clobber the resolved resume path (the argv value may be the
+            # literal "latest", or a base checkpoint a retry has moved past);
+            # the rest of `checkpoint` is already preserved from this launch
+            if key == "checkpoint.resume_from":
+                continue
+            try:
+                set_by_path(result, key, value, create=True)
+            except (KeyError, TypeError):
+                continue  # an override targeting a group the old config lacks
+    return result
 
 
 def check_configs(cfg: dotdict) -> None:
@@ -133,12 +172,34 @@ def check_configs(cfg: dotdict) -> None:
     fault = normalize_fault_cfg(rcfg)  # raises on an unknown fault kind
     if fault is not None and fault["at"] < 0:
         raise ValueError("resilience.fault.at_policy_step must be >= 0")
+    if fault is not None and fault["rank"] is not None and fault["rank"] < 0:
+        raise ValueError("resilience.fault.rank must be >= 0 (a process index)")
     supervisor_cfg = rcfg.get("supervisor") or {}
     if int(supervisor_cfg.get("max_restarts", 3) or 0) < 0:
         raise ValueError("resilience.supervisor.max_restarts must be >= 0")
     watchdog_cfg = rcfg.get("watchdog") or {}
     if bool(watchdog_cfg.get("enabled", False)) and float(watchdog_cfg.get("timeout") or 0) <= 0:
         raise ValueError("resilience.watchdog.timeout must be > 0 when the watchdog is enabled")
+    dist_cfg = rcfg.get("distributed") or {}
+    gang_n = int((dist_cfg.get("gang") or {}).get("processes") or 0)
+    if gang_n == 1 or gang_n < 0:
+        raise ValueError(
+            "resilience.distributed.gang.processes must be 0 (off) or >= 2 "
+            "(a 1-process run is what the in-process resilience.supervisor is for)"
+        )
+    if gang_n >= 2 and fault is not None and fault["rank"] is not None and fault["rank"] >= gang_n:
+        raise ValueError(
+            f"resilience.fault.rank={fault['rank']} targets no process of a "
+            f"{gang_n}-process gang — the fault would never fire"
+        )
+    hb_cfg = dist_cfg.get("heartbeat") or {}
+    hb_interval = float(hb_cfg.get("interval") or 2.0)
+    hb_timeout = float(hb_cfg.get("timeout") or 60.0)
+    if bool(hb_cfg.get("enabled", True)) and hb_timeout <= hb_interval:
+        raise ValueError(
+            "resilience.distributed.heartbeat.timeout must exceed heartbeat.interval "
+            f"(got timeout={hb_timeout}, interval={hb_interval})"
+        )
 
     # value sanity (reference cli.py:341-344)
     learning_starts = cfg.algo.get("learning_starts")
@@ -322,20 +383,32 @@ def run(args: Optional[Sequence[str]] = None) -> None:
     ``resilience.supervisor.enabled`` the launch runs under the bounded-restart
     supervisor, auto-resuming from the newest valid checkpoint on crash or
     preemption."""
+    import copy
+
     import sheeprl_tpu  # ensure registries are populated
 
     from sheeprl_tpu.resilience import (
         PREEMPTED_EXIT_CODE,
+        RANK_FAILED_EXIT_CODE,
         install_preemption_handler,
         preemption_requested,
         supervisor_enabled,
         uninstall_preemption_handler,
     )
+    from sheeprl_tpu.resilience.distributed import RankFailureError, gang_processes
 
     overrides = list(args if args is not None else sys.argv[1:])
     cfg = compose(overrides)
+
+    # gang children (SHEEPRL_COORDINATOR set) had jax.distributed brought up by
+    # __main__._gang_child_bringup BEFORE any sheeprl_tpu import — it cannot be
+    # done here, the registry imports above already ran jax computations
+
+    # the argv-merged cfg BEFORE any resume merge: supervisor retries rebuild
+    # from it so this launch's explicit overrides survive every attempt
+    argv_cfg = dotdict(copy.deepcopy(cfg.as_dict()))
     if cfg.checkpoint.resume_from:
-        cfg = resume_from_checkpoint(cfg)
+        cfg = resume_from_checkpoint(cfg, overrides=overrides)
     check_configs(cfg)
     _setup_xla_env(cfg)
     _apply_distribution_cfg(cfg)
@@ -347,13 +420,31 @@ def run(args: Optional[Sequence[str]] = None) -> None:
     if bool((cfg.get("resilience") or {}).get("handler", True)):
         handler_installed = install_preemption_handler()
     try:
-        if supervisor_enabled(cfg):
+        if gang_processes(cfg) >= 2 and not os.environ.get("SHEEPRL_GANG_RANK"):
+            # gang mode: this process never trains — it spawns and supervises
+            # the N-rank gang (resilience/distributed.py), forwarding its own
+            # SIGTERM to the children and restarting the whole gang on failure
+            from sheeprl_tpu.resilience.distributed import supervise_gang
+
+            outcome = supervise_gang(cfg, overrides)
+        elif supervisor_enabled(cfg):
             from sheeprl_tpu.resilience.supervisor import supervise
 
-            outcome = supervise(cfg, run_algorithm, resume_from_checkpoint)
+            outcome = supervise(
+                cfg,
+                run_algorithm,
+                lambda c: resume_from_checkpoint(c, overrides=overrides),
+                argv_cfg=argv_cfg,
+            )
         else:
             run_algorithm(cfg)
             outcome = "preempted" if preemption_requested() else "completed"
+    except RankFailureError as err:
+        # a PEER died and this rank tore itself down (directly, or escaping the
+        # in-process supervisor's multi-process step-aside path): exit with the
+        # distinct code so whatever supervises the gang never blames this rank
+        print(f"[sheeprl-resilience] {err}", file=sys.stderr)
+        raise SystemExit(RANK_FAILED_EXIT_CODE) from err
     finally:
         # a crash that unwound past the loop's finalize() leaves its watchdog
         # running (an abort-mode one would os._exit a later in-process run)
@@ -375,6 +466,33 @@ def diagnose(args: Optional[Sequence[str]] = None) -> int:
     from sheeprl_tpu.obs.diagnose import main as diagnose_main
 
     return diagnose_main(list(args if args is not None else sys.argv[1:]))
+
+
+def fault_matrix(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py fault-matrix`` — run the resilience fault matrix on
+    the CPU mesh: every ``resilience``-marked smoke (single-process preempt /
+    crash / ckpt_kill / env_step recovery AND the rank-targeted distributed
+    smokes — kill_rank, stale_heartbeat, sigterm-to-one-rank under the gang
+    supervisor, which gate on ``diagnose --fail-on critical`` internally).
+    Extra arguments pass through to pytest (e.g. ``-k gang`` to scope, ``-q``).
+    Exit code is pytest's — non-zero means a recovery path regressed."""
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        os.path.join(repo_root, "tests", "test_resilience"),
+        "-m",
+        "resilience",
+        "-q",
+        "-p",
+        "no:cacheprovider",
+    ] + list(args if args is not None else sys.argv[1:])
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.call(cmd, env=env, cwd=repo_root)
 
 
 def watch(args: Optional[Sequence[str]] = None) -> int:

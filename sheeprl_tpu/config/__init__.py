@@ -1,4 +1,11 @@
-from sheeprl_tpu.config.composer import Composer, ConfigError, MissingMandatoryValue, compose, deep_merge
+from sheeprl_tpu.config.composer import (
+    Composer,
+    ConfigError,
+    MissingMandatoryValue,
+    compose,
+    deep_merge,
+    explicit_overrides,
+)
 from sheeprl_tpu.config.dotdict import dotdict, get_by_path, set_by_path
 from sheeprl_tpu.config.instantiate import instantiate, locate
 
@@ -8,6 +15,7 @@ __all__ = [
     "MissingMandatoryValue",
     "compose",
     "deep_merge",
+    "explicit_overrides",
     "dotdict",
     "get_by_path",
     "set_by_path",

@@ -436,3 +436,15 @@ def compose(
     extra_dirs: Optional[Sequence[os.PathLike]] = None,
 ) -> dotdict:
     return Composer(extra_dirs).compose(overrides, config_name)
+
+
+def explicit_overrides(overrides: Sequence[str]) -> Dict[str, Any]:
+    """The dotted-key → parsed-value map of the user's EXPLICIT value overrides
+    (``a.b=c`` and ``+a.b=c``; group selections and deletions excluded). The
+    resume merge re-applies these over a restored config — something the user
+    typed on this launch's command line always beats the checkpoint's saved
+    value (``cli.resume_from_checkpoint``, ``resilience/supervisor.py``)."""
+    group_sel, dotted, additions, _ = Composer()._split_overrides(overrides)
+    merged = dict(dotted)
+    merged.update(additions)
+    return merged

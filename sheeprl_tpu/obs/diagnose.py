@@ -379,6 +379,44 @@ def detect_interruptions(events: Events) -> List[Finding]:
         e for e in events if e.get("event") == "restart" and e.get("reason") == "preempt"
     ]
     giveups = [e for e in events if e.get("event") == "giveup"]
+    # distributed runs: heartbeat failure detection names the rank that died
+    # (health status=rank_dead, resilience/distributed.py), and the gang
+    # supervisor's restart events carry the non-zero exit codes per rank — so a
+    # gang teardown is attributed to its dead rank, not "an unexplained crash"
+    rank_deaths = [
+        e for e in events if e.get("event") == "health" and e.get("status") == "rank_dead"
+    ]
+    dead_rank_ids = sorted(
+        {int(e["rank"]) for e in rank_deaths if e.get("rank") is not None}
+        | {
+            int(r)
+            for e in events
+            if e.get("event") == "giveup" or (e.get("event") == "restart" and e.get("reason") == "crash")
+            for r in (e.get("dead_ranks") or {})
+        }
+    )
+    if rank_deaths:
+        observers = sorted(
+            {int(e["observed_by"]) for e in rank_deaths if e.get("observed_by") is not None}
+        )
+        named = sorted({int(e["rank"]) for e in rank_deaths if e.get("rank") is not None})
+        findings.append(
+            _finding(
+                "interruptions",
+                "warning",
+                f"rank{'s' if len(named) != 1 else ''} "
+                f"{', '.join(map(str, named)) or '?'} of the gang "
+                f"{'were' if len(named) != 1 else 'was'} declared dead "
+                f"({rank_deaths[-1].get('reason') or 'heartbeat timeout'}"
+                + (f", observed by rank {observers[0]}" if observers else "")
+                + ") — peers tore down instead of hanging",
+                rank_deaths,
+                "read the dead rank's own log/stream for its last events; recurring "
+                "single-rank deaths at the same step are that rank's bug (OOM, env "
+                "crash), not infrastructure flakiness",
+                dead_ranks=named,
+            )
+        )
     if preempts:
         findings.append(
             _finding(
@@ -403,11 +441,18 @@ def detect_interruptions(events: Events) -> List[Finding]:
                 "interruptions",
                 "warning",
                 f"the run crashed and was auto-restarted {len(crash_restarts)} time(s)"
+                + (
+                    f" (dead rank{'s' if len(dead_rank_ids) != 1 else ''}: "
+                    f"{', '.join(map(str, dead_rank_ids))})"
+                    if dead_rank_ids
+                    else ""
+                )
                 + (f" (last error: {str(last_error)[:120]})" if last_error else ""),
                 crash_restarts,
                 "read the restart events' error fields; recurring crashes at the same "
                 "step are a code/data bug, not flakiness — the supervisor is masking it",
                 restarts=len(crash_restarts),
+                **({"dead_ranks": dead_rank_ids} if dead_rank_ids else {}),
             )
         )
     if giveups:
@@ -420,6 +465,7 @@ def detect_interruptions(events: Events) -> List[Finding]:
                 "fix the underlying crash (see the giveup event's error) or raise "
                 "resilience.supervisor.max_restarts if the failures are environmental",
                 giveups=len(giveups),
+                **({"dead_ranks": dead_rank_ids} if dead_rank_ids else {}),
             )
         )
     return findings

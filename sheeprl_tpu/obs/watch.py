@@ -13,7 +13,12 @@ the phase-attribution bar (env / replay wait / train / checkpoint / logging /
 eval / other shares of the last window), device memory (HBM when the backend
 reports it, host RSS otherwise), prefetch pipeline occupancy/staleness, the
 latest health verdict and in-loop diagnosis findings, and the attempt/restart
-state of supervised runs.
+state of supervised runs. Multi-process (gang) runs additionally get a per-rank
+liveness board: every stream's rank identity marks its writer alive, a
+``health`` ``status=rank_dead`` event (heartbeat failure detection,
+``resilience/distributed.py``) marks the named peer DEAD, and the gang
+supervisor's exit codes annotate the rest — so a gang teardown reads as "rank 1
+DEAD (heartbeat timeout)", not an unexplained crash.
 
 Exit protocol: when the run's ``summary`` event lands (flushed even on crash or
 preemption — see ``obs/telemetry.py``), ``watch`` exits with the run's status —
@@ -65,6 +70,7 @@ class WatchState:
         self.attempt = 0
         self.restarts = 0
         self.last_restart: Optional[Dict[str, Any]] = None
+        self.last_restart_dead: List[int] = []
         self.env_restarts = 0
         self.health = "unknown"
         self.findings: List[Dict[str, Any]] = []
@@ -72,6 +78,11 @@ class WatchState:
         self.summary: Optional[Dict[str, Any]] = None  # primary-stream summary
         self.gave_up = False
         self.events_seen = 0
+        # per-rank liveness of a multi-process (gang) run: every event's rank
+        # identity marks its writer alive; a health status=rank_dead names the
+        # dead peer; the gang supervisor's attempt_exit carries exit codes. A
+        # restart resets the board — the whole gang comes back as one unit.
+        self.ranks: Dict[int, str] = {}
 
     # -- event intake ------------------------------------------------------------
 
@@ -80,6 +91,12 @@ class WatchState:
             self.events_seen += 1
             self.attempt = max(self.attempt, int(event.get("attempt") or 0))
             kind = event.get("event")
+            writer = event.get("rank")
+            if writer is not None and kind not in ("restart", "giveup", "gang", "supervisor"):
+                try:
+                    self.ranks.setdefault(int(writer), "alive")
+                except (TypeError, ValueError):
+                    pass
             if kind == "start" and _is_primary(event):
                 self.start = event
             elif kind == "window" and _is_primary(event):
@@ -90,10 +107,31 @@ class WatchState:
                 self.preempted = True
             elif kind in ("restart", "resume"):
                 self.restarts += int(kind == "restart")
-                self.last_restart = event
+                # only the restart carries the reason — the resume event that
+                # follows it must not erase the "(rank N died)" attribution
+                if kind == "restart":
+                    self.last_restart = event
                 # the attempt is being restarted: the pending summary was
-                # end-of-attempt state, not the end of the run
+                # end-of-attempt state, not the end of the run — and the gang
+                # comes back as one unit, so the liveness board resets too;
+                # the heartbeat-declared dead set is captured first so the
+                # restart line can keep attributing THIS restart after the board
+                # is alive again (peers exiting nonzero BECAUSE a rank died are
+                # collateral, not the cause — only DEAD ranks are named)
+                if kind == "restart":
+                    self.last_restart_dead = sorted(
+                        r for r, s in self.ranks.items() if str(s).startswith("DEAD")
+                    )
                 self.summary = None
+                self.ranks = {r: "alive" for r in self.ranks}
+            elif kind == "gang" and event.get("status") == "attempt_exit":
+                for r, rc in (event.get("exit_codes") or {}).items():
+                    try:
+                        rank, code = int(r), int(rc)
+                    except (TypeError, ValueError):
+                        continue
+                    if not str(self.ranks.get(rank, "")).startswith("DEAD"):
+                        self.ranks[rank] = "exited 0" if code == 0 else f"EXITED {code}"
             elif kind == "giveup":
                 self.gave_up = True
             elif kind == "summary" and _is_primary(event):
@@ -109,6 +147,13 @@ class WatchState:
             self.health = str(status)
         elif status == "stalled":
             self.health = "stalled"
+        elif status == "rank_dead":
+            # the heartbeat monitor named a dead peer: a gang teardown is about
+            # to follow — attribute it instead of rendering an unexplained crash
+            try:
+                self.ranks[int(event.get("rank"))] = f"DEAD ({event.get('reason') or 'heartbeat timeout'})"
+            except (TypeError, ValueError):
+                pass
 
     # -- exit protocol -----------------------------------------------------------
 
@@ -200,10 +245,25 @@ class WatchState:
             health_bits.append(f"{self.env_restarts} env restart(s)")
         if self.restarts:
             reason = (self.last_restart or {}).get("reason")
-            health_bits.append(f"{self.restarts} attempt restart(s)" + (f" ({reason})" if reason else ""))
+            dead = self.last_restart_dead
+            health_bits.append(
+                f"{self.restarts} attempt restart(s)"
+                + (
+                    f" (rank {', '.join(map(str, dead))} died)"
+                    if dead and reason == "crash"
+                    else (f" ({reason})" if reason else "")
+                )
+            )
         if self.preempted:
             health_bits.append("preempt requested")
         lines.append("  " + " · ".join(health_bits))
+        # multi-process runs: per-rank liveness, so a gang teardown reads as
+        # "rank 1 DEAD (heartbeat timeout)" instead of an unexplained crash
+        if len(self.ranks) > 1 or any(str(s) != "alive" for s in self.ranks.values()):
+            lines.append(
+                "  ranks: "
+                + " · ".join(f"{r} {self.ranks[r]}" for r in sorted(self.ranks))
+            )
         for f in self.findings[:4]:
             lines.append(
                 f"  [{str(f.get('severity', '?')).upper()}] {f.get('detector')}: {f.get('summary')}"

@@ -139,13 +139,32 @@ class ChannelError(RuntimeError):
     can block until timeout, so crash paths must NOT attempt further puts."""
 
 
+class ChannelTimeout(ChannelError):
+    """A bounded channel ``get`` exhausted its deadline with no message — the
+    peer is slow, hung, or dead (distinguished from protocol errors so callers
+    can treat "nobody is talking" as a liveness failure)."""
+
+
 _KV_CHUNK = 2 * 1024 * 1024  # stay under gRPC message-size defaults
+
+# Fault-injection hook (resilience/faults.py, kind=channel_drop): consulted once
+# per BroadcastChannel.put; returning True makes the source SKIP the KV write
+# while still advancing its sequence counter — exactly the on-wire shape of a
+# lost message, so receivers exercise their bounded-timeout path.
+_channel_drop_hook = None
 
 
 def _kv_client():
     from jax._src import distributed as _dist
 
     return getattr(_dist.global_state, "client", None)
+
+
+def _is_deadline(exc: BaseException) -> bool:
+    """Whether a KV-store error is the blocking get's deadline expiring (the
+    jaxlib client surfaces gRPC/absl status codes only in the message text)."""
+    text = str(exc).upper()
+    return "DEADLINE" in text or "TIMED OUT" in text or "TIMEOUT" in text
 
 
 class BroadcastChannel:
@@ -162,9 +181,19 @@ class BroadcastChannel:
     skew: the source writes chunked payloads then a manifest; receivers block on
     the manifest (long timeout) and reassemble. The source garbage-collects the
     previous round's keys before writing — the blocking alternation guarantees
-    every receiver has consumed round k-1 before the source enters round k."""
+    every receiver has consumed round k-1 before the source enters round k.
+
+    Liveness bounds (resilience.distributed.channel): no channel op blocks
+    forever. A ``get`` waits in ``poll_s`` slices up to ``timeout_s`` total,
+    calling ``abort_check`` between slices — the hook the resilience layer uses
+    to break a wait the moment a peer rank is declared dead (it raises; see
+    ``sheeprl_tpu/resilience/distributed.py``) — and raises
+    :class:`ChannelTimeout` when the deadline expires with no message. ``put``'s
+    KV writes retry transient failures with bounded exponential backoff."""
 
     _TIMEOUT_S = 1800.0
+    _POLL_S = 30.0
+    _PUT_RETRIES = 3
     # per-process count of channels created per src: namespaces the keyspace so a
     # SECOND channel with the same src in one jax.distributed session (a later
     # decoupled run in the same interpreter) neither hits ALREADY_EXISTS on the
@@ -173,8 +202,18 @@ class BroadcastChannel:
     # same protocol-mandated points.
     _instances_per_src: Dict[int, int] = {}
 
-    def __init__(self, src: int) -> None:
+    def __init__(
+        self,
+        src: int,
+        *,
+        timeout_s: float | None = None,
+        poll_s: float | None = None,
+        abort_check: Any = None,
+    ) -> None:
         self.src = src
+        self.timeout_s = float(timeout_s if timeout_s is not None else self._TIMEOUT_S)
+        self.poll_s = float(poll_s if poll_s is not None else self._POLL_S)
+        self.abort_check = abort_check
         self._seq = 0
         self._nonce = BroadcastChannel._instances_per_src.get(src, 0)
         BroadcastChannel._instances_per_src[src] = self._nonce + 1
@@ -190,18 +229,22 @@ class BroadcastChannel:
                 raise RuntimeError("BroadcastChannel requires jax.distributed (use queue.Queue in-process)")
             client = _kv_client()
             if process_index() == self.src:
+                if _channel_drop_hook is not None and _channel_drop_hook():
+                    self._seq += 1  # the message is "on the wire" and lost
+                    return
                 payload = pickle.dumps(msg)
                 # GC with a TWO-round lag: consumption of round k-1 is guaranteed
                 # by the blocking alternation once the first full round completes,
                 # but the very first put (e.g. the geometry handshake) has no ack —
                 # receivers may not have read round 0 when round 1 is written.
                 if self._seq > 1:
-                    client.key_value_delete(self._tag(self._seq - 2) + "/")
+                    self._retry(lambda: client.key_value_delete(self._tag(self._seq - 2) + "/"))
                 tag = self._tag(self._seq)
                 n = max(1, -(-len(payload) // _KV_CHUNK))
                 for i in range(n):
-                    client.key_value_set_bytes(f"{tag}/c{i}", payload[i * _KV_CHUNK : (i + 1) * _KV_CHUNK])
-                client.key_value_set(f"{tag}/n", str(n))
+                    chunk = payload[i * _KV_CHUNK : (i + 1) * _KV_CHUNK]
+                    self._retry(lambda: client.key_value_set_bytes(f"{tag}/c{i}", chunk))
+                self._retry(lambda: client.key_value_set(f"{tag}/n", str(n)))
             self._seq += 1
         except BaseException as e:
             raise ChannelError(f"channel put (src={self.src}) failed") from e
@@ -214,12 +257,61 @@ class BroadcastChannel:
             if process_index() == self.src:
                 raise RuntimeError("the channel source must put, not get")
             tag = self._tag(self._seq)
-            timeout_ms = int(self._TIMEOUT_S * 1000)
-            n = int(client.blocking_key_value_get(f"{tag}/n", timeout_ms))
+            n = int(self._bounded_get(client.blocking_key_value_get, f"{tag}/n"))
             payload = b"".join(
-                client.blocking_key_value_get_bytes(f"{tag}/c{i}", timeout_ms) for i in range(n)
+                self._bounded_get(client.blocking_key_value_get_bytes, f"{tag}/c{i}")
+                for i in range(n)
             )
             self._seq += 1
             return pickle.loads(payload)
         except BaseException as e:
+            if isinstance(e, ChannelTimeout):
+                raise
+            # an abort_check verdict (a peer rank declared dead) must surface
+            # under its own identity, not be buried in a generic channel error
+            from sheeprl_tpu.resilience.distributed import RankFailureError
+
+            if isinstance(e, RankFailureError):
+                raise
             raise ChannelError(f"channel get (src={self.src}) failed") from e
+
+    # -- bounded-op internals ---------------------------------------------------
+
+    def _bounded_get(self, fn, key: str):
+        """Blocking KV read in ``poll_s`` slices up to ``timeout_s`` total, with
+        ``abort_check`` between slices so a declared-dead peer breaks the wait
+        immediately instead of after the full deadline."""
+        import time
+
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            if self.abort_check is not None:
+                self.abort_check()  # raises to break the wait
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ChannelTimeout(
+                    f"channel get (src={self.src}) timed out after {self.timeout_s:.0f}s "
+                    f"waiting for {key!r} — the source rank is slow, hung, or dead"
+                )
+            wait = min(self.poll_s, remaining)
+            try:
+                return fn(key, int(max(wait, 0.05) * 1000))
+            except Exception as e:
+                if not _is_deadline(e):
+                    raise
+                # slice expired with no value: re-check abort and keep waiting
+
+    def _retry(self, op) -> None:
+        """Run a KV write with bounded exponential backoff on transient errors."""
+        import time
+
+        delay = 0.1
+        for attempt in range(self._PUT_RETRIES):
+            try:
+                op()
+                return
+            except Exception:
+                if attempt == self._PUT_RETRIES - 1:
+                    raise
+                time.sleep(delay)
+                delay *= 2

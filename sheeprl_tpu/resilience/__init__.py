@@ -29,12 +29,29 @@ from sheeprl_tpu.resilience.discovery import (
     find_latest_checkpoint,
     is_valid_checkpoint,
     iter_checkpoints,
+    manifest_path,
+    read_manifest,
     resolve_latest,
 )
+from sheeprl_tpu.resilience.distributed import (
+    DistributedCoordinator,
+    GangFailureError,
+    RankFailureError,
+    build_coordinator,
+    channel_options,
+    checkpoint_manifest,
+    supervise_gang,
+)
 from sheeprl_tpu.resilience.faults import FAULT_KINDS, InjectedFaultError, normalize_fault_cfg, reset_faults
-from sheeprl_tpu.resilience.monitor import NullResilience, ResilienceMonitor, build_resilience
+from sheeprl_tpu.resilience.monitor import (
+    NullResilience,
+    PeerResilience,
+    ResilienceMonitor,
+    build_resilience,
+)
 from sheeprl_tpu.resilience.signals import (
     PREEMPTED_EXIT_CODE,
+    RANK_FAILED_EXIT_CODE,
     WATCHDOG_EXIT_CODE,
     install_preemption_handler,
     preemption_requested,
@@ -46,27 +63,38 @@ from sheeprl_tpu.resilience.supervisor import supervise, supervisor_enabled
 from sheeprl_tpu.resilience.watchdog import ProgressWatchdog, WatchdogError, dump_all_stacks
 
 __all__ = [
+    "DistributedCoordinator",
     "FAULT_KINDS",
+    "GangFailureError",
     "InjectedFaultError",
     "NullResilience",
+    "PeerResilience",
     "PREEMPTED_EXIT_CODE",
+    "RANK_FAILED_EXIT_CODE",
     "ProgressWatchdog",
+    "RankFailureError",
     "ResilienceMonitor",
     "WATCHDOG_EXIT_CODE",
     "WatchdogError",
+    "build_coordinator",
     "build_resilience",
+    "channel_options",
+    "checkpoint_manifest",
     "dump_all_stacks",
     "find_latest_checkpoint",
     "install_preemption_handler",
     "is_valid_checkpoint",
     "iter_checkpoints",
+    "manifest_path",
     "normalize_fault_cfg",
     "preemption_requested",
+    "read_manifest",
     "request_preemption",
     "reset_faults",
     "reset_preemption",
     "resolve_latest",
     "supervise",
+    "supervise_gang",
     "supervisor_enabled",
     "uninstall_preemption_handler",
 ]

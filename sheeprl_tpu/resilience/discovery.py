@@ -12,16 +12,62 @@ Discovery enumerates all of these, validates each candidate the same way
 ``load_checkpoint`` would resolve it, and orders by (mtime, parsed step) so a
 restarted run resumes from the newest state that is actually loadable —
 skipping torn ``.tmp`` files and orbax directories whose sidecar is missing.
+
+Multi-process runs additionally write a per-step **consistency manifest**
+(``ckpt_{step}.manifest.json``, see ``resilience/distributed.py``): begun with
+``complete: false`` before the save, committed — the marker written last — only
+after every participating rank acked. When a manifest exists for a candidate's
+step, discovery trusts it over the artifact heuristics: an incomplete manifest
+means some rank never finished that checkpoint iteration, so the whole set is
+invalid by construction and resolution falls back to the previous complete one.
+Single-process checkpoints (no manifest) keep the original validation.
 """
 
 from __future__ import annotations
 
 import glob
+import json
 import os
 import re
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 _STEP_RE = re.compile(r"ckpt_(\d+)(?:_\d+)?\.ckpt$")
+
+
+def manifest_path(path: str) -> str:
+    """The consistency manifest governing ``path``'s checkpoint SET: one per
+    step per directory (rank-suffixed files of one step share it); foreign
+    names fall back to a per-path sibling."""
+    path = str(path)
+    base = os.path.basename(path).replace(".old", "")
+    m = _STEP_RE.search(base)
+    name = f"ckpt_{m.group(1)}.manifest.json" if m else base + ".manifest.json"
+    return os.path.join(os.path.dirname(path), name)
+
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """The manifest governing ``path``, or None when there is none. A manifest
+    that exists but cannot be parsed reads as incomplete (``{}``) — it must veto
+    the candidate, not be ignored."""
+    mpath = manifest_path(path)
+    if not os.path.isfile(mpath):
+        return None
+    try:
+        with open(mpath) as f:
+            payload = json.load(f)
+        return payload if isinstance(payload, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _manifest_complete(manifest: Dict[str, Any]) -> bool:
+    if not manifest.get("complete"):
+        return False
+    expected = manifest.get("ranks_expected")
+    committed = manifest.get("ranks_committed")
+    if expected and set(committed or []) != set(expected):
+        return False
+    return True
 
 
 def checkpoint_step(path: str) -> int:
@@ -38,9 +84,15 @@ def is_valid_checkpoint(path: str) -> bool:
     - orbax directory: needs its sidecar — at ``<path>.extras.pkl`` or, in the
       mid-displacement crash window, ``<path>.old.extras.pkl``;
     - missing path with a ``<path>.old`` directory: the in-place-overwrite crash
-      window; valid when the displaced directory still pairs with a sidecar.
+      window; valid when the displaced directory still pairs with a sidecar;
+    - a consistency manifest for the candidate's step, when present, overrides
+      all of the above: only ``complete: true`` with every expected rank
+      committed is valid (torn multi-rank sets are invalid by construction).
     """
     path = str(path)
+    manifest = read_manifest(path)
+    if manifest is not None and not _manifest_complete(manifest):
+        return False
     if os.path.isfile(path):
         try:
             return os.path.getsize(path) > 0

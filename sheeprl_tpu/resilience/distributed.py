@@ -1,0 +1,900 @@
+"""Distributed resilience: coordinated preemption, rank-failure detection,
+checkpoint-set consistency and gang supervision for multi-process runs.
+
+PR 3 made a single-process run preemption-safe; every multi-process topology was
+still fragile: preempt agreement had a documented one-iteration rank-skew
+window, a crashed peer hung the other side of a decoupled channel forever, and
+the in-process supervisor stepped aside with a warning. This module closes all
+of that over the jax.distributed COORDINATION SERVICE key-value store — the
+same gRPC object plane the decoupled channels already ride, which (unlike XLA
+collectives) works across processes on every backend including the CPU test
+mesh, and tolerates arbitrarily skewed arrival.
+
+Four pillars:
+
+- **Coordinated preemption** (:class:`DistributedCoordinator`): any rank that
+  observes its local SIGTERM flag publishes a preempt *request*; rank 0 turns
+  the first request into a *decision* — "every rank stops at policy step >= S" —
+  with S placed far enough ahead (``agree_within_iters`` iterations plus the
+  control-plane polling skew at the observed step rate) that every rank has
+  seen it before reaching it. Because SPMD ranks advance through the same
+  policy-step sequence in lockstep, comparing the same S against the same step
+  sequence makes every rank take the same emergency checkpoint at the same
+  step — the PR 3 skew window is closed by construction. In the decoupled MPMD
+  topologies the player (rank 0) is the only loop driver: a learner's SIGTERM
+  becomes a request the player consumes, and the existing channel shutdown
+  protocol (want_opt_state + final ``None``) carries the coordinated teardown.
+
+- **Rank-failure detection**: every rank runs a heartbeat writer thread
+  (``resilience.distributed.heartbeat.interval``) and a failure monitor thread
+  that watches every peer's heartbeat *counter* (no cross-host clock
+  comparison). A rank silent for ``heartbeat.timeout`` seconds is declared
+  dead: a ``health`` event (``status=rank_dead``) names it, and an **abort**
+  record is published that every rank's facade — and every bounded channel
+  wait — converts into :class:`RankFailureError`, so a dead peer means a
+  prompt coordinated teardown instead of an indefinite hang.
+
+- **Checkpoint consistency** (:func:`checkpoint_manifest`): multi-process
+  checkpoints get a per-step manifest (``ckpt_{step}.manifest.json``) written
+  *before* the save with ``complete: false`` and committed *after* every
+  participating rank acks through the KV store — the commit marker is written
+  last, so a torn multi-rank save is invalid by construction and
+  ``discovery.py`` only resolves checkpoints every rank finished.
+
+- **Gang supervision** (:func:`supervise_gang`): the multi-process
+  generalization of ``supervisor.py`` — one parent owns N ``jax.distributed``
+  child processes (SPMD ranks or the decoupled player/learner pair), launched
+  with a fresh coordinator per attempt. On any child's crash or preemption it
+  tears down the survivors, resolves the latest *consistent*
+  (manifest-validated) checkpoint, and restarts the whole gang with the attempt
+  counter stamped into every rank's telemetry stream. Restart policy
+  (``max_restarts``/``backoff``/``restart_on_preempt``) is shared with the
+  in-process supervisor.
+
+See ``howto/fault_tolerance.md`` ("Distributed runs") for operational guidance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from sheeprl_tpu.parallel import distributed as par_dist
+
+
+class RankFailureError(RuntimeError):
+    """A peer rank of this multi-process run was declared dead (heartbeat
+    timeout or abnormal exit). Raised from the resilience facade's per-iteration
+    hook and from bounded channel waits so no rank blocks forever on a dead
+    peer; the run unwinds as a crash and the (gang or external) supervisor
+    restarts the whole gang."""
+
+
+# ---------------------------------------------------------------------------------
+# KV helpers: the coordination-service store as a tiny control plane
+# ---------------------------------------------------------------------------------
+
+
+def _kv() -> Any:
+    return par_dist._kv_client()
+
+
+def _kv_set(client: Any, key: str, value: str) -> None:
+    client.key_value_set(key, value, allow_overwrite=True)
+
+
+def _kv_dir(client: Any, prefix: str) -> List[tuple]:
+    try:
+        return list(client.key_value_dir_get(prefix))
+    except Exception:
+        return []  # NOT_FOUND before the first write, or a dying coordinator
+
+
+# Per-process count of coordinators built: namespaces the control-plane keyspace
+# so a LATER run in the same jax.distributed session (sequential tests in one
+# interpreter) never reads the previous run's stale requests/decisions. Aligned
+# across processes because every process builds exactly one coordinator per run
+# at the same protocol point (its resilience facade construction).
+_coordinator_builds = 0
+
+# The process's live coordinator, so bounded channel waits can consult it
+# without threading it through every construction site (see channel_options).
+_active_coordinator: Optional["DistributedCoordinator"] = None
+
+
+def active_coordinator() -> Optional["DistributedCoordinator"]:
+    return _active_coordinator
+
+
+def channel_abort_check() -> None:
+    """The ``abort_check`` hook bounded channel waits run between poll slices:
+    raises :class:`RankFailureError` the moment any peer has been declared dead
+    (the coordinator's monitor thread keeps the verdict fresh)."""
+    coord = _active_coordinator
+    if coord is not None:
+        coord.check_abort()
+
+
+def channel_options(cfg: Any) -> Dict[str, Any]:
+    """Keyword arguments for :class:`~sheeprl_tpu.parallel.distributed.BroadcastChannel`
+    from the ``resilience.distributed.channel`` config group, with the abort
+    hook attached — the decoupled loops build every channel through this."""
+    ccfg = (((cfg.get("resilience") or {}).get("distributed") or {}).get("channel")) or {}
+    return {
+        "timeout_s": float(ccfg.get("timeout") or 1800.0),
+        "poll_s": float(ccfg.get("poll") or 30.0),
+        "abort_check": channel_abort_check,
+    }
+
+
+# ---------------------------------------------------------------------------------
+# Pillars 1 + 2: preempt agreement and heartbeat-based rank-failure detection
+# ---------------------------------------------------------------------------------
+
+
+class DistributedCoordinator:
+    """Per-process control-plane presence of a multi-process run. Construct via
+    :func:`build_coordinator`; drive with :meth:`step` from the resilience
+    facade's per-iteration hook. Threads: a heartbeat writer and a peer-failure
+    monitor, both daemons, both stopped by :meth:`close`."""
+
+    def __init__(
+        self,
+        rank: int,
+        nprocs: int,
+        *,
+        agree_within_iters: int = 2,
+        poll_interval: float = 0.25,
+        heartbeat_interval: float = 2.0,
+        heartbeat_timeout: float = 60.0,
+        startup_timeout: float = 300.0,
+        heartbeat_enabled: bool = True,
+        emit: Optional[Callable[..., None]] = None,
+        namespace: Optional[str] = None,
+    ) -> None:
+        global _coordinator_builds, _active_coordinator
+        self.rank = int(rank)
+        self.nprocs = int(nprocs)
+        self.agree_within_iters = max(int(agree_within_iters), 1)
+        self.poll_interval = max(float(poll_interval), 0.01)
+        self.heartbeat_interval = max(float(heartbeat_interval), 0.05)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.startup_timeout = max(float(startup_timeout), self.heartbeat_timeout)
+        self.heartbeat_enabled = bool(heartbeat_enabled)
+        self._emit = emit or (lambda *a, **k: None)
+        nonce = _coordinator_builds
+        _coordinator_builds += 1
+        attempt = os.environ.get("SHEEPRL_GANG_ATTEMPT", "0")
+        self.ns = namespace or f"sheeprl_res/i{nonce}/a{attempt}"
+
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._last_step: Optional[int] = None
+        self._per_iter = 1
+        self._rate: Optional[float] = None  # policy steps / second (EMA)
+        self._last_step_time: Optional[float] = None
+        self._last_poll = 0.0
+        self._requests: Dict[int, int] = {}  # rank -> step at request time
+        self._published_request = False
+        self._published_decision = False
+        self._decision: Optional[Dict[str, Any]] = None
+        self._abort: Optional[Dict[str, Any]] = None
+        self._abort_announced = False
+        self._hb_counter = 0
+        self._hb_seen: Dict[int, tuple] = {}  # rank -> (counter, last_change_monotonic)
+        self._dead: Dict[int, float] = {}  # rank -> silent seconds at declaration
+        self._threads: List[threading.Thread] = []
+        _active_coordinator = self
+
+    # -- lifecycle ---------------------------------------------------------------
+
+    def start(self) -> "DistributedCoordinator":
+        if self.heartbeat_enabled and not self._threads:
+            for target, name in (
+                (self._heartbeat_loop, "sheeprl-heartbeat"),
+                (self._monitor_loop, "sheeprl-rank-monitor"),
+            ):
+                t = threading.Thread(target=target, name=name, daemon=True)
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def close(self) -> None:
+        global _active_coordinator
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        if _active_coordinator is self:
+            _active_coordinator = None
+
+    # -- the per-iteration hook --------------------------------------------------
+
+    def step(self, policy_step: int, local_preempt: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if self._last_step is not None and policy_step > self._last_step:
+                delta = policy_step - self._last_step
+                self._per_iter = max(delta, 1)
+                if self._last_step_time is not None and now > self._last_step_time:
+                    inst = delta / (now - self._last_step_time)
+                    self._rate = inst if self._rate is None else 0.5 * self._rate + 0.5 * inst
+            self._last_step = int(policy_step)
+            self._last_step_time = now
+        client = _kv()
+        if client is None:
+            return
+        if local_preempt and not self._published_request:
+            self._publish_request(client, policy_step)
+        # throttled control-plane poll; forced while a preempt is pending so the
+        # leader's decision (and the final stop step) propagates promptly
+        pending = local_preempt or self._requests or self._published_request
+        if pending or now - self._last_poll >= self.poll_interval:
+            self._last_poll = now
+            self._poll_control(client)
+        if self.rank == 0 and not self._published_decision and (local_preempt or self._requests):
+            self._publish_decision(client, policy_step)
+
+    def preempt_requested(self) -> bool:
+        """The agreed verdict every rank folds into its checkpoint condition:
+        True once the published decision's stop step is reached by the step
+        sequence all ranks share (never on the local flag alone)."""
+        with self._lock:
+            decision = self._decision
+            if decision is None:
+                return False
+            if self._last_step is None:
+                return True  # preempted before the loop produced a step
+            return self._last_step + self._per_iter >= int(decision["stop_step"])
+
+    def decision(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._decision) if self._decision else None
+
+    def check_abort(self) -> None:
+        """Raise :class:`RankFailureError` if any peer has been declared dead."""
+        with self._lock:
+            abort = self._abort
+        if abort is not None:
+            raise RankFailureError(
+                f"rank {abort.get('rank')} of this {self.nprocs}-process run was declared "
+                f"dead ({abort.get('reason', 'heartbeat timeout')}); tearing down instead of "
+                "hanging — the supervisor restarts the gang from the last consistent checkpoint"
+            )
+
+    def abort_info(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._abort) if self._abort else None
+
+    # -- control-plane internals -------------------------------------------------
+
+    def _publish_request(self, client: Any, policy_step: Optional[int]) -> None:
+        try:
+            _kv_set(
+                client,
+                f"{self.ns}/ctl/req/r{self.rank}",
+                json.dumps({"rank": self.rank, "step": int(policy_step or 0)}),
+            )
+            self._published_request = True
+        except Exception:
+            pass  # retried from the next step()
+
+    def _publish_decision(self, client: Any, policy_step: int) -> None:
+        with self._lock:
+            per_iter = self._per_iter
+            rate = self._rate
+            requests = dict(self._requests)
+        # margin: the agreement window in iterations, PLUS however many steps
+        # the gang covers in ~3 control-poll periods at the observed rate — so a
+        # rank whose throttled poll fires late still sees the decision before
+        # the step sequence reaches the stop step
+        margin = self.agree_within_iters * per_iter
+        if rate is not None:
+            margin = max(margin, int(rate * 3.0 * self.poll_interval) + per_iter)
+        stop_step = int(policy_step) + margin
+        decision = {
+            "stop_step": stop_step,
+            "decided_at_step": int(policy_step),
+            "requested_by": sorted(requests) if requests else [self.rank],
+        }
+        try:
+            _kv_set(client, f"{self.ns}/ctl/decision", json.dumps(decision))
+        except Exception:
+            return  # retried from the next step()
+        self._published_decision = True
+        with self._lock:
+            self._decision = decision
+        from sheeprl_tpu.resilience import signals
+
+        signals.mark_preempted()  # this rank's exit now reports "preempted"
+
+    def _poll_control(self, client: Any) -> None:
+        entries = _kv_dir(client, f"{self.ns}/ctl/")
+        decision = None
+        abort = None
+        requests: Dict[int, int] = {}
+        for key, value in entries:
+            name = key.rsplit("/", 1)[-1]
+            try:
+                payload = json.loads(value)
+            except (TypeError, ValueError):
+                continue
+            if name == "decision":
+                decision = payload
+            elif name == "abort":
+                abort = payload
+            elif name.startswith("r"):
+                try:
+                    requests[int(name[1:])] = int(payload.get("step") or 0)
+                except (TypeError, ValueError):
+                    continue
+        decision_is_new = False
+        with self._lock:
+            if requests:
+                self._requests.update(requests)
+            if decision is not None and self._decision is None:
+                self._decision = decision
+                decision_is_new = True
+            if abort is not None and self._abort is None:
+                self._abort = abort
+            abort_now = self._abort
+        if decision_is_new:
+            from sheeprl_tpu.resilience import signals
+
+            # gang-level agreement: this rank exits preempted even though the
+            # reclaim signal may only ever have reached a peer
+            signals.mark_preempted()
+
+        if abort_now is not None and not self._abort_announced:
+            self._abort_announced = True
+            self._emit(
+                "health",
+                status="rank_dead",
+                rank=abort_now.get("rank"),
+                reason=abort_now.get("reason"),
+                observed_by=abort_now.get("observed_by"),
+                critical=True,
+            )
+
+    # -- heartbeat threads ---------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        from sheeprl_tpu.resilience import faults
+
+        while not self._stop.wait(self.heartbeat_interval):
+            client = _kv()
+            if client is None:
+                continue
+            if faults.heartbeat_stalled():
+                continue  # injected zombie: alive but silent on the control plane
+            self._hb_counter += 1
+            with self._lock:
+                step = self._last_step
+            try:
+                _kv_set(
+                    client,
+                    f"{self.ns}/hb/r{self.rank}",
+                    json.dumps({"n": self._hb_counter, "step": step, "pid": os.getpid()}),
+                )
+            except Exception:
+                continue  # a dying coordination service: peers time out anyway
+
+    def _monitor_loop(self) -> None:
+        started = time.monotonic()
+        poll = max(min(self.heartbeat_interval, self.heartbeat_timeout / 4.0), 0.05)
+        while not self._stop.wait(poll):
+            client = _kv()
+            if client is None:
+                continue
+            now = time.monotonic()
+            seen: Dict[int, int] = {}
+            for key, value in _kv_dir(client, f"{self.ns}/hb/"):
+                name = key.rsplit("/", 1)[-1]
+                if not name.startswith("r"):
+                    continue
+                try:
+                    seen[int(name[1:])] = int(json.loads(value).get("n") or 0)
+                except (TypeError, ValueError):
+                    continue
+            for peer in range(self.nprocs):
+                if peer == self.rank or peer in self._dead:
+                    continue
+                counter = seen.get(peer)
+                prev = self._hb_seen.get(peer)
+                if counter is None and prev is None:
+                    # never heartbeated: allow for process spawn + imports
+                    if now - started > self.startup_timeout:
+                        self._declare_dead(client, peer, now - started)
+                    continue
+                if counter is not None and (prev is None or counter != prev[0]):
+                    self._hb_seen[peer] = (counter, now)
+                elif now - prev[1] > self.heartbeat_timeout:
+                    # stale counter — or a key that VANISHED after the peer had
+                    # beat (dying KV range): both are the heartbeat-timeout
+                    # window, never the startup one
+                    self._declare_dead(client, peer, now - prev[1])
+
+    def _declare_dead(self, client: Any, peer: int, silent_seconds: float) -> None:
+        self._dead[peer] = silent_seconds
+        abort = {
+            "reason": "heartbeat timeout",
+            "rank": peer,
+            "silent_seconds": round(silent_seconds, 1),
+            "observed_by": self.rank,
+        }
+        with self._lock:
+            if self._abort is None:
+                self._abort = abort
+        try:
+            _kv_set(client, f"{self.ns}/ctl/abort", json.dumps(abort))
+        except Exception:
+            pass
+        if not self._abort_announced:
+            self._abort_announced = True
+            self._emit(
+                "health",
+                status="rank_dead",
+                rank=peer,
+                reason="heartbeat timeout",
+                silent_seconds=round(silent_seconds, 1),
+                observed_by=self.rank,
+                critical=True,
+            )
+
+
+def build_coordinator(
+    cfg: Any, *, rank: int, emit: Optional[Callable[..., None]] = None
+) -> Optional[DistributedCoordinator]:
+    """Build (and start) the process's coordinator for a multi-process run; None
+    on single-process runs or when no jax.distributed client is up — every
+    caller treats None as "no coordination plane" and falls back to PR 3's
+    process-local semantics."""
+    global _manifest_timeout
+    nprocs = par_dist.process_count()
+    if nprocs <= 1 or _kv() is None:
+        return None
+    dcfg = ((cfg.get("resilience") or {}).get("distributed")) or {}
+    hcfg = dcfg.get("heartbeat") or {}
+    _manifest_timeout = float(dcfg.get("manifest_timeout") or 120.0)
+    return DistributedCoordinator(
+        rank,
+        nprocs,
+        agree_within_iters=int(dcfg.get("agree_within_iters") or 2),
+        poll_interval=float(dcfg.get("poll_interval") or 0.25),
+        heartbeat_interval=float(hcfg.get("interval") or 2.0),
+        heartbeat_timeout=float(hcfg.get("timeout") or 60.0),
+        startup_timeout=float(hcfg.get("startup_timeout") or 300.0),
+        heartbeat_enabled=bool(hcfg.get("enabled", True)),
+        emit=emit,
+    ).start()
+
+
+# ---------------------------------------------------------------------------------
+# Pillar 4: checkpoint-set consistency manifests
+# ---------------------------------------------------------------------------------
+
+
+def _write_manifest(path: str, payload: Dict[str, Any]) -> None:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)  # the begun-marker precedes the save
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+# effective manifest-ack deadline: resilience.distributed.manifest_timeout,
+# latched by build_coordinator (the checkpoint callback has no cfg in scope)
+_manifest_timeout = 120.0
+
+
+@contextmanager
+def checkpoint_manifest(fabric: Any, ckpt_path: str, timeout_s: Optional[float] = None):
+    """Bracket a multi-process checkpoint write with the consistency manifest:
+    ``complete: false`` lands atomically BEFORE the save, every participating
+    rank acks through the KV store after it, and the writer re-writes the
+    manifest with ``complete: true`` (the commit marker, written last) only
+    once all acks arrived — so discovery never resolves a checkpoint some rank
+    didn't finish. Single-process runs are a no-op (no new artifacts).
+
+    The participating ranks are the processes of ``fabric``'s mesh — the set
+    that shares ``fabric.save``'s write + barrier (the whole gang for SPMD, just
+    the player for a decoupled role split)."""
+    from sheeprl_tpu.resilience.discovery import checkpoint_step, manifest_path
+
+    if par_dist.process_count() <= 1:
+        yield
+        return
+    timeout_s = float(_manifest_timeout if timeout_s is None else timeout_s)
+    try:
+        expected = sorted({int(d.process_index) for d in fabric.mesh.devices.reshape(-1)})
+    except Exception:
+        expected = [int(par_dist.process_index())]
+    me = int(par_dist.process_index())
+    writer = me == min(expected)
+    mpath = manifest_path(ckpt_path)
+    step = checkpoint_step(ckpt_path)
+    # keyed by the SHARED manifest name, never the per-rank ckpt basename
+    # (ckpt_{step}_{rank}.ckpt differs per rank; the acks must rendezvous)
+    token = f"sheeprl_res/ckptack/{os.path.basename(mpath)}/s{step}"
+    if writer:
+        if len(expected) > 1:
+            # clear acks left by an EARLIER save of this same step (re-save of
+            # a path, sequential runs on one coordination service): a stale ack
+            # must never satisfy THIS save's rendezvous. Safe pre-save: peers
+            # only ack after the collective save, which cannot complete before
+            # the writer passes this point.
+            client = _kv()
+            if client is not None:
+                try:
+                    client.key_value_delete(token + "/")
+                except Exception:
+                    pass
+        _write_manifest(
+            mpath,
+            {
+                "schema": 1,
+                "step": step,
+                "path": os.path.basename(str(ckpt_path)),
+                "ranks_expected": expected,
+                "complete": False,
+                "begun_at": round(time.time(), 3),
+            },
+        )
+    yield  # the save itself; an exception here leaves the manifest incomplete
+
+    client = _kv()
+    if len(expected) > 1 and client is None:
+        # the ack rendezvous is impossible (coordination service already torn
+        # down): leave the manifest incomplete rather than commit a consistency
+        # that was never verified — discovery falls back to the previous set
+        return
+    if len(expected) > 1:
+        if not writer:
+            try:
+                _kv_set(client, f"{token}/r{me}", "1")
+            except Exception:
+                pass
+            return
+        # writer: bounded wait for every other rank's ack
+        need = {r for r in expected if r != me}
+        deadline = time.monotonic() + float(timeout_s)
+        while need and time.monotonic() < deadline:
+            acked = {
+                int(k.rsplit("/", 1)[-1][1:])
+                for k, _ in _kv_dir(client, token + "/")
+                if k.rsplit("/", 1)[-1].startswith("r")
+            }
+            need -= acked
+            if need:
+                time.sleep(0.2)
+        if need:
+            # leave the manifest incomplete: a rank vanished mid-checkpoint, so
+            # this set must never be resolved; discovery falls back to the
+            # previous complete one
+            return
+    if writer or not expected or len(expected) == 1:
+        _write_manifest(
+            mpath,
+            {
+                "schema": 1,
+                "step": step,
+                "path": os.path.basename(str(ckpt_path)),
+                "ranks_expected": expected,
+                "ranks_committed": expected,
+                "complete": True,
+                "committed_at": round(time.time(), 3),
+            },
+        )
+        if len(expected) > 1:
+            try:
+                client.key_value_delete(token + "/")  # consumed: no stale acks
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------------
+# Pillar 3: gang supervision — N child processes under one supervisor
+# ---------------------------------------------------------------------------------
+
+
+class GangFailureError(RuntimeError):
+    """The gang supervisor exhausted its restart budget on crashes."""
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _classify(exit_codes: Dict[int, int]) -> str:
+    from sheeprl_tpu.resilience import signals
+
+    if all(rc == 0 for rc in exit_codes.values()):
+        return "completed"
+    if all(rc in (0, signals.PREEMPTED_EXIT_CODE) for rc in exit_codes.values()):
+        return "preempt"
+    return "crash"
+
+
+def supervise_gang(cfg: Any, overrides: Sequence[str]) -> str:
+    """Launch ``resilience.distributed.gang.processes`` jax.distributed child
+    processes running this config and supervise them as ONE unit: any child's
+    crash/preempt tears down the survivors and — per the shared
+    ``resilience.supervisor`` policy — restarts the whole gang from the newest
+    manifest-consistent checkpoint, with the attempt counter stamped into every
+    rank's telemetry stream. Returns ``"completed"`` or ``"preempted"``; raises
+    :class:`GangFailureError` when the crash budget is exhausted."""
+    import signal as _signal
+    import subprocess
+    import sys
+
+    from sheeprl_tpu.obs.jsonl import JsonlEventSink
+    from sheeprl_tpu.resilience import signals
+    from sheeprl_tpu.resilience.discovery import find_latest_checkpoint
+    from sheeprl_tpu.utils.logger import run_base_dir
+
+    scfg = (cfg.get("resilience") or {}).get("supervisor") or {}
+    dcfg = (cfg.get("resilience") or {}).get("distributed") or {}
+    gcfg = dcfg.get("gang") or {}
+    n = int(gcfg.get("processes") or 0)
+    if n < 2:
+        raise ValueError("supervise_gang needs resilience.distributed.gang.processes >= 2")
+    max_restarts = int(scfg.get("max_restarts", 3))
+    backoff = float(scfg.get("backoff", 1.0))
+    backoff_cap = float(scfg.get("backoff_cap", 60.0))
+    restart_on_preempt = bool(scfg.get("restart_on_preempt", True))
+    grace = float(gcfg.get("grace") or 20.0)
+
+    run_base = run_base_dir(cfg.root_dir, cfg.run_name)
+    os.makedirs(run_base, exist_ok=True)
+    log_dir = run_base / "gang"
+    os.makedirs(log_dir, exist_ok=True)
+    jsonl_enabled = bool(((cfg.get("metric") or {}).get("telemetry") or {}).get("jsonl", True))
+    jsonl_path = str(run_base / "telemetry.jsonl")
+
+    sink: Optional[JsonlEventSink] = None
+    attempt = 0
+
+    def emit(event: str, **fields: Any) -> None:
+        nonlocal sink
+        if not jsonl_enabled:
+            return
+        if sink is None:
+            try:
+                sink = JsonlEventSink(jsonl_path)
+            except OSError:
+                return
+        fields.setdefault("attempt", attempt)
+        sink.emit(event, **fields)
+
+    # identity pins every attempt shares: resolved run identity (a timestamped
+    # run_name must not re-resolve per child), one run-base telemetry stream,
+    # and in-process supervision off (the gang owns restart policy)
+    base_args = [str(o) for o in overrides] + [
+        f"root_dir={cfg.root_dir}",
+        f"run_name={cfg.run_name}",
+        "resilience.supervisor.enabled=false",
+    ]
+    if jsonl_enabled:
+        base_args.append(f"metric.telemetry.jsonl_path={jsonl_path}")
+    fallback_resume = cfg.checkpoint.get("resume_from") or None
+
+    live_procs: List[subprocess.Popen] = []
+
+    def spawn(attempt_args: List[str]) -> List[subprocess.Popen]:
+        port = _free_port()
+        procs: List[subprocess.Popen] = []
+        accelerator = str((cfg.get("fabric") or {}).get("accelerator", "auto")).lower()
+        for rank in range(n):
+            env = dict(os.environ)
+            env["SHEEPRL_COORDINATOR"] = f"127.0.0.1:{port}"
+            env["SHEEPRL_GANG_PROCESSES"] = str(n)
+            env["SHEEPRL_GANG_RANK"] = str(rank)
+            env["SHEEPRL_GANG_ATTEMPT"] = str(attempt)
+            if accelerator == "cpu":
+                # __main__'s bring-up must pin the platform BEFORE initialize:
+                # a cpu gang must never let a child touch an accelerator backend
+                env["SHEEPRL_GANG_PLATFORM"] = "cpu"
+            log_path = log_dir / f"attempt{attempt}.rank{rank}.log"
+            log_fh = open(log_path, "ab")
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "sheeprl_tpu"] + attempt_args,
+                    env=env,
+                    stdout=log_fh,
+                    stderr=subprocess.STDOUT,
+                    # own session: a process-group SIGTERM/SIGINT (pod reclaim,
+                    # Ctrl-C) reaches only the supervisor, whose forward is then
+                    # each child's FIRST signal — group delivery plus the
+                    # forward would be the second, i.e. an instant force-exit
+                    # before any emergency checkpoint
+                    start_new_session=True,
+                )
+            )
+            log_fh.close()  # the child holds the descriptor
+        live_procs[:] = procs
+        return procs
+
+    def wait_gang(procs: List[subprocess.Popen]) -> tuple:
+        """Wait for every child; after the first exit survivors get ``grace``
+        seconds to finish on their own, then SIGTERM, then SIGKILL. Returns
+        ({rank: exit_code}, self_exited_ranks, forwarded) — self_exited holds
+        the ranks that exited BEFORE any teardown escalation (the culprits of a
+        failed attempt, as opposed to healthy survivors the supervisor itself
+        terminated), and forwarded says a preemption was relayed to the gang."""
+        forwarded = False
+        first_exit: Optional[float] = None
+        terminated = killed = False
+        self_exited: set = set()
+        while True:
+            if signals.preemption_requested() and not forwarded:
+                forwarded = True
+                emit("gang", status="preempt_forward", processes=n)
+                for p in procs:
+                    if p.poll() is None:
+                        try:
+                            p.send_signal(_signal.SIGTERM)
+                        except OSError:
+                            pass
+                # the teardown clock deliberately does NOT start here: children
+                # need the agreed stop step + the emergency save, which can
+                # exceed `grace` (a big replay buffer). The first child to
+                # actually exit starts the clock for the stragglers — and the
+                # children's own bounded channel/KV ops keep that first exit
+                # finite even when a peer is wedged.
+            rcs = [p.poll() for p in procs]
+            if not terminated and not killed:
+                self_exited.update(i for i, rc in enumerate(rcs) if rc is not None)
+            if all(rc is not None for rc in rcs):
+                return {i: int(rc) for i, rc in enumerate(rcs)}, self_exited, forwarded
+            # the first exit — clean or not — starts the teardown clock: healthy
+            # staggered completion finishes well inside `grace`, a survivor
+            # blocked on a dead peer does not and gets escalated
+            if first_exit is None and any(rc is not None for rc in rcs):
+                first_exit = time.monotonic()
+            if first_exit is not None:
+                waited = time.monotonic() - first_exit
+                # after a forwarded preempt each child already HOLDS its first
+                # signal — a second SIGTERM is the handler's force-exit path and
+                # would kill an in-flight emergency save, so the escalation
+                # skips straight to SIGKILL for stragglers
+                if waited > grace and not terminated and not forwarded:
+                    terminated = True
+                    for p in procs:
+                        if p.poll() is None:
+                            try:
+                                p.send_signal(_signal.SIGTERM)
+                            except OSError:
+                                pass
+                # the SIGTERM above was the survivor's FIRST signal — it now
+                # writes its own emergency checkpoint, which needs a window
+                # that scales with grace, not a fixed 10 s
+                elif waited > grace + max(10.0, grace) and not killed:
+                    killed = True
+                    for p in procs:
+                        if p.poll() is None:
+                            try:
+                                p.kill()
+                            except OSError:
+                                pass
+            time.sleep(0.2)
+
+    try:
+        while True:
+            if signals.preemption_requested() and not restart_on_preempt:
+                emit("supervisor", status="preempted", attempts=attempt, between_attempts=True)
+                return "preempted"
+            signals.reset_preemption()
+
+            attempt_args = list(base_args)
+            if attempt > 0:
+                resume_from = find_latest_checkpoint(str(run_base)) or fallback_resume
+                # a fault that (presumably) fired must not ride into the retry —
+                # the gang cannot see the child-process fired-ledger, so strip
+                # unconditionally, mirroring the in-process supervisor
+                attempt_args = [
+                    a for a in attempt_args if not a.startswith("checkpoint.resume_from=")
+                ]
+                attempt_args += ["resilience.fault.kind=null"]
+                if resume_from is not None:
+                    attempt_args.append(f"checkpoint.resume_from={resume_from}")
+            attempt_args.append(f"metric.telemetry.attempt={attempt}")
+
+            emit("gang", status="spawn", processes=n, args_tail=attempt_args[-3:])
+            exit_codes, self_exited, forwarded = wait_gang(spawn(attempt_args))
+            outcome = _classify(exit_codes)
+            if (
+                outcome == "crash"
+                and forwarded
+                and all(
+                    exit_codes[r] in (0, signals.PREEMPTED_EXIT_CODE) for r in self_exited
+                )
+            ):
+                # stragglers the teardown SIGKILLed during a forwarded preempt
+                # are reclaim collateral, not crashes: every rank that exited on
+                # its own cooperated, so the attempt ended by preemption
+                outcome = "preempt"
+            # attribution: the ranks that FAILED ON THEIR OWN — never the
+            # survivors the teardown escalation itself SIGTERM/SIGKILLed, not
+            # cooperative preempt exits (75 is "reschedule me", not death), and
+            # not healthy ranks reporting a PEER's death (77, RankFailureError)
+            dead_ranks = {
+                str(r): rc
+                for r, rc in exit_codes.items()
+                if rc not in (0, signals.PREEMPTED_EXIT_CODE, signals.RANK_FAILED_EXIT_CODE)
+                and r in self_exited
+            }
+            emit("gang", status="attempt_exit", exit_codes={str(r): rc for r, rc in exit_codes.items()}, outcome=outcome)
+
+            if outcome == "completed":
+                if attempt > 0:
+                    emit("supervisor", status="completed", attempts=attempt)
+                return "completed"
+            if outcome == "preempt" and not restart_on_preempt:
+                emit("supervisor", status="preempted", attempts=attempt)
+                return "preempted"
+
+            attempt += 1
+            if attempt > max_restarts:
+                emit(
+                    "giveup",
+                    reason=outcome,
+                    attempts=attempt - 1,
+                    max_restarts=max_restarts,
+                    dead_ranks=dead_ranks,
+                )
+                if outcome == "crash":
+                    raise GangFailureError(
+                        f"gang of {n} crashed {attempt - 1} time(s) past the restart "
+                        f"budget (last exit codes: {exit_codes}); see {log_dir}"
+                    )
+                return "preempted"
+
+            resume_preview = find_latest_checkpoint(str(run_base)) or fallback_resume
+            delay = min(backoff * (2.0 ** (attempt - 1)), backoff_cap) if backoff > 0 else 0.0
+            emit(
+                "restart",
+                attempt=attempt,
+                reason=outcome if outcome == "crash" else "preempt",
+                dead_ranks=dead_ranks,
+                resume_from=str(resume_preview) if resume_preview else None,
+                backoff_seconds=round(delay, 3),
+            )
+            if delay > 0:
+                time.sleep(delay)
+    finally:
+        # never orphan the gang: children run in their OWN sessions (see
+        # spawn), so a forced supervisor unwind (second Ctrl-C, crash) is the
+        # only thing standing between a wedged rank and immortality
+        for p in live_procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 5.0
+        while any(p.poll() is None for p in live_procs) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        for p in live_procs:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        if sink is not None:
+            sink.close()
+
+
+def gang_processes(cfg: Any) -> int:
+    """The configured gang size (0 when gang mode is off)."""
+    gcfg = (((cfg.get("resilience") or {}).get("distributed") or {}).get("gang")) or {}
+    return int(gcfg.get("processes") or 0)

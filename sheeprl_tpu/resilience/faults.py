@@ -22,9 +22,25 @@ first-class requirement for the same reason):
                    committed, orbax directory not): recovery must skip the torn
                    artifacts and fall back to the previous valid checkpoint.
 
+Rank-targeted faults (multi-process runs; ``resilience.fault.rank`` selects the
+target process index, default 0 — the driving rank, which keeps the original
+single-process semantics):
+
+- ``kill_rank``        — SIGKILL this process at the configured step: a dead
+                         peer with no cleanup, no channel sentinel, no exit
+                         handshake — the failure mode heartbeat detection and
+                         gang supervision exist for;
+- ``stale_heartbeat``  — stop publishing heartbeats while the process keeps
+                         running: a zombie rank, detected by the peers'
+                         failure monitors;
+- ``channel_drop``     — the target's next channel ``put`` is silently lost on
+                         the wire (the sequence advances, no payload lands):
+                         receivers must exhaust their bounded timeout instead
+                         of hanging forever.
+
 Every fault fires at most once per process (the in-process supervisor restarts
 within the same process, so a resumed attempt replaying policy steps below
-``at_policy_step`` must not re-trigger); the supervisor additionally strips the
+``at_policy_step`` must not re-trigger); the supervisors additionally strip the
 fault from retry configs, covering cross-process restarts.
 """
 
@@ -35,7 +51,15 @@ from typing import Any, Callable, Dict, Optional
 
 from sheeprl_tpu.resilience import signals
 
-FAULT_KINDS = ("crash", "sigterm", "env_step", "ckpt_kill")
+FAULT_KINDS = (
+    "crash",
+    "sigterm",
+    "env_step",
+    "ckpt_kill",
+    "kill_rank",
+    "stale_heartbeat",
+    "channel_drop",
+)
 
 
 class InjectedFaultError(RuntimeError):
@@ -45,11 +69,14 @@ class InjectedFaultError(RuntimeError):
 _lock = threading.Lock()
 _fired: Dict[tuple, int] = {}  # (kind, at_policy_step) -> policy step it fired at
 _env_fault_armed = threading.Event()
+_heartbeat_stale = threading.Event()
+_channel_drop_armed = threading.Event()
 
 
-def normalize_fault_cfg(resilience_cfg: Any) -> Optional[Dict[str, int]]:
-    """``{kind, at}`` from ``cfg.resilience.fault``, or None when off. Raises on
-    an unknown kind so config policing fails before the run launches."""
+def normalize_fault_cfg(resilience_cfg: Any) -> Optional[Dict[str, Any]]:
+    """``{kind, at, rank}`` from ``cfg.resilience.fault``, or None when off.
+    Raises on an unknown kind so config policing fails before the run launches.
+    ``rank`` is the target process index; None means the driving rank 0."""
     fault = (resilience_cfg or {}).get("fault") or {}
     kind = fault.get("kind")
     if kind is None or str(kind).lower() in ("none", "null", "off", "false"):
@@ -59,7 +86,12 @@ def normalize_fault_cfg(resilience_cfg: Any) -> Optional[Dict[str, int]]:
         raise ValueError(
             f"unknown resilience.fault.kind {kind!r}; available: none, " + ", ".join(FAULT_KINDS)
         )
-    return {"kind": kind, "at": int(fault.get("at_policy_step") or 0)}
+    rank = fault.get("rank")
+    return {
+        "kind": kind,
+        "at": int(fault.get("at_policy_step") or 0),
+        "rank": None if rank is None else int(rank),
+    }
 
 
 def has_fired() -> bool:
@@ -72,10 +104,31 @@ def reset_faults() -> None:
     with _lock:
         _fired.clear()
     _env_fault_armed.clear()
+    _heartbeat_stale.clear()
+    _channel_drop_armed.clear()
     from sheeprl_tpu.utils import checkpoint
 
     if checkpoint._fault_hook is _ckpt_kill_hook:
         checkpoint._fault_hook = None
+    from sheeprl_tpu.parallel import distributed as par_dist
+
+    if par_dist._channel_drop_hook is _consume_channel_drop:
+        par_dist._channel_drop_hook = None
+
+
+def heartbeat_stalled() -> bool:
+    """Whether the ``stale_heartbeat`` fault silenced this process's heartbeat
+    writer (permanent once fired — a zombie does not recover)."""
+    return _heartbeat_stale.is_set()
+
+
+def _consume_channel_drop() -> bool:
+    """One-shot poll the channel source runs per ``put`` (see
+    ``parallel/distributed.py``'s ``_channel_drop_hook``)."""
+    if _channel_drop_armed.is_set():
+        _channel_drop_armed.clear()
+        return True
+    return False
 
 
 def consume_env_fault() -> bool:
@@ -99,12 +152,13 @@ def _ckpt_kill_hook(stage: str, path: str) -> None:
 
 
 class FaultPlan:
-    """The armed fault a :class:`ResilienceMonitor` drives from its per-iteration
-    hook. ``maybe_fire`` is idempotent across restarts (process-global ledger)."""
+    """The armed fault a resilience facade drives from its per-iteration hook.
+    ``maybe_fire`` is idempotent across restarts (process-global ledger)."""
 
-    def __init__(self, kind: str, at_policy_step: int) -> None:
+    def __init__(self, kind: str, at_policy_step: int, rank: Optional[int] = None) -> None:
         self.kind = kind
         self.at = int(at_policy_step)
+        self.rank = rank
 
     def maybe_fire(self, policy_step: int, emit: Callable[..., None]) -> None:
         if policy_step < self.at:
@@ -114,7 +168,7 @@ class FaultPlan:
             if key in _fired:
                 return
             _fired[key] = int(policy_step)
-        emit("fault", step=policy_step, kind=self.kind, at_policy_step=self.at)
+        emit("fault", step=policy_step, kind=self.kind, at_policy_step=self.at, rank=self.rank)
         if self.kind == "crash":
             raise InjectedFaultError(
                 f"resilience.fault=crash: injected hard crash at policy step {policy_step}"
@@ -127,10 +181,32 @@ class FaultPlan:
             from sheeprl_tpu.utils import checkpoint
 
             checkpoint._fault_hook = _ckpt_kill_hook
+        elif self.kind == "kill_rank":
+            # a DEAD peer, not a crashing one: no exception path, no channel
+            # sentinel, no exit handshake — SIGKILL bypasses every cleanup
+            import os
+            import signal as _stdlib_signal
+
+            os.kill(os.getpid(), _stdlib_signal.SIGKILL)
+        elif self.kind == "stale_heartbeat":
+            _heartbeat_stale.set()
+        elif self.kind == "channel_drop":
+            from sheeprl_tpu.parallel import distributed as par_dist
+
+            _channel_drop_armed.set()
+            par_dist._channel_drop_hook = _consume_channel_drop
 
 
-def build_fault_plan(resilience_cfg: Any) -> Optional[FaultPlan]:
+def build_fault_plan(
+    resilience_cfg: Any, process_rank: Optional[int] = None
+) -> Optional[FaultPlan]:
+    """The armed plan for THIS process, or None. ``fault.rank`` targets one
+    process of a multi-process run (default 0, the driving rank — which keeps
+    single-process semantics unchanged); a non-matching rank arms nothing."""
     spec = normalize_fault_cfg(resilience_cfg)
     if spec is None:
         return None
-    return FaultPlan(spec["kind"], spec["at"])
+    target = 0 if spec["rank"] is None else int(spec["rank"])
+    if process_rank is not None and target != int(process_rank):
+        return None
+    return FaultPlan(spec["kind"], spec["at"], rank=target)

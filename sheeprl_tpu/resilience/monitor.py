@@ -32,6 +32,7 @@ from typing import Any, Optional
 
 from sheeprl_tpu.obs.jsonl import JsonlEventSink
 from sheeprl_tpu.resilience import signals
+from sheeprl_tpu.resilience.distributed import build_coordinator
 from sheeprl_tpu.resilience.faults import build_fault_plan
 from sheeprl_tpu.resilience.watchdog import ProgressWatchdog, stop_all_watchdogs
 
@@ -56,22 +57,101 @@ class NullResilience:
         return False
 
 
-class PollResilience(NullResilience):
-    """Non-rank-0 facade for multi-process SPMD: no events, faults or watchdog
-    (rank-0 concerns), but the preemption poll is LIVE. Every rank folds the
-    same flag into its checkpoint condition and loop-exit break — a hard-coded
-    False would be rank-divergent, and ``fabric.save`` barriers across
-    processes, so rank 0 would hang in its emergency checkpoint while the other
-    ranks sail past the block (external launchers deliver the reclaim SIGTERM to
-    every process, so the process-local flags agree)."""
+class PeerResilience(NullResilience):
+    """Non-rank-0 facade for multi-process runs (SPMD ranks, decoupled learner
+    processes). Replaces PR 3's ``PollResilience`` live-local-poll caveat: with
+    the coordination plane up, the preemption verdict every rank folds into its
+    checkpoint condition is the *agreed* decision from
+    :class:`~sheeprl_tpu.resilience.distributed.DistributedCoordinator` — a
+    local SIGTERM only *publishes a request*; the rank keeps running until the
+    rank-0-led stop step, so every rank stops at the same iteration by
+    construction (no signal-skew window). Without a coordination plane (no
+    jax.distributed KV client) the poll falls back to the live process-local
+    flag, which is still strictly better than a hard-coded False.
+
+    Also rank-local concerns the PR 3 facade lacked: heartbeat presence +
+    peer-failure detection (a dead peer raises :class:`RankFailureError` from
+    ``step`` instead of letting this rank hang), rank-targeted fault plans, and
+    critical events (its own ``telemetry.rank{r}.jsonl`` sibling, or the
+    provided role telemetry)."""
 
     enabled = True
 
+    def __init__(self, fabric: Any, cfg: Any, log_dir: Optional[str] = None, telemetry: Any = None) -> None:
+        rcfg = cfg.get("resilience") or {}
+        tcfg = (cfg.get("metric") or {}).get("telemetry") or {}
+        self._telemetry = telemetry
+        self._rank = int(getattr(fabric, "global_rank", 0) or 0)
+        self._attempt = int(tcfg.get("attempt") or 0)
+        self._fault = build_fault_plan(rcfg, process_rank=self._rank)
+        self._preempt_seen = False
+        self._emit_lock = threading.Lock()
+        self._own_sink: Optional[JsonlEventSink] = None
+        self._jsonl_enabled = bool(tcfg.get("jsonl", True))
+        self._sink_path = _rank_stream_path(tcfg.get("jsonl_path"), log_dir, self._rank)
+        self._coord = build_coordinator(cfg, rank=self._rank, emit=self._emit_critical)
+
+    # -- hooks -------------------------------------------------------------------
+
+    def step(self, policy_step: int) -> None:
+        if self._fault is not None:
+            self._fault.maybe_fire(policy_step, self._emit_critical)
+        local = signals.local_preemption_requested()
+        if local and not self._preempt_seen:
+            self._preempt_seen = True
+            self._emit_critical(
+                "preempt", step=policy_step, signum=signals.preempt_signum(), rank=self._rank
+            )
+        if self._coord is not None:
+            self._coord.step(policy_step, local_preempt=local)
+            self._coord.check_abort()  # a dead peer: tear down, don't hang
+
     def preempt_requested(self) -> bool:
+        if self._coord is not None:
+            return self._coord.preempt_requested()
         return signals.preemption_requested()
 
     def finalize(self, policy_step: Optional[int] = None) -> bool:
-        return signals.preemption_requested()
+        preempted = self.preempt_requested() or signals.preemption_requested()
+        if self._coord is not None:
+            self._coord.close()
+            self._coord = None
+        if self._own_sink is not None:
+            self._own_sink.close()
+            self._own_sink = None
+        return preempted
+
+    # -- internals ---------------------------------------------------------------
+
+    def _emit_critical(self, event: str, step: Optional[int] = None, critical: bool = True, **fields: Any) -> None:
+        with self._emit_lock:
+            if self._telemetry is not None and getattr(self._telemetry, "enabled", False):
+                if self._telemetry.emit_event(event, step=step, **fields):
+                    return
+            if self._own_sink is None:
+                if not self._jsonl_enabled or self._sink_path is None:
+                    return
+                try:
+                    self._own_sink = JsonlEventSink(
+                        self._sink_path, rank=self._rank, attempt=self._attempt
+                    )
+                except OSError:
+                    return
+            self._own_sink.emit(event, step=step, **fields)
+
+
+def _rank_stream_path(jsonl_path: Any, log_dir: Optional[str], rank: int) -> Optional[str]:
+    """A peer rank's own stream: ``telemetry.rank{r}.jsonl`` next to the primary
+    stream (never the primary file itself — per-path seq counters are per
+    process, so cross-process writers must not share a file)."""
+    import os
+
+    if jsonl_path:
+        root, ext = os.path.splitext(str(jsonl_path))
+        return f"{root}.rank{rank}{ext or '.jsonl'}"
+    if log_dir:
+        return os.path.join(str(log_dir), f"telemetry.rank{rank}.jsonl")
+    return None
 
 
 class ResilienceMonitor:
@@ -89,7 +169,8 @@ class ResilienceMonitor:
         tcfg = (cfg.get("metric") or {}).get("telemetry") or {}
         self._fabric = fabric
         self._telemetry = telemetry
-        self._fault = build_fault_plan(rcfg)
+        rank0 = int(getattr(fabric, "global_rank", 0) or 0)
+        self._fault = build_fault_plan(rcfg, process_rank=rank0)
         self._preempt_seen = False
         self._emit_lock = threading.Lock()
         self._own_sink: Optional[JsonlEventSink] = None
@@ -120,6 +201,14 @@ class ResilienceMonitor:
                 grace=float(wcfg.get("grace") or 30.0),
             ).start()
 
+        # multi-process runs get the coordination plane: preempt agreement,
+        # heartbeats and rank-failure detection (resilience/distributed.py);
+        # None on single-process runs — everything below degrades to PR 3's
+        # process-local semantics
+        self._coord = build_coordinator(
+            cfg, rank=self._rank, emit=lambda event, **f: self._emit(event, **f)
+        )
+
         if cfg.get("checkpoint", {}).get("resume_from"):
             self._emit("resume", resume_from=str(cfg.checkpoint.resume_from))
 
@@ -130,20 +219,42 @@ class ResilienceMonitor:
             self.watchdog.feed(policy_step)
         if self._fault is not None:
             self._fault.maybe_fire(policy_step, self._emit_critical)
-        if not self._preempt_seen and signals.preemption_requested():
+        local = signals.local_preemption_requested()
+        if self._coord is not None:
+            self._coord.step(policy_step, local_preempt=local)
+            self._coord.check_abort()  # a dead peer: coordinated teardown, not a hang
+        if not self._preempt_seen and (local or (self._coord is not None and self._coord.decision() is not None)):
             self._preempt_seen = True
+            decision = self._coord.decision() if self._coord is not None else None
             self._emit(
                 "preempt",
                 step=policy_step,
                 signum=signals.preempt_signum(),
                 critical=True,
+                **(
+                    {
+                        "stop_step": decision["stop_step"],
+                        "requested_by": decision.get("requested_by"),
+                    }
+                    if decision
+                    else {}
+                ),
             )
             self._fabric.print(
                 f"[sheeprl-resilience] preemption requested at policy step {policy_step}: "
-                "writing emergency checkpoint and shutting down"
+                + (
+                    f"all ranks take the emergency checkpoint at step >= {decision['stop_step']}"
+                    if decision
+                    else "writing emergency checkpoint and shutting down"
+                )
             )
 
     def preempt_requested(self) -> bool:
+        # multi-process: the AGREED decision, never the local flag alone — every
+        # rank folds the same verdict into the same iteration's checkpoint
+        # condition (closing PR 3's one-iteration signal-skew window)
+        if self._coord is not None:
+            return self._coord.preempt_requested()
         return signals.preemption_requested()
 
     def observe_checkpoint(
@@ -168,7 +279,14 @@ class ResilienceMonitor:
         if self.watchdog is not None:
             self.watchdog.stop()
             self.watchdog = None
-        preempted = signals.preemption_requested()
+        # the agreed decision counts even when the signal landed on a PEER rank
+        # (this process never saw a local flag but still preempted with the gang)
+        preempted = signals.preemption_requested() or (
+            self._coord is not None and self._coord.decision() is not None
+        )
+        if self._coord is not None:
+            self._coord.close()
+            self._coord = None
         if preempted:
             self._emit(
                 "preempt_exit",
@@ -204,23 +322,39 @@ class ResilienceMonitor:
 
 
 def build_resilience(fabric: Any, cfg: Any, log_dir: Optional[str] = None, telemetry: Any = None):
-    """Build the run's resilience facade from the ``resilience`` config group.
-    Rank-0-only (one controller process observes the run; MPMD trainer roles are
-    reached through the channel shutdown protocol, not their own monitor).
-    Returns :class:`NullResilience` when every feature is off — the loops then
-    behave byte-for-byte as before."""
+    """Build the run's resilience facade from the ``resilience`` config group:
+    the full :class:`ResilienceMonitor` on rank 0 (events, faults, watchdog,
+    preempt agreement leadership), :class:`PeerResilience` on every other rank
+    of a multi-process run (agreed-preempt consumption, heartbeat presence,
+    rank-targeted faults, peer-failure detection). Returns
+    :class:`NullResilience` when every feature is off — the loops then behave
+    byte-for-byte as before."""
     rcfg = cfg.get("resilience") or {}
-    if not getattr(fabric, "is_global_zero", True):
-        # non-rank-0 SPMD processes: the preemption poll must stay live so the
-        # per-rank checkpoint conditions (and fabric.save's cross-process
-        # barrier) cannot diverge on a pod-wide SIGTERM
-        return PollResilience() if bool(rcfg.get("handler", True)) else NullResilience()
     handler = bool(rcfg.get("handler", True))
+    if not getattr(fabric, "is_global_zero", True):
+        rank = int(getattr(fabric, "global_rank", 0) or 0)
+        fault_on = build_fault_plan(rcfg, process_rank=rank) is not None
+        # the multi_process term mirrors the rank-0 gate below: rank 0 WILL run
+        # the failure monitor, so every peer must heartbeat — a NullResilience
+        # peer would be declared dead after startup_timeout on a healthy run
+        if not (handler or fault_on or _multi_process()):
+            return NullResilience()
+        return PeerResilience(fabric, cfg, log_dir, telemetry=telemetry)
     # single source of truth for "is a fault configured" (check_configs already
     # validated, so an unknown kind cannot raise here)
-    fault_on = build_fault_plan(rcfg) is not None
+    fault_on = build_fault_plan(rcfg, process_rank=0) is not None
     watchdog_on = bool((rcfg.get("watchdog") or {}).get("enabled", False))
     supervised = bool((rcfg.get("supervisor") or {}).get("enabled", False))
-    if not (handler or fault_on or watchdog_on or supervised):
+    multi_process = _multi_process()
+    if not (handler or fault_on or watchdog_on or supervised or multi_process):
         return NullResilience()
     return ResilienceMonitor(fabric, cfg, log_dir, telemetry=telemetry)
+
+
+def _multi_process() -> bool:
+    from sheeprl_tpu.parallel import distributed as par_dist
+
+    try:
+        return par_dist.process_count() > 1
+    except Exception:
+        return False

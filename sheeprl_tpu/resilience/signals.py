@@ -30,11 +30,21 @@ from typing import Dict, Optional, Tuple
 PREEMPTED_EXIT_CODE = 75
 # Watchdog abort escalation exit status (see resilience/watchdog.py).
 WATCHDOG_EXIT_CODE = 76
+# A healthy rank that tore itself down because a PEER was declared dead
+# (resilience/distributed.py RankFailureError): the gang supervisor must not
+# blame this rank for the attempt's death — the dead peer is the culprit.
+RANK_FAILED_EXIT_CODE = 77
 
 _DEFAULT_SIGNALS: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)
 
 _state_lock = threading.Lock()
 _flag = threading.Event()
+# gang-level preemption: set when the distributed coordinator learns the gang
+# agreed to preempt (a SIGTERM may have landed on a PEER rank only). Kept
+# separate from _flag so the second-signal force-exit escape keys strictly on a
+# signal THIS process received — an OS SIGTERM arriving after the gang flag was
+# set must take the normal cooperative path, not an immediate re-raise.
+_gang_flag = threading.Event()
 _signum: Optional[int] = None
 _received_at: Optional[float] = None
 _prev_handlers: Dict[int, object] = {}
@@ -112,8 +122,22 @@ def uninstall_preemption_handler() -> None:
 
 
 def preemption_requested() -> bool:
-    """The poll the training loops run at iteration boundaries."""
+    """The poll the training loops run at iteration boundaries — true on a
+    process-local signal OR a gang-level agreement relayed by the distributed
+    coordinator (so every rank of a preempting gang exits preempted, including
+    ranks the reclaim signal never reached)."""
+    return _flag.is_set() or _gang_flag.is_set()
+
+
+def local_preemption_requested() -> bool:
+    """Strictly the process-local signal flag — what a rank *publishes* to the
+    coordination plane (the gang flag is what it *consumes* back)."""
     return _flag.is_set()
+
+
+def mark_preempted() -> None:
+    """Record a gang-level preemption agreement (distributed coordinator only)."""
+    _gang_flag.set()
 
 
 def preempt_signum() -> Optional[int]:
@@ -129,9 +153,10 @@ def preempt_age_seconds() -> Optional[float]:
 
 
 def reset_preemption() -> None:
-    """Clear the flag (the in-process supervisor calls this between attempts)."""
+    """Clear the flags (the supervisors call this between attempts)."""
     global _signum, _received_at
     _flag.clear()
+    _gang_flag.clear()
     _signum = None
     _received_at = None
 

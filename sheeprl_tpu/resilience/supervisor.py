@@ -52,10 +52,19 @@ def supervise(
     cfg: dotdict,
     run_fn: Callable[[dotdict], Any],
     resume_merge: Callable[[dotdict], dotdict],
+    argv_cfg: Optional[dotdict] = None,
 ) -> str:
     """Run ``run_fn(cfg)`` under restart supervision. Returns ``"completed"`` or
     ``"preempted"`` (the CLI maps the latter to the preempted exit code);
-    a crash that exhausts the restart budget re-raises."""
+    a crash that exhausts the restart budget re-raises.
+
+    ``argv_cfg`` is the original *argv-merged* config — ``compose(overrides)``
+    BEFORE any launch-time resume merge. Retry attempts are rebuilt from it
+    (not from the resolved ``cfg``) and re-merged against the retry's resolved
+    checkpoint through ``resume_merge``, which the CLI closes over the user's
+    explicit dotted overrides — so a ``buffer.size=N`` typed on the command
+    line survives every attempt instead of being silently replaced by the
+    checkpoint's saved config."""
     from sheeprl_tpu.parallel import distributed
     from sheeprl_tpu.utils.logger import run_base_dir
 
@@ -98,7 +107,14 @@ def supervise(
         fields.setdefault("attempt", attempt)
         sink.emit(event, **fields)
 
-    original = dotdict(copy.deepcopy(cfg.as_dict()))
+    # retries rebuild from the argv-merged cfg, NOT the resolved base: when the
+    # launch itself resumed, the resolved cfg already had the old run's config
+    # merged over it — rebuilding from that bakes the old values in a second
+    # time and user overrides can never win the retry merge
+    original = dotdict(copy.deepcopy((argv_cfg if argv_cfg is not None else cfg).as_dict()))
+    # ...but the resume fallback must be the RESOLVED path (the argv value may
+    # be the literal "latest")
+    fallback_resume = cfg.checkpoint.get("resume_from") or None
     current = cfg
     attempt = 0
     try:
@@ -146,9 +162,7 @@ def supervise(
             # nothing in THIS run's dir yet (crash before the first checkpoint)
             # must not discard a resume checkpoint the user originally launched
             # with — fall back to it rather than silently starting from scratch
-            resume_from = find_latest_checkpoint(str(run_base)) or (
-                original.checkpoint.get("resume_from") or None
-            )
+            resume_from = find_latest_checkpoint(str(run_base)) or fallback_resume
             delay = min(backoff * (2.0 ** (attempt - 1)), backoff_cap) if backoff > 0 else 0.0
             emit(
                 "restart",
@@ -174,6 +188,11 @@ def supervise(
             # (after resume_merge: `metric` is non-resumable, so this sticks)
             retry.metric.setdefault("telemetry", dotdict({}))
             retry.metric.telemetry.attempt = attempt
+            # the retry was rebuilt from the ARGV config, which never carried
+            # the run-base stream pin set on the resolved cfg above — re-pin it
+            # or attempt 2+ would write its own per-version stream
+            if jsonl_enabled:
+                retry.metric.telemetry.jsonl_path = cfg.metric.telemetry.jsonl_path
             current = retry
     finally:
         if sink is not None:

@@ -25,12 +25,17 @@ class CheckpointCallback:
         state: Dict[str, Any],
         replay_buffer=None,
     ) -> None:
+        from sheeprl_tpu.resilience.distributed import checkpoint_manifest
         from sheeprl_tpu.resilience.watchdog import watchdogs_paused
 
         # the write blocks the loop for as long as the state is big (a large
         # synchronous orbax save can exceed any sane stall timeout) — that is
-        # progress, not a hang, so the progress watchdog must not trip on it
-        with watchdogs_paused():
+        # progress, not a hang, so the progress watchdog must not trip on it.
+        # checkpoint_manifest (multi-process only) brackets the save with the
+        # consistency manifest: begun incomplete before the write, committed
+        # only after every mesh rank finished — a crash anywhere inside leaves
+        # a set discovery refuses to resolve.
+        with watchdogs_paused(), checkpoint_manifest(fabric, ckpt_path):
             if replay_buffer is not None:
                 true_dones = self._ckpt_rb(replay_buffer)
                 state["rb"] = replay_buffer
@@ -121,5 +126,25 @@ class CheckpointCallback:
             if not os.path.isdir(sidecar[: -len(".extras.pkl")]):
                 try:
                     os.remove(sidecar)
+                except OSError:
+                    pass
+        # consistency manifests whose checkpoint set was swept above (multi-
+        # process runs; see resilience/distributed.py): a manifest with no
+        # remaining ckpt_* artifact for its step is dead weight
+        from sheeprl_tpu.resilience.discovery import manifest_path
+
+        remaining = {
+            manifest_path(c) for c in glob.glob(os.path.join(ckpt_folder, "*.ckpt"))
+        }
+        # a displaced `<path>.ckpt.old` set (mid-displacement crash window,
+        # see discovery.py) is still resolvable: its manifest must survive too
+        remaining |= {
+            manifest_path(c[: -len(".old")])
+            for c in glob.glob(os.path.join(ckpt_folder, "*.ckpt.old"))
+        }
+        for manifest in glob.glob(os.path.join(ckpt_folder, "ckpt_*.manifest.json")):
+            if manifest not in remaining:
+                try:
+                    os.remove(manifest)
                 except OSError:
                     pass

@@ -97,6 +97,31 @@ def test_summary_finishes_with_run_status_and_restart_supersedes_it():
     assert state.exit_code == 1 and "restart budget" in state.status_line
 
 
+def test_gang_restart_line_attributes_the_dead_rank_after_board_reset():
+    state = WatchState()
+    # gang crash stream order: health(rank_dead) → gang(attempt_exit) → restart;
+    # the restart resets the liveness board to alive, but the restart line must
+    # still attribute THIS restart's dead rank
+    state.consume(
+        [
+            _event("start", 1.0),
+            _event("window", 2.0, rank=1, step=10),
+            _event("health", 3.0, status="rank_dead", rank=1, reason="heartbeat timeout"),
+            _event("gang", 4.0, status="attempt_exit", exit_codes={"0": 75, "1": -9}),
+            _event("restart", 5.0, attempt=1, reason="crash"),
+            # the retry's resume event always follows the restart — it must not
+            # erase the restart's reason/attribution
+            _event("resume", 5.5, attempt=1, resume_from="ckpt_1024_0.ckpt"),
+        ]
+    )
+    frame = state.render("run", 6.0, ["telemetry.jsonl"])
+    assert "1 attempt restart(s) (rank 1 died)" in frame
+    assert "ranks: 0 alive · 1 alive" in frame  # the board itself did reset
+    # a later rank_dead in attempt 1 must not rewrite attempt 0's attribution
+    state.consume([_event("health", 7.0, status="rank_dead", rank=0, reason="heartbeat timeout")])
+    assert "(rank 1 died)" in state.render("run", 8.0, ["telemetry.jsonl"])
+
+
 # ---------------------------------------------------------------------------------
 # watch_run on synthetic run dirs
 # ---------------------------------------------------------------------------------

@@ -1,0 +1,493 @@
+"""Unit tests for the distributed-resilience layer (resilience/distributed.py)
+against a fake in-process KV store — no subprocesses, no jax.distributed. The
+real 2-process gang paths are covered by tests/test_resilience/test_gang_smoke.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel import distributed as par_dist
+from sheeprl_tpu.resilience import distributed as res_dist
+from sheeprl_tpu.resilience import signals
+from sheeprl_tpu.resilience.discovery import (
+    find_latest_checkpoint,
+    is_valid_checkpoint,
+    manifest_path,
+    read_manifest,
+)
+from sheeprl_tpu.resilience.distributed import (
+    DistributedCoordinator,
+    RankFailureError,
+    checkpoint_manifest,
+)
+from sheeprl_tpu.resilience.faults import build_fault_plan, heartbeat_stalled, reset_faults
+
+
+class FakeKV:
+    """Dict-backed stand-in for the jax.distributed coordination-service client."""
+
+    def __init__(self) -> None:
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.store[key] = value
+
+    def key_value_set_bytes(self, key, value):
+        self.store[key] = value
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in sorted(self.store.items()) if k.startswith(prefix)]
+
+    def key_value_delete(self, key):
+        for k in [k for k in self.store if k.startswith(key)]:
+            del self.store[k]
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key in self.store:
+            return self.store[key]
+        time.sleep(timeout_ms / 1000.0)
+        raise RuntimeError(f"DEADLINE_EXCEEDED: key {key!r} not found")
+
+    blocking_key_value_get_bytes = blocking_key_value_get
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    signals.reset_preemption()
+    reset_faults()
+    yield
+    signals.reset_preemption()
+    reset_faults()
+    # a test that forgot to close its coordinator must not leak the abort hook
+    coord = res_dist.active_coordinator()
+    if coord is not None:
+        coord.close()
+
+
+# ---------------------------------------------------------------------------------
+# pillar 1: coordinated preemption
+# ---------------------------------------------------------------------------------
+
+
+def test_coordinated_preempt_agreement_no_skew(monkeypatch):
+    """The PR 3 skew window, closed: a local SIGTERM on rank 1 only publishes a
+    REQUEST; both ranks flip their preempt verdict at the same agreed stop step."""
+    fake = FakeKV()
+    monkeypatch.setattr(res_dist, "_kv", lambda: fake)
+    c0 = DistributedCoordinator(0, 2, heartbeat_enabled=False, namespace="t/agree", poll_interval=0.01)
+    c1 = DistributedCoordinator(1, 2, heartbeat_enabled=False, namespace="t/agree", poll_interval=0.01)
+    try:
+        for step in (0, 4, 8):
+            c0.step(step)
+            c1.step(step)
+            time.sleep(0.02)
+        # the signal lands on rank 1 ONLY
+        c1.step(12, local_preempt=True)
+        assert not c1.preempt_requested(), "a local flag alone must not stop a rank"
+        time.sleep(0.02)
+        c0.step(12)  # leader sees the request and publishes the decision
+        decision = c0.decision()
+        assert decision is not None and decision["stop_step"] > 12
+        assert decision["requested_by"] == [1]
+        stop = int(decision["stop_step"])
+        # both ranks walk the same step sequence: the verdicts must agree at
+        # every iteration and flip True before the stop step passes
+        flipped_at = {}
+        for step in range(16, stop + 16, 4):
+            c0.step(step)
+            c1.step(step)
+            v0, v1 = c0.preempt_requested(), c1.preempt_requested()
+            assert v0 == v1, f"rank-divergent verdict at step {step}"
+            if v0 and 0 not in flipped_at:
+                flipped_at[0] = step
+                flipped_at[1] = step
+        assert flipped_at, "the agreed stop step never arrived"
+        assert flipped_at[0] + 4 >= stop
+        # the gang agreed: this process exits "preempted" even though the OS
+        # signal never reached it
+        assert signals.preemption_requested()
+        assert not signals.local_preemption_requested()
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_leader_own_signal_also_decides(monkeypatch):
+    fake = FakeKV()
+    monkeypatch.setattr(res_dist, "_kv", lambda: fake)
+    c0 = DistributedCoordinator(0, 2, heartbeat_enabled=False, namespace="t/lead", poll_interval=0.01)
+    try:
+        c0.step(0)
+        c0.step(8, local_preempt=True)
+        decision = c0.decision()
+        assert decision is not None
+        assert json.loads(fake.store["t/lead/ctl/decision"])["stop_step"] == decision["stop_step"]
+    finally:
+        c0.close()
+
+
+# ---------------------------------------------------------------------------------
+# pillar 2: heartbeats and rank-failure detection
+# ---------------------------------------------------------------------------------
+
+
+def _wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_heartbeat_silence_declares_peer_dead(monkeypatch):
+    fake = FakeKV()
+    monkeypatch.setattr(res_dist, "_kv", lambda: fake)
+    events = []
+    c0 = DistributedCoordinator(
+        0,
+        2,
+        namespace="t/hb",
+        heartbeat_interval=0.05,
+        heartbeat_timeout=0.3,
+        startup_timeout=5.0,
+        emit=lambda ev, **f: events.append((ev, f)),
+    ).start()
+    try:
+        # the peer beats, then goes silent (its counter stops advancing)
+        for n in range(1, 4):
+            fake.key_value_set("t/hb/hb/r1", json.dumps({"n": n}))
+            time.sleep(0.1)
+        assert c0.abort_info() is None
+        assert _wait_for(lambda: c0.abort_info() is not None), "silent peer never declared dead"
+        abort = c0.abort_info()
+        assert abort["rank"] == 1 and abort["observed_by"] == 0
+        with pytest.raises(RankFailureError, match="rank 1"):
+            c0.check_abort()
+        assert ("health",) == tuple({ev for ev, _ in events})
+        assert events[0][1]["status"] == "rank_dead" and events[0][1]["rank"] == 1
+        # our own heartbeats kept publishing
+        assert "t/hb/hb/r0" in fake.store
+    finally:
+        c0.close()
+
+
+def test_heartbeat_startup_timeout_covers_never_started_peer(monkeypatch):
+    fake = FakeKV()
+    monkeypatch.setattr(res_dist, "_kv", lambda: fake)
+    c0 = DistributedCoordinator(
+        0, 2, namespace="t/hb2", heartbeat_interval=0.05, heartbeat_timeout=0.2, startup_timeout=0.3
+    ).start()
+    try:
+        assert _wait_for(lambda: c0.abort_info() is not None)
+        assert c0.abort_info()["rank"] == 1
+    finally:
+        c0.close()
+
+
+def test_heartbeat_vanished_key_uses_heartbeat_timeout(monkeypatch):
+    """A peer whose heartbeat KEY disappears after it had beat (dying KV range)
+    is declared dead within heartbeat_timeout, not the much larger
+    startup_timeout."""
+    fake = FakeKV()
+    monkeypatch.setattr(res_dist, "_kv", lambda: fake)
+    c0 = DistributedCoordinator(
+        0,
+        2,
+        namespace="t/hb3",
+        heartbeat_interval=0.05,
+        heartbeat_timeout=0.3,
+        startup_timeout=60.0,
+    ).start()
+    try:
+        fake.key_value_set("t/hb3/hb/r1", json.dumps({"n": 1}))
+        assert _wait_for(lambda: 1 in c0._hb_seen)  # the monitor saw it alive
+        del fake.store["t/hb3/hb/r1"]
+        assert _wait_for(lambda: c0.abort_info() is not None, timeout=3.0), (
+            "vanished heartbeat key fell into the startup window"
+        )
+        assert c0.abort_info()["rank"] == 1
+    finally:
+        c0.close()
+
+
+def test_abort_published_by_peer_is_consumed(monkeypatch):
+    """A rank that did NOT observe the death itself still aborts: the verdict
+    rides the control plane."""
+    fake = FakeKV()
+    monkeypatch.setattr(res_dist, "_kv", lambda: fake)
+    c0 = DistributedCoordinator(0, 3, heartbeat_enabled=False, namespace="t/ab", poll_interval=0.01)
+    try:
+        fake.key_value_set(
+            "t/ab/ctl/abort", json.dumps({"rank": 2, "reason": "heartbeat timeout", "observed_by": 1})
+        )
+        time.sleep(0.02)
+        c0.step(4)
+        with pytest.raises(RankFailureError, match="rank 2"):
+            c0.check_abort()
+    finally:
+        c0.close()
+
+
+# ---------------------------------------------------------------------------------
+# rank-targeted faults
+# ---------------------------------------------------------------------------------
+
+
+def test_fault_plan_rank_targeting():
+    cfg = {"fault": {"kind": "kill_rank", "at_policy_step": 10, "rank": 1}}
+    assert build_fault_plan(cfg, process_rank=0) is None
+    plan = build_fault_plan(cfg, process_rank=1)
+    assert plan is not None and plan.kind == "kill_rank" and plan.rank == 1
+    # default rank is 0, the driving rank — single-process semantics unchanged
+    cfg = {"fault": {"kind": "crash", "at_policy_step": 10}}
+    assert build_fault_plan(cfg, process_rank=0) is not None
+    assert build_fault_plan(cfg, process_rank=2) is None
+
+
+def test_stale_heartbeat_fault_silences_writer():
+    plan = build_fault_plan({"fault": {"kind": "stale_heartbeat", "at_policy_step": 4, "rank": 0}}, process_rank=0)
+    assert not heartbeat_stalled()
+    plan.maybe_fire(4, lambda *a, **k: None)
+    assert heartbeat_stalled()  # permanent: a zombie does not recover
+    reset_faults()
+    assert not heartbeat_stalled()
+
+
+def test_channel_drop_fault_loses_exactly_one_put(monkeypatch):
+    fake = FakeKV()
+    monkeypatch.setattr(par_dist, "_kv_client", lambda: fake)
+    monkeypatch.setattr(par_dist, "process_count", lambda: 2)
+    monkeypatch.setattr(par_dist, "process_index", lambda: 0)
+    plan = build_fault_plan({"fault": {"kind": "channel_drop", "at_policy_step": 0, "rank": 0}}, process_rank=0)
+    plan.maybe_fire(0, lambda *a, **k: None)
+    ch = par_dist.BroadcastChannel(src=0)
+    ch.put({"round": 0})  # dropped on the wire
+    assert ch._seq == 1 and not any("/c0" in k for k in fake.store)
+    ch.put({"round": 1})  # the next one lands
+    assert ch._seq == 2 and any(k.endswith("/n") for k in fake.store)
+
+
+# ---------------------------------------------------------------------------------
+# bounded channel ops
+# ---------------------------------------------------------------------------------
+
+
+def test_channel_get_times_out_bounded(monkeypatch):
+    fake = FakeKV()
+    monkeypatch.setattr(par_dist, "_kv_client", lambda: fake)
+    monkeypatch.setattr(par_dist, "process_count", lambda: 2)
+    monkeypatch.setattr(par_dist, "process_index", lambda: 1)
+    ch = par_dist.BroadcastChannel(src=0, timeout_s=0.4, poll_s=0.1)
+    t0 = time.monotonic()
+    with pytest.raises(par_dist.ChannelTimeout, match="slow, hung, or dead"):
+        ch.get()
+    assert time.monotonic() - t0 < 5.0, "the wait must be bounded"
+
+
+def test_channel_get_abort_check_breaks_wait_unwrapped(monkeypatch):
+    fake = FakeKV()
+    monkeypatch.setattr(par_dist, "_kv_client", lambda: fake)
+    monkeypatch.setattr(par_dist, "process_count", lambda: 2)
+    monkeypatch.setattr(par_dist, "process_index", lambda: 1)
+
+    def abort():
+        raise RankFailureError("rank 0 of this 2-process run was declared dead")
+
+    ch = par_dist.BroadcastChannel(src=0, timeout_s=30.0, poll_s=0.1, abort_check=abort)
+    t0 = time.monotonic()
+    with pytest.raises(RankFailureError):  # NOT wrapped into ChannelError
+        ch.get()
+    assert time.monotonic() - t0 < 5.0, "a declared-dead peer must break the wait immediately"
+
+
+def test_channel_put_retries_transient_kv_failures(monkeypatch):
+    class Flaky(FakeKV):
+        def __init__(self, failures):
+            super().__init__()
+            self.failures = failures
+
+        def key_value_set_bytes(self, key, value):
+            if self.failures > 0:
+                self.failures -= 1
+                raise RuntimeError("UNAVAILABLE: transient")
+            super().key_value_set_bytes(key, value)
+
+    fake = Flaky(failures=2)
+    monkeypatch.setattr(par_dist, "_kv_client", lambda: fake)
+    monkeypatch.setattr(par_dist, "process_count", lambda: 2)
+    monkeypatch.setattr(par_dist, "process_index", lambda: 0)
+    ch = par_dist.BroadcastChannel(src=0)
+    ch.put({"ok": True})  # 2 transient failures < 3 retries
+    assert any(k.endswith("/n") for k in fake.store)
+    fake.failures = 99
+    with pytest.raises(par_dist.ChannelError):
+        ch.put({"ok": False})
+
+
+def test_channel_options_attach_abort_hook():
+    from sheeprl_tpu.config import dotdict
+
+    cfg = dotdict(
+        {"resilience": {"distributed": {"channel": {"timeout": 7.0, "poll": 0.5}}}}
+    )
+    opts = res_dist.channel_options(cfg)
+    assert opts["timeout_s"] == 7.0 and opts["poll_s"] == 0.5
+    assert opts["abort_check"] is res_dist.channel_abort_check
+    # with no active coordinator the hook is a no-op
+    res_dist.channel_abort_check()
+
+
+# ---------------------------------------------------------------------------------
+# pillar 4: checkpoint-set consistency manifests
+# ---------------------------------------------------------------------------------
+
+
+def _fabric_with_ranks(*ranks):
+    devices = np.array([SimpleNamespace(process_index=r) for r in ranks], dtype=object)
+    return SimpleNamespace(mesh=SimpleNamespace(devices=devices))
+
+
+def test_manifest_single_process_is_noop(tmp_path, monkeypatch):
+    monkeypatch.setattr(par_dist, "process_count", lambda: 1)
+    ckpt = tmp_path / "ckpt_100_0.ckpt"
+    with checkpoint_manifest(_fabric_with_ranks(0), str(ckpt)):
+        ckpt.write_bytes(b"x")
+    assert read_manifest(str(ckpt)) is None  # no new artifacts on 1-process runs
+    assert is_valid_checkpoint(str(ckpt))
+
+
+def test_manifest_commit_requires_every_rank_ack(tmp_path, monkeypatch):
+    fake = FakeKV()
+    monkeypatch.setattr(res_dist, "_kv", lambda: fake)
+    monkeypatch.setattr(par_dist, "process_count", lambda: 2)
+    monkeypatch.setattr(par_dist, "process_index", lambda: 0)
+    fabric = _fabric_with_ranks(0, 1)
+    ckpt = tmp_path / "ckpt_100_0.ckpt"
+    # no peer ack within the deadline: the manifest stays incomplete and VETOES
+    with checkpoint_manifest(fabric, str(ckpt), timeout_s=0.2):
+        ckpt.write_bytes(b"x")
+    manifest = read_manifest(str(ckpt))
+    assert manifest is not None and manifest["complete"] is False
+    assert manifest["ranks_expected"] == [0, 1]
+    assert not is_valid_checkpoint(str(ckpt))
+    # the peer acks DURING the save (keyed by the SHARED manifest name, not the
+    # per-rank ckpt basename): committed, every rank recorded, resolvable. An
+    # ack set BEFORE the bracket would be a stale leftover of an earlier save
+    # of this step and is cleared at entry — regression-tested below.
+    with checkpoint_manifest(fabric, str(ckpt), timeout_s=5.0):
+        fake.key_value_set("sheeprl_res/ckptack/ckpt_100.manifest.json/s100/r1", "1")
+    manifest = read_manifest(str(ckpt))
+    assert manifest["complete"] is True and set(manifest["ranks_committed"]) == {0, 1}
+    assert is_valid_checkpoint(str(ckpt))
+    # the consumed acks were cleaned up
+    assert not fake.key_value_dir_get("sheeprl_res/ckptack/ckpt_100.manifest.json/s100/")
+    # a STALE ack (left by that earlier save) must not satisfy a NEW save of
+    # the same step: it is cleared before the write begins
+    fake.key_value_set("sheeprl_res/ckptack/ckpt_100.manifest.json/s100/r1", "1")
+    with checkpoint_manifest(fabric, str(ckpt), timeout_s=0.2):
+        ckpt.write_bytes(b"y")
+    manifest = read_manifest(str(ckpt))
+    assert manifest["complete"] is False, "a stale ack satisfied the rendezvous"
+    assert not is_valid_checkpoint(str(ckpt))
+
+
+def test_manifest_non_writer_acks(tmp_path, monkeypatch):
+    fake = FakeKV()
+    monkeypatch.setattr(res_dist, "_kv", lambda: fake)
+    monkeypatch.setattr(par_dist, "process_count", lambda: 2)
+    monkeypatch.setattr(par_dist, "process_index", lambda: 1)
+    ckpt = tmp_path / "ckpt_64_1.ckpt"
+    with checkpoint_manifest(_fabric_with_ranks(0, 1), str(ckpt), timeout_s=1.0):
+        pass
+    # rank 1 writes no manifest, only its ack — under the rank-0 path's name
+    assert read_manifest(str(ckpt)) is None
+    assert fake.store.get("sheeprl_res/ckptack/ckpt_64.manifest.json/s64/r1") == "1"
+
+
+def test_manifest_without_kv_client_stays_incomplete(tmp_path, monkeypatch):
+    """No KV client on a multi-rank mesh (coordination service already torn
+    down): the ack rendezvous is impossible, so the manifest must stay
+    incomplete — never commit a consistency that was not verified."""
+    monkeypatch.setattr(res_dist, "_kv", lambda: None)
+    monkeypatch.setattr(par_dist, "process_count", lambda: 2)
+    monkeypatch.setattr(par_dist, "process_index", lambda: 0)
+    ckpt = tmp_path / "ckpt_32_0.ckpt"
+    with checkpoint_manifest(_fabric_with_ranks(0, 1), str(ckpt), timeout_s=0.2):
+        ckpt.write_bytes(b"x")
+    manifest = read_manifest(str(ckpt))
+    assert manifest is not None and manifest["complete"] is False
+    assert not is_valid_checkpoint(str(ckpt))
+
+
+def test_manifest_crash_inside_save_leaves_incomplete(tmp_path, monkeypatch):
+    fake = FakeKV()
+    monkeypatch.setattr(res_dist, "_kv", lambda: fake)
+    monkeypatch.setattr(par_dist, "process_count", lambda: 2)
+    monkeypatch.setattr(par_dist, "process_index", lambda: 0)
+    ckpt = tmp_path / "ckpt_8_0.ckpt"
+    with pytest.raises(RuntimeError, match="boom"):
+        with checkpoint_manifest(_fabric_with_ranks(0, 1), str(ckpt), timeout_s=0.2):
+            ckpt.write_bytes(b"torn")
+            raise RuntimeError("boom")
+    manifest = read_manifest(str(ckpt))
+    assert manifest is not None and not manifest.get("complete")
+    assert not is_valid_checkpoint(str(ckpt))
+
+
+def test_discovery_prefers_older_complete_set_over_newer_torn_one(tmp_path):
+    older = tmp_path / "ckpt_8_0.ckpt"
+    older.write_bytes(b"x")
+    (tmp_path / "ckpt_8.manifest.json").write_text(
+        json.dumps({"schema": 1, "step": 8, "complete": True, "ranks_expected": [0], "ranks_committed": [0]})
+    )
+    newer = tmp_path / "ckpt_16_0.ckpt"
+    newer.write_bytes(b"x")
+    (tmp_path / "ckpt_16.manifest.json").write_text(
+        json.dumps({"schema": 1, "step": 16, "complete": False, "ranks_expected": [0, 1]})
+    )
+    past = time.time() - 60
+    os.utime(older, (past, past))
+    assert not is_valid_checkpoint(str(newer))
+    assert find_latest_checkpoint(str(tmp_path)) == str(older)
+
+
+def test_discovery_unparseable_manifest_vetoes(tmp_path):
+    ckpt = tmp_path / "ckpt_4_0.ckpt"
+    ckpt.write_bytes(b"x")
+    assert is_valid_checkpoint(str(ckpt))  # no manifest: original heuristics
+    (tmp_path / "ckpt_4.manifest.json").write_text("{torn")
+    assert not is_valid_checkpoint(str(ckpt))
+
+
+def test_manifest_path_shared_across_rank_suffixes(tmp_path):
+    a = manifest_path(str(tmp_path / "ckpt_128_0.ckpt"))
+    b = manifest_path(str(tmp_path / "ckpt_128_1.ckpt"))
+    assert a == b == str(tmp_path / "ckpt_128.manifest.json")
+    # the .old displaced crash window shares its step's manifest too
+    assert manifest_path(str(tmp_path / "ckpt_128_0.ckpt.old")) == a
+
+
+# ---------------------------------------------------------------------------------
+# explicit CLI overrides (the resume-merge fix)
+# ---------------------------------------------------------------------------------
+
+
+def test_explicit_overrides_extracts_only_value_overrides():
+    from sheeprl_tpu.config import explicit_overrides
+
+    parsed = explicit_overrides(
+        ["exp=sac", "env=dummy", "buffer.size=777", "+algo.extra=1", "fabric.accelerator=cpu"]
+    )
+    # group selections (exp=, env=) are not dotted value overrides
+    assert parsed["buffer.size"] == 777
+    assert parsed["algo.extra"] == 1
+    assert parsed["fabric.accelerator"] == "cpu"
+    assert "exp" not in parsed and "env" not in parsed

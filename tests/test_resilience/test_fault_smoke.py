@@ -358,3 +358,50 @@ def test_env_step_fault_restarts_and_is_surfaced_in_telemetry(monkeypatch):
     assert env_finding["metrics"]["restarts"] >= 1
     assert "interruptions" not in _detectors(diag["findings"])
     assert "nonfinite_loss" not in _detectors(diag["findings"])
+
+
+@pytest.mark.timeout(280)
+def test_cli_override_survives_resume_launch_and_retry():
+    """Regression (satellite): an explicit dotted override typed on the command
+    line must beat the checkpoint's saved config — at the resume LAUNCH and on
+    every supervisor retry. The old merge dropped it both times when resuming
+    another run's checkpoint (the retry rebuilt from the already-merged cfg)."""
+    import yaml
+
+    # run A: a finished run whose saved config carries buffer.size=512
+    run(_SAC + ["root_dir=tres", "run_name=sac-ovr-base"])
+    base_ckpts = sorted(
+        glob.glob("logs/runs/tres/sac-ovr-base/version_0/checkpoint/*.ckpt"),
+        key=os.path.getmtime,
+    )
+    assert base_ckpts
+    # run B: resume A's checkpoint with an explicit buffer.size=700 override and
+    # a mid-run crash, so attempt 2 exercises the supervisor's retry merge too
+    run(
+        _SAC
+        + _SUPERVISED
+        + [
+            f"checkpoint.resume_from={base_ckpts[-1]}",
+            "buffer.size=700",
+            "algo.total_steps=64",
+            "resilience.fault.kind=crash",
+            "resilience.fault.at_policy_step=40",
+            "root_dir=tres",
+            "run_name=sac-ovr",
+        ]
+    )
+    cfg_files = sorted(glob.glob("logs/runs/tres/sac-ovr/version_*/config.yaml"))
+    assert len(cfg_files) >= 2, "the crash fault must have produced a second attempt"
+    for path in cfg_files:
+        with open(path) as f:
+            saved = yaml.safe_load(f)
+        assert saved["buffer"]["size"] == 700, f"override dropped in {path}"
+    events = _events("tres", "sac-ovr")
+    _assert_ordered(
+        events,
+        [
+            ("fault", lambda e: e["kind"] == "crash"),
+            ("restart", lambda e: e["reason"] == "crash"),
+            ("supervisor", lambda e: e["status"] == "completed"),
+        ],
+    )

@@ -1,0 +1,229 @@
+"""End-to-end gang-supervision smokes on the CPU mesh: REAL 2-process
+jax.distributed decoupled-sac runs (player = rank 0, learner = rank 1) under
+``resilience.distributed.gang.processes=2``, driven by rank-targeted fault
+injection. The acceptance pair:
+
+- ``kill_rank`` on the learner: heartbeat death declaration → bounded channel
+  abort on the player (RankFailureError, not a hang) → gang teardown → restart
+  from the newest manifest-consistent checkpoint → completion to total_steps;
+- ``sigterm`` to the learner only: the published request becomes rank 0's
+  agreed stop-step decision, the player writes the emergency checkpoint at the
+  agreed step although the OS signal never reached it, BOTH ranks exit
+  preempted (75), and the gang restarts and completes.
+
+Each smoke also runs the diagnosis engine over the merged multi-attempt stream
+and gates on its verdicts (the ``fault-matrix`` CLI contract: no criticals, the
+interruption attributed to the right rank).
+
+Scoped with the ``resilience`` marker (the ``fault-matrix`` CLI and
+``pytest -m resilience`` run them) and ``slow`` (each gang is a real ~60 s
+multi-process run — too heavy for the bounded tier-1 sweep, which keeps the
+single-process fault smokes). True multi-process SPMD cannot run on the CPU
+backend (XLA refuses cross-process collectives there — the same limitation the
+object-plane test documents), so the decoupled topology is the multi-process
+coverage and SPMD agreement is unit-tested in
+tests/test_resilience/test_distributed.py.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sheeprl_tpu.obs.diagnose import run_detectors
+from sheeprl_tpu.obs.streams import merged_events
+from sheeprl_tpu.resilience.discovery import read_manifest
+
+pytestmark = [pytest.mark.resilience, pytest.mark.slow]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BASE = [
+    "exp=sac_decoupled",
+    "env=dummy",
+    "env.id=continuous_dummy",
+    "dry_run=False",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "metric.log_level=0",
+    "buffer.memmap=False",
+    "buffer.size=512",
+    "buffer.checkpoint=True",
+    "env.num_envs=2",
+    "algo.learning_starts=4",
+    "algo.run_test=False",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.per_rank_batch_size=4",
+    "metric.telemetry.enabled=true",
+    "resilience.distributed.gang.processes=2",
+    "resilience.distributed.gang.grace=15",
+    "resilience.supervisor.backoff=0.05",
+    "resilience.distributed.poll_interval=0.05",
+    "resilience.distributed.heartbeat.interval=0.2",
+    "resilience.distributed.heartbeat.timeout=4",
+    "resilience.distributed.heartbeat.startup_timeout=240",
+    "resilience.distributed.channel.timeout=90",
+    "resilience.distributed.channel.poll=0.5",
+    "root_dir=tgang",
+]
+
+
+def _run_gang(overrides, timeout=360):
+    # children must own their local device set: the pytest process's 8-virtual-
+    # device XLA_FLAGS would be inherited by every rank otherwise
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["SHEEPRL_GANG_PLATFORM"] = "cpu"  # pin supervisor + children before jax init
+    # run in the test's conftest-chdir'd tmp cwd (fresh logs/ per test, and the
+    # restart event's relative resume_from resolves from the test process too);
+    # the package only imports from the repo root, so point PYTHONPATH at it
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu"] + overrides,
+        cwd=os.getcwd(),
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        timeout=timeout,
+    )
+
+
+def _run_base(run_name: str) -> str:
+    return os.path.join(os.getcwd(), "logs", "runs", "tgang", run_name)
+
+
+def _events(run_name: str):
+    path = os.path.join(_run_base(run_name), "telemetry.jsonl")
+    assert os.path.isfile(path), f"run-base telemetry.jsonl missing at {path}"
+    return [json.loads(line) for line in open(path)]
+
+
+def _assert_ordered(events, sequence):
+    idx = 0
+    for want, pred in sequence:
+        while idx < len(events) and not (
+            events[idx]["event"] == want and (pred is None or pred(events[idx]))
+        ):
+            idx += 1
+        assert idx < len(events), f"event {want!r} missing (or out of order)"
+        idx += 1
+
+
+def _final_checkpoint_step(run_name: str) -> int:
+    ckpts = sorted(
+        glob.glob(os.path.join(_run_base(run_name), "version_*", "checkpoint", "*.ckpt")),
+        key=os.path.getmtime,
+    )
+    assert ckpts, "no checkpoint written"
+    manifest = read_manifest(ckpts[-1])
+    assert manifest is not None and manifest.get("complete"), (
+        f"final checkpoint {ckpts[-1]} has no complete consistency manifest"
+    )
+    return int(manifest["step"])
+
+
+@pytest.mark.timeout(420)
+def test_gang_kill_rank_restarts_from_consistent_checkpoint():
+    total = 64
+    result = _run_gang(
+        _BASE
+        + [
+            f"algo.total_steps={total}",
+            "checkpoint.every=16",
+            "run_name=gang-kill",
+            "resilience.fault.kind=kill_rank",
+            "resilience.fault.rank=1",
+            "resilience.fault.at_policy_step=32",
+        ]
+    )
+    out = result.stdout.decode(errors="replace")
+    assert result.returncode == 0, f"gang run failed ({result.returncode}):\n{out[-4000:]}"
+
+    events = _events("gang-kill")
+    _assert_ordered(
+        events,
+        [
+            ("gang", lambda e: e["status"] == "spawn"),
+            ("health", lambda e: e["status"] == "rank_dead" and e["rank"] == 1),
+            ("gang", lambda e: e["status"] == "attempt_exit" and e["outcome"] == "crash"),
+            ("restart", lambda e: e["reason"] == "crash" and "1" in (e.get("dead_ranks") or {})),
+            ("resume", None),
+            ("supervisor", lambda e: e["status"] == "completed"),
+        ],
+    )
+    # the SIGKILLed learner took no cleanup path: only heartbeat detection can
+    # have named it, and the supervisor's own teardown victims must not be blamed
+    restart = next(e for e in events if e["event"] == "restart")
+    assert list(restart["dead_ranks"]) == ["1"]
+    # the retry resumed from a manifest-consistent checkpoint and completed
+    assert restart["resume_from"], "restart must resume from a checkpoint"
+    manifest = read_manifest(restart["resume_from"])
+    assert manifest is not None and manifest.get("complete"), (
+        f"restarted from {restart['resume_from']!r} without a complete manifest: {manifest!r}"
+    )
+    assert _final_checkpoint_step("gang-kill") == total
+
+    # diagnose over the merged multi-attempt stream names the dead rank and
+    # raises nothing critical (the fault-matrix gate)
+    findings = run_detectors(list(merged_events(_run_base("gang-kill"))))
+    assert all(f["severity"] != "critical" for f in findings), findings
+    interruptions = [f for f in findings if f["detector"] == "interruptions"]
+    assert any(f.get("metrics", {}).get("dead_ranks") == [1] for f in interruptions), interruptions
+
+
+@pytest.mark.timeout(420)
+def test_gang_sigterm_one_rank_agreed_preempt_and_restart():
+    total = 128
+    result = _run_gang(
+        _BASE
+        + [
+            f"algo.total_steps={total}",
+            "checkpoint.every=32",
+            "run_name=gang-sigterm",
+            "resilience.fault.kind=sigterm",
+            "resilience.fault.rank=1",
+            "resilience.fault.at_policy_step=48",
+        ]
+    )
+    out = result.stdout.decode(errors="replace")
+    assert result.returncode == 0, f"gang run failed ({result.returncode}):\n{out[-4000:]}"
+
+    events = _events("gang-sigterm")
+    # rank agreement: the signal landed on the LEARNER only, yet the player
+    # (rank 0) records the agreed decision and writes the emergency checkpoint
+    # at the agreed stop step
+    preempt = next(e for e in events if e["event"] == "preempt" and e.get("stop_step"))
+    stop = int(preempt["stop_step"])
+    emergency = [e for e in events if e["event"] == "checkpoint" and e.get("reason") == "preempt"]
+    if emergency:  # the decision may land beyond a cadence checkpoint's step
+        assert abs(int(emergency[-1]["step"]) - stop) <= 8
+    _assert_ordered(
+        events,
+        [
+            ("preempt", lambda e: e.get("stop_step")),
+            ("preempt_exit", None),
+            ("gang", lambda e: e["status"] == "attempt_exit" and e["outcome"] == "preempt"),
+            ("restart", lambda e: e["reason"] == "preempt"),
+            ("resume", None),
+            ("supervisor", lambda e: e["status"] == "completed"),
+        ],
+    )
+    # BOTH ranks exited preempted (75) — the rank the signal never reached too
+    attempt_exit = next(
+        e for e in events if e["event"] == "gang" and e["status"] == "attempt_exit"
+    )
+    assert attempt_exit["exit_codes"] == {"0": 75, "1": 75}
+    # preempt exits are reschedules, not deaths: nobody gets blamed
+    restart = next(e for e in events if e["event"] == "restart")
+    assert not restart.get("dead_ranks")
+    assert _final_checkpoint_step("gang-sigterm") == total
+
+    findings = run_detectors(list(merged_events(_run_base("gang-sigterm"))))
+    assert all(f["severity"] != "critical" for f in findings), findings
+    (interruption,) = [f for f in findings if f["detector"] == "interruptions"]
+    assert interruption["severity"] == "info"  # a preempt+resume is routine

@@ -155,7 +155,9 @@ def test_normalize_fault_cfg():
     assert normalize_fault_cfg({"fault": {"kind": None}}) is None
     assert normalize_fault_cfg({"fault": {"kind": "none"}}) is None
     spec = normalize_fault_cfg({"fault": {"kind": "crash", "at_policy_step": 7}})
-    assert spec == {"kind": "crash", "at": 7}
+    assert spec == {"kind": "crash", "at": 7, "rank": None}
+    spec = normalize_fault_cfg({"fault": {"kind": "kill_rank", "at_policy_step": 3, "rank": 1}})
+    assert spec == {"kind": "kill_rank", "at": 3, "rank": 1}
     with pytest.raises(ValueError, match="unknown resilience.fault.kind"):
         normalize_fault_cfg({"fault": {"kind": "explode"}})
 
@@ -339,21 +341,24 @@ def test_build_resilience_null_when_everything_off():
 
 
 def test_build_resilience_off_rank_zero_keeps_preempt_poll_live():
-    """Non-rank-0 SPMD processes must poll the real flag: a hard-coded False
-    would desync the per-rank checkpoint conditions (and fabric.save's
-    cross-process barrier) on a pod-wide SIGTERM."""
-    from sheeprl_tpu.resilience.monitor import PollResilience
+    """Non-rank-0 processes get the PeerResilience facade. Without a
+    coordination plane (no jax.distributed KV client in this process) its
+    preempt poll falls back to the LIVE process-local flag — never a hard-coded
+    False, which would desync the per-rank checkpoint conditions (and
+    fabric.save's cross-process barrier) on a pod-wide SIGTERM. With the plane
+    up it consumes the agreed decision instead (tests/test_distributed.py)."""
+    from sheeprl_tpu.resilience.monitor import PeerResilience
 
     class NonZero(_FabricStub):
         is_global_zero = False
 
     monitor = build_resilience(NonZero(), _cfg(), None)
-    assert isinstance(monitor, PollResilience)
+    assert isinstance(monitor, PeerResilience)
     assert not monitor.preempt_requested()
     request_preemption()
     assert monitor.preempt_requested()
     assert monitor.finalize(1) is True
-    # with the handler disabled there is nothing to poll: plain Null
+    # with the handler disabled (and no fault targeting this rank): plain Null
     assert type(build_resilience(NonZero(), _cfg(handler=False), None)) is NullResilience
 
 
@@ -389,3 +394,108 @@ def test_monitor_eager_events_with_supervisor(tmp_path):
     events = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
     assert [e["event"] for e in events] == ["resume", "checkpoint"]
     assert events[1]["reason"] == "periodic"
+
+
+# -- supervisor edge cases (in-process; unit-driven with stub run_fns) ---------------
+
+
+def _sup_cfg(restart_on_preempt=True, resume_from=None):
+    return dotdict(
+        {
+            "root_dir": "tsup",
+            "run_name": "run",
+            "checkpoint": {"resume_from": resume_from},
+            "metric": {"telemetry": {"jsonl": False}},
+            "buffer": {"size": 999},
+            "resilience": {
+                "supervisor": {
+                    "enabled": True,
+                    "max_restarts": 2,
+                    "backoff": 0.0,
+                    "restart_on_preempt": restart_on_preempt,
+                },
+                "fault": {"kind": None, "at_policy_step": 0},
+            },
+        }
+    )
+
+
+def test_supervise_sigterm_between_attempts_honors_restart_on_preempt(tmp_path, monkeypatch):
+    """A SIGTERM landing between attempts (during teardown/backoff) is a real
+    reclaim: with restart_on_preempt=false the supervisor must NOT relaunch a
+    full attempt on a dying node."""
+    from sheeprl_tpu.resilience.supervisor import supervise
+
+    monkeypatch.chdir(tmp_path)
+    calls = []
+
+    def crash_then_signal(cfg):
+        calls.append(cfg)
+        if len(calls) == 1:
+            request_preemption()  # the reclaim lands while the attempt unwinds
+            raise InjectedFaultError("injected crash")
+
+    outcome = supervise(_sup_cfg(restart_on_preempt=False), crash_then_signal, lambda c: c)
+    assert outcome == "preempted"
+    assert len(calls) == 1, "a dying node must not get a fresh attempt"
+
+    # same sequence with restart_on_preempt=true: the flag is reset and the
+    # retry runs to completion
+    reset_preemption()
+    calls.clear()
+    outcome = supervise(_sup_cfg(restart_on_preempt=True), crash_then_signal, lambda c: c)
+    assert outcome == "completed"
+    assert len(calls) == 2
+
+
+def test_supervise_crash_before_first_ckpt_falls_back_to_original_resume(tmp_path, monkeypatch):
+    """A crash before THIS run wrote any checkpoint must retry from the user's
+    original resume_from, not silently restart from scratch."""
+    from sheeprl_tpu.resilience.supervisor import supervise
+
+    monkeypatch.chdir(tmp_path)
+    base = tmp_path / "elsewhere" / "ckpt_100_0.ckpt"
+    base.parent.mkdir(parents=True)
+    base.write_bytes(b"x")
+    calls, merged = [], []
+
+    def crash_once(cfg):
+        calls.append(cfg)
+        if len(calls) == 1:
+            raise InjectedFaultError("early crash")
+
+    def resume_merge(cfg):
+        merged.append(cfg)
+        return cfg
+
+    outcome = supervise(_sup_cfg(resume_from=str(base)), crash_once, resume_merge)
+    assert outcome == "completed"
+    assert calls[1].checkpoint.resume_from == str(base)
+    assert merged, "the fallback retry must still go through the resume merge"
+
+
+def test_supervise_retry_rebuilds_from_argv_cfg(tmp_path, monkeypatch):
+    """Regression (satellite): retries rebuild from the ARGV-merged config, so a
+    user override the launch-time resume merge was applied over survives attempt
+    2 — rebuilding from the resolved cfg would bake the checkpoint's stale value
+    back in."""
+    import copy
+
+    from sheeprl_tpu.resilience.supervisor import supervise
+
+    monkeypatch.chdir(tmp_path)
+    argv_cfg = _sup_cfg()
+    argv_cfg.buffer.size = 777  # what the user typed on the command line
+    resolved = dotdict(copy.deepcopy(argv_cfg.as_dict()))
+    resolved.buffer.size = 999  # what a stale merge would have left behind
+    calls = []
+
+    def crash_once(cfg):
+        calls.append(cfg)
+        if len(calls) == 1:
+            raise InjectedFaultError("crash")
+
+    outcome = supervise(resolved, crash_once, lambda c: c, argv_cfg=argv_cfg)
+    assert outcome == "completed"
+    assert calls[0].buffer.size == 999  # attempt 1 ran the resolved launch cfg
+    assert calls[1].buffer.size == 777  # the retry rebuilt from argv

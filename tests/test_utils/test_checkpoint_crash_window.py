@@ -115,6 +115,29 @@ def test_orphan_sidecar_gc_spares_live_pairs(tmp_path):
     assert load_checkpoint(live)
 
 
+def test_manifest_sweep_spares_displaced_old_set(tmp_path):
+    """A checkpoint caught mid-displacement (only `<path>.ckpt.old` remains,
+    see discovery.py) keeps its consistency manifest: sweeping it would let a
+    torn multi-rank .old set pass validation on artifact heuristics alone."""
+    import json
+
+    live = str(tmp_path / "ckpt_20_0.ckpt")
+    with open(live, "wb") as f:
+        f.write(b"x")
+    displaced = str(tmp_path / "ckpt_10_0.ckpt.old")
+    with open(displaced, "wb") as f:
+        f.write(b"x")
+    for step in (10, 20):
+        with open(tmp_path / f"ckpt_{step}.manifest.json", "w") as f:
+            json.dump({"schema": 1, "step": step, "complete": True,
+                       "ranks_expected": [0, 1], "ranks_committed": [0, 1]}, f)
+    CheckpointCallback(keep_last=5)._delete_old_checkpoints(str(tmp_path), live=live)
+    assert os.path.isfile(tmp_path / "ckpt_10.manifest.json"), (
+        "the displaced .old set's manifest must survive the sweep"
+    )
+    assert os.path.isfile(tmp_path / "ckpt_20.manifest.json")
+
+
 def test_keep_last_sweeps_sharded_directories(tmp_path):
     """keep_last removes stale orbax DIRECTORIES (with their sidecars), not just
     pickle files."""
