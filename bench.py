@@ -29,6 +29,11 @@ Select a single workload with BENCH_ALGO:
   image, so the env falls back to the pixel dummy env (same 64x64 rgb obs shape).
   The emulator is a sub-ms slice of the reference's ~97 ms/step, so the comparison
   is dominated by framework+training cost either way.
+- ppo_anakin — the on-device env plane + Anakin fused rollout/train topology
+  (envs/jax + algos/ppo/anakin.py): steady-state env-steps/sec with CartPole
+  stepping INSIDE the jitted program. Scale jump vs the host `ppo` workload is
+  structural (~100x: no host<->device handoff per env step); the fingerprint's
+  ``env_backend`` field keeps the regression gate from diffing across planes.
 - dreamer_v3_mfu — flagship-size (S preset) DV3 train-program MFU on the
   accelerator: FLOPs from XLA's own cost model over achieved step time vs chip
   peak (sheeprl_tpu/utils/mfu.py). Run automatically as an extra when the
@@ -500,6 +505,63 @@ def _bench_sac_steady() -> dict:
     return result
 
 
+def _bench_ppo_anakin() -> dict:
+    """ppo_anakin steady-state env-steps/sec: the on-device env plane + Anakin
+    fused rollout/train topology (exp=ppo_anakin_benchmarks — CartPole inside
+    the jitted program, 8192 envs x 128 rollout steps per call). Reported over
+    the post-compile BenchWindow like the other steady workloads; the number is
+    on a ~100x different scale than the host `ppo` workload BY DESIGN (no
+    host<->device handoff per env step), and ``conditions.env_backend`` plus the
+    fingerprint's ``env_backend`` keep the regression gate from ever diffing it
+    against a host-env run."""
+    total_steps, ref_seconds = BASELINES["ppo"]
+    baseline_sps = total_steps / ref_seconds  # the reference's host PPO, 4 CPUs
+
+    total = 16_777_216  # 16 fused iterations of 1048576 env steps
+    steady_start = 2_097_152  # 2 iterations of warmup: compile + cache effects
+    args = [
+        "exp=ppo_anakin_benchmarks",
+        f"algo.total_steps={total}",
+        # one telemetry window per fused iteration, so the run's diagnosis
+        # verdict gets steady windows (not just the final close window) and the
+        # rollout/train attribution lands in conditions.diagnosis
+        "metric.telemetry.every=1048576",
+    ]
+    probe = _accelerator_probe_cached()
+    if not probe["alive"] or probe["platform"] == "cpu":
+        args += ["fabric.accelerator=cpu"]
+
+    steady = _steady_window_run(args, steady_start)
+    sps = steady["steps"] / steady["seconds"]
+    conditions = {
+        "steady_window_steps": steady["steps"],
+        "steady_window_seconds": round(steady["seconds"], 2),
+        "total_steps": total,
+        "baseline_sps": round(baseline_sps, 2),
+        # which environment plane stepped the run — the workload's defining axis
+        "env_backend": "jax",
+        "accelerator": (
+            "cpu-fallback"
+            if not probe["alive"]
+            else "cpu"
+            if probe["platform"] == "cpu"
+            else f"tpu ({probe['device_kind']})"
+            if probe["platform"] in ("tpu", "axon")
+            else probe["platform"]
+        ),
+    }
+    for key in ("telemetry", "fingerprint", "diagnosis"):
+        if key in steady:
+            conditions[key] = steady[key]
+    return {
+        "metric": "ppo_anakin_env_steps_per_sec",
+        "value": round(sps, 2),
+        "unit": "env-steps/sec (steady-state)",
+        "vs_baseline": round(sps / baseline_sps, 3),
+        "conditions": conditions,
+    }
+
+
 def _bench_dv3_mfu_flagship(size: str = "S") -> dict:
     """Standalone extra: flagship-size DV3 train-program MFU on the accelerator."""
     stats = _dv3_train_mfu(size=size)
@@ -546,6 +608,8 @@ def _workload_fingerprint(algo: str) -> dict | None:
 def _bench(algo: str) -> dict:
     if algo == "dreamer_v3_mfu":
         result = _bench_dv3_mfu_flagship()
+    elif algo == "ppo_anakin":
+        result = _bench_ppo_anakin()
     elif algo == "sac_steady":
         result = _bench_sac_steady()
     elif algo.startswith("dreamer_v"):
@@ -718,6 +782,16 @@ def main() -> int:
             print(json.dumps({**result, "extras": extras}), flush=True)
         except Exception as exc:
             result["sac_steady_extra_error"] = repr(exc)[:500]
+            chip_busy = live and isinstance(exc, BenchTimeout)
+    # ppo_anakin steady-state: the on-device env plane + fused rollout/train
+    # topology — the act-path counterpart of the ppo headline (runs on CPU or
+    # chip alike; one compile + ~2 min of fused iterations)
+    if not chip_busy:
+        try:
+            extras.append(_bench_subprocess("ppo_anakin", timeout=900))
+            print(json.dumps({**result, "extras": extras}), flush=True)
+        except Exception as exc:
+            result["ppo_anakin_extra_error"] = repr(exc)[:500]
             chip_busy = live and isinstance(exc, BenchTimeout)
     if chip_busy:
         # The abandoned child is still compiling/claiming on the single-tenant

@@ -19,8 +19,10 @@ from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE  # noqa: E402
 # populate the algorithm/evaluation registries (role of sheeprl/__init__.py:17-51)
 _ALGO_MODULES = [
     "sheeprl_tpu.algos.a2c.a2c",
+    "sheeprl_tpu.algos.a2c.a2c_anakin",
     "sheeprl_tpu.algos.a2c.evaluate",
     "sheeprl_tpu.algos.ppo.ppo",
+    "sheeprl_tpu.algos.ppo.ppo_anakin",
     "sheeprl_tpu.algos.ppo.ppo_decoupled",
     "sheeprl_tpu.algos.ppo.evaluate",
     "sheeprl_tpu.algos.sac.sac",

@@ -1,0 +1,16 @@
+"""A2C, Anakin topology: on-device envs with one fused rollout+GAE+update
+program per iteration — one accumulated full-rollout gradient step, a2c losses
+(see ``algos/ppo/anakin.py`` for the shared driver; ``algos/a2c/a2c.py`` is the
+host-env reference semantics)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.ppo.anakin import run_anakin
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    run_anakin(fabric, cfg)

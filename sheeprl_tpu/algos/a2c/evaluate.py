@@ -9,4 +9,4 @@ from __future__ import annotations
 from sheeprl_tpu.algos.ppo.evaluate import evaluate as _ppo_evaluate
 from sheeprl_tpu.utils.registry import register_evaluation
 
-evaluate = register_evaluation(algorithms=["a2c"])(_ppo_evaluate)
+evaluate = register_evaluation(algorithms=["a2c", "a2c_anakin"])(_ppo_evaluate)

@@ -13,7 +13,7 @@ from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
-@register_evaluation(algorithms=["ppo", "ppo_decoupled"])
+@register_evaluation(algorithms=["ppo", "ppo_decoupled", "ppo_anakin"])
 def evaluate(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     logdir = cfg.get("log_dir", "logs/evaluation")
     env = make_env(cfg, cfg.seed, 0, logdir, "test")()

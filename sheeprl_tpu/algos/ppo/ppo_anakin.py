@@ -1,0 +1,15 @@
+"""PPO, Anakin topology: on-device envs, rollout+GAE+optimization fused into
+one donated jitted program over the mesh (see ``algos/ppo/anakin.py`` for the
+architecture; ``algos/ppo/ppo.py`` is the host-env reference semantics)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.ppo.anakin import run_anakin
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    run_anakin(fabric, cfg)

@@ -1,0 +1,122 @@
+"""Classic-control environments in pure JAX, numerically matching gymnasium.
+
+Dynamics, reward, termination and reset distributions are transcribed from
+gymnasium's ``CartPoleEnv`` / ``PendulumEnv`` (classic_control module) so the
+step-semantics parity suite (``tests/test_envs/test_jax_parity.py``) can drive
+both implementations over the same action sequence and assert obs/reward/
+termination agreement within float tolerance.
+
+State is the raw physics vector; PRNG randomness only enters at ``reset``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax.base import ActionSpec, EnvSpec, JaxEnv
+
+
+class CartPole(JaxEnv):
+    """gymnasium ``CartPole-v1``: euler-integrated cart-pole, 2 discrete actions,
+    reward 1 per step (terminal step included), termination on |x| > 2.4 or
+    |theta| > ~12 deg. The v1 500-step truncation is the AutoReset wrapper's job
+    (``max_episode_steps``), exactly like gymnasium's TimeLimit."""
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    TOTAL_MASS = MASSPOLE + MASSCART
+    LENGTH = 0.5  # half the pole's length
+    POLEMASS_LENGTH = MASSPOLE * LENGTH
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_THRESHOLD = 12 * 2 * np.pi / 360
+    X_THRESHOLD = 2.4
+
+    spec = EnvSpec(
+        obs_shape=(4,),
+        action=ActionSpec(kind="discrete", num_actions=2),
+        # gymnasium advertises the threshold-derived bounds; parity is on values,
+        # bounds are informational only
+        obs_low=-np.inf,
+        obs_high=np.inf,
+    )
+
+    def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        state = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+        return state, state
+
+    def step(
+        self, state: jax.Array, action: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        x, x_dot, theta, theta_dot = state[0], state[1], state[2], state[3]
+        force = jnp.where(action == 1, self.FORCE_MAG, -self.FORCE_MAG).astype(jnp.float32)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        temp = (force + self.POLEMASS_LENGTH * theta_dot**2 * sintheta) / self.TOTAL_MASS
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * costheta**2 / self.TOTAL_MASS)
+        )
+        xacc = temp - self.POLEMASS_LENGTH * thetaacc * costheta / self.TOTAL_MASS
+        # euler integration, gymnasium's kinematics_integrator="euler" order
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * xacc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * thetaacc
+        new_state = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+        done = (
+            (jnp.abs(x) > self.X_THRESHOLD) | (jnp.abs(theta) > self.THETA_THRESHOLD)
+        )
+        reward = jnp.float32(1.0)
+        return new_state, new_state, reward, done, {}
+
+
+class Pendulum(JaxEnv):
+    """gymnasium ``Pendulum-v1``: torque-controlled pendulum swing-up, continuous
+    action in [-2, 2], never terminates (truncation-only episodes — gymnasium's
+    200-step TimeLimit maps to the AutoReset ``max_episode_steps``)."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+
+    spec = EnvSpec(
+        obs_shape=(3,),
+        action=ActionSpec(kind="continuous", num_actions=0, shape=(1,), low=-2.0, high=2.0),
+        obs_low=-8.0,
+        obs_high=8.0,
+    )
+
+    @staticmethod
+    def _obs(state: jax.Array) -> jax.Array:
+        th, thdot = state[0], state[1]
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot]).astype(jnp.float32)
+
+    def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        # gymnasium: th ~ U(-pi, pi), thdot ~ U(-1, 1)
+        high = jnp.array([np.pi, 1.0], jnp.float32)
+        state = jax.random.uniform(key, (2,), jnp.float32, -1.0, 1.0) * high
+        return state, self._obs(state)
+
+    def step(
+        self, state: jax.Array, action: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        th, thdot = state[0], state[1]
+        u = jnp.clip(action.reshape(()), -self.MAX_TORQUE, self.MAX_TORQUE)
+        angle_norm = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        costs = angle_norm**2 + 0.1 * thdot**2 + 0.001 * u**2
+        newthdot = thdot + (
+            3.0 * self.G / (2.0 * self.L) * jnp.sin(th) + 3.0 / (self.M * self.L**2) * u
+        ) * self.DT
+        newthdot = jnp.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
+        newth = th + newthdot * self.DT
+        new_state = jnp.stack([newth, newthdot]).astype(jnp.float32)
+        reward = (-costs).astype(jnp.float32)
+        return new_state, self._obs(new_state), reward, jnp.bool_(False), {}

@@ -1,0 +1,99 @@
+"""Batched gridworld family in pure JAX (the JaxARC direction, PAPERS.md).
+
+A family of NxN navigation tasks over a static wall layout: the agent starts at
+a random free cell, a goal sits at another random free cell, actions are
+up/right/down/left, reaching the goal terminates with reward 1, every other
+step costs ``step_penalty``. Layouts are precomputed boolean masks (pure data),
+so a whole family member is one ``jnp.where`` pipeline — vmap over thousands of
+instances is free.
+
+Observation is MLP-friendly: one-hot agent position concat one-hot goal
+position (``2 * N * N`` floats).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax.base import ActionSpec, EnvSpec, JaxEnv
+
+# dr, dc per action: 0=up 1=right 2=down 3=left
+_MOVES = np.array([[-1, 0], [0, 1], [1, 0], [0, -1]], np.int32)
+
+
+def _four_rooms_walls(size: int) -> np.ndarray:
+    """Classic four-rooms layout: a cross of walls with one door per arm."""
+    walls = np.zeros((size, size), bool)
+    mid = size // 2
+    walls[mid, :] = True
+    walls[:, mid] = True
+    q1, q3 = mid // 2, mid + 1 + (size - mid - 1) // 2
+    for r, c in ((mid, q1), (mid, q3), (q1, mid), (q3, mid)):
+        walls[r, c] = False
+    return walls
+
+
+_LAYOUTS = {
+    "empty": lambda size: np.zeros((size, size), bool),
+    "four_rooms": _four_rooms_walls,
+}
+
+
+class GridWorld(JaxEnv):
+    """One member of the gridworld family (``layout`` in {empty, four_rooms},
+    ``size`` >= 5). State is ``(agent_rc, goal_rc)`` int32 pairs."""
+
+    def __init__(self, size: int = 8, layout: str = "empty", step_penalty: float = 0.01):
+        if layout not in _LAYOUTS:
+            raise ValueError(f"unknown gridworld layout {layout!r}; choose from {sorted(_LAYOUTS)}")
+        if size < 5:
+            raise ValueError(f"gridworld size must be >= 5, got {size}")
+        self.size = int(size)
+        self.layout = layout
+        self.step_penalty = float(step_penalty)
+        walls = _LAYOUTS[layout](self.size)
+        self._walls = jnp.asarray(walls)
+        free = np.argwhere(~walls).astype(np.int32)
+        self._free_cells = jnp.asarray(free)  # [F, 2] sampling table of free cells
+        self.spec = EnvSpec(
+            obs_shape=(2 * self.size * self.size,),
+            action=ActionSpec(kind="discrete", num_actions=4),
+            obs_low=0.0,
+            obs_high=1.0,
+        )
+
+    def _obs(self, state: Tuple[jax.Array, jax.Array]) -> jax.Array:
+        agent, goal = state
+        n = self.size * self.size
+        agent_idx = agent[0] * self.size + agent[1]
+        goal_idx = goal[0] * self.size + goal[1]
+        one_hot = jnp.zeros((2 * n,), jnp.float32)
+        return one_hot.at[agent_idx].set(1.0).at[n + goal_idx].set(1.0)
+
+    def reset(self, key: jax.Array) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+        ka, kg = jax.random.split(key)
+        num_free = self._free_cells.shape[0]
+        agent = self._free_cells[jax.random.randint(ka, (), 0, num_free)]
+        # goal re-drawn from the cells != agent by shifting the draw past it
+        draw = jax.random.randint(kg, (), 0, num_free - 1)
+        agent_pos = jnp.argmax(jnp.all(self._free_cells == agent, axis=1))
+        goal = self._free_cells[jnp.where(draw >= agent_pos, draw + 1, draw)]
+        state = (agent, goal)
+        return state, self._obs(state)
+
+    def step(
+        self, state: Tuple[jax.Array, jax.Array], action: jax.Array
+    ) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        agent, goal = state
+        move = jnp.asarray(_MOVES)[action]
+        target = jnp.clip(agent + move, 0, self.size - 1)
+        blocked = self._walls[target[0], target[1]]
+        new_agent = jnp.where(blocked, agent, target)
+        done = jnp.all(new_agent == goal)
+        reward = jnp.where(done, 1.0, -self.step_penalty).astype(jnp.float32)
+        new_state = (new_agent, goal)
+        return new_state, self._obs(new_state), reward, done, {}

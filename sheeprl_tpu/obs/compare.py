@@ -43,7 +43,17 @@ MEMORY_GROWTH = 0.10  # ≥10% peak-memory growth flags
 COMPILE_STORM_DELTA = 3  # ≥3 extra compiles escalates to critical
 DEFAULT_BENCH_THRESHOLD = 0.05  # bench-diff per-metric relative threshold
 
-_PHASE_KEYS = ("env", "replay_wait", "train", "checkpoint", "logging", "eval", "analysis", "other")
+_PHASE_KEYS = (
+    "env",
+    "rollout",
+    "replay_wait",
+    "train",
+    "checkpoint",
+    "logging",
+    "eval",
+    "analysis",
+    "other",
+)
 
 _PHASE_SUGGESTIONS = {
     "replay_wait": "the replay pipeline got slower: check buffer.prefetch.depth and host "
@@ -54,6 +64,8 @@ _PHASE_SUGGESTIONS = {
     "other": "unattributed time grew: a loop phase may have lost its Time/* span "
     "(howto/observability.md §phase attribution)",
     "env": "env interaction got slower: check env worker health and vectorization",
+    "rollout": "the fused on-device rollout got slower: check the jax env's step cost "
+    "and the anakin program's FLOPs split (howto/jax_envs.md)",
 }
 
 

@@ -131,6 +131,9 @@ def detect_recompile_storm(events: Events) -> List[Finding]:
         for w in windows[first_trained + 1 :]
         if ((w.get("compile") or {}).get("window_count") or 0) > 0
         and (w.get("step") or 0) > warmup_steps
+        # the final (close-time) window absorbs the end-of-run test's
+        # first-time eval-program compiles — legitimate, not shape churn
+        and not w.get("final")
     ]
     if not affected:
         return []
@@ -513,8 +516,9 @@ def detect_unattributed_time(events: Events) -> List[Finding]:
             f"{unattributed:.0%} of steady wall time is not attributed to any named "
             "phase — the attribution invariant is leaking",
             worst,
-            "a loop phase is missing its Time/* span (env interaction, checkpoint, "
-            "logging); see howto/observability.md §phase attribution",
+            "a loop phase is missing its Time/* span (env interaction, fused "
+            "rollout, checkpoint, logging); see howto/observability.md §phase "
+            "attribution",
             named_fraction=round(att["named_fraction"], 4),
             wall_seconds=round(att["wall_seconds"], 3),
         )

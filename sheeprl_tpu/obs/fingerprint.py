@@ -15,6 +15,8 @@ check mechanical instead of tribal knowledge: every telemetry ``start`` event
   tree has uncommitted changes), so a regression can be pinned to a commit;
 - ``backend`` / ``device_kind`` / ``device_count`` / ``mesh_shape`` — the
   hardware the programs compiled for;
+- ``env_backend`` — which environment plane stepped the run (``host``
+  gymnasium vs the on-device ``jax`` plane, ``env.backend``);
 - ``key_shapes`` — the config values that directly set compiled program shapes
   (num_envs, per-rank batch/sequence, rollout steps).
 
@@ -59,7 +61,10 @@ _VOLATILE_TOP_KEYS = (
 
 # fingerprint fields that veto comparability when BOTH sides carry a value and
 # the values differ; code_version is deliberately absent (cross-commit diffs
-# are the point of the regression gate)
+# are the point of the regression gate). env_backend is its own top-level field
+# (not a key_shapes entry) so pre-PR-7 recordings — whose key_shapes dict
+# predates it — stay comparable under the None-tolerant rule while a host-env
+# run can never silently diff against a jax-env run.
 COMPARE_KEYS = (
     "algo",
     "config_hash",
@@ -67,6 +72,7 @@ COMPARE_KEYS = (
     "device_kind",
     "device_count",
     "mesh_shape",
+    "env_backend",
     "key_shapes",
 )
 
@@ -150,6 +156,7 @@ def run_fingerprint(cfg: Mapping[str, Any], fabric: Any = None) -> Dict[str, Any
     ``None``/absent rather than an exception — the fingerprint must never be the
     thing that takes a run down."""
     algo_cfg = cfg.get("algo") or {}
+    env_cfg = cfg.get("env") or {}
     fp: Dict[str, Any] = {
         "algo": algo_cfg.get("name") if hasattr(algo_cfg, "get") else None,
         "config_hash": config_hash(cfg),
@@ -158,6 +165,12 @@ def run_fingerprint(cfg: Mapping[str, Any], fabric: Any = None) -> Dict[str, Any
         "device_kind": None,
         "device_count": None,
         "mesh_shape": None,
+        # which environment plane stepped the run (host gymnasium vs the
+        # on-device jax plane): throughput across planes lives on different
+        # scales, so compare/bench-diff must refuse to silently diff them
+        "env_backend": str(env_cfg.get("backend") or "host")
+        if hasattr(env_cfg, "get")
+        else None,
         "key_shapes": _key_shapes(cfg),
     }
     if fabric is not None:

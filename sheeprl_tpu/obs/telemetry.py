@@ -23,8 +23,9 @@ config group) and driven by four hooks, each a no-op when the feature is off:
   profiler window.
 
 Every ``window`` event carries a ``phases`` wall-time breakdown (env
-interaction, replay/prefetch wait, device train, checkpoint write, logging,
-eval/test, unattributed remainder — see ``_PHASE_TIMERS``) and every event the
+interaction, fused on-device rollout, replay/prefetch wait, device train,
+checkpoint write, logging, eval/test, unattributed remainder — see
+``_PHASE_TIMERS``) and every event the
 stream identity triple ``rank``/``attempt``/``seq`` (``obs/jsonl.py``). At
 window cadence the in-loop diagnosis (``metric.telemetry.diagnosis``, default
 on) runs the ``obs/diagnose.py`` detector catalog over the run's own history
@@ -69,6 +70,11 @@ _PREFETCH_COUNTERS = (
 # sum(phases.values()) ≈ window wall_seconds.
 _PHASE_TIMERS = {
     "env": "Time/env_interaction_time",
+    # fused on-device env+act (the Anakin loops: the rollout half of ONE jitted
+    # rollout+train program, split from `train` by a one-shot measured
+    # rollout-only wall time — algos/ppo/anakin.py). Host-env loops simply
+    # contribute zero here.
+    "rollout": "Time/rollout_time",
     "train": "Time/train_time",
     "checkpoint": "Time/checkpoint_time",
     "logging": "Time/logging_time",
@@ -657,6 +663,8 @@ class RunTelemetry:
         total_compile_seconds = snap["seconds"] - self._compile_base["seconds"]
         if (
             window_compiles > 0
+            and not final  # the close-time window absorbs the end-of-run
+            # test's first-time eval compiles — legitimate, not shape churn
             and self.compile_warmup_steps > 0
             and policy_step > self.compile_warmup_steps
         ):
@@ -695,6 +703,7 @@ class RunTelemetry:
             replay_wait = min(max(float(prefetch["wait_seconds"]), 0.0), train_seconds)
         phases = {
             "env": env_seconds,
+            "rollout": self._window_phases["rollout"],
             "replay_wait": replay_wait,
             "train": train_seconds - replay_wait,
             "checkpoint": self._window_phases["checkpoint"],

@@ -43,6 +43,7 @@ __all__ = ["WatchState", "main", "watch_run"]
 # phase → (bar glyph, short label); order matches the loop's own wall-time layout
 _PHASE_GLYPHS = (
     ("env", "E", "env"),
+    ("rollout", "r", "rollout"),
     ("replay_wait", "R", "replay"),
     ("train", "T", "train"),
     ("checkpoint", "C", "ckpt"),

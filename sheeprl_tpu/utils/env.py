@@ -134,12 +134,31 @@ def make_env(
     """Build a thunk creating a fully-wrapped env with Dict observations."""
 
     def thunk() -> gym.Env:
-        instantiate_kwargs = {}
-        if "seed" in cfg.env.wrapper:
-            instantiate_kwargs["seed"] = seed
-        if "rank" in cfg.env.wrapper:
-            instantiate_kwargs["rank"] = rank + vector_env_idx
-        env = instantiate(cfg.env.wrapper, **instantiate_kwargs)
+        backend = str(cfg.env.get("backend", "host") or "host").lower()
+        if backend == "jax":
+            # on-device env plane (sheeprl_tpu/envs/jax) behind the same
+            # factory: the pure env steps through a host-side gymnasium
+            # adapter, so every wrapper below stacks on it unchanged. The
+            # adapter only applies the id's default step budget when the
+            # config does not install its own TimeLimit further down.
+            from sheeprl_tpu.envs.jax import JaxToGymEnv
+
+            env: gym.Env = JaxToGymEnv(
+                str(cfg.env.id),
+                seed=seed if seed is not None else 0,
+                apply_default_time_limit=not (
+                    cfg.env.max_episode_steps and cfg.env.max_episode_steps > 0
+                ),
+            )
+        elif backend != "host":
+            raise ValueError(f"unknown env.backend {backend!r}; choose host or jax")
+        else:
+            instantiate_kwargs = {}
+            if "seed" in cfg.env.wrapper:
+                instantiate_kwargs["seed"] = seed
+            if "rank" in cfg.env.wrapper:
+                instantiate_kwargs["rank"] = rank + vector_env_idx
+            env = instantiate(cfg.env.wrapper, **instantiate_kwargs)
 
         try:
             env_spec = str(gym.spec(cfg.env.id).entry_point)
@@ -232,7 +251,13 @@ def make_env(
         if cfg.env.max_episode_steps and cfg.env.max_episode_steps > 0:
             env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
         env = gym.wrappers.RecordEpisodeStatistics(env)
-        if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
+        if (
+            cfg.env.capture_video
+            and backend != "jax"  # the adapter has no render frames to record
+            and rank == 0
+            and vector_env_idx == 0
+            and run_name is not None
+        ):
             if cfg.env.grayscale:
                 env = GrayscaleRenderWrapper(env)
             try:

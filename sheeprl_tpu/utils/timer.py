@@ -50,6 +50,15 @@ class timer(ContextDecorator):
             self._start = None
         return False
 
+    def add(self, seconds: float) -> None:
+        """Account an externally measured span. The Anakin loops measure ONE
+        fused rollout+train program call and split its wall time across two
+        phase timers by a measured rollout-only share — a context manager
+        cannot express that, so they add the shares directly."""
+        if not timer.disabled and seconds > 0:
+            self._total += seconds
+            self._count += 1
+
     def compute(self) -> float:
         return self._total
 

@@ -1,0 +1,208 @@
+"""Unit suite for the on-device env plane (sheeprl_tpu/envs/jax): the JaxEnv
+protocol surface, the AutoReset wrapper contract (SAME_STEP semantics, episode
+accumulators, truncation), vmap batching, the gridworld family, the factory id
+namespace and the gymnasium adapter."""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.jax import (
+    AutoReset,
+    CartPole,
+    GridWorld,
+    JaxToGymEnv,
+    Pendulum,
+    VmapEnv,
+    make_jax_env,
+    resolve_jax_env,
+)
+
+
+def test_specs():
+    assert CartPole.spec.obs_shape == (4,)
+    assert CartPole.spec.action.kind == "discrete"
+    assert CartPole.spec.action.num_actions == 2
+    assert CartPole.spec.action.actions_dim == (2,)
+    assert Pendulum.spec.action.kind == "continuous"
+    assert Pendulum.spec.action.shape == (1,)
+    g = GridWorld(8, "empty")
+    assert g.spec.obs_shape == (128,)
+    assert g.spec.action.num_actions == 4
+
+
+def test_reset_step_pure_and_deterministic():
+    env = CartPole()
+    key = jax.random.PRNGKey(0)
+    s1, o1 = env.reset(key)
+    s2, o2 = env.reset(key)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    ns1, no1, r1, d1, _ = env.step(s1, jnp.int32(1))
+    ns2, no2, r2, d2, _ = env.step(s2, jnp.int32(1))
+    np.testing.assert_array_equal(np.asarray(no1), np.asarray(no2))
+    assert float(r1) == float(r2) == 1.0
+
+
+def test_autoreset_same_step_semantics():
+    """The done step returns the FRESH reset obs; the terminal obs rides in
+    info; episode accumulators reset — the host plane's SAME_STEP contract."""
+    env = AutoReset(CartPole(), max_episode_steps=None)
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    # drive one action until termination
+    for t in range(1000):
+        prev_obs = obs
+        state, obs, reward, done, info = env.step(state, jnp.int32(1))
+        if bool(done):
+            break
+    else:
+        pytest.fail("cartpole never terminated under a constant action")
+    assert bool(info["terminated"]) and not bool(info["truncated"])
+    # the terminal obs is the crashed state, the returned obs a fresh reset
+    assert abs(float(np.asarray(info["terminal_observation"])[2])) > CartPole.THETA_THRESHOLD
+    assert np.all(np.abs(np.asarray(obs)) <= 0.05)
+    # accumulators: reward 1/step over t+1 steps, reported at the done step
+    assert int(info["episode_length"]) == t + 1
+    assert float(info["episode_return"]) == pytest.approx(t + 1)
+    # and carried state is zeroed for the new episode
+    assert int(state.episode_length) == 0
+    assert float(state.episode_return) == 0.0
+
+
+def test_autoreset_truncation_boundary():
+    env = AutoReset(Pendulum(), max_episode_steps=5)
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    for t in range(5):
+        state, obs, reward, done, info = env.step(state, jnp.zeros((1,), jnp.float32))
+    assert bool(done) and bool(info["truncated"]) and not bool(info["terminated"])
+    assert int(info["episode_length"]) == 5
+    # pendulum never terminates: steps 1-4 were not done
+    state, obs, reward, done, info = env.step(state, jnp.zeros((1,), jnp.float32))
+    assert not bool(done) and int(info["episode_length"]) == 1
+
+
+def test_vmap_batching_independent_episodes():
+    env = VmapEnv(AutoReset(CartPole(), max_episode_steps=None), 32)
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    assert obs.shape == (32, 4)
+    # distinct per-env resets
+    assert len({tuple(np.asarray(o)) for o in obs}) > 1
+    step = jax.jit(env.step)
+    done_seen = np.zeros(32, bool)
+    for _ in range(200):
+        state, obs, reward, done, info = step(state, jnp.ones((32,), jnp.int32))
+        done_seen |= np.asarray(done)
+    # every env eventually fails under a constant action, each on its own clock
+    assert done_seen.all()
+
+
+def test_gridworld_reaches_goal_and_walls_block():
+    g = GridWorld(8, "empty", step_penalty=0.01)
+    state, obs = g.reset(jax.random.PRNGKey(3))
+    agent, goal = (np.asarray(x) for x in state)
+    # walk towards the goal greedily; empty layout cannot block
+    for _ in range(32):
+        dr, dc = goal[0] - agent[0], goal[1] - agent[1]
+        if dr < 0:
+            a = 0
+        elif dc > 0:
+            a = 1
+        elif dr > 0:
+            a = 2
+        else:
+            a = 3
+        state, obs, reward, done, _ = g.step(state, jnp.int32(a))
+        agent = np.asarray(state[0])
+        if bool(done):
+            assert float(reward) == 1.0
+            break
+    else:
+        pytest.fail("greedy walk never reached the goal on the empty layout")
+
+    fr = GridWorld(8, "four_rooms")
+    walls = np.asarray(fr._walls)
+    assert walls.any()
+    # an agent facing a wall stays put
+    r, c = np.argwhere(walls)[0]
+    free_below = (r + 1 < 8) and not walls[r + 1, c]
+    if free_below:
+        state = (jnp.array([r + 1, c], jnp.int32), jnp.array([0, 0], jnp.int32))
+        new_state, *_ = fr.step(state, jnp.int32(0))  # up, into the wall
+        np.testing.assert_array_equal(np.asarray(new_state[0]), [r + 1, c])
+
+
+def test_factory_ids_and_errors():
+    for env_id in ("CartPole-v1", "Pendulum-v1", "gridworld_empty", "gridworld_four_rooms"):
+        env, limit = resolve_jax_env(env_id)
+        assert env.spec.obs_shape
+    env, _ = resolve_jax_env("gridworld_empty-16")
+    assert env.size == 16
+    with pytest.raises(ValueError, match="unknown jax env id"):
+        resolve_jax_env("Humanoid-v4")
+    with pytest.raises(ValueError, match="layout"):
+        GridWorld(8, "maze")
+
+
+def test_make_jax_env_applies_default_and_override_limits():
+    class _Cfg(dict):
+        pass
+
+    from sheeprl_tpu.utils.utils import dotdict
+
+    cfg = dotdict({"env": {"id": "CartPole-v1", "max_episode_steps": None}})
+    env = make_jax_env(cfg, 4)
+    assert env.spec.max_episode_steps == 500
+    cfg = dotdict({"env": {"id": "CartPole-v1", "max_episode_steps": 64}})
+    assert make_jax_env(cfg, 4).spec.max_episode_steps == 64
+    cfg = dotdict({"env": {"id": "CartPole-v1", "max_episode_steps": -1}})
+    assert make_jax_env(cfg, 4).spec.max_episode_steps is None
+
+
+def test_gym_adapter_contract():
+    env = JaxToGymEnv("CartPole-v1", seed=7)
+    assert isinstance(env, gym.Env)
+    assert isinstance(env.action_space, gym.spaces.Discrete)
+    obs, info = env.reset()
+    assert obs.shape == (4,) and obs.dtype == np.float32
+    obs2, reward, terminated, truncated, _ = env.step(1)
+    assert reward == 1.0 and not truncated
+    # reseeding reproduces the reset
+    o1, _ = env.reset(seed=11)
+    o2, _ = env.reset(seed=11)
+    np.testing.assert_array_equal(o1, o2)
+    # default TimeLimit applies (pendulum: 200 steps, truncation-only)
+    p = JaxToGymEnv("Pendulum-v1", seed=0)
+    p.reset()
+    for t in range(200):
+        _, _, terminated, truncated, _ = p.step(np.zeros(1, np.float32))
+        assert not terminated
+    assert truncated
+
+
+def test_gym_adapter_through_make_env_factory():
+    """env.backend=jax slots behind make_env: dict obs coercion + episode stats
+    wrappers stack on the adapter unchanged."""
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.utils.env import make_env
+
+    cfg = compose(
+        [
+            "exp=ppo",
+            "env.backend=jax",
+            "env.capture_video=False",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    env = make_env(cfg, 3, 0)()
+    assert isinstance(env.observation_space, gym.spaces.Dict)
+    obs, _ = env.reset(seed=3)
+    assert set(obs.keys()) == {"state"}
+    obs, reward, terminated, truncated, info = env.step(env.action_space.sample())
+    assert obs["state"].shape == (4,)
+
+    bad = compose(["exp=ppo", "env.backend=torch", "algo.mlp_keys.encoder=[state]"])
+    with pytest.raises(ValueError, match="unknown env.backend"):
+        make_env(bad, 0, 0)()

@@ -218,7 +218,7 @@ def test_phases_breakdown_tiles_the_window(tmp_path):
         window = [e for e in read_events(str(tmp_path / "telemetry.jsonl")) if e["event"] == "window"][0]
         phases = window["phases"]
         assert set(phases) == {
-            "env", "replay_wait", "train", "checkpoint", "logging", "eval", "analysis", "other",
+            "env", "rollout", "replay_wait", "train", "checkpoint", "logging", "eval", "analysis", "other",
         }
         for name in ("env", "train", "checkpoint", "logging"):
             assert phases[name] >= 0.015, (name, phases)

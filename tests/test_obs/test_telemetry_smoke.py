@@ -79,7 +79,7 @@ def _assert_stream_shape(events, expect_train: bool):
     for w in windows:
         phases = w["phases"]
         assert set(phases) == {
-            "env", "replay_wait", "train", "checkpoint", "logging", "eval", "analysis", "other",
+            "env", "rollout", "replay_wait", "train", "checkpoint", "logging", "eval", "analysis", "other",
         }
         assert abs(sum(phases.values()) - w["wall_seconds"]) < 0.05 * w["wall_seconds"] + 0.01
     # compile accounting: the jitted act/train programs compiled during the run
