@@ -38,6 +38,11 @@ Select a single workload with BENCH_ALGO:
   accelerator: FLOPs from XLA's own cost model over achieved step time vs chip
   peak (sheeprl_tpu/utils/mfu.py). Run automatically as an extra when the
   accelerator probe reports a live non-CPU chip.
+- dv3_2d_mesh — model-parallelism dryrun: DV3-L per-device parameter footprint
+  on the named [2,4] data x model mesh vs the [8] replicated mesh, on 8
+  virtual CPU devices (init-time only, never claims the chip). Bytes units
+  gate lower-is-better under --against. SHEEPRL_BENCH_DV3_2D_SIZE overrides
+  the preset.
 
 The dreamer_v3 extra also records the MFU of the benchmark-size train program in
 its ``conditions.train_mfu`` block (and mirrors ``mfu`` top-level).
@@ -562,6 +567,93 @@ def _bench_ppo_anakin() -> dict:
     }
 
 
+def _bench_dv3_2d_mesh(size: str = "L") -> dict:
+    """2-D mesh GSPMD dryrun workload: DV3-``size`` (default L) parameters
+    built on the named ``[2, 4]`` data x model CPU mesh (8 virtual devices) vs
+    the ``[8]`` replicated data mesh, recording the per-device parameter
+    footprint, RSS, and (on a real chip mesh) HBM for each — the
+    model-parallelism acceptance number for MULTICHIP JSONs, gateable with
+    ``--against`` (bytes units are lower-is-better in bench-diff). Pure
+    init-time measurement on the virtual CPU mesh: no accelerator claim, no
+    train step (the train-program collectives are covered by the AOT suite,
+    tests/test_parallel/test_mesh_2d.py)."""
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+        # virtual-CPU-mesh workload by definition — must never touch (or wedge
+        # on) the tunneled accelerator
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import gymnasium as gym
+    import jax
+    import numpy as np
+
+    if len(jax.devices("cpu")) < 8:
+        raise RuntimeError(
+            "dv3_2d_mesh needs 8 virtual CPU devices; run in a fresh process or set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 before jax imports"
+        )
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.obs.fingerprint import run_fingerprint
+    from sheeprl_tpu.obs.telemetry import mesh_device_memory, rss_peak_bytes
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.parallel.sharding import per_device_bytes, sharding_summary
+
+    cfg = compose(["exp=dreamer_v3", f"algo=dreamer_v3_{size}"] + _dummy_pixel_overrides())
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    actions_dim = (4,)
+
+    def measure(mesh_shape, axis_names):
+        fabric = Fabric(
+            devices=-1, accelerator="cpu", mesh_shape=mesh_shape, axis_names=axis_names
+        )
+        fabric._setup()
+        _, params = build_agent(fabric, actions_dim, False, cfg, obs_space, jax.random.PRNGKey(0))
+        if not fabric.model_parallel:
+            # the [8] data mesh replicates params on every device — materialize
+            # that placement so the footprint/RSS numbers are measured, not assumed
+            params = fabric.replicate_pytree(params)
+        jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+        footprint = per_device_bytes(params)
+        entry = {
+            "mesh_shape": list(mesh_shape),
+            "axis_names": list(axis_names),
+            "param_bytes_per_device": {str(k): v for k, v in sorted(footprint.items())},
+            "param_bytes_per_device_max": max(footprint.values()),
+            "hbm": mesh_device_memory(fabric.devices),
+            "rss_peak_bytes": rss_peak_bytes(),
+            **sharding_summary(params),
+        }
+        fingerprint = run_fingerprint(cfg, fabric)
+        del params  # free the tree before the next mesh materializes
+        return entry, fingerprint
+
+    replicated, _ = measure([8], ["data"])
+    sharded, fingerprint = measure([2, 4], ["data", "model"])
+
+    return {
+        "metric": "dv3_2d_mesh_param_bytes_per_device",
+        "value": sharded["param_bytes_per_device_max"],
+        "unit": "bytes/device (DV3 params, [2,4] data x model mesh)",
+        # vs the replicated [8] mesh: < 1.0 is the model-parallel win
+        "vs_baseline": round(
+            sharded["param_bytes_per_device_max"]
+            / max(replicated["param_bytes_per_device_max"], 1),
+            4,
+        ),
+        "conditions": {
+            "model_size": size,
+            "mesh_shape": sharded["mesh_shape"],
+            "axis_names": sharded["axis_names"],
+            "sharded": sharded,
+            "replicated": replicated,
+            "fingerprint": fingerprint,
+        },
+    }
+
+
 def _bench_dv3_mfu_flagship(size: str = "S") -> dict:
     """Standalone extra: flagship-size DV3 train-program MFU on the accelerator."""
     stats = _dv3_train_mfu(size=size)
@@ -608,6 +700,8 @@ def _workload_fingerprint(algo: str) -> dict | None:
 def _bench(algo: str) -> dict:
     if algo == "dreamer_v3_mfu":
         result = _bench_dv3_mfu_flagship()
+    elif algo == "dv3_2d_mesh":
+        result = _bench_dv3_2d_mesh(os.environ.get("SHEEPRL_BENCH_DV3_2D_SIZE", "L"))
     elif algo == "ppo_anakin":
         result = _bench_ppo_anakin()
     elif algo == "sac_steady":
@@ -793,6 +887,14 @@ def main() -> int:
         except Exception as exc:
             result["ppo_anakin_extra_error"] = repr(exc)[:500]
             chip_busy = live and isinstance(exc, BenchTimeout)
+    # dv3_2d_mesh: per-device DV3-L parameter footprint on the [2,4] data x
+    # model mesh vs the [8] replicated mesh — init-time-only on 8 VIRTUAL CPU
+    # devices (never touches the chip), so it runs regardless of chip_busy
+    try:
+        extras.append(_bench_subprocess("dv3_2d_mesh", timeout=900))
+        print(json.dumps({**result, "extras": extras}), flush=True)
+    except Exception as exc:
+        result["dv3_2d_mesh_extra_error"] = repr(exc)[:500]
     if chip_busy:
         # The abandoned child is still compiling/claiming on the single-tenant
         # chip; further live-chip extras would only queue behind it and time out

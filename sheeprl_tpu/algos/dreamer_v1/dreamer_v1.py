@@ -42,7 +42,8 @@ from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import ActPlacement, BenchWindow, Ratio, foreach_gradient_step, save_configs
 
-def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx):
+
+def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx, state_shardings=None):
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
@@ -135,8 +136,12 @@ def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx):
 
     # donate_argnums: XLA reuses the train-state buffers in place instead of
     # copying them every gradient step (drivers always rebind to the returned
-    # trees, so the invalidated inputs are never read again)
-    @partial(jax.jit, donate_argnums=(0, 1))
+    # trees, so the invalidated inputs are never read again).
+    # state_shardings (parallel/sharding.py build_state_shardings) pins the
+    # state outputs' mesh placement so GSPMD cannot reshard them on output.
+    jit_kwargs = {"out_shardings": tuple(state_shardings)} if state_shardings is not None else {}
+
+    @partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
     def train_step(params, opt_state, batch, k):
         k_world, k_img = jax.random.split(jnp.asarray(k))
 
@@ -281,7 +286,12 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
     if state is not None and "rb" in state:
         rb = state["rb"]
 
-    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+    from sheeprl_tpu.parallel.sharding import build_state_shardings
+
+    train_phase = make_train_phase(
+        agent, cfg, world_tx, actor_tx, critic_tx,
+        state_shardings=build_state_shardings(fabric, params, opt_state),
+    )
 
     act = ActPlacement(fabric, lambda p: {"world_model": p["world_model"], "actor": p["actor"]})
     act_params = act.view(params)
@@ -317,7 +327,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             sequence_length=cfg.algo.per_rank_sequence_length,
         ),
         uint8_keys=cnn_keys,
-        sharding=fabric.sharding(None, None, "data") if world_size > 1 else None,
+        sharding=fabric.sharding(None, None, "data") if fabric.num_devices > 1 else None,
         name="dv1-replay-prefetch",
     )
     telemetry.attach_sampler(sampler)

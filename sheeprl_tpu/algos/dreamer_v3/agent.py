@@ -897,6 +897,17 @@ def build_agent(
 
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+        if getattr(fabric, "model_parallel", False):
+            # restored trees land in the same rule-derived shardings a fresh init
+            # would get, so the train program compiles identically across resume
+            params = fabric.shard_params(params)
+    elif getattr(fabric, "model_parallel", False):
+        # jit with out_shardings (parallel/sharding.py): every kernel lands
+        # directly in its model-axis shard — the full replicated tree never
+        # materializes, so a model larger than one chip's HBM still initializes
+        from sheeprl_tpu.parallel.sharding import init_sharded
+
+        params = init_sharded(fabric.mesh, _init_all, key)
     else:
         params = jax.jit(_init_all)(key)
     return agent, params

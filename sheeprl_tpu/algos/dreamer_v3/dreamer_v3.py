@@ -69,13 +69,25 @@ from sheeprl_tpu.utils.utils import (
 )
 
 
-def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx, world_latent_hook=None):
+def make_train_phase(
+    agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx, world_latent_hook=None,
+    state_shardings=None,
+):
     """Build the jitted multi-gradient-step train program. Returns
     train_phase(params, opt_state, moments_state, data, cum_steps, key).
 
     ``world_latent_hook(wm_params, latents, key) -> (head_latents, extra_loss,
     extra_metrics)`` lets forks transform the latent the world-model heads consume and
-    add loss terms (offline_dreamer's CEM bottleneck); None keeps plain DV3."""
+    add loss terms (offline_dreamer's CEM bottleneck); None keeps plain DV3.
+
+    ``state_shardings`` — optional ``(params, opt_state, moments, metrics)``
+    out_shardings pytrees (prefixes allowed) pinning the train-state OUTPUT
+    placement on a multi-device mesh. Without the pin GSPMD is free to reshard
+    state outputs however propagation likes (observed: small actor/critic leaves
+    scattered over an 8-device data mesh), which breaks the params-stay-put
+    contract the loops and the donation aliasing rely on; with it, outputs land
+    exactly where the inputs live (replicated on a 1-D mesh, rule-sharded over
+    ``model`` on a 2-D one — ``build_state_shardings``)."""
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
@@ -220,7 +232,11 @@ def make_train_phase(agent: DV3Agent, cfg, world_tx, actor_tx, critic_tx, world_
     # instead of copying the whole train state every gradient step (all drivers —
     # foreach_gradient_step, the trainers, warmup — rebind to the returned trees,
     # so the invalidated inputs are never read again).
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    jit_kwargs = {}
+    if state_shardings is not None:
+        jit_kwargs["out_shardings"] = tuple(state_shardings)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2), **jit_kwargs)
     def train_step(params, opt_state, moments_state, batch, cum, k):
         k_world, k_img = jax.random.split(jnp.asarray(k))
 
@@ -322,8 +338,10 @@ class _InlineTrainer:
         self.moments_state = moments_state
         # the replay sampler stages train blocks with this sharding (off-thread when
         # prefetch is on); a channel trainer keeps it None — its data plane ships
-        # host blocks and the learner stages them itself
-        self.data_sharding = fabric.sharding(None, None, "data") if fabric.world_size > 1 else None
+        # host blocks and the learner stages them itself. The guard is TOTAL mesh
+        # devices: a data x model mesh needs the batch committed to the mesh
+        # (P("data") replicates it over the model axis) even when data extent is 1
+        self.data_sharding = fabric.sharding(None, None, "data") if fabric.num_devices > 1 else None
 
     def train(self, data, cum_steps, train_key, want_full_state: bool, want_metrics: bool):
         """One train round over the ``[G, T, B, ...]`` block (already staged with
@@ -495,7 +513,18 @@ def run_dreamer(
     if state is not None and "rb" in state:
         rb = state["rb"]
 
-    train_phase = make_train_phase_fn(agent, cfg, world_tx, actor_tx, critic_tx)
+    from sheeprl_tpu.parallel.sharding import build_state_shardings
+
+    train_phase = make_train_phase_fn(
+        agent,
+        cfg,
+        world_tx,
+        actor_tx,
+        critic_tx,
+        # pin the train state's output placement on any multi-device mesh:
+        # replicated on 1-D dp, rule-sharded over `model` on a 2-D mesh
+        state_shardings=build_state_shardings(fabric, params, opt_state, moments_state),
+    )
 
     # Act/train device split (shared ActPlacement design, utils/utils.py): with the
     # fabric on an accelerator the per-step player program runs on the host CPU

@@ -72,8 +72,11 @@ def _warmup_train_step(fabric, cfg, train_phase, params, opt_state, observation_
         batch[k] = np.zeros((T, B, 1), np.float32)
     p, o, m = params, opt_state, init_moments()
     if mesh_size > 1:
-        p = fabric.replicate_pytree(p)
-        o = fabric.replicate_pytree(o)
+        # rule-derived placement: kernels shard over a `model` axis when the mesh
+        # has one, everything else replicates — identical to replicate_pytree on
+        # the 1-D learner-slice mesh
+        p = fabric.shard_params(p)
+        o = fabric.shard_params(o)
         m = fabric.replicate_pytree(m)
         batch = jax.device_put(batch, fabric.sharding(None, "data"))
     else:
@@ -113,8 +116,10 @@ def _trainer_loop(
     try:
         mesh_size = fabric.world_size
         if mesh_size > 1:
-            params = fabric.replicate_pytree(params)
-            opt_state = fabric.replicate_pytree(opt_state)
+            # same placement as the warmup burn above (shard_params == replicate
+            # on a mesh without a model axis)
+            params = fabric.shard_params(params)
+            opt_state = fabric.shard_params(opt_state)
             moments_state = fabric.replicate_pytree(moments_state)
         last_step = 0
         while True:
@@ -266,7 +271,12 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
     key, agent_key = jax.random.split(key)
     agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
     world_tx, actor_tx, critic_tx, opt_state = build_optimizers(cfg, params)
-    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+    from sheeprl_tpu.parallel.sharding import build_state_shardings
+
+    train_phase = make_train_phase(
+        agent, cfg, world_tx, actor_tx, critic_tx,
+        state_shardings=build_state_shardings(fabric, params, opt_state, init_moments()),
+    )
     moments_state = init_moments()
 
     # the learner's peer facade comes up BEFORE the first blocking channel op:

@@ -377,6 +377,10 @@ def build_agent(
     }
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    if getattr(fabric, "model_parallel", False):
+        # data x model mesh: land every kernel in its rule-derived model-axis
+        # shard (parallel/sharding.py); a 1-D mesh leaves this a no-op
+        params = fabric.shard_params(params)
     return agent, params
 
 

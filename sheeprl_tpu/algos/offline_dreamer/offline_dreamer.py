@@ -21,10 +21,12 @@ from sheeprl_tpu.algos.offline_dreamer.utils import test  # noqa: F401 — re-ex
 from sheeprl_tpu.utils.registry import register_algorithm
 
 
-def make_offline_train_phase(agent, cfg, world_tx, actor_tx, critic_tx):
+def make_offline_train_phase(agent, cfg, world_tx, actor_tx, critic_tx, state_shardings=None):
     """Dreamer-V3 train phase with the CEM world-latent hook (when use_cbm)."""
     if not agent.use_cbm:
-        return make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+        return make_train_phase(
+            agent, cfg, world_tx, actor_tx, critic_tx, state_shardings=state_shardings
+        )
 
     def world_latent_hook(wm_params, latents, key):
         k_rand, k_concepts = jax.random.split(key)
@@ -38,7 +40,13 @@ def make_offline_train_phase(agent, cfg, world_tx, actor_tx, critic_tx):
         return head_latents, extra_loss, {"Loss/concept_loss": c_loss}
 
     return make_train_phase(
-        agent, cfg, world_tx, actor_tx, critic_tx, world_latent_hook=world_latent_hook
+        agent,
+        cfg,
+        world_tx,
+        actor_tx,
+        critic_tx,
+        world_latent_hook=world_latent_hook,
+        state_shardings=state_shardings,
     )
 
 

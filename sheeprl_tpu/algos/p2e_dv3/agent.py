@@ -99,6 +99,11 @@ def build_agent(
     }
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    if getattr(fabric, "model_parallel", False):
+        # the DV3 subtree is already sharded by build_dv3_agent's jitted init;
+        # device_put with the same rule is a no-op there and lands the eager
+        # exploration heads/ensembles (and any resumed tree) in their shards
+        params = fabric.shard_params(params)
     return agent, ensembles, params
 
 

@@ -159,7 +159,12 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     ):
         rb = state["rb"]
 
-    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+    from sheeprl_tpu.parallel.sharding import build_state_shardings
+
+    train_phase = make_train_phase(
+        agent, cfg, world_tx, actor_tx, critic_tx,
+        state_shardings=build_state_shardings(fabric, params, opt_state, moments_state),
+    )
 
     start_iter = (state["iter_num"] // world_size) + 1 if resume else 1
     policy_step = state["iter_num"] * num_envs if resume else 0
@@ -189,7 +194,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             sequence_length=cfg.algo.per_rank_sequence_length,
         ),
         uint8_keys=cnn_keys,
-        sharding=fabric.sharding(None, None, "data") if world_size > 1 else None,
+        sharding=fabric.sharding(None, None, "data") if fabric.num_devices > 1 else None,
         name="p2e-dv3-ft-replay-prefetch",
     )
     telemetry.attach_sampler(sampler)

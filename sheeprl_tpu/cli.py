@@ -147,6 +147,38 @@ def check_configs(cfg: dotdict) -> None:
     if decoupled and devices < 1:
         raise ValueError(f"decoupled algorithms need fabric.devices >= 1, got {devices}")
 
+    # named-mesh sanity: canonicalize mesh_shape/axis_names (raises on shape/name
+    # mismatches, duplicate names, a missing "data" axis, multiple wildcards)
+    # before the run launches, and police the strategy interaction
+    from sheeprl_tpu.parallel.fabric import normalize_mesh_spec
+
+    mesh_shape, _mesh_axes = normalize_mesh_spec(
+        cfg.fabric.get("mesh_shape"), cfg.fabric.get("axis_names")
+    )
+    if strategy == "single_device" and len(mesh_shape) > 1:
+        raise ValueError(
+            f"single_device strategy cannot drive a multi-axis mesh "
+            f"(fabric.mesh_shape={mesh_shape}); launch with 'fabric.strategy=dp' or 'auto'"
+        )
+    if decoupled and len(mesh_shape) > 1:
+        raise ValueError(
+            f"{cfg.algo.name} is decoupled: its player/learner slices run 1-D data "
+            f"meshes (a multi-axis fabric.mesh_shape={mesh_shape} is only supported "
+            "by the coupled topologies — see howto/model_parallel.md)"
+        )
+    if "model" in _mesh_axes and len(mesh_shape) > 1:
+        module = entry[0]["module"]
+        if not any(fam in module for fam in ("dreamer", "p2e")):
+            # the mesh layer is generic but only the Dreamer family shards its
+            # parameters over `model` (howto/model_parallel.md) — elsewhere the
+            # model-axis devices would just repeat replicated work
+            warnings.warn(
+                f"fabric.mesh_shape={mesh_shape} carries a 'model' axis but "
+                f"{cfg.algo.name} does not shard parameters over it; those devices "
+                "will do replicated work. The Dreamer family is the wired-up "
+                "consumer — see howto/model_parallel.md."
+            )
+
     # optional-dependency downgrade (reference cli.py:333-340)
     if not cfg.model_manager.get("disabled", True):
         from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE

@@ -254,6 +254,9 @@ class LayerNormGRUCell(nn.Module):
                 and ops.pallas_gru_applicable(inp.shape[-1], self.hidden_size)
                 and os.environ.get("SHEEPRL_DISABLE_PALLAS", "0") != "1"
                 and jax.default_backend() == "tpu"
+                # Pallas kernels don't partition: a multi-device mesh (dp or
+                # model-sharded GRU kernel) must take the XLA path
+                and not ops.partitioned_mesh_active()
             ):
                 return jax.lax.platform_dependent(
                     tpu=lambda: ops.fused_ln_gru_step(

@@ -537,8 +537,15 @@ def _last_json_line(text: str) -> Any:
 
 
 def _lower_is_better(unit: str) -> bool:
+    # seconds-style latencies and bytes-style memory footprints regress UP
+    # (the dv3_2d_mesh workload gates per-device parameter bytes)
     unit = (unit or "").lower()
-    return unit.startswith("seconds") or "seconds/" in unit
+    return (
+        unit.startswith("seconds")
+        or "seconds/" in unit
+        or unit.startswith("bytes")
+        or "bytes/" in unit
+    )
 
 
 def bench_diff(

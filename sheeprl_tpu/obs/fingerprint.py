@@ -64,7 +64,9 @@ _VOLATILE_TOP_KEYS = (
 # are the point of the regression gate). env_backend is its own top-level field
 # (not a key_shapes entry) so pre-PR-7 recordings — whose key_shapes dict
 # predates it — stay comparable under the None-tolerant rule while a host-env
-# run can never silently diff against a jax-env run.
+# run can never silently diff against a jax-env run. axis_names (None-tolerant
+# the same way for pre-2-D-mesh recordings) keeps a [2, 4] data x model run
+# from ever silently diffing against a [2, 4]... data-only one.
 COMPARE_KEYS = (
     "algo",
     "config_hash",
@@ -72,9 +74,33 @@ COMPARE_KEYS = (
     "device_kind",
     "device_count",
     "mesh_shape",
+    "axis_names",
     "env_backend",
     "key_shapes",
 )
+
+
+def canonical_mesh_shape(mesh_shape: Any) -> Optional[List[int]]:
+    """One serialized form for a mesh shape no matter which container carried
+    it — tuple, list, Hydra ListConfig, numpy shape, or a bare int — so two
+    identical runs can never false-mismatch on ``(2, 4)`` vs ``[2, 4]``, while
+    ``[8]`` vs ``[2, 4]`` stays a real veto. Returns None (fingerprint =
+    unknown, never vetoes) for unresolvable values, INCLUDING shapes that still
+    carry a ``-1`` wildcard: the wildcard's extent depends on the device count,
+    and stamping it raw would false-mismatch against the resolved shape."""
+    if mesh_shape is None:
+        return None
+    if isinstance(mesh_shape, (int,)) or (
+        hasattr(mesh_shape, "__int__") and not hasattr(mesh_shape, "__iter__")
+    ):
+        mesh_shape = [mesh_shape]
+    try:
+        shape = [int(s) for s in mesh_shape]
+    except (TypeError, ValueError):
+        return None
+    if any(s < 1 for s in shape):
+        return None
+    return shape
 
 _CODE_VERSION_CACHE: Dict[str, Optional[str]] = {}
 
@@ -157,6 +183,7 @@ def run_fingerprint(cfg: Mapping[str, Any], fabric: Any = None) -> Dict[str, Any
     thing that takes a run down."""
     algo_cfg = cfg.get("algo") or {}
     env_cfg = cfg.get("env") or {}
+    fabric_cfg = cfg.get("fabric") or {}
     fp: Dict[str, Any] = {
         "algo": algo_cfg.get("name") if hasattr(algo_cfg, "get") else None,
         "config_hash": config_hash(cfg),
@@ -165,6 +192,7 @@ def run_fingerprint(cfg: Mapping[str, Any], fabric: Any = None) -> Dict[str, Any
         "device_kind": None,
         "device_count": None,
         "mesh_shape": None,
+        "axis_names": None,
         # which environment plane stepped the run (host gymnasium vs the
         # on-device jax plane): throughput across planes lives on different
         # scales, so compare/bench-diff must refuse to silently diff them
@@ -173,16 +201,42 @@ def run_fingerprint(cfg: Mapping[str, Any], fabric: Any = None) -> Dict[str, Any
         else None,
         "key_shapes": _key_shapes(cfg),
     }
+    if hasattr(fabric_cfg, "get"):
+        # cfg-only route (no live fabric — bench wall-clock workloads): the
+        # canonical form only sticks when fully explicit; a -1 wildcard stays
+        # None so it cannot false-mismatch the resolved shape a live run stamps
+        fp["mesh_shape"] = canonical_mesh_shape(fabric_cfg.get("mesh_shape"))
+        axes = fabric_cfg.get("axis_names")
+        if axes is not None:
+            if isinstance(axes, str):
+                # a scalar override (fabric.axis_names=data) arrives as a bare
+                # string — wrap it like normalize_mesh_spec does, or iterating
+                # would char-split it into a fingerprint that vetoes the live
+                # run's ["data"]
+                axes = [axes]
+            try:
+                fp["axis_names"] = [str(a) for a in axes]
+            except TypeError:
+                pass
     if fabric is not None:
         device = getattr(fabric, "device", None)
         fp["backend"] = getattr(device, "platform", None)
         fp["device_kind"] = getattr(device, "device_kind", None)
         try:
-            fp["device_count"] = int(getattr(fabric, "world_size", None))
-        except (TypeError, ValueError):
+            # TOTAL mesh devices (= world_size on a 1-D mesh; on a 2-D mesh
+            # world_size is only the data extent and mesh_shape carries the split)
+            fp["device_count"] = int(fabric.mesh.devices.size)
+        except Exception:
+            try:
+                fp["device_count"] = int(getattr(fabric, "world_size", None))
+            except (TypeError, ValueError):
+                pass
+        try:
+            fp["mesh_shape"] = canonical_mesh_shape(fabric.mesh.devices.shape)
+        except Exception:
             pass
         try:
-            fp["mesh_shape"] = list(fabric.mesh.devices.shape)
+            fp["axis_names"] = [str(a) for a in fabric.mesh.axis_names]
         except Exception:
             pass
     return fp

@@ -177,6 +177,30 @@ def device_memory(device: Any) -> Optional[Dict[str, int]]:
     return out or None
 
 
+def mesh_device_memory(devices: Sequence[Any]) -> Optional[Dict[str, Any]]:
+    """Allocator stats across EVERY local mesh device: the top-level keys
+    report the worst device (max — one hot model-axis shard is what OOMs a
+    run, not the mean) and ``per_device`` carries the full breakdown when more
+    than one device reports, so ``watch``/``diagnose`` can see a model-axis
+    imbalance instead of a single-device guess. None on backends without
+    allocator stats (host CPU)."""
+    per = []
+    for d in devices:
+        mem = device_memory(d)
+        if mem:
+            per.append({"id": getattr(d, "id", None), **mem})
+    if not per:
+        return None
+    out: Dict[str, Any] = {}
+    for key in ("bytes_in_use", "peak_bytes", "largest_alloc_size", "bytes_limit", "num_allocs"):
+        vals = [p[key] for p in per if key in p]
+        if vals:
+            out[key] = max(vals)
+    if len(per) > 1:
+        out["per_device"] = per
+    return out or None
+
+
 def _nonfinite_losses(losses: Any) -> list:
     """Names of non-finite entries in the latest observed losses. Accepts the
     loops' two shapes: a metrics mapping (dreamer host metrics) or an array of
@@ -246,6 +270,18 @@ class RunTelemetry:
             self._sink = JsonlEventSink(path, rank=self._rank, attempt=self._attempt)
 
         self._device = getattr(fabric, "device", None)
+        # every LOCAL mesh device: Mem/hbm_* gauges report the max across them
+        # and window events carry a per-device breakdown (a 2-D model-axis
+        # mesh can be imbalanced; one device's stats would hide that)
+        try:
+            local_pid = getattr(self._device, "process_index", 0)
+            self._devices = [
+                d
+                for d in (getattr(fabric, "devices", None) or [])
+                if getattr(d, "process_index", 0) == local_pid
+            ] or ([self._device] if self._device is not None else [])
+        except Exception:
+            self._devices = [self._device] if self._device is not None else []
         self._peak_flops = peak_flops(self._device) if self._device is not None else None
         self._world_size = int(getattr(fabric, "world_size", 1) or 1)
 
@@ -504,7 +540,7 @@ class RunTelemetry:
             )
             wall = time.perf_counter() - self._start_time if self._start_step is not None else 0.0
             snap = compile_snapshot()
-            hbm = device_memory(self._device) if self._device is not None else None
+            hbm = mesh_device_memory(self._devices)
             peak_hbm = max(self._peak_hbm, (hbm or {}).get("peak_bytes", 0)) or None
             overall_mfu = None
             if (
@@ -674,7 +710,7 @@ class RunTelemetry:
                 "look for shape churn (varying gradient-step counts, env batch changes)"
             )
 
-        hbm = device_memory(self._device) if self._device is not None else None
+        hbm = mesh_device_memory(self._devices)
         if hbm and hbm.get("peak_bytes"):
             self._peak_hbm = max(self._peak_hbm, hbm["peak_bytes"])
         rss = _rss_bytes()

@@ -42,6 +42,13 @@ from jax import lax
 
 
 def _fast_conv_enabled() -> bool:
+    # the custom-vjp decomposition is single-device-only: under a partitioned
+    # mesh its packing reshapes make the SPMD partitioner mis-scale fused
+    # loss/grad reductions (see sheeprl_tpu/ops/__init__.py)
+    from sheeprl_tpu import ops
+
+    if ops.partitioned_mesh_active():
+        return False
     return os.environ.get("SHEEPRL_DISABLE_FAST_CONV", "0") != "1"
 
 

@@ -37,6 +37,11 @@ from jax import lax
 
 
 def _fused_deconv_enabled() -> bool:
+    # single-device-only decomposition — see ops/conv.py's matching gate
+    from sheeprl_tpu import ops
+
+    if ops.partitioned_mesh_active():
+        return False
     return os.environ.get("SHEEPRL_DISABLE_FUSED_DECONV", "0") != "1"
 
 

@@ -27,6 +27,53 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from sheeprl_tpu.parallel import distributed
 
 
+def normalize_mesh_spec(
+    mesh_shape: Any, axis_names: Any
+) -> "tuple[List[int], tuple[str, ...]]":
+    """Canonicalize a (mesh_shape, axis_names) pair from any config container
+    (tuple, list, Hydra ListConfig, a bare int) into ``([int, ...], (str, ...))``
+    and validate the invariants every consumer relies on:
+
+    - one axis name per mesh dimension, names unique;
+    - at most one wildcard (``-1``) dimension, every other dimension >= 1;
+    - the batch axis ``"data"`` must exist — activations are P("data") sharded
+      and the per-rank batch math divides by its extent.
+
+    The canonical form is also the FINGERPRINT form (obs/fingerprint.py): two
+    configs that build the same mesh must serialize identically regardless of
+    which container type carried them.
+    """
+    if mesh_shape is None:
+        mesh_shape = [-1]
+    if isinstance(mesh_shape, (int, np.integer)):
+        mesh_shape = [int(mesh_shape)]
+    try:
+        shape = [int(s) for s in mesh_shape]
+    except (TypeError, ValueError) as exc:
+        raise ValueError(f"fabric.mesh_shape must be a list of ints, got {mesh_shape!r}") from exc
+    if axis_names is None:
+        axis_names = ["data"]
+    if isinstance(axis_names, str):
+        axis_names = [axis_names]
+    names = tuple(str(a) for a in axis_names)
+    if len(names) != len(shape):
+        raise ValueError(
+            f"fabric.axis_names {list(names)} must name every fabric.mesh_shape "
+            f"dimension {shape} (got {len(names)} names for {len(shape)} dims)"
+        )
+    if len(set(names)) != len(names):
+        raise ValueError(f"fabric.axis_names must be unique, got {list(names)}")
+    if "data" not in names:
+        raise ValueError(
+            f"fabric.axis_names must include 'data' (the batch axis), got {list(names)}"
+        )
+    if sum(1 for s in shape if s == -1) > 1:
+        raise ValueError(f"fabric.mesh_shape allows at most one -1 wildcard, got {shape}")
+    if any(s == 0 or s < -1 for s in shape):
+        raise ValueError(f"fabric.mesh_shape dimensions must be >= 1 (or one -1), got {shape}")
+    return shape, names
+
+
 class Fabric:
     def __init__(
         self,
@@ -39,6 +86,8 @@ class Fabric:
         checkpoint_backend: str = "pickle",
         checkpoint_async: bool = False,
         local_mesh: bool = False,
+        mesh_shape: Any = None,
+        axis_names: Any = None,
     ) -> None:
         # local_mesh=True restricts the mesh to THIS process's devices — the MPMD
         # role topology (player process / learner process run different programs on
@@ -51,6 +100,10 @@ class Fabric:
         self.local_mesh = local_mesh
         self.process_group: Optional[Sequence[int]] = None
         self.requested_devices = devices
+        # named N-D mesh request (default [-1]/["data"]: the whole selection on a
+        # 1-D data axis — byte-identical to the pre-mesh_shape fabric). A "model"
+        # axis turns on parameter sharding via parallel/sharding.py.
+        self.mesh_shape, self.axis_names = normalize_mesh_spec(mesh_shape, axis_names)
         self.num_nodes = num_nodes
         self.strategy = strategy
         self.accelerator = accelerator
@@ -81,8 +134,27 @@ class Fabric:
 
     @property
     def world_size(self) -> int:
-        """Number of devices on the data axis — the unit 'per_rank' sizes refer to."""
-        return len(self.devices)
+        """Number of devices on the ``data`` axis — the unit 'per_rank' sizes refer
+        to (global batch = per_rank_batch_size x world_size, policy counters scale
+        by it). On the default 1-D mesh this is every device; on a 2-D
+        ``data``x``model`` mesh only the data extent — the model axis splits
+        parameters, not the batch."""
+        return int(self.mesh.shape.get("data", self.num_devices))
+
+    @property
+    def num_devices(self) -> int:
+        """Total devices in the mesh across ALL axes (= world_size on a 1-D mesh)."""
+        return int(self.mesh.devices.size)
+
+    @property
+    def model_axis_size(self) -> int:
+        """Extent of the ``model`` (parameter-sharding) axis; 1 when absent."""
+        return int(self.mesh.shape.get("model", 1))
+
+    @property
+    def model_parallel(self) -> bool:
+        """Whether this mesh shards parameters over a non-trivial ``model`` axis."""
+        return self.model_axis_size > 1
 
     @property
     def global_rank(self) -> int:
@@ -144,6 +216,12 @@ class Fabric:
                     f"process {jax.process_index()} built a process_group mesh "
                     f"{group} it does not belong to"
                 )
+            if len(self.mesh_shape) > 1:
+                raise RuntimeError(
+                    "process-group meshes are 1-D data-parallel slices (every member "
+                    "process contributes the same per-process devices); a multi-axis "
+                    f"fabric.mesh_shape {self.mesh_shape} is not supported there"
+                )
             per = self.requested_devices
             per = None if per in ("auto", -1, "-1", None) else int(per)
             selected: List[jax.Device] = []
@@ -162,17 +240,54 @@ class Fabric:
             if self.local_mesh:
                 all_devices = [d for d in all_devices if d.process_index == jax.process_index()]
             n = self.requested_devices
-            if n in ("auto", -1, "-1", None):
-                n = len(all_devices)
-            n = int(n)
-            if n > len(all_devices):
+            n = None if n in ("auto", -1, "-1", None) else int(n)
+            shape = list(self.mesh_shape)
+            known = int(np.prod([s for s in shape if s != -1])) if shape else 1
+            if -1 in shape:
+                # the wildcard dimension absorbs the rest of the device selection:
+                # fabric.devices when given, every available device otherwise
+                total = n if n is not None else len(all_devices)
+                if total % known != 0:
+                    # a 1-device host launching e.g. the 2d-cpu preset lands here
+                    # (1 % 2 != 0) — carry the simulated-mesh remedy, not just
+                    # the arithmetic
+                    raise RuntimeError(
+                        f"fabric.mesh_shape {self.mesh_shape} cannot tile {total} devices: "
+                        f"{total} is not divisible by the explicit dims' product {known}; "
+                        "for CPU-simulated meshes set "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=N"
+                    )
+                shape[shape.index(-1)] = total // known
+            else:
+                # an explicit mesh shape defines the device count; fabric.devices is
+                # only cross-checked (1 is the untouched config default, so a bare
+                # `fabric.mesh_shape=[2,4]` override works without also setting it)
+                total = known
+                if n is not None and n not in (1, total):
+                    raise RuntimeError(
+                        f"fabric.devices={n} disagrees with fabric.mesh_shape "
+                        f"{self.mesh_shape} (= {total} devices); drop one of the two "
+                        "or set fabric.devices=-1"
+                    )
+            if total > len(all_devices):
                 raise RuntimeError(
-                    f"requested {n} devices but only {len(all_devices)} {platform} devices are "
+                    f"requested {total} devices but only {len(all_devices)} {platform} devices are "
                     "available; for CPU-simulated meshes set "
                     "XLA_FLAGS=--xla_force_host_platform_device_count=N"
                 )
-            mesh_devices = np.asarray(all_devices[:n])
-        self._mesh = Mesh(mesh_devices, axis_names=("data",))
+            mesh_devices = np.asarray(all_devices[:total]).reshape(shape)
+        self._mesh = Mesh(mesh_devices, axis_names=self.axis_names)
+        # the custom-kernel fast paths (fast conv / fused deconv / Pallas GRU)
+        # are single-device decompositions the SPMD partitioner mis-compiles on
+        # a partitioned mesh. The gate is STICKY upward: once any multi-device
+        # mesh exists in this process every later trace takes the native
+        # lowerings — a 1-device fabric built mid-run (eval views, reference
+        # builds) must not silently re-arm the fast paths for a partitioned
+        # program whose first call (= trace) happens after it.
+        if int(self._mesh.devices.size) > 1:
+            from sheeprl_tpu import ops
+
+            ops.set_partitioned_mesh(True)
         # make uncommitted computations follow the selected accelerator (otherwise a
         # `fabric.accelerator=cpu` run would still trace onto a default TPU device);
         # the default must be a LOCAL device — a process_group mesh interleaves
@@ -208,6 +323,23 @@ class Fabric:
 
     def replicate_pytree(self, tree: Any) -> Any:
         return jax.device_put(tree, self.replicated)
+
+    def param_shardings(self, tree: Any) -> Any:
+        """Per-leaf :class:`NamedSharding` tree for a parameter pytree under the
+        rule module (``parallel/sharding.py``): matmul/conv kernels split over the
+        ``model`` axis when divisible, everything else replicated. On a mesh
+        without a non-trivial ``model`` axis every leaf is replicated — i.e. this
+        degrades to :attr:`replicated` exactly. ``tree`` may hold arrays or
+        ``ShapeDtypeStruct`` avals (``jax.eval_shape`` output)."""
+        from sheeprl_tpu.parallel.sharding import param_sharding_tree
+
+        return param_sharding_tree(self.mesh, tree)
+
+    def shard_params(self, tree: Any) -> Any:
+        """Device-put a parameter pytree with the rule-derived shardings
+        (:meth:`param_shardings`). Identical to :meth:`replicate_pytree` on a
+        mesh without a ``model`` axis."""
+        return jax.device_put(tree, self.param_shardings(tree))
 
     def all_gather(self, tree: Any) -> Any:
         """Host-visible gather of per-device data (reference fabric.all_gather,

@@ -1,0 +1,144 @@
+"""Parameter-sharding rules for named N-D meshes (GSPMD, SNIPPETS [1]-[3] style).
+
+The mesh layer (``parallel/fabric.py``) can now carry a ``model`` axis next to
+``data``; this module decides WHERE each parameter leaf splits over it. The rule
+is the "naive sharding" pattern of SNIPPETS [1] generalized to 2-D:
+
+- Linear / GRU kernels (ndim == 2): shard the LARGEST matmul dimension when it
+  divides by the model-axis extent; try the other dimension next; otherwise
+  replicate. Ties prefer the output (last) dimension — column-parallel keeps the
+  activation layout ``P("data")`` and lets XLA all-gather lazily.
+- Conv / deconv kernels (ndim >= 3, e.g. ``[kh, kw, cin, cout]``): same rule
+  over the CHANNEL dims (the last two axes) — spatial taps never split.
+- Vectors and scalars (biases, LayerNorm scale/offset, the learnable initial
+  recurrent state, Moments quantiles): replicated. They are O(feature) bytes;
+  splitting them buys nothing and costs a collective per use.
+
+No hand-written collectives anywhere: the rule only PLACES parameters
+(``NamedSharding`` via ``jax.jit(init, out_shardings=...)`` or
+``jax.device_put``), and XLA's SPMD partitioner inserts the
+all-gathers/reduce-scatters the train program needs. Activations stay sharded
+on the batch axis (``P("data")``), so a mesh without a non-trivial ``model``
+axis degrades to plain replication — byte-identical to the pre-2-D fabric.
+
+See ``howto/model_parallel.md`` for the config surface and the divisibility
+constraints in practice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "build_state_shardings",
+    "init_sharded",
+    "leaf_partition_spec",
+    "param_sharding_tree",
+    "per_device_bytes",
+    "sharding_summary",
+]
+
+MODEL_AXIS = "model"
+
+
+def leaf_partition_spec(shape: Any, mesh: Mesh, model_axis: str = MODEL_AXIS) -> P:
+    """The rule for ONE leaf: a :class:`PartitionSpec` over ``model_axis`` on the
+    largest divisible matmul/channel dimension, or the replicated spec."""
+    size = int(mesh.shape.get(model_axis, 1))
+    shape = tuple(int(s) for s in shape)
+    if size <= 1 or len(shape) < 2:
+        return P()
+    # candidate axes: both dims of a 2-D kernel; the channel dims (last two) of a
+    # conv/deconv kernel — largest extent first, output dim on ties
+    cands = sorted((len(shape) - 2, len(shape) - 1), key=lambda a: (shape[a], a), reverse=True)
+    for axis in cands:
+        if shape[axis] % size == 0:
+            spec = [None] * len(shape)
+            spec[axis] = model_axis
+            return P(*spec)
+    return P()
+
+
+def param_sharding_tree(mesh: Mesh, tree: Any, model_axis: str = MODEL_AXIS) -> Any:
+    """Map a parameter pytree (arrays or ``ShapeDtypeStruct`` avals) to a
+    matching tree of :class:`NamedSharding` under :func:`leaf_partition_spec`."""
+    return jax.tree_util.tree_map(
+        lambda leaf: NamedSharding(mesh, leaf_partition_spec(np.shape(leaf), mesh, model_axis)),
+        tree,
+    )
+
+
+def init_sharded(
+    mesh: Mesh,
+    init_fn: Callable,
+    *args: Any,
+    model_axis: str = MODEL_AXIS,
+) -> Any:
+    """Run a parameter-init function as ONE jitted program whose outputs land
+    directly in their rule-derived shardings (``jax.jit(init,
+    out_shardings=rule)``, the SNIPPETS [2] recipe): the full replicated tree is
+    never materialized, so a model bigger than one device's HBM still
+    initializes. Shapes come from ``jax.eval_shape`` — nothing executes twice."""
+    avals = jax.eval_shape(init_fn, *args)
+    shardings = param_sharding_tree(mesh, avals, model_axis)
+    return jax.jit(init_fn, out_shardings=shardings)(*args)
+
+
+def build_state_shardings(fabric: Any, *state_trees: Any) -> Optional[tuple]:
+    """out_shardings for a fused Dreamer-family train program on ``fabric``'s
+    mesh: one rule-derived sharding tree per donated state tree (params,
+    opt_state, moments, ...) plus a trailing replicated prefix for the metrics
+    output; ``None`` on a single device, where the pin buys nothing.
+
+    Pinning matters on ANY multi-device mesh: without out_shardings GSPMD may
+    reshard small state leaves over the mesh on output — observed on the plain
+    8-device data mesh — silently breaking the params-stay-put contract and the
+    donation aliasing the drivers rely on."""
+    if getattr(fabric, "num_devices", 1) <= 1:
+        return None
+    return tuple(fabric.param_shardings(t) for t in state_trees) + (fabric.replicated,)
+
+
+def per_device_bytes(tree: Any) -> Dict[int, int]:
+    """Actual bytes each addressable device holds for ``tree`` (replicated
+    leaves count fully on EVERY device — this is real memory, not logical size).
+    The number the 2-D-mesh acceptance gate compares: per-device parameter
+    footprint on ``[2, 4]`` must sit strictly below the ``[8]`` replicated run."""
+    acc: Dict[int, int] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        for shard in leaf.addressable_shards:
+            acc[shard.device.id] = acc.get(shard.device.id, 0) + int(shard.data.nbytes)
+    return acc
+
+
+def sharding_summary(tree: Any, model_axis: str = MODEL_AXIS) -> Dict[str, Any]:
+    """Compact census of a sharded parameter tree for logs/tests:
+    ``{sharded_leaves, replicated_leaves, sharded_bytes, total_bytes}`` where
+    "sharded" means the leaf's spec names ``model_axis``."""
+    sharded = replicated = 0
+    sharded_bytes = total_bytes = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        nbytes = int(leaf.nbytes)
+        total_bytes += nbytes
+        spec: Optional[P] = getattr(leaf.sharding, "spec", None)
+        if spec is not None and any(
+            model_axis in (e if isinstance(e, tuple) else (e,)) for e in spec if e is not None
+        ):
+            sharded += 1
+            sharded_bytes += nbytes
+        else:
+            replicated += 1
+    return {
+        "sharded_leaves": sharded,
+        "replicated_leaves": replicated,
+        "sharded_bytes": sharded_bytes,
+        "total_bytes": total_bytes,
+    }

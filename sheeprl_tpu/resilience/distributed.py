@@ -642,16 +642,17 @@ def supervise_gang(cfg: Any, overrides: Sequence[str]) -> str:
     from sheeprl_tpu.resilience.discovery import find_latest_checkpoint
     from sheeprl_tpu.utils.logger import run_base_dir
 
+    from sheeprl_tpu.resilience.restart_policy import RestartPolicy, run_restart_policy
+
     scfg = (cfg.get("resilience") or {}).get("supervisor") or {}
     dcfg = (cfg.get("resilience") or {}).get("distributed") or {}
     gcfg = dcfg.get("gang") or {}
     n = int(gcfg.get("processes") or 0)
     if n < 2:
         raise ValueError("supervise_gang needs resilience.distributed.gang.processes >= 2")
-    max_restarts = int(scfg.get("max_restarts", 3))
-    backoff = float(scfg.get("backoff", 1.0))
-    backoff_cap = float(scfg.get("backoff_cap", 60.0))
-    restart_on_preempt = bool(scfg.get("restart_on_preempt", True))
+    # restart/backoff/giveup policy shared with the in-process supervisor
+    # (resilience/restart_policy.py) — only the attempt mechanics differ here
+    policy = RestartPolicy.from_cfg(scfg)
     grace = float(gcfg.get("grace") or 20.0)
 
     run_base = run_base_dir(cfg.root_dir, cfg.run_name)
@@ -662,7 +663,6 @@ def supervise_gang(cfg: Any, overrides: Sequence[str]) -> str:
     jsonl_path = str(run_base / "telemetry.jsonl")
 
     sink: Optional[JsonlEventSink] = None
-    attempt = 0
 
     def emit(event: str, **fields: Any) -> None:
         nonlocal sink
@@ -673,7 +673,7 @@ def supervise_gang(cfg: Any, overrides: Sequence[str]) -> str:
                 sink = JsonlEventSink(jsonl_path)
             except OSError:
                 return
-        fields.setdefault("attempt", attempt)
+        fields.setdefault("attempt", policy.attempt)
         sink.emit(event, **fields)
 
     # identity pins every attempt shares: resolved run identity (a timestamped
@@ -690,7 +690,7 @@ def supervise_gang(cfg: Any, overrides: Sequence[str]) -> str:
 
     live_procs: List[subprocess.Popen] = []
 
-    def spawn(attempt_args: List[str]) -> List[subprocess.Popen]:
+    def spawn(attempt_args: List[str], attempt: int) -> List[subprocess.Popen]:
         port = _free_port()
         procs: List[subprocess.Popen] = []
         accelerator = str((cfg.get("fabric") or {}).get("accelerator", "auto")).lower()
@@ -788,89 +788,80 @@ def supervise_gang(cfg: Any, overrides: Sequence[str]) -> str:
                                 pass
             time.sleep(0.2)
 
-    try:
-        while True:
-            if signals.preemption_requested() and not restart_on_preempt:
-                emit("supervisor", status="preempted", attempts=attempt, between_attempts=True)
-                return "preempted"
-            signals.reset_preemption()
+    def run_attempt(attempt: int):
+        attempt_args = list(base_args)
+        if attempt > 0:
+            resume_from = find_latest_checkpoint(str(run_base)) or fallback_resume
+            # a fault that (presumably) fired must not ride into the retry —
+            # the gang cannot see the child-process fired-ledger, so strip
+            # unconditionally, mirroring the in-process supervisor
+            attempt_args = [
+                a for a in attempt_args if not a.startswith("checkpoint.resume_from=")
+            ]
+            attempt_args += ["resilience.fault.kind=null"]
+            if resume_from is not None:
+                attempt_args.append(f"checkpoint.resume_from={resume_from}")
+        attempt_args.append(f"metric.telemetry.attempt={attempt}")
 
-            attempt_args = list(base_args)
-            if attempt > 0:
-                resume_from = find_latest_checkpoint(str(run_base)) or fallback_resume
-                # a fault that (presumably) fired must not ride into the retry —
-                # the gang cannot see the child-process fired-ledger, so strip
-                # unconditionally, mirroring the in-process supervisor
-                attempt_args = [
-                    a for a in attempt_args if not a.startswith("checkpoint.resume_from=")
-                ]
-                attempt_args += ["resilience.fault.kind=null"]
-                if resume_from is not None:
-                    attempt_args.append(f"checkpoint.resume_from={resume_from}")
-            attempt_args.append(f"metric.telemetry.attempt={attempt}")
-
-            emit("gang", status="spawn", processes=n, args_tail=attempt_args[-3:])
-            exit_codes, self_exited, forwarded = wait_gang(spawn(attempt_args))
-            outcome = _classify(exit_codes)
-            if (
-                outcome == "crash"
-                and forwarded
-                and all(
-                    exit_codes[r] in (0, signals.PREEMPTED_EXIT_CODE) for r in self_exited
-                )
-            ):
-                # stragglers the teardown SIGKILLed during a forwarded preempt
-                # are reclaim collateral, not crashes: every rank that exited on
-                # its own cooperated, so the attempt ended by preemption
-                outcome = "preempt"
-            # attribution: the ranks that FAILED ON THEIR OWN — never the
-            # survivors the teardown escalation itself SIGTERM/SIGKILLed, not
-            # cooperative preempt exits (75 is "reschedule me", not death), and
-            # not healthy ranks reporting a PEER's death (77, RankFailureError)
-            dead_ranks = {
-                str(r): rc
-                for r, rc in exit_codes.items()
-                if rc not in (0, signals.PREEMPTED_EXIT_CODE, signals.RANK_FAILED_EXIT_CODE)
-                and r in self_exited
-            }
-            emit("gang", status="attempt_exit", exit_codes={str(r): rc for r, rc in exit_codes.items()}, outcome=outcome)
-
-            if outcome == "completed":
-                if attempt > 0:
-                    emit("supervisor", status="completed", attempts=attempt)
-                return "completed"
-            if outcome == "preempt" and not restart_on_preempt:
-                emit("supervisor", status="preempted", attempts=attempt)
-                return "preempted"
-
-            attempt += 1
-            if attempt > max_restarts:
-                emit(
-                    "giveup",
-                    reason=outcome,
-                    attempts=attempt - 1,
-                    max_restarts=max_restarts,
-                    dead_ranks=dead_ranks,
-                )
-                if outcome == "crash":
-                    raise GangFailureError(
-                        f"gang of {n} crashed {attempt - 1} time(s) past the restart "
-                        f"budget (last exit codes: {exit_codes}); see {log_dir}"
-                    )
-                return "preempted"
-
-            resume_preview = find_latest_checkpoint(str(run_base)) or fallback_resume
-            delay = min(backoff * (2.0 ** (attempt - 1)), backoff_cap) if backoff > 0 else 0.0
-            emit(
-                "restart",
-                attempt=attempt,
-                reason=outcome if outcome == "crash" else "preempt",
-                dead_ranks=dead_ranks,
-                resume_from=str(resume_preview) if resume_preview else None,
-                backoff_seconds=round(delay, 3),
+        emit("gang", status="spawn", processes=n, args_tail=attempt_args[-3:])
+        exit_codes, self_exited, forwarded = wait_gang(spawn(attempt_args, attempt))
+        outcome = _classify(exit_codes)
+        if (
+            outcome == "crash"
+            and forwarded
+            and all(
+                exit_codes[r] in (0, signals.PREEMPTED_EXIT_CODE) for r in self_exited
             )
-            if delay > 0:
-                time.sleep(delay)
+        ):
+            # stragglers the teardown SIGKILLed during a forwarded preempt
+            # are reclaim collateral, not crashes: every rank that exited on
+            # its own cooperated, so the attempt ended by preemption
+            outcome = "preempt"
+        # attribution: the ranks that FAILED ON THEIR OWN — never the
+        # survivors the teardown escalation itself SIGTERM/SIGKILLed, not
+        # cooperative preempt exits (75 is "reschedule me", not death), and
+        # not healthy ranks reporting a PEER's death (77, RankFailureError)
+        dead_ranks = {
+            str(r): rc
+            for r, rc in exit_codes.items()
+            if rc not in (0, signals.PREEMPTED_EXIT_CODE, signals.RANK_FAILED_EXIT_CODE)
+            and r in self_exited
+        }
+        emit(
+            "gang",
+            status="attempt_exit",
+            exit_codes={str(r): rc for r, rc in exit_codes.items()},
+            outcome=outcome,
+        )
+        return outcome, {"dead_ranks": dead_ranks, "exit_codes": exit_codes}
+
+    def restart_fields(attempt, outcome, info):
+        resume_preview = find_latest_checkpoint(str(run_base)) or fallback_resume
+        return {
+            "dead_ranks": info["dead_ranks"],
+            "resume_from": str(resume_preview) if resume_preview else None,
+        }
+
+    def giveup_fields(info):
+        return {"dead_ranks": info["dead_ranks"]}
+
+    def on_giveup(outcome, info):
+        if outcome == "crash":
+            raise GangFailureError(
+                f"gang of {n} crashed {policy.attempt - 1} time(s) past the restart "
+                f"budget (last exit codes: {info['exit_codes']}); see {log_dir}"
+            )
+        return "preempted"
+
+    try:
+        return run_restart_policy(
+            policy,
+            run_attempt,
+            emit,
+            restart_fields=restart_fields,
+            giveup_fields=giveup_fields,
+            on_giveup=on_giveup,
+        )
     finally:
         # never orphan the gang: children run in their OWN sessions (see
         # spawn), so a forced supervisor unwind (second Ctrl-C, crash) is the

@@ -24,7 +24,6 @@ channel planes — so the supervisor steps aside with a warning there.
 from __future__ import annotations
 
 import copy
-import time
 import warnings
 from typing import Any, Callable, Optional
 
@@ -32,6 +31,7 @@ from sheeprl_tpu.config import dotdict
 from sheeprl_tpu.obs.jsonl import JsonlEventSink
 from sheeprl_tpu.resilience import faults, signals
 from sheeprl_tpu.resilience.discovery import find_latest_checkpoint
+from sheeprl_tpu.resilience.restart_policy import RestartPolicy, run_restart_policy
 from sheeprl_tpu.resilience.watchdog import stop_all_watchdogs
 
 
@@ -77,11 +77,7 @@ def supervise(
         run_fn(cfg)
         return "preempted" if signals.preemption_requested() else "completed"
 
-    scfg = cfg.resilience.supervisor
-    max_restarts = int(scfg.get("max_restarts", 3))
-    backoff = float(scfg.get("backoff", 1.0))
-    backoff_cap = float(scfg.get("backoff_cap", 60.0))
-    restart_on_preempt = bool(scfg.get("restart_on_preempt", True))
+    policy = RestartPolicy.from_cfg(cfg.resilience.supervisor)
 
     run_base = run_base_dir(cfg.root_dir, cfg.run_name)
     # one event stream across attempts: every restart appends to the same file.
@@ -103,8 +99,9 @@ def supervise(
             except OSError:
                 return
         # supervisor events are stamped with the attempt they decide ABOUT, not
-        # the sink's creation-time default (one sink spans every attempt)
-        fields.setdefault("attempt", attempt)
+        # the sink's creation-time default (one sink spans every attempt) — the
+        # shared policy loop keeps the live counter on `policy`
+        fields.setdefault("attempt", policy.attempt)
         sink.emit(event, **fields)
 
     # retries rebuild from the argv-merged cfg, NOT the resolved base: when the
@@ -115,68 +112,13 @@ def supervise(
     # ...but the resume fallback must be the RESOLVED path (the argv value may
     # be the literal "latest")
     fallback_resume = cfg.checkpoint.get("resume_from") or None
-    current = cfg
-    attempt = 0
-    try:
-        while True:
-            # a SIGTERM that landed BETWEEN attempts (e.g. during the backoff
-            # sleep) is a real reclaim: blindly resetting it would relaunch a
-            # full attempt on a dying node. Honor the same policy as an in-run
-            # preemption — restart only when restart_on_preempt says so.
-            if signals.preemption_requested() and not restart_on_preempt:
-                emit("supervisor", status="preempted", attempts=attempt, between_attempts=True)
-                return "preempted"
-            signals.reset_preemption()
-            error: Optional[BaseException] = None
-            try:
-                run_fn(current)
-            except Exception as e:  # SystemExit/KeyboardInterrupt propagate
-                error = e
-                # an exception skipped the loop's finalize(): stop any orphaned
-                # watchdog NOW — an abort-mode one is in its grace countdown
-                # toward os._exit and would kill the restarted attempt
-                stop_all_watchdogs()
-            preempted = signals.preemption_requested() and error is None
-            if error is None and not preempted:
-                if attempt > 0:
-                    emit("supervisor", status="completed", attempts=attempt)
-                return "completed"
+    state: dict = {"current": cfg, "resume_from": None}
 
-            reason = "crash" if error is not None else "preempt"
-            if reason == "preempt" and not restart_on_preempt:
-                emit("supervisor", status="preempted", attempts=attempt)
-                return "preempted"
-            attempt += 1
-            if attempt > max_restarts:
-                emit(
-                    "giveup",
-                    reason=reason,
-                    attempts=attempt - 1,
-                    max_restarts=max_restarts,
-                    error=repr(error) if error is not None else None,
-                )
-                if error is not None:
-                    raise error
-                return "preempted"
-
-            # nothing in THIS run's dir yet (crash before the first checkpoint)
-            # must not discard a resume checkpoint the user originally launched
-            # with — fall back to it rather than silently starting from scratch
-            resume_from = find_latest_checkpoint(str(run_base)) or fallback_resume
-            delay = min(backoff * (2.0 ** (attempt - 1)), backoff_cap) if backoff > 0 else 0.0
-            emit(
-                "restart",
-                attempt=attempt,
-                reason=reason,
-                resume_from=resume_from,
-                backoff_seconds=round(delay, 3),
-                error=repr(error)[:500] if error is not None else None,
-            )
-            if delay > 0:
-                time.sleep(delay)
-
+    def run_attempt(attempt: int):
+        if attempt > 0:
             retry = dotdict(copy.deepcopy(original.as_dict()))
             _strip_fired_fault(retry)
+            resume_from = state["resume_from"]
             if resume_from is not None:
                 retry.checkpoint.resume_from = resume_from
                 retry = resume_merge(retry)
@@ -193,7 +135,50 @@ def supervise(
             # or attempt 2+ would write its own per-version stream
             if jsonl_enabled:
                 retry.metric.telemetry.jsonl_path = cfg.metric.telemetry.jsonl_path
-            current = retry
+            state["current"] = retry
+        error: Optional[BaseException] = None
+        try:
+            run_fn(state["current"])
+        except Exception as e:  # SystemExit/KeyboardInterrupt propagate
+            error = e
+            # an exception skipped the loop's finalize(): stop any orphaned
+            # watchdog NOW — an abort-mode one is in its grace countdown
+            # toward os._exit and would kill the restarted attempt
+            stop_all_watchdogs()
+        preempted = signals.preemption_requested() and error is None
+        if error is None and not preempted:
+            return "completed", {}
+        return ("crash" if error is not None else "preempt"), {"error": error}
+
+    def restart_fields(attempt, outcome, info):
+        # nothing in THIS run's dir yet (crash before the first checkpoint)
+        # must not discard a resume checkpoint the user originally launched
+        # with — fall back to it rather than silently starting from scratch
+        state["resume_from"] = find_latest_checkpoint(str(run_base)) or fallback_resume
+        error = info.get("error")
+        return {
+            "resume_from": state["resume_from"],
+            "error": repr(error)[:500] if error is not None else None,
+        }
+
+    def giveup_fields(info):
+        error = info.get("error")
+        return {"error": repr(error) if error is not None else None}
+
+    def on_giveup(outcome, info):
+        if info.get("error") is not None:
+            raise info["error"]
+        return "preempted"
+
+    try:
+        return run_restart_policy(
+            policy,
+            run_attempt,
+            emit,
+            restart_fields=restart_fields,
+            giveup_fields=giveup_fields,
+            on_giveup=on_giveup,
+        )
     finally:
         if sink is not None:
             sink.close()

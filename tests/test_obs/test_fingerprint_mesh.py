@@ -1,0 +1,110 @@
+"""Fingerprint canonicalization of the mesh topology (2-D mesh satellite):
+``mesh_shape`` serializes identically whatever container carried it — so
+`compare`/`bench --against` never false-mismatches two identical runs — while
+``[8]`` vs ``[2, 4]`` (and data-only vs data x model ``axis_names``) stays a
+real veto, tested in BOTH directions."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sheeprl_tpu.obs.fingerprint import (
+    canonical_mesh_shape,
+    fingerprint_compatible,
+    run_fingerprint,
+)
+
+
+def test_canonical_mesh_shape_container_invariance():
+    assert canonical_mesh_shape([2, 4]) == [2, 4]
+    assert canonical_mesh_shape((2, 4)) == [2, 4]
+    assert canonical_mesh_shape(np.asarray([2, 4])) == [2, 4]
+    assert canonical_mesh_shape((np.int64(2), np.int64(4))) == [2, 4]
+    assert canonical_mesh_shape(8) == [8]
+    # a list-like config wrapper (Hydra ListConfig stand-in)
+    class _ListConfig(list):
+        pass
+
+    assert canonical_mesh_shape(_ListConfig([2, 4])) == [2, 4]
+
+
+def test_canonical_mesh_shape_unresolvables_stay_unknown():
+    # a -1 wildcard depends on the device count: stamping it raw would
+    # false-mismatch the resolved shape a live run records
+    assert canonical_mesh_shape([-1]) is None
+    assert canonical_mesh_shape([2, -1]) is None
+    assert canonical_mesh_shape(None) is None
+    assert canonical_mesh_shape("nonsense") is None
+
+
+def _fp(mesh_shape, axis_names=None):
+    fp = {"algo": "dreamer_v3", "mesh_shape": mesh_shape}
+    if axis_names is not None:
+        fp["axis_names"] = axis_names
+    return fp
+
+
+def test_identical_meshes_from_different_containers_are_compatible():
+    ok, mismatches = fingerprint_compatible(
+        _fp(canonical_mesh_shape((2, 4))), _fp(canonical_mesh_shape([2, 4]))
+    )
+    assert ok and not mismatches
+
+
+def test_different_mesh_shapes_veto_both_directions():
+    a, b = _fp([8]), _fp([2, 4])
+    ok_ab, mis_ab = fingerprint_compatible(a, b)
+    ok_ba, mis_ba = fingerprint_compatible(b, a)
+    assert not ok_ab and "mesh_shape" in mis_ab
+    assert not ok_ba and "mesh_shape" in mis_ba
+
+
+def test_axis_names_veto_and_none_tolerance():
+    # same device count, different topology: data-only vs data x model
+    a = _fp([2, 4], ["data", "model"])
+    b = _fp([2, 4], ["data", "replica"])
+    ok, mismatches = fingerprint_compatible(a, b)
+    assert not ok and "axis_names" in mismatches
+    # pre-2-D-mesh recordings carry no axis_names: never vetoed
+    old = _fp([2, 4])
+    ok, mismatches = fingerprint_compatible(a, old)
+    assert ok and not mismatches
+
+
+def test_run_fingerprint_cfg_route_matches_live_fabric_route():
+    """A cfg-only fingerprint (bench wall-clock workloads) and a live-fabric
+    one of the same run must agree on the mesh fields."""
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    cfg = {
+        "algo": {"name": "dreamer_v3"},
+        "env": {},
+        "fabric": {"mesh_shape": (2, 4), "axis_names": ("data", "model")},
+    }
+    cfg_fp = run_fingerprint(cfg)
+    assert cfg_fp["mesh_shape"] == [2, 4]
+    assert cfg_fp["axis_names"] == ["data", "model"]
+
+    fabric = Fabric(devices=-1, accelerator="cpu", mesh_shape=[2, 4], axis_names=["data", "model"])
+    fabric._setup()
+    live_fp = run_fingerprint(cfg, fabric)
+    assert live_fp["mesh_shape"] == [2, 4]
+    assert live_fp["axis_names"] == ["data", "model"]
+    assert live_fp["device_count"] == 8  # TOTAL devices, not the data extent
+    ok, mismatches = fingerprint_compatible(cfg_fp, live_fp)
+    assert ok and not mismatches
+
+    # the wildcard config route stays unknown rather than false-mismatching
+    # the resolved shape a live run stamps (config_hash dropped: the edited
+    # fabric subdict legitimately changes it, which is not what this asserts)
+    wc_fp = run_fingerprint({**cfg, "fabric": {"mesh_shape": [2, -1], "axis_names": ["data", "model"]}})
+    assert wc_fp["mesh_shape"] is None
+    wc_fp.pop("config_hash"), live_fp.pop("config_hash")
+    ok, mismatches = fingerprint_compatible(wc_fp, live_fp)
+    assert ok and not mismatches
+
+
+def test_cfg_route_wraps_scalar_axis_names():
+    """A bare-string override (fabric.axis_names=data) must not char-split."""
+    fp = run_fingerprint({"algo": {"name": "ppo"}, "env": {}, "fabric": {"axis_names": "data"}})
+    assert fp["axis_names"] == ["data"]

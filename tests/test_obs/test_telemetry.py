@@ -546,3 +546,62 @@ def test_profiler_window_bounds(tmp_path):
     assert t.profiler.stopped_at == 12  # first step >= start + num_steps
     dumped = list((tmp_path / "prof").rglob("*"))
     assert any(p.is_file() for p in dumped), "no trace files written"
+
+
+# ---------------------------------------------------------------------------------
+# mesh memory (2-D mesh satellite): max-across-mesh + per-device breakdown
+# ---------------------------------------------------------------------------------
+def test_mesh_device_memory_reports_max_and_per_device():
+    from sheeprl_tpu.obs.telemetry import mesh_device_memory
+
+    class _Dev:
+        def __init__(self, id, in_use, peak=None):
+            self.id = id
+            self._stats = {"bytes_in_use": in_use}
+            if peak is not None:
+                self._stats["peak_bytes_in_use"] = peak
+
+        def memory_stats(self):
+            return self._stats
+
+    class _NoStats:
+        id = 99
+
+        def memory_stats(self):
+            return None
+
+    devs = [_Dev(0, 100, peak=400), _Dev(1, 300, peak=250), _NoStats()]
+    mem = mesh_device_memory(devs)
+    # top-level keys report the WORST device (one hot model-axis shard OOMs a
+    # run, not the mean); the breakdown names each device
+    assert mem["bytes_in_use"] == 300
+    assert mem["peak_bytes"] == 400
+    per = {p["id"]: p for p in mem["per_device"]}
+    assert per[0]["bytes_in_use"] == 100 and per[1]["bytes_in_use"] == 300
+    assert 99 not in per  # stats-less devices don't pollute the breakdown
+
+    # single reporting device: same top-level shape, no per_device noise
+    solo = mesh_device_memory([_Dev(7, 42, peak=43)])
+    assert solo == {"bytes_in_use": 42, "peak_bytes": 43}
+
+    # no allocator stats anywhere (host CPU): None, exactly like device_memory
+    assert mesh_device_memory([_NoStats()]) is None
+    assert mesh_device_memory([]) is None
+
+
+def test_telemetry_collects_local_mesh_devices():
+    """A multi-device fabric's telemetry watches EVERY local mesh device, so a
+    model-axis imbalance is visible in the window's hbm breakdown."""
+    from sheeprl_tpu.obs.telemetry import RunTelemetry
+
+    class _MeshFabric(FakeFabric):
+        def __init__(self):
+            super().__init__()
+            self.devices = jax.devices("cpu")[:4]
+            self.world_size = 4
+
+    t = RunTelemetry(_MeshFabric(), _cfg(telemetry={"enabled": True, "jsonl": False}), None)
+    try:
+        assert len(t._devices) == 4
+    finally:
+        t.close(0)

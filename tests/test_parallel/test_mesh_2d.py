@@ -1,0 +1,234 @@
+"""2-D mesh GSPMD: named data x model sharding for the Dreamer family.
+
+- mesh construction: `fabric.mesh_shape`/`axis_names` build named N-D meshes
+  (wildcard resolution, validation) with the default byte-identical to the old
+  1-D fabric;
+- the sharding rule (parallel/sharding.py): kernels split over `model` on the
+  largest divisible matmul/channel dim, everything else replicates;
+- DV3 on the [2, 4] CPU mesh: params verifiably sharded (per-shard shapes via
+  ``addressable_shards``), per-device parameter footprint strictly below full
+  replication, one REAL train step with loss parity vs a single-device run of
+  the same weights (``__graft_entry__.dryrun_multichip_2d``);
+- TPU-readiness AOT compile test (ROADMAP item 5 style, same pattern as the
+  Anakin suite): ``jit(...).lower(...)`` of the fused DV3 train step on the
+  8-device [2, 4] mesh, asserting donation/input-output aliasing survives 2-D
+  sharding and the optimized HLO contains the XLA-inserted collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.fabric import Fabric
+from sheeprl_tpu.parallel.sharding import (
+    leaf_partition_spec,
+    param_sharding_tree,
+    per_device_bytes,
+    sharding_summary,
+)
+
+
+def _fabric_2d(mesh_shape=(2, 4)):
+    fabric = Fabric(
+        devices=-1, accelerator="cpu", mesh_shape=list(mesh_shape), axis_names=["data", "model"]
+    )
+    fabric._setup()
+    return fabric
+
+
+def test_fabric_builds_named_2d_mesh():
+    fabric = _fabric_2d()
+    assert dict(fabric.mesh.shape) == {"data": 2, "model": 4}
+    assert fabric.world_size == 2  # per-rank batch math scales by the DATA extent only
+    assert fabric.num_devices == 8
+    assert fabric.model_axis_size == 4
+    assert fabric.model_parallel is True
+
+
+def test_fabric_wildcard_model_axis_absorbs_remaining_devices():
+    fabric = Fabric(
+        devices=-1, accelerator="cpu", mesh_shape=[2, -1], axis_names=["data", "model"]
+    )
+    fabric._setup()
+    assert dict(fabric.mesh.shape) == {"data": 2, "model": 4}
+
+
+def test_fabric_default_mesh_is_byte_identical_1d():
+    fabric = Fabric(devices=4, accelerator="cpu")
+    fabric._setup()
+    assert fabric.mesh.axis_names == ("data",)
+    assert fabric.world_size == fabric.num_devices == 4
+    assert fabric.model_parallel is False
+    # shard_params degrades to plain replication without a model axis
+    tree = fabric.shard_params({"w": np.ones((8, 16), np.float32)})
+    assert tree["w"].sharding.is_fully_replicated
+
+
+def test_mesh_spec_validation_errors():
+    with pytest.raises(ValueError, match="must name every"):
+        Fabric(mesh_shape=[2, 4], axis_names=["data"])
+    with pytest.raises(ValueError, match="unique"):
+        Fabric(mesh_shape=[2, 4], axis_names=["data", "data"])
+    with pytest.raises(ValueError, match="must include 'data'"):
+        Fabric(mesh_shape=[2, 4], axis_names=["batch", "model"])
+    with pytest.raises(ValueError, match="at most one -1"):
+        Fabric(mesh_shape=[-1, -1], axis_names=["data", "model"])
+    with pytest.raises(ValueError, match=">= 1"):
+        Fabric(mesh_shape=[0, 4], axis_names=["data", "model"])
+    f = Fabric(devices=4, accelerator="cpu", mesh_shape=[2, 4], axis_names=["data", "model"])
+    with pytest.raises(RuntimeError, match="disagrees"):
+        f._setup()
+
+
+def test_param_sharding_rule_units():
+    mesh = _fabric_2d().mesh
+    # 2-D kernel: largest divisible dim takes the model axis (prefer out on tie)
+    assert leaf_partition_spec((64, 256), mesh)[1] == "model"
+    assert leaf_partition_spec((256, 64), mesh)[0] == "model"
+    assert leaf_partition_spec((128, 128), mesh)[1] == "model"  # tie -> output dim
+    # largest not divisible -> falls back to the other dim
+    assert leaf_partition_spec((301, 64), mesh)[1] == "model"
+    # nothing divisible -> replicated
+    assert leaf_partition_spec((7, 3), mesh) == jax.sharding.PartitionSpec()
+    # vectors/scalars always replicate
+    assert leaf_partition_spec((1024,), mesh) == jax.sharding.PartitionSpec()
+    assert leaf_partition_spec((), mesh) == jax.sharding.PartitionSpec()
+    # conv kernels: only the channel dims (last two) may shard
+    spec = leaf_partition_spec((4, 4, 8, 64), mesh)
+    assert spec[3] == "model" and spec[0] is None and spec[1] is None
+
+
+def test_param_sharding_tree_and_per_device_bytes():
+    fabric = _fabric_2d()
+    params = {
+        "dense": {"kernel": np.ones((64, 128), np.float32), "bias": np.ones((128,), np.float32)},
+        "odd": np.ones((7, 3), np.float32),
+    }
+    sharded = fabric.shard_params(params)
+    kernel = sharded["dense"]["kernel"]
+    assert kernel.sharding.spec == jax.sharding.PartitionSpec(None, "model")
+    shapes = {s.data.shape for s in kernel.addressable_shards}
+    assert shapes == {(64, 32)}  # 128 / model extent 4
+    assert sharded["dense"]["bias"].sharding.is_fully_replicated
+    census = sharding_summary(sharded)
+    assert census["sharded_leaves"] == 1 and census["replicated_leaves"] == 2
+    footprint = per_device_bytes(sharded)
+    assert set(footprint) == {d.id for d in fabric.devices}
+    # kernel/4 + bias + odd, replicated leaves counted fully per device
+    expected = 64 * 32 * 4 + 128 * 4 + 7 * 3 * 4
+    assert all(v == expected for v in footprint.values())
+    assert max(footprint.values()) < census["total_bytes"]
+
+
+def _tiny_dv3_on_2d_mesh():
+    import __graft_entry__ as graft
+
+    cfg = graft._dv3_cfg()
+    fabric, agent, params = graft._build(
+        cfg, graft._obs_space(), (4,), mesh_shape=[2, 4], axis_names=["data", "model"]
+    )
+    return cfg, fabric, agent, params
+
+
+@pytest.mark.timeout(280)
+def test_dv3_params_shard_on_model_axis():
+    """build_agent on a model-parallel fabric lands kernels in their rule
+    shards directly from the jitted init (out_shardings) — per-shard shapes
+    verified via addressable_shards, per-device footprint strictly below
+    replication."""
+    _, fabric, agent, params = _tiny_dv3_on_2d_mesh()
+    census = sharding_summary(params)
+    assert census["sharded_leaves"] > 0
+    # e.g. the actor's DenseStack kernel [24, 8]: 24 % 4 == 0 -> P('model', None)
+    leaf = params["actor"]["DenseStack_0"]["Dense_0"]["kernel"]
+    assert not leaf.sharding.is_fully_replicated
+    shard_shapes = {s.data.shape for s in leaf.addressable_shards}
+    assert len(shard_shapes) == 1
+    per_shard = next(iter(shard_shapes))
+    assert int(np.prod(per_shard)) * fabric.model_axis_size == leaf.size
+    footprint = per_device_bytes(params)
+    assert max(footprint.values()) < census["total_bytes"]
+    # resumed params land in the SAME shardings (restore path)
+    import __graft_entry__ as graft
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+
+    host_state = jax.tree_util.tree_map(np.asarray, params)
+    _, restored = build_agent(
+        fabric, (4,), False, graft._dv3_cfg(), graft._obs_space(), jax.random.PRNGKey(0), host_state
+    )
+    r_leaf = restored["actor"]["DenseStack_0"]["Dense_0"]["kernel"]
+    assert r_leaf.sharding.spec == leaf.sharding.spec
+    np.testing.assert_array_equal(np.asarray(r_leaf), np.asarray(leaf))
+
+
+@pytest.mark.timeout(560)
+def test_dv3_train_step_aot_donation_and_collectives():
+    """TPU-readiness AOT compile test on the 8-device [2, 4] mesh: (a) the
+    donation/input-output aliasing survives 2-D sharding (with pinned
+    out_shardings jax lowers it as `tf.aliasing_output` entries; XLA's
+    optimized HLO must carry `input_output_alias`), and (b) XLA inserted the
+    expected collectives — all-gathers for the model-axis resharding and
+    all-reduces for the data-axis gradient sums — with no hand-written
+    collective anywhere in the train program."""
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import build_optimizers, make_train_phase
+    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+    from sheeprl_tpu.parallel.sharding import build_state_shardings
+    from sheeprl_tpu.utils.mfu import abstractify
+
+    cfg, fabric, agent, params = _tiny_dv3_on_2d_mesh()
+    world_tx, actor_tx, critic_tx, opt_state = build_optimizers(cfg, params)
+    train_phase = make_train_phase(
+        agent,
+        cfg,
+        world_tx,
+        actor_tx,
+        critic_tx,
+        state_shardings=build_state_shardings(fabric, params, opt_state, init_moments()),
+    )
+    T, B = int(cfg.algo.per_rank_sequence_length), 16
+    rng = np.random.default_rng(0)
+    batch = {
+        "rgb": rng.integers(0, 255, (T, B, 3, 64, 64)).astype(np.uint8),
+        "state": rng.normal(size=(T, B, 10)).astype(np.float32),
+        "actions": np.eye(4, dtype=np.float32)[rng.integers(0, 4, (T, B))],
+        "rewards": rng.normal(size=(T, B, 1)).astype(np.float32),
+        "terminated": np.zeros((T, B, 1), np.float32),
+        "truncated": np.zeros((T, B, 1), np.float32),
+        "is_first": np.zeros((T, B, 1), np.float32),
+    }
+    batch = jax.device_put(batch, fabric.sharding(None, "data"))
+    args = (
+        params,
+        opt_state,
+        fabric.replicate_pytree(init_moments()),
+        batch,
+        jnp.asarray(0),
+        jnp.asarray(jax.random.PRNGKey(0)),
+    )
+    lowered = train_phase.train_step.lower(*abstractify(args))
+    mlir = lowered.as_text()
+    donors = mlir.count("tf.aliasing_output") + mlir.count("jax.buffer_donor")
+    assert donors >= 10, "donation was dropped in 2-D lowering"
+
+    hlo = lowered.compile().as_text()
+    assert "input_output_alias" in hlo, "XLA dropped the input/output aliasing"
+    assert "all-gather" in hlo, "no model-axis all-gather in the optimized HLO"
+    assert "all-reduce" in hlo, "no data-axis gradient all-reduce in the optimized HLO"
+
+
+@pytest.mark.timeout(560)
+def test_dv3_2d_mesh_trains_with_loss_parity():
+    """One REAL train step on the [2, 4] mesh (the dryrun the MULTICHIP gate
+    runs): sharded params update in place, per-device parameter footprint stays
+    strictly below replication, and the loss matches a single-device run of the
+    same weights within tolerance."""
+    import __graft_entry__ as graft
+
+    summary = graft.dryrun_multichip_2d(8)
+    assert summary["mesh_shape"] == [2, 4]
+    assert summary["sharded_leaves"] > 0
+    assert summary["param_bytes_per_device_max"] < summary["param_bytes_total"]
+    assert summary["loss_vs_1d"] <= max(1e-3, 5e-3 * abs(summary["loss"]))
