@@ -43,6 +43,11 @@ Select a single workload with BENCH_ALGO:
   virtual CPU devices (init-time only, never claims the chip). Bytes units
   gate lower-is-better under --against. SHEEPRL_BENCH_DV3_2D_SIZE overrides
   the preset.
+- serve_load — the policy serving tier (sheeprl_tpu/serve) under synthetic
+  open-loop load: trains a tiny PPO checkpoint, serves it through the
+  continuous-batching slot-table server, and reports sessions/sec plus a
+  nested p99 step-latency workload ("ms" units gate LOWER-is-better under
+  --against). CPU-only; measures the serving machinery, not the model.
 
 The dreamer_v3 extra also records the MFU of the benchmark-size train program in
 its ``conditions.train_mfu`` block (and mirrors ``mfu`` top-level).
@@ -654,6 +659,159 @@ def _bench_dv3_2d_mesh(size: str = "L") -> dict:
     }
 
 
+def _bench_serve_load(
+    slots: int = 8, sessions: int = 48, steps_per_session: int = 64
+) -> dict:
+    """``serve_load``: the policy serving tier under synthetic open-loop load
+    (sheeprl_tpu/serve, howto/serving.md). Trains a tiny PPO checkpoint, then
+    drives ``sessions`` fixed-length synthetic sessions through the
+    continuous-batching server (``slots`` device-resident slots) with arrivals
+    never gated on completions, and reports sessions/sec with the p99 step
+    latency riding as a nested extra workload — latency units gate
+    LOWER-is-better under ``--against`` (obs/compare.py ``_lower_is_better``).
+    CPU-only by construction (the checkpoint is tiny); the numbers measure the
+    serving machinery — batching, slot table, donated step program — not the
+    model."""
+    import shutil
+
+    from sheeprl_tpu.cli import run
+
+    workdir = tempfile.mkdtemp(prefix="sheeprl-serve-load-")
+    try:
+        run(
+            [
+                "exp=ppo",
+                "env=dummy",
+                "env.id=discrete_dummy",
+                "env.num_envs=2",
+                "env.capture_video=False",
+                "fabric.accelerator=cpu",
+                "algo.rollout_steps=16",
+                "algo.total_steps=128",
+                "algo.update_epochs=1",
+                "algo.cnn_keys.encoder=[]",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.run_test=False",
+                "metric.log_level=0",
+                "metric.disable_timer=True",
+                "checkpoint.save_last=True",
+                f"hydra.run.dir={workdir}/train",
+            ]
+        )
+
+        from sheeprl_tpu.parallel.fabric import Fabric
+        from sheeprl_tpu.serve.drivers import run_synthetic_load
+        from sheeprl_tpu.serve.main import build_serve_cfg
+        from sheeprl_tpu.serve.policy import resolve_serve_policy
+        from sheeprl_tpu.serve.server import PolicyServer
+        from sheeprl_tpu.serve.telemetry import ServingTelemetry
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+        from sheeprl_tpu.obs.jsonl import read_events
+
+        cfg = build_serve_cfg(
+            [
+                f"checkpoint_path={workdir}/train",
+                f"serve.slots={slots}",
+                "serve.max_batch_wait_ms=2.0",
+            ]
+        )
+        fabric = Fabric(devices=1, accelerator="cpu")
+        fabric._setup()
+        state = load_checkpoint(cfg.checkpoint_path)
+        policy = resolve_serve_policy(fabric, cfg, state)
+
+        telemetry_path = os.path.join(workdir, "telemetry.jsonl")
+        telemetry = ServingTelemetry(
+            fabric,
+            cfg,
+            None,
+            every=max((sessions * steps_per_session) // 16, 64),
+            serve_info={"slots": slots, "workload": "serve_load"},
+            jsonl_path=telemetry_path,
+        )
+        server = PolicyServer(
+            policy,
+            slots=slots,
+            max_batch_wait_ms=float(cfg.serve.max_batch_wait_ms),
+            base_seed=int(cfg.seed),
+            telemetry=telemetry,
+        )
+        # warm the step/attach programs BEFORE load arrives (the serve.prime
+        # story): the measured latencies then reflect steady-state serving,
+        # not the one-time XLA compile landing inside the first window
+        import numpy as np
+
+        server.table.step(
+            {k: spec.zeros(slots) for k, spec in policy.obs_spec.items()},
+            np.zeros((slots,), np.bool_),
+        )
+        server.table.attach({0: int(cfg.seed)})
+
+        with server:
+            load = run_synthetic_load(
+                server,
+                sessions=sessions,
+                steps_per_session=steps_per_session,
+                seed=int(cfg.seed),
+            )
+
+        events = read_events(telemetry_path)
+        summary = next((e for e in reversed(events) if e.get("event") == "summary"), {})
+        start = next((e for e in events if e.get("event") == "start"), {})
+        serve_summary = summary.get("serve") or {}
+        latency = serve_summary.get("latency_ms") or {}
+        windows = [e for e in events if e.get("event") == "window"]
+        occupancy = [
+            (w.get("serve") or {}).get("occupancy")
+            for w in windows
+            if (w.get("serve") or {}).get("occupancy") is not None
+        ]
+        fingerprint = start.get("fingerprint")
+
+        conditions = {
+            "slots": slots,
+            "max_batch_wait_ms": float(cfg.serve.max_batch_wait_ms),
+            "sessions": sessions,
+            "steps_per_session": steps_per_session,
+            "steps_per_sec": load["steps_per_sec"],
+            "load_errors": load["errors"],
+            "latency_ms": latency,
+            "occupancy_mean": round(sum(occupancy) / len(occupancy), 4) if occupancy else None,
+            "telemetry": {
+                k: v for k, v in summary.items() if k not in ("event", "time", "seq")
+            },
+            "fingerprint": fingerprint,
+        }
+        p99 = latency.get("p99")
+        result = {
+            "metric": "serve_load_sessions_per_sec",
+            "value": load["sessions_per_sec"],
+            "unit": "sessions/sec (open-loop synthetic load)",
+            "vs_baseline": None,  # first serving tier — no reference number exists
+            "conditions": conditions,
+        }
+        if p99 is not None:
+            # the latency companion gates independently; "ms" units are
+            # lower-is-better in bench-diff (verified by test_compare)
+            result["extras"] = [
+                {
+                    "metric": "serve_load_step_latency_p99_ms",
+                    "value": p99,
+                    "unit": "ms (p99 step latency)",
+                    "vs_baseline": None,
+                    "conditions": {
+                        "slots": slots,
+                        "sessions": sessions,
+                        "p50_ms": latency.get("p50"),
+                        "fingerprint": fingerprint,
+                    },
+                }
+            ]
+        return result
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _bench_dv3_mfu_flagship(size: str = "S") -> dict:
     """Standalone extra: flagship-size DV3 train-program MFU on the accelerator."""
     stats = _dv3_train_mfu(size=size)
@@ -706,6 +864,8 @@ def _bench(algo: str) -> dict:
         result = _bench_ppo_anakin()
     elif algo == "sac_steady":
         result = _bench_sac_steady()
+    elif algo == "serve_load":
+        result = _bench_serve_load()
     elif algo.startswith("dreamer_v"):
         result = _bench_dreamer_steady(algo)
     else:
@@ -895,6 +1055,14 @@ def main() -> int:
         print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:
         result["dv3_2d_mesh_extra_error"] = repr(exc)[:500]
+    # serve_load: the policy serving tier under synthetic open-loop load
+    # (sessions/sec + p99 step latency + occupancy) — tiny CPU-only checkpoint,
+    # never touches the chip, so it runs regardless of chip_busy
+    try:
+        extras.append(_bench_subprocess("serve_load", timeout=900))
+        print(json.dumps({**result, "extras": extras}), flush=True)
+    except Exception as exc:
+        result["serve_load_extra_error"] = repr(exc)[:500]
     if chip_busy:
         # The abandoned child is still compiling/claiming on the single-tenant
         # chip; further live-chip extras would only queue behind it and time out

@@ -13,7 +13,10 @@ Also hosts the offline/observability tooling (howto/observability.md):
   regression gate (``--fail-on regression`` for CI);
 - ``python sheeprl.py fault-matrix`` — the resilience fault matrix on the CPU
   mesh (single-process + rank-targeted distributed fault smokes; see
-  ``howto/fault_tolerance.md``).
+  ``howto/fault_tolerance.md``);
+- ``python sheeprl.py serve checkpoint_path=<ckpt>`` — the policy serving
+  tier: continuous-batching inference over a device-resident session-slot
+  table (``howto/serving.md``).
 """
 
 import os
@@ -39,7 +42,15 @@ def _gang_parent_pin() -> None:
 
 _gang_parent_pin()
 
-from sheeprl_tpu.cli import bench_diff, compare, diagnose, fault_matrix, run, watch  # noqa: E402
+from sheeprl_tpu.cli import (  # noqa: E402
+    bench_diff,
+    compare,
+    diagnose,
+    fault_matrix,
+    run,
+    serve,
+    watch,
+)
 
 _SUBCOMMANDS = {
     "diagnose": diagnose,
@@ -47,6 +58,7 @@ _SUBCOMMANDS = {
     "compare": compare,
     "bench-diff": bench_diff,
     "fault-matrix": fault_matrix,
+    "serve": serve,
 }
 
 if __name__ == "__main__":

@@ -52,6 +52,14 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.p2e_dv1.evaluate",
     "sheeprl_tpu.algos.offline_dreamer.offline_dreamer",
     "sheeprl_tpu.algos.offline_dreamer.evaluate",
+    # serving-policy extractors (sheeprl_tpu/serve, howto/serving.md) — one per
+    # family, next to the evaluate registrations they mirror
+    "sheeprl_tpu.algos.ppo.serve",
+    "sheeprl_tpu.algos.ppo_recurrent.serve",
+    "sheeprl_tpu.algos.sac.serve",
+    "sheeprl_tpu.algos.dreamer_v3.serve",
+    "sheeprl_tpu.algos.dreamer_v2.serve",
+    "sheeprl_tpu.algos.dreamer_v1.serve",
 ]
 
 import importlib  # noqa: E402

@@ -169,7 +169,18 @@ def main(fabric, cfg: Dict[str, Any]):
         vl = value_loss(out["values"], batch["returns"], loss_reduction)
         return pg + vl, (pg, vl)
 
-    @jax.jit
+    # out_shardings pins the state outputs on multi-device meshes — see the
+    # ppo make_train_phase note (PR 8 residual; build_state_shardings)
+    from functools import partial
+
+    from sheeprl_tpu.parallel.sharding import build_state_shardings
+
+    _state_shardings = build_state_shardings(fabric, params, opt_state)
+    _train_jit_kwargs = (
+        {"out_shardings": tuple(_state_shardings)} if _state_shardings is not None else {}
+    )
+
+    @partial(jax.jit, **_train_jit_kwargs)
     def train_phase(params, opt_state, data, next_values):
         returns, advantages = gae(
             data["rewards"],

@@ -1,0 +1,35 @@
+"""Dreamer-V2 serving extractor: the shared Dreamer serving shape
+(``dreamer_v3/serve.py``) with DV2's zero initial carry and straight-through
+one-hot sampler."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.serve import dreamer_serve_policy
+from sheeprl_tpu.serve.policy import ServePolicy
+from sheeprl_tpu.utils.registry import register_serve_policy
+
+
+@register_serve_policy(algorithms=["dreamer_v2"])
+def get_serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any]) -> ServePolicy:
+    from sheeprl_tpu.algos.dreamer_v2.agent import actor_sample, build_agent
+
+    def init_carry(agent, wm_params):
+        # PlayerDV2 resets to zeros (no learnable initial state in DV2)
+        return (
+            jnp.zeros((agent.recurrent_state_size,), jnp.float32),
+            jnp.zeros((agent.stoch_state_size,), jnp.float32),
+        )
+
+    return dreamer_serve_policy(
+        fabric,
+        cfg,
+        state,
+        build_agent=build_agent,
+        actor_sample=actor_sample,
+        init_carry=init_carry,
+        family="dreamer_v2",
+    )

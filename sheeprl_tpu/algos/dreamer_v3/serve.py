@@ -1,0 +1,145 @@
+"""Dreamer-family serving extractors — the RSSM case of the O(1) session-state
+argument (PAPERS.md arxiv 2603.09555 applied to world-model policies, see
+howto/serving.md).
+
+The per-session carry is exactly the player's per-env state: previous action,
+recurrent state ``h``, stochastic state ``z``, plus the session PRNG key —
+a few KB per slot regardless of episode length, device-resident, updated in
+place by the donated slot-table step program. ``step_slot`` mirrors
+``PlayerDV3._step`` per slot (encoder → recurrent → representation → actor
+sample), so serving runs the same math as evaluation, vmapped over sessions.
+
+``dreamer_v1``/``dreamer_v2`` reuse the same shape through
+:func:`dreamer_serve_policy` with their own initial carries and actor samplers
+(their ``serve.py`` modules parameterize it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.serve.policy import ServePolicy, space_obs_spec
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import register_serve_policy
+
+
+def dreamer_serve_policy(
+    fabric,
+    cfg: Dict[str, Any],
+    state: Dict[str, Any],
+    *,
+    build_agent: Callable,
+    actor_sample: Callable,
+    init_carry: Callable[[Any, Any], Tuple[jax.Array, jax.Array]],
+    family: str,
+) -> ServePolicy:
+    """Shared Dreamer-family serving policy: ``init_carry(agent, wm_params)``
+    returns the unbatched ``(h0, z0)`` pair for one fresh session."""
+    env = make_env(cfg, cfg.seed, 0, None, "serve-probe")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    action_shape = tuple(int(s) for s in action_space.shape)
+    env.close()
+
+    agent, params = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        jax.random.PRNGKey(cfg.seed),
+        state["agent"] if state else None,
+    )
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    greedy = bool((cfg.get("serve") or {}).get("greedy", True))
+    act_dim_total = int(np.sum(actions_dim))
+    splits = np.cumsum(actions_dim)[:-1].tolist()
+
+    def init_slot(params, key):
+        h0, z0 = init_carry(agent, params["world_model"])
+        return {
+            "action": jnp.zeros((act_dim_total,), jnp.float32),
+            "h": h0,
+            "z": z0,
+            "key": key,
+        }
+
+    def step_slot(params, carry, obs):
+        key, k_repr, k_act = jax.random.split(carry["key"], 3)
+        wm = params["world_model"]
+        norm: Dict[str, jax.Array] = {}
+        for k in obs_keys:
+            v = obs[k].astype(jnp.float32)
+            if k in cnn_keys:
+                # frame-stack folds into channels; pixels -> [-0.5, 0.5]
+                # (the dreamer prepare_obs path, per slot)
+                norm[k] = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
+            else:
+                norm[k] = v.reshape(-1)
+        embedded = agent.encoder.apply({"params": wm["encoder"]}, norm)
+        h = agent._recurrent(wm, carry["z"], carry["action"], carry["h"])
+        _, z = agent._representation(wm, h, embedded, k_repr)
+        latent = jnp.concatenate([z, h], axis=-1)
+        pre = agent.actor.apply({"params": params["actor"]}, latent)
+        actions = actor_sample(agent, pre, k_act, greedy=greedy)
+        if is_continuous:
+            env_action = actions.reshape(action_shape).astype(jnp.float32)
+        else:
+            blocks = jnp.split(actions, splits, axis=-1)
+            env_action = jnp.stack([b.argmax(axis=-1) for b in blocks], axis=-1).reshape(
+                action_shape
+            ).astype(jnp.int32)
+        return env_action, {
+            "action": actions.reshape(act_dim_total).astype(jnp.float32),
+            "h": h,
+            "z": z,
+            "key": key,
+        }
+
+    return ServePolicy(
+        algo=str(cfg.algo.name),
+        params=params,
+        init_slot=init_slot,
+        step_slot=step_slot,
+        obs_spec=space_obs_spec(observation_space, obs_keys),
+        action_shape=action_shape,
+        action_dtype=np.float32 if is_continuous else np.int32,
+        meta={"family": family, "greedy": greedy, "recurrent": True},
+    )
+
+
+@register_serve_policy(algorithms=["dreamer_v3", "dreamer_v3_decoupled"])
+def get_serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any]) -> ServePolicy:
+    from sheeprl_tpu.algos.dreamer_v3.agent import actor_sample, build_agent
+
+    def init_carry(agent, wm_params):
+        # learnable tanh(w) initial recurrent state + transition-mode posterior
+        # (the same initial state PlayerDV3 resets to)
+        return agent.initial_state(wm_params, ())
+
+    return dreamer_serve_policy(
+        fabric,
+        cfg,
+        state,
+        build_agent=build_agent,
+        actor_sample=actor_sample,
+        init_carry=init_carry,
+        family="dreamer_v3",
+    )

@@ -65,10 +65,26 @@ def _build_optimizer(cfg, total_iters: int) -> optax.GradientTransformation:
     return tx
 
 
-def make_train_phase(agent, cfg, fabric, tx, actions_dim, is_continuous, cnn_keys, obs_keys, total_num_envs):
+def make_train_phase(
+    agent,
+    cfg,
+    fabric,
+    tx,
+    actions_dim,
+    is_continuous,
+    cnn_keys,
+    obs_keys,
+    total_num_envs,
+    state_shardings=None,
+):
     """Build the fused per-iteration optimization program (GAE + update_epochs ×
     minibatches in one jitted scan). Module-level so the DP numerical-parity tests
-    exercise exactly the program main() ships (reference train(), ppo.py:52-102)."""
+    exercise exactly the program main() ships (reference train(), ppo.py:52-102).
+
+    ``state_shardings`` — optional ``(params, opt_state, metrics)`` out_shardings
+    pinning the state outputs on multi-device meshes (replicated on dp; without
+    the pin GSPMD propagation may re-scatter small state leaves on output — the
+    PR 8 residual; ``parallel/sharding.py build_state_shardings``)."""
     world_size = fabric.world_size
     loss_reduction = cfg.algo.loss_reduction
     vf_coef = float(cfg.algo.vf_coef)
@@ -98,7 +114,9 @@ def make_train_phase(agent, cfg, fabric, tx, actions_dim, is_continuous, cnn_key
         loss = pg_loss + vf_coef * v_loss + ent_coef * ent_loss
         return loss, (pg_loss, v_loss, ent_loss)
 
-    @jax.jit
+    jit_kwargs = {"out_shardings": tuple(state_shardings)} if state_shardings is not None else {}
+
+    @partial(jax.jit, **jit_kwargs)
     def train_phase(params, opt_state, data, next_values, train_key, clip_coef, ent_coef):
         """One fused device program per iteration: GAE + update_epochs x minibatches."""
         returns, advantages = gae(
@@ -306,8 +324,19 @@ def main(fabric, cfg: Dict[str, Any]):
         _, values = agent.apply({"params": params}, norm_obs)
         return values
 
+    from sheeprl_tpu.parallel.sharding import build_state_shardings
+
     train_phase = make_train_phase(
-        agent, cfg, fabric, tx, actions_dim, is_continuous, cnn_keys, obs_keys, total_num_envs
+        agent,
+        cfg,
+        fabric,
+        tx,
+        actions_dim,
+        is_continuous,
+        cnn_keys,
+        obs_keys,
+        total_num_envs,
+        state_shardings=build_state_shardings(fabric, params, opt_state),
     )
 
     # replicate params/opt_state over the mesh once; rollout data arrives data-sharded

@@ -1,0 +1,92 @@
+"""PPO-family serving extractor (``get_serve_policy``, howto/serving.md).
+
+Covers every algorithm that checkpoints a :class:`PPOAgent` params tree under
+``state["agent"]``: ppo, ppo_decoupled, the Anakin fused topology, and a2c
+(which reuses the PPO agent). Feedforward policies carry only their PRNG key as
+per-session state; the serving carry is O(1) trivially.
+
+Action parity with the evaluation path: with ``serve.greedy=true`` (the
+default) the served action is the distribution mode — the exact computation of
+``ppo.utils.test`` — so a served session's action stream matches the
+sequential evaluate path bit-for-bit on identical observation sequences.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import build_agent, policy_output
+from sheeprl_tpu.serve.policy import ServePolicy, space_obs_spec
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import register_serve_policy
+
+
+@register_serve_policy(algorithms=["ppo", "ppo_decoupled", "ppo_anakin", "a2c", "a2c_anakin"])
+def get_serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any]) -> ServePolicy:
+    env = make_env(cfg, cfg.seed, 0, None, "serve-probe")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    action_shape = tuple(int(s) for s in action_space.shape)
+    env.close()
+
+    agent, params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, observation_space, jax.random.PRNGKey(cfg.seed)
+    )
+    if state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    greedy = bool((cfg.get("serve") or {}).get("greedy", True))
+    splits = np.cumsum(actions_dim)[:-1].tolist()
+
+    def init_slot(params, key):
+        return {"key": key}
+
+    def step_slot(params, carry, obs):
+        key, step_key = jax.random.split(carry["key"])
+        norm: Dict[str, jax.Array] = {}
+        for k in obs_keys:
+            v = obs[k].astype(jnp.float32)
+            if k in cnn_keys:
+                # frame-stack dims fold into channels, pixels -> [-0.5, 0.5]
+                # (the ppo.utils.prepare_obs/normalize_obs path, per slot)
+                norm[k] = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
+            else:
+                norm[k] = v.reshape(-1)
+        actor_outs, values = agent.apply({"params": params}, norm)
+        out = policy_output(actor_outs, values, step_key, actions_dim, is_continuous, greedy=greedy)
+        if is_continuous:
+            env_action = out["actions"].reshape(action_shape).astype(jnp.float32)
+        else:
+            blocks = jnp.split(out["actions"], splits, axis=-1)
+            env_action = jnp.stack([b.argmax(axis=-1) for b in blocks], axis=-1).reshape(
+                action_shape
+            ).astype(jnp.int32)
+        return env_action, {"key": key}
+
+    return ServePolicy(
+        algo=str(cfg.algo.name),
+        params=params,
+        init_slot=init_slot,
+        step_slot=step_slot,
+        obs_spec=space_obs_spec(observation_space, obs_keys),
+        action_shape=action_shape,
+        action_dtype=np.float32 if is_continuous else np.int32,
+        meta={"family": "ppo", "greedy": greedy, "recurrent": False},
+    )

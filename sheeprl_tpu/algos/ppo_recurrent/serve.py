@@ -1,0 +1,108 @@
+"""Recurrent-PPO serving extractor: the GRU/LSTM case of the O(1) session
+state argument (howto/serving.md). The per-session carry is (prev one-hot
+action, hx, cx, key) — a few KB per slot, device-resident, updated in place by
+the donated slot-table step program; the host never sees it."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import make_dists
+from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
+from sheeprl_tpu.serve.policy import ServePolicy, space_obs_spec
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import register_serve_policy
+
+
+@register_serve_policy(algorithms=["ppo_recurrent"])
+def get_serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any]) -> ServePolicy:
+    env = make_env(cfg, cfg.seed, 0, None, "serve-probe")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    action_shape = tuple(int(s) for s in action_space.shape)
+    env.close()
+
+    agent, params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, observation_space, jax.random.PRNGKey(cfg.seed)
+    )
+    if state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    greedy = bool((cfg.get("serve") or {}).get("greedy", True))
+    act_dim_total = int(np.sum(actions_dim))
+    hidden = int(agent.rnn_hidden_size)
+    splits = np.cumsum(actions_dim)[:-1].tolist()
+
+    def init_slot(params, key):
+        return {
+            "prev_action": jnp.zeros((act_dim_total,), jnp.float32),
+            "hx": jnp.zeros((hidden,), jnp.float32),
+            "cx": jnp.zeros((hidden,), jnp.float32),
+            "key": key,
+        }
+
+    def step_slot(params, carry, obs):
+        key, step_key = jax.random.split(carry["key"])
+        norm: Dict[str, jax.Array] = {}
+        for k in obs_keys:
+            v = obs[k].astype(jnp.float32)
+            if k in cnn_keys:
+                norm[k] = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
+            else:
+                norm[k] = v.reshape(-1)
+        feat = agent.feature_extractor.apply({"params": params["feature_extractor"]}, norm)
+        x = jnp.concatenate([feat, carry["prev_action"]], axis=-1)[None]  # [1, F+A]
+        (cx, hx), out = agent.rnn.apply(
+            {"params": params["rnn"]}, (carry["cx"][None], carry["hx"][None]), x
+        )
+        rnn_out = out[0]
+        pre_dist = agent.actor.apply({"params": params["actor"]}, rnn_out)
+        dists = make_dists(pre_dist, is_continuous)
+        if is_continuous:
+            dist = dists[0]
+            act = dist.mode if greedy else dist.sample(step_key)
+            stored = act
+            env_action = act.reshape(action_shape).astype(jnp.float32)
+        else:
+            keys = jax.random.split(step_key, len(dists))
+            blocks = [
+                d.mode if greedy else d.sample(keys[i]) for i, d in enumerate(dists)
+            ]
+            stored = jnp.concatenate(blocks, axis=-1)
+            env_action = jnp.stack([b.argmax(axis=-1) for b in blocks], axis=-1).reshape(
+                action_shape
+            ).astype(jnp.int32)
+        return env_action, {
+            "prev_action": stored.reshape(act_dim_total).astype(jnp.float32),
+            "hx": hx[0],
+            "cx": cx[0],
+            "key": key,
+        }
+
+    return ServePolicy(
+        algo=str(cfg.algo.name),
+        params=params,
+        init_slot=init_slot,
+        step_slot=step_slot,
+        obs_spec=space_obs_spec(observation_space, obs_keys),
+        action_shape=action_shape,
+        action_dtype=np.float32 if is_continuous else np.int32,
+        meta={"family": "ppo_recurrent", "greedy": greedy, "recurrent": True},
+    )

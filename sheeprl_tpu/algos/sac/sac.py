@@ -211,8 +211,19 @@ def main(fabric, cfg: Dict[str, Any]):
 
     # donate_argnums: XLA reuses the params/opt-state buffers in place instead of
     # copying the whole train state every round (callers always rebind to the
-    # returned trees, so the invalidated inputs are never read again)
-    @partial(jax.jit, donate_argnums=(0, 1))
+    # returned trees, so the invalidated inputs are never read again).
+    # out_shardings pins the state outputs on multi-device meshes (replicated on
+    # dp) — without the pin GSPMD propagation may re-scatter small state leaves
+    # on output, silently degrading the donation aliasing (the PR 8 residual;
+    # parallel/sharding.py build_state_shardings).
+    from sheeprl_tpu.parallel.sharding import build_state_shardings
+
+    _state_shardings = build_state_shardings(fabric, params, opt_state)
+    _train_jit_kwargs = (
+        {"out_shardings": tuple(_state_shardings)} if _state_shardings is not None else {}
+    )
+
+    @partial(jax.jit, donate_argnums=(0, 1), **_train_jit_kwargs)
     def train_phase(params, opt_state, data, iter_num, train_key):
         """scan over the [G, B, ...] gradient-step axis: critic -> EMA -> actor -> alpha
         (one fused device program per iteration; reference train(), sac.py:32-81)."""

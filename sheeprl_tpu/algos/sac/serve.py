@@ -1,0 +1,83 @@
+"""SAC-family serving extractor (sac / sac_decoupled / droq): continuous-control
+MLP actors. Per-session state is the PRNG key alone; with ``serve.greedy=true``
+(default) the served action is the squashed mean — the exact computation of
+``sac.utils.test``."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import greedy_action, squash_and_logprob
+from sheeprl_tpu.serve.policy import ServePolicy, space_obs_spec
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import register_serve_policy
+
+
+def _sac_like_serve_policy(fabric, cfg, state, build_agent: Callable) -> ServePolicy:
+    env = make_env(cfg, cfg.seed, 0, None, "serve-probe")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("SAC-family serving requires a continuous (Box) action space")
+    action_shape = tuple(int(s) for s in action_space.shape)
+    env.close()
+
+    actor, _critic, params = build_agent(
+        fabric,
+        cfg,
+        observation_space,
+        action_space,
+        jax.random.PRNGKey(cfg.seed),
+        state["agent"] if state else None,
+    )
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    greedy = bool((cfg.get("serve") or {}).get("greedy", True))
+    action_scale = jnp.asarray(actor.action_scale, jnp.float32).reshape(-1)
+    action_bias = jnp.asarray(actor.action_bias, jnp.float32).reshape(-1)
+
+    def init_slot(params, key):
+        return {"key": key}
+
+    def step_slot(params, carry, obs):
+        key, step_key = jax.random.split(carry["key"])
+        flat = jnp.concatenate(
+            [obs[k].astype(jnp.float32).reshape(-1) for k in mlp_keys], axis=-1
+        )
+        mean, std = actor.apply({"params": params["actor"]}, flat)
+        if greedy:
+            action = greedy_action(mean, action_scale, action_bias)
+        else:
+            action, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+        return action.reshape(action_shape).astype(jnp.float32), {"key": key}
+
+    return ServePolicy(
+        algo=str(cfg.algo.name),
+        params=params,
+        init_slot=init_slot,
+        step_slot=step_slot,
+        obs_spec=space_obs_spec(observation_space, mlp_keys),
+        action_shape=action_shape,
+        action_dtype=np.float32,
+        meta={"family": "sac", "greedy": greedy, "recurrent": False},
+    )
+
+
+@register_serve_policy(algorithms=["sac", "sac_decoupled"])
+def get_serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any]) -> ServePolicy:
+    from sheeprl_tpu.algos.sac.agent import build_agent
+
+    return _sac_like_serve_policy(fabric, cfg, state, build_agent)
+
+
+@register_serve_policy(algorithms=["droq"])
+def get_serve_policy_droq(fabric, cfg: Dict[str, Any], state: Dict[str, Any]) -> ServePolicy:
+    from sheeprl_tpu.algos.droq.agent import build_agent
+
+    return _sac_like_serve_policy(fabric, cfg, state, build_agent)

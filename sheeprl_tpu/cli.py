@@ -559,6 +559,21 @@ def bench_diff(args: Optional[Sequence[str]] = None) -> int:
     return bench_diff_main(list(args if args is not None else sys.argv[1:]))
 
 
+def serve(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py serve checkpoint_path=<ckpt> [serve.* overrides]`` —
+    the policy serving tier (howto/serving.md): load any registered agent
+    checkpoint (``checkpoint_path`` may be a file, a run dir, or a multi-rank
+    checkpoint set — resolved through the supervisor's manifest-validated
+    discovery), compile ONE donated fixed-shape step program, and serve
+    concurrent sessions via continuous batching over a device-resident slot
+    table. ``serve.prime=true`` compiles the serving programs into the
+    persistent XLA cache and exits (cold-start priming, the ``sheeprl-compile``
+    story for serving)."""
+    from sheeprl_tpu.serve.main import serve_main
+
+    return serve_main(list(args if args is not None else sys.argv[1:]))
+
+
 def check_configs_evaluation(cfg: dotdict) -> None:
     if cfg.float32_matmul_precision not in ("default", "high", "highest"):
         raise ValueError(
@@ -610,7 +625,12 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
     ckpt_path = kv.get("checkpoint_path")
     if ckpt_path is None:
         raise ValueError("you must specify checkpoint_path=...")
-    ckpt_path = Path(ckpt_path)
+    # a run dir / experiment tree / multi-rank checkpoint set resolves to its
+    # newest manifest-valid checkpoint — the same discovery rules the crash
+    # supervisor and the serving tier use (resilience/discovery.py)
+    from sheeprl_tpu.resilience.discovery import resolve_checkpoint_path
+
+    ckpt_path = Path(resolve_checkpoint_path(ckpt_path))
     cfg_path = ckpt_path.parent.parent / "config.yaml"
     if not cfg_path.is_file():
         cfg_path = ckpt_path.parent / "config.yaml"
@@ -691,12 +711,22 @@ def compile_warm(args: Optional[Sequence[str]] = None) -> None:
 
     Model/batch/sequence config is untouched — shapes must match the real run.
     Finetuning/offline entrypoints that need a checkpoint or dataset are not
-    supported (prime their base exp instead)."""
+    supported (prime their base exp instead).
+
+    Serving: ``sheeprl-compile checkpoint_path=<ckpt> [serve.* overrides]``
+    primes the SERVING tier instead — it AOT-compiles the batched slot-table
+    step/attach programs for that checkpoint (exact slot count and obs shapes)
+    into the same persistent cache, so ``sheeprl.py serve`` cold-starts as a
+    cache hit. Equivalent to ``sheeprl.py serve ... serve.prime=true``."""
     import time
 
     import sheeprl_tpu  # noqa: F401 - populate registries
 
     overrides = list(args if args is not None else sys.argv[1:])
+    if any(o.startswith("checkpoint_path=") for o in overrides):
+        # serving-tier priming: the step program's shapes come from the
+        # checkpoint + serve.* knobs, not from an exp config
+        raise SystemExit(serve(overrides + ["serve.prime=true"]))
     cfg = compose(overrides)
     total = one_train_phase_steps(cfg)
     import tempfile

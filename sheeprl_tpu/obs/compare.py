@@ -515,9 +515,21 @@ def load_bench_workloads(source: Any) -> List[Dict[str, Any]]:
         obj = _last_json_line(str(obj["tail"]))
     if not isinstance(obj, Mapping) or "metric" not in obj:
         raise ValueError(f"not a bench result: {str(source)[:120]!r}")
-    workloads = [dict(obj)] + [dict(e) for e in obj.get("extras") or [] if isinstance(e, Mapping)]
-    for w in workloads:
-        w.pop("extras", None)
+
+    # recursive extras flatten: a workload may itself carry companion metrics
+    # (serve_load reports sessions/sec with the p99-latency workload riding in
+    # its own extras) — every nested level gates independently
+    workloads: List[Dict[str, Any]] = []
+
+    def _collect(entry: Mapping) -> None:
+        row = dict(entry)
+        nested = row.pop("extras", None) or []
+        workloads.append(row)
+        for e in nested:
+            if isinstance(e, Mapping):
+                _collect(e)
+
+    _collect(obj)
     return workloads
 
 
@@ -537,14 +549,21 @@ def _last_json_line(text: str) -> Any:
 
 
 def _lower_is_better(unit: str) -> bool:
-    # seconds-style latencies and bytes-style memory footprints regress UP
-    # (the dv3_2d_mesh workload gates per-device parameter bytes)
+    # latency-style (seconds/ms) and memory-style (bytes) units regress UP —
+    # the serve_load p99 step-latency workload gates in "ms" and dv3_2d_mesh
+    # gates per-device parameter bytes. The "_ms"/" ms" suffix forms cover
+    # metric-style units ("latency_ms") without false-matching substrings in
+    # rate units ("items/sec").
     unit = (unit or "").lower()
     return (
         unit.startswith("seconds")
         or "seconds/" in unit
         or unit.startswith("bytes")
         or "bytes/" in unit
+        or unit.startswith("ms")
+        or unit.startswith("milliseconds")
+        or unit.endswith("_ms")
+        or "_ms " in unit
     )
 
 

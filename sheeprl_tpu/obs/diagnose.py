@@ -28,7 +28,14 @@ env_instability     env crash-restart clusters and watchdog stall events
 interruptions       preempt / crash-restart / giveup lifecycle events
 nonfinite_loss      the loss-finiteness health guard tripped
 unattributed_time   the phases breakdown leaves too much wall time unnamed
+occupancy_collapse  (serving) batch occupancy fell away with sessions attached
+latency_regression  (serving) window p99 step latency far above the run median
+slot_starvation     (serving) sessions queued while the slot table ran full
 ==================  ============================================================
+
+The three serving detectors read the ``serve`` block of a serving run's
+windows (``sheeprl_tpu/serve/telemetry.py``); training streams carry none, so
+they are free no-ops there.
 """
 
 from __future__ import annotations
@@ -58,6 +65,14 @@ ENV_RESTART_CLUSTER_SECONDS = 120.0
 UNATTRIBUTED_FRACTION = 0.10  # >10% of steady wall time unnamed
 UNATTRIBUTED_MIN_WALL_SECONDS = 5.0  # ignore micro-runs where noise dominates
 RECOMPILE_STORM_WINDOWS = 3  # affected windows that escalate to critical
+# serving detectors (windows carrying a `serve` block — sheeprl_tpu/serve)
+SERVE_MIN_WINDOWS = 4
+OCCUPANCY_COLLAPSE_RATIO = 0.5  # late-half median occupancy vs early-half
+OCCUPANCY_COLLAPSE_CRITICAL = 0.25
+LATENCY_REGRESSION_RATIO = 2.0  # window p99 vs run median p99
+LATENCY_REGRESSION_CRITICAL = 4.0
+SLOT_STARVATION_OCCUPANCY = 0.95  # "table full" occupancy floor
+SLOT_STARVATION_FRACTION = 0.5  # share of windows with a waiting queue
 
 
 def _ref(event: Dict[str, Any]) -> Dict[str, Any]:
@@ -525,6 +540,136 @@ def detect_unattributed_time(events: Events) -> List[Finding]:
     ]
 
 
+def _serve_windows(events: Events) -> List[Dict[str, Any]]:
+    """Steady windows carrying a ``serve`` block (serving runs only — training
+    streams contribute none, so the serving detectors are free no-ops there)."""
+    return [w for w in _windows(events) if isinstance(w.get("serve"), dict)]
+
+
+def _median(values: List[float]) -> float:
+    values = sorted(values)
+    n = len(values)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return values[mid] if n % 2 else 0.5 * (values[mid - 1] + values[mid])
+
+
+def detect_occupancy_collapse(events: Events) -> List[Finding]:
+    """Batch occupancy fell away while sessions were still attached: the server
+    is ticking mostly-empty batches — throughput is latency-bound, not
+    compute-bound (coalescing window too short, or client think-time dominates)."""
+    windows = _serve_windows(events)
+    if len(windows) < SERVE_MIN_WINDOWS:
+        return []
+    occ = [_f(w["serve"].get("occupancy")) for w in windows]
+    half = len(occ) // 2
+    early, late = _median(occ[:half]), _median(occ[half:])
+    late_windows = windows[half:]
+    active = _median(
+        [_f((w["serve"].get("sessions") or {}).get("active")) for w in late_windows]
+    )
+    if early <= 0 or active < 1 or late >= OCCUPANCY_COLLAPSE_RATIO * early:
+        return []
+    severity = "critical" if late < OCCUPANCY_COLLAPSE_CRITICAL * early else "warning"
+    return [
+        _finding(
+            "occupancy_collapse",
+            severity,
+            f"batch occupancy collapsed {early:.2f} → {late:.2f} with ~{active:.0f} "
+            "session(s) still attached — the step program is ticking mostly-empty batches",
+            late_windows,
+            "raise serve.max_batch_wait_ms so slow clients coalesce into one tick, "
+            "or shrink serve.slots to match the real concurrency",
+            early_occupancy=round(early, 4),
+            late_occupancy=round(late, 4),
+            active_sessions=active,
+        )
+    ]
+
+
+def detect_latency_regression(events: Events) -> List[Finding]:
+    """Per-step p99 latency of later windows far above the run's own median:
+    the server got slower while serving (queue pressure, host contention, a
+    recompile) — the SLO signal, independent of any absolute target."""
+    windows = _serve_windows(events)
+    if len(windows) < SERVE_MIN_WINDOWS:
+        return []
+    p99s = [
+        (_w, _f((_w["serve"].get("latency_ms") or {}).get("p99"))) for _w in windows
+    ]
+    p99s = [(w, v) for w, v in p99s if v > 0]
+    if len(p99s) < SERVE_MIN_WINDOWS:
+        return []
+    baseline = _median([v for _, v in p99s])
+    # window 0 absorbs the cold compiles — a spike there is startup, not drift
+    affected = [
+        (w, v) for w, v in p99s[1:] if v > LATENCY_REGRESSION_RATIO * baseline
+    ]
+    if not affected:
+        return []
+    worst = max(v for _, v in affected)
+    severity = (
+        "critical"
+        if worst > LATENCY_REGRESSION_CRITICAL * baseline and len(affected) >= 2
+        else "warning"
+    )
+    return [
+        _finding(
+            "latency_regression",
+            severity,
+            f"step-latency p99 regressed to {worst:.1f}ms in {len(affected)} window(s) "
+            f"vs the run median {baseline:.1f}ms",
+            [w for w, _ in affected],
+            "check for host contention and recompiles (compile.window_count in the "
+            "affected windows); if occupancy also rose, the table is saturated — "
+            "raise serve.slots",
+            baseline_p99_ms=round(baseline, 3),
+            worst_p99_ms=round(worst, 3),
+            windows=len(affected),
+        )
+    ]
+
+
+def detect_slot_starvation(events: Events) -> List[Finding]:
+    """Sessions queued for a slot while the table ran full: admission is
+    throttled by capacity, not by traffic — sessions/sec is capped below demand."""
+    windows = _serve_windows(events)
+    if len(windows) < 2:
+        return []
+    starved = [
+        w
+        for w in windows
+        if _f(w["serve"].get("queue_depth")) >= 1.0
+        and _f(w["serve"].get("occupancy")) >= SLOT_STARVATION_OCCUPANCY
+    ]
+    if len(starved) < max(2, int(SLOT_STARVATION_FRACTION * len(windows))):
+        return []
+    depth = _median([_f(w["serve"].get("queue_depth")) for w in starved])
+    slots = max(
+        (
+            int((e.get("serve") or {}).get("slots") or 0)
+            for e in events
+            if e.get("event") == "start"
+        ),
+        default=0,
+    )
+    return [
+        _finding(
+            "slot_starvation",
+            "warning",
+            f"sessions queued for a slot (median queue depth {depth:.1f}) while the "
+            f"table ran full in {len(starved)}/{len(windows)} window(s)",
+            starved,
+            f"raise serve.slots (currently {slots or 'unknown'}) — the step program "
+            "recompiles once for the new shape, then admission is O(1) again",
+            queue_depth=round(depth, 2),
+            starved_windows=len(starved),
+            slots=slots or None,
+        )
+    ]
+
+
 DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "recompile_storm": detect_recompile_storm,
     "prefetch_starvation": detect_prefetch_starvation,
@@ -535,6 +680,9 @@ DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "interruptions": detect_interruptions,
     "nonfinite_loss": detect_nonfinite_loss,
     "unattributed_time": detect_unattributed_time,
+    "occupancy_collapse": detect_occupancy_collapse,
+    "latency_regression": detect_latency_regression,
+    "slot_starvation": detect_slot_starvation,
 }
 
 

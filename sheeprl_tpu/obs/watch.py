@@ -46,6 +46,8 @@ _PHASE_GLYPHS = (
     ("rollout", "r", "rollout"),
     ("replay_wait", "R", "replay"),
     ("train", "T", "train"),
+    ("serve_step", "S", "serve"),
+    ("serve_wait", "w", "wait"),
     ("checkpoint", "C", "ckpt"),
     ("logging", "L", "log"),
     ("eval", "V", "eval"),
@@ -237,6 +239,20 @@ class WatchState:
                 + f"{mem}   compiles {compile_.get('count', 0)}"
                 + pipe
             )
+            serve = w.get("serve")
+            if isinstance(serve, dict):
+                # a SERVING run's window (sheeprl_tpu/serve): sessions + latency
+                lat = serve.get("latency_ms") or {}
+                sessions = serve.get("sessions") or {}
+                bits = [
+                    f"sessions {sessions.get('active', 0)}",
+                    f"occupancy {float(serve.get('occupancy') or 0.0):.0%}",
+                ]
+                if lat.get("p50") is not None:
+                    bits.append(f"latency p50 {lat['p50']:.1f}ms p99 {lat.get('p99', 0):.1f}ms")
+                if serve.get("queue_depth"):
+                    bits.append(f"queue {float(serve['queue_depth']):.1f}")
+                lines.append("  serve: " + " · ".join(bits))
             phases = w.get("phases")
             if isinstance(phases, dict):
                 wall = float(w.get("wall_seconds") or 0.0)

@@ -140,6 +140,32 @@ def find_latest_checkpoint(search_dir: str) -> Optional[str]:
     return max(valid, key=lambda c: (_candidate_mtime(c), checkpoint_step(c)))
 
 
+def resolve_checkpoint_path(path: str) -> str:
+    """One checkpoint-resolution rule for every consumer that takes a
+    ``checkpoint_path`` (``sheeprl_eval``, ``sheeprl.py serve``): an exact
+    checkpoint (pickle file, orbax dir + sidecar, or a ``.old`` crash-window
+    survivor) resolves to itself; anything else that is a DIRECTORY — a run
+    dir, an experiment tree, a multi-rank checkpoint dir — resolves to its
+    newest valid checkpoint under the same manifest-validated rules the crash
+    supervisor uses (torn multi-rank sets can never resolve). Raises
+    ``FileNotFoundError`` when nothing valid is found."""
+    path = str(path)
+    if os.path.isfile(path):
+        # an exact file wins even without validation: the caller named it
+        return path
+    if is_valid_checkpoint(path):
+        return path
+    if os.path.isdir(path):
+        found = find_latest_checkpoint(path)
+        if found is not None:
+            return found
+        raise FileNotFoundError(
+            f"checkpoint_path={path!r} is a directory with no valid checkpoint under it "
+            "(torn multi-rank sets — incomplete manifests — are skipped by construction)"
+        )
+    raise FileNotFoundError(f"checkpoint_path={path!r}: no such file, directory or checkpoint set")
+
+
 def resolve_latest(cfg) -> str:
     """Resolve ``checkpoint.resume_from=latest`` for the CLI: newest valid
     checkpoint across every run under this experiment's ``root_dir`` (honoring a

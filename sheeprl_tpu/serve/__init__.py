@@ -1,0 +1,38 @@
+"""sheeprl_tpu.serve — the policy serving tier (ROADMAP item 3).
+
+Training produces checkpoints; this package serves them under load:
+``python sheeprl.py serve checkpoint_path=<ckpt>`` loads any registered agent
+checkpoint, compiles ONE donated fixed-shape step program per policy, and
+serves concurrent sessions via continuous batching over a device-resident
+slot table (O(1) recurrent/RSSM session state per step, updated in place).
+
+Layout (shape parity with ``obs/`` and ``resilience/``):
+
+- ``policy.py``  — the :class:`ServePolicy` contract + per-family registry
+- ``slots.py``   — the device slot table and its donated step/attach programs
+- ``server.py``  — the continuous-batching server + in-process session API
+- ``drivers.py`` — env-session and open-loop load clients
+- ``telemetry.py`` — the serving telemetry stream (watch/diagnose-compatible)
+- ``main.py``    — the CLI verb implementation + compile-cache priming
+
+See ``howto/serving.md``.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.serve.policy import ObsSpec, ServePolicy, resolve_serve_policy, space_obs_spec
+from sheeprl_tpu.serve.server import PolicyServer, ServeSession, ServerClosed
+from sheeprl_tpu.serve.slots import SlotTable
+from sheeprl_tpu.serve.telemetry import ServingTelemetry
+
+__all__ = [
+    "ObsSpec",
+    "PolicyServer",
+    "ServePolicy",
+    "ServeSession",
+    "ServerClosed",
+    "ServingTelemetry",
+    "SlotTable",
+    "resolve_serve_policy",
+    "space_obs_spec",
+]

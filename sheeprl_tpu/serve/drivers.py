@@ -1,0 +1,142 @@
+"""Serving-run drivers: real env sessions (CLI smoke) and open-loop load.
+
+Both are plain clients of :class:`~sheeprl_tpu.serve.server.PolicyServer` —
+the server never knows whether a session is a gymnasium episode, a synthetic
+load generator, or (eventually) a network frontend.
+
+- :func:`run_env_sessions` — ``serve.sessions=N`` mode: N concurrent client
+  threads each play a real environment episode end-to-end with served actions
+  (the "millions of users" traffic pattern shrunk to a CPU smoke). Returns the
+  per-session action streams, which the parity tests compare against a
+  sequential reference.
+- :func:`run_synthetic_load` — the ``serve_load`` bench workload: an open-loop
+  session generator (arrivals do not wait for completions) pushing
+  fixed-length sessions of random observations through the server, measuring
+  sessions/sec and per-step latency percentiles under genuine concurrency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sheeprl_tpu.serve.server import PolicyServer, ServerClosed
+
+__all__ = ["run_env_sessions", "run_synthetic_load"]
+
+
+def run_env_sessions(
+    server: PolicyServer,
+    cfg: Any,
+    *,
+    sessions: int,
+    max_session_steps: int = 1000,
+    log_dir: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    """Drive ``sessions`` concurrent env episodes through the server; returns
+    one record per session: ``{seed, steps, reward, actions, error}``."""
+    from sheeprl_tpu.utils.env import make_env
+
+    results: List[Dict[str, Any]] = [{} for _ in range(sessions)]
+
+    def _client(i: int) -> None:
+        record: Dict[str, Any] = {"seed": int(cfg.seed) + i, "steps": 0, "reward": 0.0, "actions": []}
+        results[i] = record
+        env = None
+        session = None
+        try:
+            env = make_env(cfg, record["seed"], i, log_dir, "serve", vector_env_idx=i)()
+            session = server.open_session(seed=record["seed"])
+            obs = env.reset(seed=record["seed"])[0]
+            for _ in range(max_session_steps):
+                action = session.step(obs)
+                record["actions"].append(np.asarray(action))
+                obs, reward, terminated, truncated, _ = env.step(
+                    np.asarray(action).reshape(env.action_space.shape)
+                )
+                record["reward"] += float(np.asarray(reward))
+                record["steps"] += 1
+                if bool(terminated) or bool(truncated):
+                    break
+        except (ServerClosed, TimeoutError) as exc:
+            record["error"] = repr(exc)
+        finally:
+            if session is not None:
+                session.close()
+            if env is not None:
+                env.close()
+
+    threads = [threading.Thread(target=_client, args=(i,), daemon=True) for i in range(sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def run_synthetic_load(
+    server: PolicyServer,
+    *,
+    sessions: int,
+    steps_per_session: int,
+    arrival_interval_s: float = 0.0,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """Open-loop load: ``sessions`` synthetic clients arrive on a fixed
+    schedule (never gated on completions) and each runs ``steps_per_session``
+    random-observation steps. Returns host-side aggregates; the authoritative
+    latency/occupancy numbers come from the server's telemetry summary."""
+    rng = np.random.default_rng(seed)
+    spec = server.policy.obs_spec
+    done = threading.Event()
+    state = {"finished": 0, "steps": 0, "errors": 0}
+    lock = threading.Lock()
+
+    def _client(i: int) -> None:
+        session = None
+        try:
+            session = server.open_session(seed=seed + i)
+            obs = {
+                k: (rng.integers(0, 255, s.shape).astype(s.dtype)
+                    if np.issubdtype(np.dtype(s.dtype), np.integer)
+                    else rng.normal(size=s.shape).astype(s.dtype))
+                for k, s in spec.items()
+            }
+            steps = 0
+            for _ in range(steps_per_session):
+                session.step(obs)
+                steps += 1
+            with lock:
+                state["finished"] += 1
+                state["steps"] += steps
+        except (ServerClosed, TimeoutError):
+            with lock:
+                state["errors"] += 1
+        finally:
+            # a timed-out session MUST release its slot — a leaked slot shrinks
+            # capacity for every later session and cascades the stall
+            if session is not None:
+                session.close()
+            with lock:
+                if state["finished"] + state["errors"] >= sessions:
+                    done.set()
+
+    t0 = time.perf_counter()
+    for i in range(sessions):
+        threading.Thread(target=_client, args=(i,), daemon=True).start()
+        if arrival_interval_s > 0:
+            time.sleep(arrival_interval_s)
+    done.wait()
+    wall = time.perf_counter() - t0
+    return {
+        "sessions": sessions,
+        "sessions_finished": state["finished"],
+        "errors": state["errors"],
+        "steps": state["steps"],
+        "wall_seconds": round(wall, 3),
+        "sessions_per_sec": round(state["finished"] / wall, 3) if wall > 0 else None,
+        "steps_per_sec": round(state["steps"] / wall, 3) if wall > 0 else None,
+    }

@@ -1,0 +1,237 @@
+"""``python sheeprl.py serve checkpoint_path=<ckpt> [serve.* overrides]``.
+
+Composition mirrors ``sheeprl-eval`` (cli.evaluation): the config is read from
+the checkpoint's own ``config.yaml``, a ``serve`` block of serving knobs is
+merged over it (defaults below, then dotted ``serve.*`` CLI overrides), the
+checkpoint is resolved through the crash supervisor's discovery rules
+(``resolve_checkpoint_path`` — a run DIR or multi-rank set resolves to its
+newest manifest-valid checkpoint), and the registered family extractor builds
+the :class:`~sheeprl_tpu.serve.policy.ServePolicy` the server batches.
+
+Serving knobs (``serve.*``):
+
+- ``slots`` — concurrent device-resident sessions (the batch dimension of the
+  ONE compiled step program);
+- ``max_batch_wait_ms`` — continuous-batching coalescing window;
+- ``greedy`` — deterministic (mode) actions vs sampled ones;
+- ``sessions`` / ``max_session_steps`` — the built-in env-session driver: N
+  concurrent client threads each play a real env episode with served actions
+  (the in-process session API is the transport surface; this driver is its
+  operational smoke);
+- ``telemetry.enabled`` / ``telemetry.every`` — the serving telemetry stream
+  (``watch``/``diagnose`` compatible, see howto/serving.md);
+- ``prime=true`` — compile the step/attach programs into the persistent XLA
+  compile cache and exit WITHOUT serving: the ``sheeprl-compile`` story for the
+  serving tier (cold-start becomes a cache hit).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = ["SERVE_DEFAULTS", "build_serve_cfg", "serve_main"]
+
+SERVE_DEFAULTS: Dict[str, Any] = {
+    "slots": 4,
+    "max_batch_wait_ms": 2.0,
+    "greedy": True,
+    "sessions": 2,
+    "max_session_steps": 1000,
+    "request_timeout": 120.0,
+    "log_dir": None,  # default: logs/serve/<algo>_<timestamp>
+    "prime": False,
+    "telemetry": {"enabled": True, "every": 256},
+}
+
+
+def build_serve_cfg(overrides: Sequence[str]):
+    """Compose the serving config: checkpoint's config.yaml + serve defaults +
+    dotted CLI overrides. Returns the dotdict cfg (with ``checkpoint_path``
+    resolved and ``serve`` populated)."""
+    import copy
+
+    import yaml
+
+    from sheeprl_tpu.config import dotdict, set_by_path
+    from sheeprl_tpu.resilience.discovery import resolve_checkpoint_path
+
+    kv = dict(o.split("=", 1) for o in overrides if "=" in o)
+    ckpt_arg = kv.get("checkpoint_path")
+    if ckpt_arg is None:
+        raise ValueError(
+            "you must specify checkpoint_path=... (a checkpoint file, a run dir, "
+            "or a multi-rank checkpoint dir — discovery resolves the newest valid set)"
+        )
+    from pathlib import Path
+
+    ckpt_path = Path(resolve_checkpoint_path(ckpt_arg))
+    cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not cfg_path.is_file():
+        cfg_path = ckpt_path.parent / "config.yaml"
+    if not cfg_path.is_file():
+        raise ValueError(
+            f"cannot serve {ckpt_path}: no config.yaml found next to the checkpoint"
+        )
+    with open(cfg_path) as f:
+        base = yaml.safe_load(f)
+    # serving is single-controller, one env worth of obs per session
+    base["env"]["num_envs"] = 1
+    base["env"]["capture_video"] = False
+    base.setdefault("fabric", {})
+    base["fabric"]["devices"] = 1
+    base["checkpoint_path"] = str(ckpt_path)
+    base["serve"] = copy.deepcopy(SERVE_DEFAULTS)
+    cfg = dotdict(base)
+    for key, raw in kv.items():
+        if key == "checkpoint_path":
+            continue
+        try:
+            value = yaml.safe_load(raw)
+        except yaml.YAMLError:
+            value = raw
+        try:
+            set_by_path(cfg, key, value, create=True)
+        except (KeyError, TypeError):
+            continue
+    cfg.seed = int(kv.get("seed", base.get("seed", 42)))
+    return cfg
+
+
+def _default_log_dir(cfg) -> str:
+    stamp = time.strftime("%Y-%m-%d_%H-%M-%S")
+    return os.path.join("logs", "serve", f"{cfg.algo.name}_{stamp}")
+
+
+def _prime(server, policy) -> Dict[str, int]:
+    """AOT-compile the serving step/attach programs (landing them in the
+    persistent XLA compile cache) without serving a single request."""
+    import numpy as np
+
+    from sheeprl_tpu.utils.mfu import abstractify
+
+    table = server.table
+    step, attach = table.aot_programs()
+    obs = {k: spec.zeros(table.num_slots) for k, spec in policy.obs_spec.items()}
+    mask = np.zeros((table.num_slots,), np.bool_)
+    keys = table._slot_keys([0] * table.num_slots)
+    compiled = 0
+    for fn, args in (
+        (step, (policy.params, table.states, obs, mask)),
+        (attach, (policy.params, table.states, keys, mask)),
+    ):
+        fn.lower(*abstractify(args)).compile()
+        compiled += 1
+    return {"programs": compiled, "slots": table.num_slots}
+
+
+def serve_main(args: Optional[Sequence[str]] = None) -> int:
+    """The ``serve`` verb implementation (called by ``sheeprl_tpu.cli.serve``)."""
+    import jax
+
+    import sheeprl_tpu  # noqa: F401 — populate the serve registry
+
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.serve.drivers import run_env_sessions
+    from sheeprl_tpu.serve.policy import resolve_serve_policy
+    from sheeprl_tpu.serve.server import PolicyServer
+    from sheeprl_tpu.serve.telemetry import ServingTelemetry
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.compile_cache import enable_compile_cache
+
+    overrides = list(args if args is not None else sys.argv[1:])
+    cfg = build_serve_cfg(overrides)
+    serve_cfg = cfg.serve
+
+    # the persistent compile cache is the serving cold-start story: a primed
+    # (serve.prime=true) or previously-served policy compiles as a cache hit
+    enable_compile_cache()
+
+    fabric = Fabric(
+        devices=1,
+        accelerator=cfg.fabric.get("accelerator", "auto"),
+        precision=cfg.fabric.get("precision", "32-true"),
+        checkpoint_backend=str((cfg.get("checkpoint") or {}).get("backend", "pickle")),
+    )
+    # pin the platform BEFORE loading (same rationale as eval_algorithm)
+    fabric._setup()
+    state = load_checkpoint(cfg.checkpoint_path)
+    policy = resolve_serve_policy(fabric, cfg, state)
+
+    log_dir = serve_cfg.get("log_dir") or _default_log_dir(cfg)
+    os.makedirs(log_dir, exist_ok=True)
+    tcfg = serve_cfg.get("telemetry") or {}
+    telemetry = ServingTelemetry(
+        fabric,
+        cfg,
+        log_dir,
+        enabled=bool(tcfg.get("enabled", True)),
+        every=int(tcfg.get("every", 256)),
+        serve_info={
+            "slots": int(serve_cfg.slots),
+            "max_batch_wait_ms": float(serve_cfg.max_batch_wait_ms),
+            "greedy": bool(serve_cfg.greedy),
+            "checkpoint_path": str(cfg.checkpoint_path),
+            **policy.meta,
+        },
+    )
+
+    server = PolicyServer(
+        policy,
+        slots=int(serve_cfg.slots),
+        max_batch_wait_ms=float(serve_cfg.max_batch_wait_ms),
+        base_seed=int(cfg.seed),
+        telemetry=telemetry,
+        request_timeout=float(serve_cfg.request_timeout),
+    )
+
+    if bool(serve_cfg.get("prime")):
+        t0 = time.perf_counter()
+        stats = _prime(server, policy)
+        telemetry.close(clean_exit=True)
+        cache_dir = jax.config.jax_compilation_cache_dir
+        print(
+            f"[sheeprl-serve] primed {stats['programs']} serving program(s) for "
+            f"{cfg.algo.name} ({stats['slots']} slots) in {time.perf_counter() - t0:.1f}s"
+            + (
+                f" — persistent cache at {cache_dir}"
+                if cache_dir
+                else " — WARNING: persistent compile cache is DISABLED (SHEEPRL_JAX_CACHE=0?)"
+            )
+        )
+        return 0
+
+    sessions = int(serve_cfg.sessions)
+    if sessions < 1:
+        telemetry.close(clean_exit=True)
+        print(
+            "[sheeprl-serve] serve.sessions=0: nothing to drive. The in-process "
+            "session API (PolicyServer.open_session) is the transport surface; "
+            "set serve.sessions=N to run N concurrent env sessions to completion.",
+            file=sys.stderr,
+        )
+        return 2
+
+    print(
+        f"[sheeprl-serve] serving {cfg.algo.name} from {cfg.checkpoint_path} — "
+        f"{serve_cfg.slots} slots, {sessions} env session(s), telemetry at {log_dir}"
+    )
+    results: List[Dict[str, Any]]
+    with server:
+        results = run_env_sessions(
+            server,
+            cfg,
+            sessions=sessions,
+            max_session_steps=int(serve_cfg.max_session_steps),
+            log_dir=log_dir,
+        )
+    failed = [r for r in results if r.get("error")]
+    for r in results:
+        print(
+            f"[sheeprl-serve] session seed={r.get('seed')}: {r.get('steps', 0)} steps, "
+            f"reward {r.get('reward', 0.0):.2f}"
+            + (f" — ERROR {r['error']}" if r.get("error") else "")
+        )
+    return 1 if failed else 0

@@ -1,0 +1,89 @@
+"""The serving-side policy contract: one pure per-session step function.
+
+A :class:`ServePolicy` is what a family's ``get_serve_policy`` extractor
+(registered via ``register_serve_policy``, living next to the family's
+``evaluate`` registration) distills out of a training checkpoint. It is the
+*session-oriented* view of a policy:
+
+- ``init_slot(params, key) -> carry`` builds ONE session's device-resident
+  state — for recurrent/RSSM policies the O(1) per-step carry (previous
+  action, GRU/RSSM latent), for feedforward policies just the PRNG key. The
+  carry ALWAYS includes the session's own PRNG key, so a session's action
+  stream is a pure function of (params, seed, obs sequence) — independent of
+  which other sessions share its batch.
+- ``step_slot(params, carry, obs) -> (action, carry')`` advances ONE session
+  by one step. Pure and unbatched: the slot table vmaps it over the slot axis
+  and compiles a single donated fixed-shape program
+  (``serve/slots.py``), which is why admission/eviction never recompiles.
+
+Observations arrive RAW (the dtypes the env emits — uint8 pixels, float
+vectors); any normalization (pixels → [-0.5, 0.5], reshapes) happens inside
+``step_slot`` so the host↔device transfer stays as small as the env's own
+observation. The returned action is env-facing (argmax'd ints for discrete
+spaces, floats for continuous) — what ``env.step`` accepts for one env.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ObsSpec", "ServePolicy", "resolve_serve_policy", "space_obs_spec"]
+
+
+@dataclass(frozen=True)
+class ObsSpec:
+    """Per-session observation layout: ``shape`` WITHOUT the slot axis, and the
+    dtype the env emits (uint8 pixels stage 4x cheaper than float32)."""
+
+    shape: Tuple[int, ...]
+    dtype: Any
+
+    def zeros(self, num_slots: int) -> np.ndarray:
+        return np.zeros((num_slots, *self.shape), dtype=self.dtype)
+
+
+@dataclass
+class ServePolicy:
+    """A checkpointed policy in serving form. See the module docstring for the
+    ``init_slot``/``step_slot`` contract."""
+
+    algo: str
+    params: Any
+    init_slot: Callable[[Any, Any], Any]
+    step_slot: Callable[[Any, Any, Dict[str, Any]], Tuple[Any, Any]]
+    obs_spec: Dict[str, ObsSpec]
+    action_shape: Tuple[int, ...]
+    action_dtype: Any = np.float32
+    # free-form description stamped into the serving telemetry start event
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+def space_obs_spec(observation_space, obs_keys: Sequence[str]) -> Dict[str, ObsSpec]:
+    """ObsSpec dict for the policy's encoder keys from a gym Dict space."""
+    spec: Dict[str, ObsSpec] = {}
+    for k in obs_keys:
+        space = observation_space[k]
+        spec[k] = ObsSpec(tuple(int(s) for s in space.shape), np.dtype(space.dtype))
+    return spec
+
+
+def resolve_serve_policy(fabric, cfg, state) -> ServePolicy:
+    """Look up ``cfg.algo.name`` in the serve registry and build its policy.
+    Raises with the registered set when the family has no serving extractor."""
+    import importlib
+
+    from sheeprl_tpu.utils.registry import get_serve, serve_registry
+
+    entry = get_serve(cfg.algo.name)
+    if entry is None:
+        available = ", ".join(sorted(serve_registry.keys()))
+        raise ValueError(
+            f"no serving policy registered for algorithm {cfg.algo.name!r}; "
+            f"available: {available} (add a get_serve_policy extractor next to the "
+            "family's evaluate registration — see howto/serving.md)"
+        )
+    module = importlib.import_module(entry["module"])
+    return getattr(module, entry["entrypoint"])(fabric, cfg, state)

@@ -1,0 +1,311 @@
+"""Continuous-batching policy server over a device-resident slot table.
+
+Concurrent sessions (each a client thread, ultimately a network frontend)
+submit observations; a single tick loop coalesces whatever is pending into one
+fixed-shape batched step over the slot table (``serve/slots.py``) and fans the
+actions back out. Throughput is bounded by batch occupancy, not by per-session
+round-trips — the continuous-batching design of LLM serving applied to
+recurrent policy inference:
+
+- **admission**: a new session waits in the queue until a slot frees up, then
+  one masked ``attach`` program initializes its device carry *between* steps —
+  no recompile, no effect on co-resident sessions;
+- **coalescing**: a tick fires as soon as every attached session has a pending
+  request, or after ``max_batch_wait_ms`` from the first pending request —
+  latency is traded against occupancy with one knob;
+- **masking**: sessions that did not submit this tick keep their carry
+  bit-exact (the step program ``where``s them out) — a slow client never
+  corrupts its own session state;
+- **eviction**: closing a session frees its slot immediately; the stale carry
+  is overwritten by the next admission.
+
+The server is transport-agnostic: :meth:`PolicyServer.open_session` returns an
+in-process handle (``session.step(obs) -> action``); the CLI's env driver and
+the bench's open-loop generator (``serve/drivers.py``) are both plain clients.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sheeprl_tpu.serve.policy import ServePolicy
+from sheeprl_tpu.serve.slots import SlotTable
+
+__all__ = ["PolicyServer", "ServeSession", "ServerClosed"]
+
+
+class ServerClosed(RuntimeError):
+    """The server shut down (or crashed) while a session was waiting on it."""
+
+
+class ServeSession:
+    """Client-side handle for one policy session. Thread-confined: one client
+    thread drives ``step`` sequentially; concurrency lives ACROSS sessions."""
+
+    def __init__(self, server: "PolicyServer", seed: int) -> None:
+        self._server = server
+        self.seed = int(seed)
+        self.slot: Optional[int] = None
+        self.steps = 0
+        self._obs: Optional[Dict[str, np.ndarray]] = None
+        self._action: Optional[np.ndarray] = None
+        self._submit_time = 0.0
+        self._attached_time = 0.0
+        self._event = threading.Event()
+        self._closed = False
+
+    def step(self, obs: Dict[str, np.ndarray], timeout: Optional[float] = None) -> np.ndarray:
+        """Submit one observation, block until the batched step returns this
+        session's action."""
+        if self._closed:
+            raise ServerClosed("session is closed")
+        self._server._submit(self, obs)
+        if not self._event.wait(timeout if timeout is not None else self._server.request_timeout):
+            raise TimeoutError(
+                f"serve session (slot {self.slot}) timed out waiting for an action"
+            )
+        if self._server._error is not None:
+            raise ServerClosed(f"policy server died: {self._server._error!r}")
+        if self._action is None:
+            raise ServerClosed("policy server shut down mid-request")
+        self.steps += 1
+        return self._action
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._server._release(self)
+
+
+class PolicyServer:
+    """The batching inference server. Construct, then use as a context manager
+    (or call :meth:`start`/:meth:`close`); clients call :meth:`open_session`."""
+
+    def __init__(
+        self,
+        policy: ServePolicy,
+        *,
+        slots: int = 4,
+        max_batch_wait_ms: float = 2.0,
+        base_seed: int = 0,
+        telemetry: Any = None,
+        request_timeout: float = 120.0,
+    ) -> None:
+        self.policy = policy
+        self.table = SlotTable(policy, slots, base_seed=base_seed)
+        self.max_batch_wait_ms = float(max_batch_wait_ms)
+        self.request_timeout = float(request_timeout)
+        self.telemetry = telemetry
+
+        self._cond = threading.Condition()
+        self._admission: deque = deque()  # sessions waiting for a slot
+        self._sessions: Dict[int, ServeSession] = {}  # slot -> session
+        self._started_delta = 0
+        self._finished_delta = 0
+        self._closing = False
+        self._closed = False
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        # preallocated [S, ...] staging buffers, zeroed rows for masked slots
+        self._obs_buf = {k: spec.zeros(self.table.num_slots) for k, spec in policy.obs_spec.items()}
+
+    # -- lifecycle -----------------------------------------------------------------
+
+    def start(self) -> "PolicyServer":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run, name="sheeprl-serve", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self, clean_exit: bool = True) -> None:
+        with self._cond:
+            # _closing may already be set by a CRASHED tick loop — the close
+            # tail (join, client wakeup, telemetry summary) must still run
+            # exactly once, with clean_exit=False so the stream records the
+            # failure instead of never ending (watch would hang on no summary)
+            if self._closed:
+                return
+            self._closed = True
+            self._closing = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+        # wake anyone still blocked on a request
+        for session in list(self._sessions.values()) + list(self._admission):
+            session._event.set()
+        if self.telemetry is not None:
+            self.telemetry.close(clean_exit=clean_exit and self._error is None)
+
+    def __enter__(self) -> "PolicyServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(clean_exit=exc_type is None)
+
+    # -- client API ----------------------------------------------------------------
+
+    def open_session(self, seed: Optional[int] = None) -> ServeSession:
+        """Create a session; it attaches to a slot as soon as one frees up (its
+        first ``step`` blocks through the admission wait)."""
+        with self._cond:
+            if self._closing:
+                raise ServerClosed("server is shutting down")
+            session = ServeSession(self, seed if seed is not None else len(self._sessions))
+            self._admission.append(session)
+            self._started_delta += 1
+            self._cond.notify_all()
+            return session
+
+    @property
+    def active_sessions(self) -> int:
+        with self._cond:
+            return len(self._sessions)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._admission)
+
+    # -- session plumbing ----------------------------------------------------------
+
+    def _submit(self, session: ServeSession, obs: Dict[str, np.ndarray]) -> None:
+        with self._cond:
+            if self._closing:
+                raise ServerClosed("server is shutting down")
+            session._obs = obs
+            session._action = None
+            session._submit_time = time.perf_counter()
+            session._event.clear()
+            self._cond.notify_all()
+
+    def _release(self, session: ServeSession) -> None:
+        with self._cond:
+            if session.slot is not None:
+                self._sessions.pop(session.slot, None)
+                self.table.evict(session.slot)
+                session.slot = None
+                self._finished_delta += 1
+            elif session in self._admission:
+                self._admission.remove(session)
+                self._finished_delta += 1
+            session._event.set()
+            self._cond.notify_all()
+
+    # -- tick loop -----------------------------------------------------------------
+
+    def _admit_locked(self) -> Dict[int, int]:
+        """Move queued sessions into free slots; returns slot -> seed for the
+        attach program (caller runs it OUTSIDE the lock)."""
+        attached: Dict[int, int] = {}
+        while self._admission:
+            slot = self.table.try_admit(self._admission[0])
+            if slot is None:
+                break
+            session = self._admission.popleft()
+            session.slot = slot
+            session._attached_time = time.perf_counter()
+            self._sessions[slot] = session
+            attached[slot] = session.seed
+        return attached
+
+    def _pending_locked(self) -> List[ServeSession]:
+        return [s for s in self._sessions.values() if s._obs is not None]
+
+    def _run(self) -> None:
+        try:
+            self._loop()
+        except BaseException as exc:  # deliver the failure, never hang clients
+            self._error = exc
+            with self._cond:
+                self._closing = True
+                for session in list(self._sessions.values()) + list(self._admission):
+                    session._event.set()
+                self._cond.notify_all()
+
+    def _loop(self) -> None:
+        wait_budget = self.max_batch_wait_ms / 1000.0
+        while True:
+            wait_started = time.perf_counter()
+            with self._cond:
+                if self._closing:
+                    return
+                attached = self._admit_locked()
+            if attached:
+                self.table.attach(attached)
+
+            # coalescing wait: fire when every attached session is pending, or
+            # max_batch_wait_ms after the FIRST pending request arrived
+            with self._cond:
+                while not self._closing:
+                    pending = self._pending_locked()
+                    if pending:
+                        # remaining coalescing budget measured from the FIRST
+                        # pending request — a wakeup mid-window must not re-arm
+                        # the full budget (that would double the worst-case
+                        # added latency)
+                        oldest = min(s._submit_time for s in pending)
+                        remaining = wait_budget - (time.perf_counter() - oldest)
+                        if len(pending) == len(self._sessions) or remaining <= 0:
+                            break
+                    if self._admission and self.table.free_slots:
+                        break  # admit first, then come back for the batch
+                    self._cond.wait(remaining if pending else 0.05)
+                if self._closing:
+                    return
+                pending = self._pending_locked()
+                if not pending:
+                    continue
+                batch = [(s.slot, s) for s in pending]
+                active = len(self._sessions)
+                queue_depth = len(self._admission)
+                started = self._started_delta
+                finished = self._finished_delta
+                self._started_delta = 0
+                self._finished_delta = 0
+            wait_seconds = time.perf_counter() - wait_started
+
+            # stage [S, ...] obs (zero rows for masked slots), run ONE step
+            mask = np.zeros((self.table.num_slots,), np.bool_)
+            for slot, session in batch:
+                mask[slot] = True
+                for k, buf in self._obs_buf.items():
+                    buf[slot] = np.asarray(session._obs[k], dtype=buf.dtype).reshape(
+                        buf.shape[1:]
+                    )
+            t0 = time.perf_counter()
+            actions = self.table.step(self._obs_buf, mask)
+            step_seconds = time.perf_counter() - t0
+
+            now = time.perf_counter()
+            latencies = []
+            for slot, session in batch:
+                session._obs = None
+                session._action = np.array(actions[slot])
+                # STEP latency: a queued session's first request starts its
+                # clock at slot attach — time spent waiting for a slot is the
+                # admission queue's number (queue_depth / slot_starvation),
+                # not the step program's
+                latencies.append(
+                    (now - max(session._submit_time, session._attached_time)) * 1000.0
+                )
+                session._event.set()
+
+            if self.telemetry is not None:
+                self.telemetry.observe_tick(
+                    batch=len(batch),
+                    slots=self.table.num_slots,
+                    active=active,
+                    queue_depth=queue_depth,
+                    step_seconds=step_seconds,
+                    wait_seconds=wait_seconds,
+                    latencies_ms=latencies,
+                    started=started,
+                    finished=finished,
+                    state_bytes=self.table.state_bytes(),
+                )

@@ -1,0 +1,168 @@
+"""Device-resident session-slot table: O(1) per-step state, updated in place.
+
+The compiler-first O(1) autoregressive-caching argument (PAPERS.md, arxiv
+2603.09555) applied to GRU/RSSM policies: each concurrent session owns one row
+of a fixed-size slot table whose state pytree lives on-device with a leading
+``[S]`` slot axis. ONE donated, fixed-shape jitted program
+
+    step(params, slot_states, slot_obs, slot_mask) -> (actions, slot_states')
+
+advances every pending session per tick — the donated ``slot_states`` buffers
+are updated in place (XLA input/output aliasing), so steady-state serving moves
+only observations in and actions out across the host↔device boundary; session
+state NEVER crosses it. Masked slots (inactive, or active but without a pending
+request this tick) keep their carry bit-exact via a ``where`` — no gather, no
+scatter, no shape change, hence no recompile, ever.
+
+Admission is the same trick: ``attach(params, states, keys, mask)`` writes
+freshly initialized carries into the masked slots between steps (one fixed-shape
+donated program for ANY subset of slots), so sessions attach and evict without
+touching the step program.
+
+Per-slot PRNG keys ride inside the carry (``ServePolicy.init_slot``), which
+makes every session's action stream a pure function of (params, seed, obs
+sequence) — batch composition cannot perturb it. That is the property the
+serving parity tests pin (tests/test_serve/test_policies.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.serve.policy import ServePolicy
+
+__all__ = ["SlotTable"]
+
+
+def _mask_select(mask: jax.Array):
+    """tree_map-able ``where`` over the slot axis: mask [S] broadcast against
+    arbitrary-rank leaves."""
+
+    def sel(new, old):
+        m = mask.reshape(mask.shape[0], *([1] * (new.ndim - 1)))
+        return jnp.where(m, new, old)
+
+    return sel
+
+
+class SlotTable:
+    """S device-resident session slots + the donated step/attach programs.
+
+    Host-side bookkeeping (which session holds which slot) is plain Python —
+    the device programs only ever see the fixed ``[S]`` shapes. Not thread-safe
+    by itself; the server serializes access through its tick loop.
+    """
+
+    def __init__(self, policy: ServePolicy, num_slots: int, base_seed: int = 0) -> None:
+        if num_slots < 1:
+            raise ValueError(f"serve.slots must be >= 1, got {num_slots}")
+        self.policy = policy
+        self.num_slots = int(num_slots)
+        self.base_seed = int(base_seed)
+
+        vstep = jax.vmap(policy.step_slot, in_axes=(None, 0, 0))
+        vinit = jax.vmap(policy.init_slot, in_axes=(None, 0))
+
+        def _step(params, states, obs, mask):
+            actions, new_states = vstep(params, states, obs)
+            new_states = jax.tree_util.tree_map(_mask_select(mask), new_states, states)
+            return actions, new_states
+
+        def _attach(params, states, keys, mask):
+            fresh = vinit(params, keys)
+            return jax.tree_util.tree_map(_mask_select(mask), fresh, states)
+
+        # donation: the slot-state buffers are reused in place every tick — the
+        # table's state footprint is O(S), not O(S * ticks); callers rebind to
+        # the returned tree so the invalidated inputs are never read again
+        self._step = jax.jit(_step, donate_argnums=(1,))
+        self._attach = jax.jit(_attach, donate_argnums=(1,))
+        self._vinit = jax.jit(vinit)
+
+        keys = self._slot_keys(self.base_seed + i for i in range(self.num_slots))
+        self.states = self._vinit(policy.params, keys)
+        # fixed-shape table: the state footprint is a CONSTANT after init (no
+        # recompiles, no shape changes) — computed once, never on the tick path
+        self._state_bytes = sum(
+            int(leaf.nbytes)
+            for leaf in jax.tree_util.tree_leaves(self.states)
+            if hasattr(leaf, "nbytes")
+        )
+        self._free: List[int] = list(range(self.num_slots))
+        self._owner: Dict[int, Any] = {}  # slot -> opaque session handle
+        self._lock = threading.Lock()
+
+    # -- host bookkeeping ----------------------------------------------------------
+
+    def _slot_keys(self, seeds) -> jax.Array:
+        return jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
+
+    @property
+    def free_slots(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        with self._lock:
+            return len(self._owner)
+
+    def try_admit(self, session: Any) -> Optional[int]:
+        """Claim a free slot for ``session`` (device state still stale until
+        :meth:`attach` runs); None when the table is full."""
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop(0)
+            self._owner[slot] = session
+            return slot
+
+    def evict(self, slot: int) -> None:
+        """Release ``slot``. The stale device carry is left in place — the next
+        admission's :meth:`attach` overwrites it, so eviction is free."""
+        with self._lock:
+            self._owner.pop(slot, None)
+            if slot not in self._free:
+                self._free.append(slot)
+
+    # -- device programs -----------------------------------------------------------
+
+    def attach(self, slot_seeds: Dict[int, int]) -> None:
+        """Initialize the carries of ``slot_seeds``'s slots (slot -> session
+        seed) in ONE fixed-shape donated program — any subset, no recompile."""
+        if not slot_seeds:
+            return
+        mask = np.zeros((self.num_slots,), np.bool_)
+        seeds = [0] * self.num_slots
+        for slot, seed in slot_seeds.items():
+            mask[slot] = True
+            seeds[slot] = int(seed)
+        keys = self._slot_keys(seeds)
+        self.states = self._attach(self.policy.params, self.states, keys, jnp.asarray(mask))
+
+    def step(self, obs: Dict[str, np.ndarray], mask: np.ndarray) -> np.ndarray:
+        """One serving tick: ``obs`` are ``[S, ...]`` host arrays (zeros in
+        masked-out rows), ``mask`` the pending-request slots. Returns the
+        ``[S, ...]`` action array (masked rows carry garbage — the caller only
+        reads rows it asked for)."""
+        actions, self.states = self._step(
+            self.policy.params, self.states, obs, jnp.asarray(mask)
+        )
+        return np.asarray(actions)
+
+    # -- introspection -------------------------------------------------------------
+
+    def state_bytes(self) -> int:
+        """Device bytes the whole slot table holds — the O(S) session-state
+        footprint reported in serving telemetry (constant; cached at init)."""
+        return self._state_bytes
+
+    def aot_programs(self) -> Tuple[Any, Any]:
+        """The (step, attach) jitted callables for AOT lowering/priming — the
+        TPU-readiness tests lower exactly what serving runs."""
+        return self._step, self._attach

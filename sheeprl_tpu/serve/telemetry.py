@@ -1,0 +1,330 @@
+"""Serving telemetry: the run-telemetry contract, spoken by an inference server.
+
+A serving run writes the same ``telemetry.jsonl`` stream a training run does
+(``start`` / ``window`` / ``health`` / ``summary`` events with the stream
+identity triple — ``obs/jsonl.py``), so the whole PR 2–5 consumer stack works
+on it unchanged: ``sheeprl.py watch`` follows it live and exits on its summary,
+``sheeprl.py diagnose`` runs the detector catalog over it (including the
+serving-specific detectors — occupancy_collapse, latency_regression,
+slot_starvation), ``compare``/``bench-diff`` match it by fingerprint.
+
+What differs is the payload: a serving window's unit of progress is one
+*served session step* (``sps`` = served slot-steps/sec — the number ``watch``
+renders), and each window carries a ``serve`` block:
+
+- ``latency_ms``: p50/p99/mean request latency (submit → action delivered),
+- ``occupancy``: mean fraction of slots doing useful work per tick,
+- ``sessions``: active / started / finished counters + sessions/sec,
+- ``queue_depth``: sessions waiting for a free slot (slot starvation signal),
+- ``ticks`` and ``state_bytes`` (the O(S) device session-state footprint).
+
+Phase attribution reuses the training schema with two serving phases:
+``serve_step`` (device program wall time) and ``serve_wait`` (idle, waiting for
+client requests) — so ``diagnose``'s unattributed-time invariant holds on a
+mostly-idle server too.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sheeprl_tpu.obs.compile_monitor import compile_snapshot, install_compile_monitor
+from sheeprl_tpu.obs.jsonl import JsonlEventSink
+from sheeprl_tpu.obs.telemetry import (
+    _rss_bytes,
+    device_memory,
+    rss_peak_bytes,
+)
+
+__all__ = ["ServingTelemetry"]
+
+_HISTORY_CAP = 512
+_LATENCY_RESERVOIR = 65536  # bounded overall-latency sample for the summary
+
+
+def _percentiles(samples) -> Optional[Dict[str, float]]:
+    if not len(samples):
+        return None
+    arr = np.asarray(samples, dtype=np.float64)
+    return {
+        "p50": round(float(np.percentile(arr, 50)), 3),
+        "p99": round(float(np.percentile(arr, 99)), 3),
+        "mean": round(float(arr.mean()), 3),
+        "max": round(float(arr.max()), 3),
+    }
+
+
+class ServingTelemetry:
+    """JSONL stream + live diagnosis for one serving run. The server calls
+    :meth:`observe_tick` once per batch tick and :meth:`close` at shutdown;
+    windows are emitted every ``every`` served steps."""
+
+    def __init__(
+        self,
+        fabric: Any,
+        cfg: Any,
+        log_dir: Optional[str],
+        *,
+        enabled: bool = True,
+        every: int = 256,
+        serve_info: Optional[Dict[str, Any]] = None,
+        jsonl_path: Optional[str] = None,
+        diagnosis: bool = True,
+    ) -> None:
+        self.enabled = bool(enabled)
+        self.every = max(int(every), 1)
+        self.diagnosis = bool(diagnosis)
+        self._device = getattr(fabric, "device", None)
+        self._sink: Optional[JsonlEventSink] = None
+        self._history: List[Dict[str, Any]] = []
+        self._last_diagnosis_key: Any = None
+
+        # cumulative counters
+        self._steps = 0
+        self._ticks = 0
+        self._sessions_started = 0
+        self._sessions_finished = 0
+        self._sessions_active = 0
+        self._queue_depth = 0
+        self._state_bytes: Optional[int] = None
+        self._peak_hbm = 0
+
+        # window accumulators
+        self._window_idx = 0
+        self._win_steps = 0
+        self._win_ticks = 0
+        self._win_occupancy_sum = 0.0
+        self._win_latencies: List[float] = []
+        self._win_step_seconds = 0.0
+        self._win_wait_seconds = 0.0
+        self._win_queue_sum = 0
+        self._win_sessions_started = 0
+        self._win_sessions_finished = 0
+        self._all_latencies: deque = deque(maxlen=_LATENCY_RESERVOIR)
+
+        self._start_time = time.perf_counter()
+        self._anchor_time = self._start_time
+        self._compile_base = {"count": 0, "seconds": 0.0}
+        self._compile_last = {"count": 0, "seconds": 0.0}
+
+        if not self.enabled:
+            return
+        install_compile_monitor()
+        self._compile_base = compile_snapshot()
+        self._compile_last = dict(self._compile_base)
+        path = jsonl_path or (
+            os.path.join(log_dir, "telemetry.jsonl") if log_dir else "telemetry.jsonl"
+        )
+        self._sink = JsonlEventSink(path, rank=0, attempt=0)
+        from sheeprl_tpu.obs.fingerprint import run_fingerprint
+
+        try:
+            fingerprint: Optional[Dict[str, Any]] = run_fingerprint(cfg, fabric)
+        except Exception:
+            fingerprint = None
+        start_event = dict(
+            platform=getattr(self._device, "platform", None),
+            device_kind=getattr(self._device, "device_kind", None),
+            world_size=1,
+            every=self.every,
+            compile_warmup_steps=0,
+            serve=dict(serve_info or {}),
+            fingerprint=fingerprint,
+        )
+        self._append_history("start", start_event)
+        self._sink.emit("start", step=None, **start_event)
+
+    # -- per-tick hook -------------------------------------------------------------
+
+    def observe_tick(
+        self,
+        *,
+        batch: int,
+        slots: int,
+        active: int,
+        queue_depth: int,
+        step_seconds: float,
+        wait_seconds: float,
+        latencies_ms: Optional[List[float]] = None,
+        started: int = 0,
+        finished: int = 0,
+        state_bytes: Optional[int] = None,
+    ) -> None:
+        """One server tick: ``batch`` sessions stepped out of ``slots`` total
+        (``active`` attached), after ``wait_seconds`` of coalescing/idle wait
+        and ``step_seconds`` of device program wall time."""
+        if not self.enabled:
+            return
+        self._ticks += 1
+        self._steps += int(batch)
+        self._sessions_started += int(started)
+        self._sessions_finished += int(finished)
+        self._sessions_active = int(active)
+        self._queue_depth = int(queue_depth)
+        if state_bytes is not None:
+            self._state_bytes = int(state_bytes)
+
+        self._win_ticks += 1
+        self._win_steps += int(batch)
+        self._win_occupancy_sum += float(batch) / max(int(slots), 1)
+        self._win_step_seconds += float(step_seconds)
+        self._win_wait_seconds += float(wait_seconds)
+        self._win_queue_sum += int(queue_depth)
+        self._win_sessions_started += int(started)
+        self._win_sessions_finished += int(finished)
+        if latencies_ms:
+            self._win_latencies.extend(float(v) for v in latencies_ms)
+            self._all_latencies.extend(float(v) for v in latencies_ms)
+
+        if self._win_steps >= self.every:
+            self._emit_window()
+
+    # -- window / summary ----------------------------------------------------------
+
+    def _serve_block(self, wall: float) -> Dict[str, Any]:
+        ticks = max(self._win_ticks, 1)
+        return {
+            "latency_ms": _percentiles(self._win_latencies),
+            "occupancy": round(self._win_occupancy_sum / ticks, 4),
+            "sessions": {
+                "active": self._sessions_active,
+                "started": self._win_sessions_started,
+                "finished": self._win_sessions_finished,
+                "per_sec": round(self._win_sessions_finished / wall, 3) if wall > 0 else None,
+            },
+            "queue_depth": round(self._win_queue_sum / ticks, 2),
+            "ticks": self._win_ticks,
+            "state_bytes": self._state_bytes,
+        }
+
+    def _emit_window(self, final: bool = False) -> None:
+        now = time.perf_counter()
+        wall = max(now - self._anchor_time, 1e-9)
+        steps = self._win_steps
+        if steps == 0 and final:
+            return
+
+        snap = compile_snapshot()
+        window_compiles = snap["count"] - self._compile_last["count"]
+        window_compile_seconds = snap["seconds"] - self._compile_last["seconds"]
+        self._compile_last = dict(snap)
+
+        hbm = device_memory(self._device) if self._device is not None else None
+        if hbm and hbm.get("peak_bytes"):
+            self._peak_hbm = max(self._peak_hbm, hbm["peak_bytes"])
+
+        step_s = min(self._win_step_seconds, wall)
+        wait_s = min(self._win_wait_seconds, max(wall - step_s, 0.0))
+        phases = {
+            "serve_step": round(step_s, 4),
+            "serve_wait": round(wait_s, 4),
+            "other": round(max(wall - step_s - wait_s, 0.0), 4),
+        }
+
+        window_event: Dict[str, Any] = dict(
+            step=self._steps,
+            window=self._window_idx,
+            final=bool(final),
+            steps=steps,
+            wall_seconds=round(wall, 4),
+            sps=round(steps / wall, 3),
+            serve=self._serve_block(wall),
+            phases=phases,
+            hbm=hbm,
+            rss_bytes=_rss_bytes(),
+            rss_peak_bytes=rss_peak_bytes(),
+            compile={
+                "count": snap["count"] - self._compile_base["count"],
+                "seconds": round(snap["seconds"] - self._compile_base["seconds"], 3),
+                "window_count": window_compiles,
+                "window_seconds": round(window_compile_seconds, 3),
+            },
+        )
+        self._append_history("window", window_event)
+        if self._sink is not None:
+            self._sink.emit("window", **window_event)
+        if self.diagnosis:
+            self._run_live_diagnosis()
+
+        self._window_idx += 1
+        self._win_steps = 0
+        self._win_ticks = 0
+        self._win_occupancy_sum = 0.0
+        self._win_latencies = []
+        self._win_step_seconds = 0.0
+        self._win_wait_seconds = 0.0
+        self._win_queue_sum = 0
+        self._win_sessions_started = 0
+        self._win_sessions_finished = 0
+        self._anchor_time = now
+
+    def close(self, clean_exit: bool = True) -> None:
+        """Flush the last partial window and the run summary; idempotent."""
+        if not self.enabled:
+            return
+        self.enabled = False
+        if self._win_steps > 0:
+            self._emit_window(final=True)
+        if self._sink is None:
+            return
+        wall = time.perf_counter() - self._start_time
+        snap = compile_snapshot()
+        hbm = device_memory(self._device) if self._device is not None else None
+        peak_hbm = max(self._peak_hbm, (hbm or {}).get("peak_bytes", 0)) or None
+        self._sink.emit(
+            "summary",
+            step=self._steps,
+            clean_exit=bool(clean_exit),
+            windows=self._window_idx,
+            total_steps=self._steps,
+            wall_seconds=round(wall, 3),
+            sps=round(self._steps / wall, 3) if wall > 0 else None,
+            serve={
+                "latency_ms": _percentiles(self._all_latencies),
+                "sessions_started": self._sessions_started,
+                "sessions_finished": self._sessions_finished,
+                "sessions_per_sec": round(self._sessions_finished / wall, 3)
+                if wall > 0
+                else None,
+                "ticks": self._ticks,
+                "state_bytes": self._state_bytes,
+            },
+            compile={
+                "count": snap["count"] - self._compile_base["count"],
+                "seconds": round(snap["seconds"] - self._compile_base["seconds"], 3),
+            },
+            hbm_peak_bytes=peak_hbm,
+            rss_peak_bytes=rss_peak_bytes(),
+            health="ok",
+        )
+        self._sink.close()
+        self._sink = None
+
+    # -- internals -----------------------------------------------------------------
+
+    def _append_history(self, event: str, payload: Dict[str, Any]) -> None:
+        self._history.append({"event": event, "time": round(time.time(), 3), **payload})
+        if len(self._history) > _HISTORY_CAP:
+            del self._history[: len(self._history) - _HISTORY_CAP]
+
+    def _run_live_diagnosis(self) -> None:
+        from sheeprl_tpu.obs.diagnose import run_detectors
+
+        findings = run_detectors(self._history)
+        key = tuple(sorted((f["detector"], f["severity"]) for f in findings))
+        if findings and key != self._last_diagnosis_key and self._sink is not None:
+            self._sink.emit(
+                "health",
+                step=self._steps,
+                status="diagnosis",
+                findings=[
+                    {k: f[k] for k in ("detector", "severity", "summary", "suggestion")}
+                    for f in findings
+                ],
+            )
+        self._last_diagnosis_key = key

@@ -13,6 +13,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 algorithm_registry: Dict[str, List[Dict[str, Any]]] = {}
 # algo name -> {"module": str, "entrypoint": str}
 evaluation_registry: Dict[str, List[Dict[str, Any]]] = {}
+# algo name -> {"module": str, "entrypoint": str} — get_serve_policy extractors
+# (sheeprl_tpu/serve): build a batched, slot-steppable policy from a checkpoint
+serve_registry: Dict[str, List[Dict[str, Any]]] = {}
 
 
 def _register_algorithm(fn: Callable, decoupled: bool = False) -> Callable:
@@ -49,6 +52,29 @@ def register_evaluation(algorithms: Sequence[str]) -> Callable:
         return _register_evaluation(fn, algorithms=algorithms)
 
     return wrap
+
+
+def register_serve_policy(algorithms: Sequence[str]) -> Callable:
+    """Register a per-family ``get_serve_policy(fabric, cfg, state)`` extractor
+    (lives next to the family's ``evaluate`` registration): returns the
+    :class:`sheeprl_tpu.serve.ServePolicy` the batching inference server steps."""
+
+    def wrap(fn: Callable) -> Callable:
+        module = fn.__module__
+        entrypoint = fn.__name__
+        algos = [algorithms] if isinstance(algorithms, str) else list(algorithms)
+        for algo in algos:
+            serve_registry.setdefault(algo, []).append(
+                {"module": module, "entrypoint": entrypoint, "name": algo}
+            )
+        return fn
+
+    return wrap
+
+
+def get_serve(name: str) -> Optional[Dict[str, Any]]:
+    regs = serve_registry.get(name)
+    return regs[0] if regs else None
 
 
 def get_algorithm(name: str) -> Optional[Dict[str, Any]]:

@@ -350,3 +350,93 @@ def test_bench_py_against_gates_regression(tmp_path):
         rc = bench._gate_against(result, bench._parse_args(["--against", old_path, "--fail-on", "regression"]))
     assert rc == 0
     assert json.loads(stdout.getvalue().strip().splitlines()[-1])["regressions"] == []
+
+
+def _serve_load_json(sps=40.0, p99=6.0):
+    """The serve_load workload shape: sessions/sec headline with the p99
+    step-latency companion riding in NESTED extras (bench.py _bench_serve_load)."""
+    fp = {**_FP, "algo": "ppo"}
+    return {
+        "metric": "ppo_env_steps_per_sec",
+        "value": 100.0,
+        "unit": "env-steps/sec",
+        "conditions": {"fingerprint": _FP},
+        "extras": [
+            {
+                "metric": "serve_load_sessions_per_sec",
+                "value": sps,
+                "unit": "sessions/sec (open-loop synthetic load)",
+                "conditions": {"fingerprint": fp},
+                "extras": [
+                    {
+                        "metric": "serve_load_step_latency_p99_ms",
+                        "value": p99,
+                        "unit": "ms (p99 step latency)",
+                        "conditions": {"fingerprint": fp},
+                    }
+                ],
+            }
+        ],
+    }
+
+
+def test_lower_is_better_unit_directions():
+    """Satellite: units ending in _ms / starting with ms|seconds|bytes gate
+    lower-is-better; rate units gate higher-is-better — the serve_load p99
+    metric can never be gated backwards."""
+    from sheeprl_tpu.obs.compare import _lower_is_better
+
+    for unit in (
+        "ms (p99 step latency)",
+        "milliseconds",
+        "latency_ms",
+        "seconds/train-step",
+        "seconds",
+        "bytes/device (DV3 params, [2,4] data x model mesh)",
+    ):
+        assert _lower_is_better(unit), unit
+    for unit in (
+        "env-steps/sec",
+        "sessions/sec (open-loop synthetic load)",
+        "env-steps/sec (steady-state)",
+        "MFU (fraction of chip peak bf16)",
+        "atoms/sec",  # contains the "ms/" byte sequence — must NOT match
+        "items/sec",
+    ):
+        assert not _lower_is_better(unit), unit
+
+
+def test_load_bench_workloads_flattens_nested_extras():
+    workloads = load_bench_workloads(_serve_load_json())
+    names = [w["metric"] for w in workloads]
+    assert names == [
+        "ppo_env_steps_per_sec",
+        "serve_load_sessions_per_sec",
+        "serve_load_step_latency_p99_ms",
+    ]
+    assert all("extras" not in w for w in workloads)
+
+
+def test_serve_load_p99_gates_lower_is_better():
+    """p99 UP = regression, p99 DOWN = improvement; sessions/sec keeps the
+    opposite direction — both gated from one nested serve_load entry."""
+    old = _serve_load_json(sps=40.0, p99=6.0)
+    worse = _serve_load_json(sps=40.0, p99=9.0)  # +50% latency
+    diff = bench_diff(old, worse)
+    by_metric = {w["metric"]: w for w in diff["workloads"]}
+    row = by_metric["serve_load_step_latency_p99_ms"]
+    assert row["direction"] == "lower-is-better"
+    assert row["status"] == "regression"
+    assert "serve_load_step_latency_p99_ms" in diff["regressions"]
+
+    better = _serve_load_json(sps=40.0, p99=3.0)  # -50% latency
+    diff = bench_diff(old, better)
+    by_metric = {w["metric"]: w for w in diff["workloads"]}
+    assert by_metric["serve_load_step_latency_p99_ms"]["status"] == "improvement"
+
+    slower = _serve_load_json(sps=20.0, p99=6.0)  # -50% sessions/sec
+    diff = bench_diff(old, slower)
+    by_metric = {w["metric"]: w for w in diff["workloads"]}
+    row = by_metric["serve_load_sessions_per_sec"]
+    assert row["direction"] == "higher-is-better"
+    assert row["status"] == "regression"

@@ -285,3 +285,96 @@ def test_diagnose_cli_end_to_end(tmp_path):
         timeout=110,
     )
     assert proc.returncode == 2 and "no telemetry" in proc.stderr
+
+
+def _serve_window(step, occupancy=0.9, p50=1.0, p99=4.0, queue=0.0, active=3, final=False):
+    """A serving window (sheeprl_tpu/serve/telemetry.py shape)."""
+    return {
+        "event": "window",
+        "time": 1000.0 + step,
+        "step": step,
+        "final": final,
+        "wall_seconds": 5.0,
+        "sps": step / 5.0 if step else 1.0,
+        "serve": {
+            "latency_ms": {"p50": p50, "p99": p99, "mean": p50, "max": p99},
+            "occupancy": occupancy,
+            "sessions": {"active": active, "started": 0, "finished": 0, "per_sec": 1.0},
+            "queue_depth": queue,
+            "ticks": 50,
+        },
+        "phases": {"serve_step": 3.0, "serve_wait": 1.8, "other": 0.2},
+        "compile": {"window_count": 0, "window_seconds": 0.0},
+    }
+
+
+def test_occupancy_collapse_detector():
+    healthy = [_serve_window(s * 100, occupancy=0.9) for s in range(1, 9)]
+    assert not _by(run_detectors(healthy), "occupancy_collapse")
+    # occupancy falls away in the late half while sessions stay attached
+    collapsed = [_serve_window(s * 100, occupancy=0.9) for s in range(1, 5)] + [
+        _serve_window((4 + s) * 100, occupancy=0.3, active=3) for s in range(1, 5)
+    ]
+    (f,) = _by(run_detectors(collapsed), "occupancy_collapse")
+    assert f["severity"] == "warning"
+    assert f["metrics"]["late_occupancy"] < f["metrics"]["early_occupancy"]
+    # a drained server (no sessions) is a quiet server, not a collapse
+    drained = [_serve_window(s * 100, occupancy=0.9) for s in range(1, 5)] + [
+        _serve_window((4 + s) * 100, occupancy=0.1, active=0) for s in range(1, 5)
+    ]
+    assert not _by(run_detectors(drained), "occupancy_collapse")
+    # deeper collapse escalates to critical
+    severe = [_serve_window(s * 100, occupancy=0.9) for s in range(1, 5)] + [
+        _serve_window((4 + s) * 100, occupancy=0.1, active=3) for s in range(1, 5)
+    ]
+    (f,) = _by(run_detectors(severe), "occupancy_collapse")
+    assert f["severity"] == "critical"
+
+
+def test_latency_regression_detector():
+    steady = [_serve_window(s * 100, p99=4.0) for s in range(1, 7)]
+    assert not _by(run_detectors(steady), "latency_regression")
+    # a window-0 spike is startup (cold compile), never a regression
+    cold_start = [_serve_window(100, p99=400.0)] + [
+        _serve_window((1 + s) * 100, p99=4.0) for s in range(1, 7)
+    ]
+    assert not _by(run_detectors(cold_start), "latency_regression")
+    # late windows far above the run median regress
+    regressed = [_serve_window(s * 100, p99=4.0) for s in range(1, 5)] + [
+        _serve_window((4 + s) * 100, p99=30.0) for s in range(1, 3)
+    ]
+    (f,) = _by(run_detectors(regressed), "latency_regression")
+    assert f["severity"] == "critical"  # >4x median across >=2 windows
+    assert f["metrics"]["worst_p99_ms"] == 30.0
+    mild = [_serve_window(s * 100, p99=4.0) for s in range(1, 6)] + [
+        _serve_window(600, p99=10.0)
+    ]
+    (f,) = _by(run_detectors(mild), "latency_regression")
+    assert f["severity"] == "warning"
+
+
+def test_slot_starvation_detector():
+    free = [_serve_window(s * 100, occupancy=0.7, queue=0.0) for s in range(1, 6)]
+    assert not _by(run_detectors(free), "slot_starvation")
+    starved = [
+        {"event": "start", "time": 0.0, "serve": {"slots": 4}},
+    ] + [_serve_window(s * 100, occupancy=1.0, queue=3.0) for s in range(1, 6)]
+    (f,) = _by(run_detectors(starved), "slot_starvation")
+    assert f["severity"] == "warning"
+    assert f["metrics"]["slots"] == 4
+    assert "serve.slots" in f["suggestion"]
+    # queue without a full table is coalescing, not starvation
+    queued_not_full = [
+        _serve_window(s * 100, occupancy=0.5, queue=2.0) for s in range(1, 6)
+    ]
+    assert not _by(run_detectors(queued_not_full), "slot_starvation")
+
+
+def test_serving_detectors_ignore_training_streams():
+    """Training windows carry no `serve` block: the serving detectors are
+    structural no-ops on every existing stream."""
+    events = [_window(s * 100) for s in range(1, 8)]
+    findings = run_detectors(
+        events, detectors=("occupancy_collapse", "latency_regression", "slot_starvation")
+    )
+    assert findings == []

@@ -1,0 +1,209 @@
+"""Per-family serving-policy parity tests.
+
+Two properties pin the serving tier's correctness:
+
+1. **Evaluate parity** (feedforward, greedy): a served PPO action equals the
+   sequential evaluation path's computation (normalize → agent.apply →
+   policy_output mode → argmax) on the same observation — bit-for-bit.
+2. **Batch independence** (all families): a session's action stream through
+   the CONCURRENT server equals the same session served alone, step for step —
+   per-slot PRNG keys + slot masking make every session a pure function of
+   (params, seed, obs sequence), whatever else shares its batch.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.parallel.fabric import Fabric
+from sheeprl_tpu.serve.policy import resolve_serve_policy
+from sheeprl_tpu.serve.server import PolicyServer
+
+pytestmark = pytest.mark.serve
+
+_PPO_OVERRIDES = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "algo.cnn_keys.encoder=[]",
+    "algo.mlp_keys.encoder=[state]",
+    "metric.log_level=0",
+]
+
+_DV3_OVERRIDES = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "metric.log_level=0",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.cnn_keys.decoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.mlp_keys.decoder=[state]",
+]
+
+
+def _fabric() -> Fabric:
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric._setup()
+    return fabric
+
+
+def _policy(overrides):
+    cfg = compose(overrides)
+    cfg["serve"] = {"greedy": True}
+    return cfg, resolve_serve_policy(_fabric(), cfg, None)
+
+
+def _random_obs_seq(policy, steps, seed):
+    rng = np.random.default_rng(seed)
+    seq = []
+    for _ in range(steps):
+        obs = {}
+        for k, spec in policy.obs_spec.items():
+            if np.issubdtype(np.dtype(spec.dtype), np.integer):
+                obs[k] = rng.integers(0, 255, spec.shape).astype(spec.dtype)
+            else:
+                obs[k] = rng.normal(size=spec.shape).astype(spec.dtype)
+        seq.append(obs)
+    return seq
+
+
+def _serve_streams(policy, obs_seqs, slots):
+    """Serve each (seed, obs sequence) as one concurrent session; returns the
+    per-session action lists."""
+    out = {}
+    with PolicyServer(policy, slots=slots, max_batch_wait_ms=1.0) as server:
+
+        def client(i):
+            session = server.open_session(seed=1000 + i)
+            out[i] = [np.asarray(session.step(obs)) for obs in obs_seqs[i]]
+            session.close()
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(len(obs_seqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    return out
+
+
+def test_ppo_serve_matches_sequential_evaluate_path():
+    """Served greedy PPO actions == the evaluate path's computation, exactly."""
+    from sheeprl_tpu.algos.ppo.agent import build_agent, policy_output
+    from sheeprl_tpu.algos.ppo.utils import normalize_obs
+
+    cfg, policy = _policy(_PPO_OVERRIDES)
+    obs_seq = _random_obs_seq(policy, 6, seed=0)
+    served = _serve_streams(policy, [obs_seq], slots=2)[0]
+
+    # the evaluate computation (ppo.utils.test): normalize -> apply -> mode -> argmax
+    import gymnasium as gym
+
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, None, "parity-probe")()
+    agent, _ = build_agent(
+        _fabric(), (env.action_space.n,), False, cfg, env.observation_space, jax.random.PRNGKey(cfg.seed)
+    )
+    env.close()
+    params = policy.params
+    for obs, served_action in zip(obs_seq, served):
+        batched = {"state": jnp.asarray(obs["state"], jnp.float32).reshape(1, -1)}
+        actor_outs, values = agent.apply({"params": params}, batched)
+        out = policy_output(
+            actor_outs, values, jax.random.PRNGKey(0), (env.action_space.n,), False, greedy=True
+        )
+        expected = int(np.asarray(out["actions"][0]).argmax())
+        assert int(served_action) == expected
+
+
+@pytest.mark.timeout(300)
+def test_dreamer_v3_sessions_are_batch_independent():
+    """The RSSM carry (h, z, prev action, key) rides the slot table: a session
+    served among concurrent neighbours produces the same action stream as the
+    same session served ALONE on an otherwise-empty table."""
+    _, policy = _policy(_DV3_OVERRIDES)
+    seqs = [_random_obs_seq(policy, 5, seed=i) for i in range(3)]
+    concurrent = _serve_streams(policy, seqs, slots=2)  # 3 sessions, 2 slots
+    alone = _serve_streams(policy, seqs[:1], slots=2)
+    np.testing.assert_array_equal(np.stack(concurrent[0]), np.stack(alone[0]))
+    # different sessions (different seeds/obs) are genuinely different streams
+    assert not np.array_equal(np.stack(concurrent[0]), np.stack(concurrent[1]))
+
+
+def test_ppo_recurrent_carry_advances_and_is_deterministic():
+    overrides = [
+        "exp=ppo_recurrent",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "algo.cnn_keys.encoder=[]",
+        "algo.mlp_keys.encoder=[state]",
+        "metric.log_level=0",
+    ]
+    _, policy = _policy(overrides)
+    carry = policy.init_slot(policy.params, jax.random.PRNGKey(0))
+    assert set(carry) == {"prev_action", "hx", "cx", "key"}
+    obs_seq = _random_obs_seq(policy, 4, seed=1)
+    a = _serve_streams(policy, [obs_seq], slots=1)[0]
+    b = _serve_streams(policy, [obs_seq], slots=3)[0]
+    np.testing.assert_array_equal(np.stack(a), np.stack(b))
+
+
+def test_sac_serve_greedy_matches_evaluate_path():
+    overrides = [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "algo.mlp_keys.encoder=[state]",
+        "metric.log_level=0",
+    ]
+    from sheeprl_tpu.algos.sac.agent import greedy_action
+
+    cfg, policy = _policy(overrides)
+    obs_seq = _random_obs_seq(policy, 4, seed=2)
+    served = _serve_streams(policy, [obs_seq], slots=2)[0]
+
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, None, "parity-probe")()
+    actor, _, params = build_agent(
+        _fabric(), cfg, env.observation_space, env.action_space, jax.random.PRNGKey(cfg.seed), None
+    )
+    scale = (env.action_space.high - env.action_space.low) / 2.0
+    bias = (env.action_space.high + env.action_space.low) / 2.0
+    env.close()
+    for obs, served_action in zip(obs_seq, served):
+        flat = jnp.asarray(obs["state"], jnp.float32).reshape(1, -1)
+        mean, _ = actor.apply({"params": params["actor"]}, flat)
+        expected = np.asarray(greedy_action(mean, scale, bias)).reshape(served_action.shape)
+        np.testing.assert_allclose(served_action, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_unregistered_algo_raises_with_catalog():
+    cfg = compose(_PPO_OVERRIDES)
+    cfg["algo"]["name"] = "definitely_not_registered"
+    with pytest.raises(ValueError, match="no serving policy registered"):
+        resolve_serve_policy(_fabric(), cfg, None)

@@ -1,0 +1,87 @@
+"""Shared checkpoint-path resolution (satellite of ISSUE 9): ``sheeprl-eval``
+and ``sheeprl.py serve`` accept a checkpoint FILE, a run dir, or a multi-rank
+checkpoint set, resolved through the crash supervisor's manifest-validated
+discovery (resilience/discovery.py resolve_checkpoint_path)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from sheeprl_tpu.resilience.discovery import resolve_checkpoint_path
+
+pytestmark = pytest.mark.serve
+
+
+def _ckpt(dirpath, name, content=b"x") -> str:
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, name)
+    with open(path, "wb") as fh:
+        fh.write(content)
+    return path
+
+
+def test_exact_file_resolves_to_itself(tmp_path):
+    path = _ckpt(str(tmp_path), "ckpt_100_0.ckpt")
+    assert resolve_checkpoint_path(path) == path
+
+
+def test_run_dir_resolves_to_newest_valid(tmp_path):
+    ckdir = str(tmp_path / "version_0" / "checkpoint")
+    old = _ckpt(ckdir, "ckpt_100_0.ckpt")
+    os.utime(old, (time.time() - 100, time.time() - 100))
+    new = _ckpt(ckdir, "ckpt_200_0.ckpt")
+    assert resolve_checkpoint_path(str(tmp_path)) == new
+
+
+def test_incomplete_manifest_vetoes_multi_rank_set(tmp_path):
+    """A torn multi-rank set (incomplete manifest) can never resolve — the
+    previous complete step wins."""
+    ckdir = str(tmp_path / "checkpoint")
+    good = _ckpt(ckdir, "ckpt_100_0.ckpt")
+    os.utime(good, (time.time() - 100, time.time() - 100))
+    _ckpt(ckdir, "ckpt_200_0.ckpt")
+    _ckpt(ckdir, "ckpt_200_1.ckpt")
+    with open(os.path.join(ckdir, "ckpt_200.manifest.json"), "w") as fh:
+        json.dump({"complete": False, "ranks_expected": [0, 1], "ranks_committed": [0]}, fh)
+    assert resolve_checkpoint_path(str(tmp_path)) == good
+
+
+def test_empty_dir_and_missing_path_raise(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no valid checkpoint"):
+        resolve_checkpoint_path(str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="no such file"):
+        resolve_checkpoint_path(str(tmp_path / "nope"))
+
+
+def test_eval_cli_accepts_run_dir(tmp_path, monkeypatch):
+    """cli.evaluation resolves checkpoint_path through the same helper — a run
+    dir with a config.yaml two levels above the checkpoint evaluates."""
+    import yaml
+
+    from sheeprl_tpu.cli import evaluation
+
+    # fabricate a run tree with a config the eval path can read; the checkpoint
+    # itself is junk — asserting the error comes AFTER resolution is enough here
+    run_dir = tmp_path / "version_0"
+    ckdir = run_dir / "checkpoint"
+    _ckpt(str(ckdir), "ckpt_64_0.ckpt", b"not-a-pickle")
+    with open(run_dir / "config.yaml", "w") as fh:
+        yaml.safe_dump(
+            {
+                "env": {"id": "discrete_dummy", "num_envs": 1, "capture_video": False},
+                "algo": {"name": "ppo"},
+                "fabric": {"accelerator": "cpu"},
+                "float32_matmul_precision": "high",
+                "seed": 5,
+            },
+            fh,
+        )
+    with pytest.raises(Exception) as excinfo:
+        evaluation([f"checkpoint_path={tmp_path}"])
+    # resolution succeeded (no FileNotFoundError about the path): the failure is
+    # the junk checkpoint payload, proving the dir resolved to the .ckpt file
+    assert not isinstance(excinfo.value, FileNotFoundError)

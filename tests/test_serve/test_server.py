@@ -1,0 +1,198 @@
+"""Continuous-batching server unit tests: session lifecycle, batching
+determinism, overload admission, shutdown semantics, telemetry stream shape,
+and the synthetic load driver."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.drivers import run_synthetic_load
+from sheeprl_tpu.serve.policy import ObsSpec, ServePolicy
+from sheeprl_tpu.serve.server import PolicyServer, ServerClosed
+from sheeprl_tpu.serve.telemetry import ServingTelemetry
+
+pytestmark = pytest.mark.serve
+
+
+def _echo_policy() -> ServePolicy:
+    """action = seed-keyed noise + running count: distinguishes sessions AND steps."""
+    params = {"gain": jnp.float32(100.0)}
+
+    def init_slot(params, key):
+        return {"count": jnp.float32(0), "key": key}
+
+    def step_slot(params, carry, obs):
+        count = carry["count"] + 1
+        key, k = jax.random.split(carry["key"])
+        action = carry["count"] * params["gain"] + obs["state"].sum() + jax.random.uniform(k, ())
+        return action, {"count": count, "key": key}
+
+    return ServePolicy(
+        algo="echo",
+        params=params,
+        init_slot=init_slot,
+        step_slot=step_slot,
+        obs_spec={"state": ObsSpec((2,), np.float32)},
+        action_shape=(),
+    )
+
+
+class _Fabric:
+    device = jax.devices("cpu")[0]
+
+
+_CFG = {"algo": {"name": "echo"}, "env": {}}
+
+
+def _drive(server, n_sessions, n_steps, obs_fn=None):
+    out = {}
+
+    def client(i):
+        s = server.open_session(seed=i)
+        acts = []
+        for t in range(n_steps):
+            obs = {"state": (obs_fn(i, t) if obs_fn else np.full((2,), i, np.float32))}
+            acts.append(float(s.step(obs)))
+        s.close()
+        out[i] = acts
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n_sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return out
+
+
+def test_sessions_complete_and_streams_are_batch_independent():
+    """More sessions than slots: everyone completes, and each session's action
+    stream equals the same session served ALONE — batch composition and
+    admission order cannot perturb a session (per-slot PRNG keys + masking)."""
+    policy = _echo_policy()
+    with PolicyServer(policy, slots=2, max_batch_wait_ms=1.0) as server:
+        out = _drive(server, 5, 8)
+    assert sorted(out) == [0, 1, 2, 3, 4]
+    assert all(len(v) == 8 for v in out.values())
+    with PolicyServer(policy, slots=2, max_batch_wait_ms=1.0) as server:
+        alone = _drive(server, 1, 8)  # session seed=0, empty table
+    assert alone[0] == out[0]
+
+
+def test_sequential_steps_within_session_advance_state():
+    policy = _echo_policy()
+    with PolicyServer(policy, slots=1, max_batch_wait_ms=0.5) as server:
+        out = _drive(server, 1, 4, obs_fn=lambda i, t: np.zeros((2,), np.float32))
+    # count * 100 + noise: steps are strictly ordered, no step lost or repeated
+    rounded = [int(a // 100) for a in out[0]]
+    assert rounded == [0, 1, 2, 3]
+
+
+def test_closed_server_rejects_and_wakes_clients():
+    policy = _echo_policy()
+    server = PolicyServer(policy, slots=1).start()
+    server.close()
+    with pytest.raises(ServerClosed):
+        server.open_session()
+
+
+def test_synthetic_load_driver_counts():
+    policy = _echo_policy()
+    with PolicyServer(policy, slots=4, max_batch_wait_ms=1.0) as server:
+        load = run_synthetic_load(server, sessions=6, steps_per_session=5, seed=3)
+    assert load["sessions_finished"] == 6
+    assert load["errors"] == 0
+    assert load["steps"] == 30
+    assert load["sessions_per_sec"] > 0
+
+
+def test_serving_telemetry_stream_shape(tmp_path):
+    """The serving stream speaks the run-telemetry contract: start (fingerprint
+    + serve info), windows with sps/serve/phases/compile, a clean-exit summary
+    — what `watch` and `diagnose` consume unchanged."""
+    policy = _echo_policy()
+    tel = ServingTelemetry(
+        _Fabric(), _CFG, str(tmp_path), every=8, serve_info={"slots": 2, "max_batch_wait_ms": 1.0}
+    )
+    with PolicyServer(policy, slots=2, max_batch_wait_ms=1.0, telemetry=tel) as server:
+        _drive(server, 3, 8)
+    events = [json.loads(line) for line in (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "start" and kinds[-1] == "summary"
+    start = events[0]
+    assert start["serve"]["slots"] == 2
+    assert "fingerprint" in start
+    windows = [e for e in events if e["event"] == "window"]
+    assert windows, "no telemetry window emitted"
+    for w in windows:
+        assert w["sps"] > 0
+        serve = w["serve"]
+        assert 0.0 <= serve["occupancy"] <= 1.0
+        assert serve["latency_ms"]["p99"] >= serve["latency_ms"]["p50"] > 0
+        phases = w["phases"]
+        assert set(phases) == {"serve_step", "serve_wait", "other"}
+        assert sum(phases.values()) == pytest.approx(w["wall_seconds"], rel=0.05)
+    summary = events[-1]
+    assert summary["clean_exit"] is True
+    assert summary["total_steps"] == 24
+    assert summary["serve"]["sessions_started"] == 3
+    # identity triple for the streams merge
+    assert all({"rank", "attempt", "seq"} <= set(e) for e in events)
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+
+
+def test_watch_follows_serving_stream(tmp_path):
+    """`watch` consumes a finished serving stream and exits with its status."""
+    from sheeprl_tpu.obs.watch import watch_run
+
+    policy = _echo_policy()
+    tel = ServingTelemetry(_Fabric(), _CFG, str(tmp_path), every=8, serve_info={"slots": 2})
+    with PolicyServer(policy, slots=2, max_batch_wait_ms=1.0, telemetry=tel) as server:
+        _drive(server, 2, 8)
+    import io
+
+    out = io.StringIO()
+    rc = watch_run(str(tmp_path), interval=0.05, grace=0.1, timeout=10, plain=True, out=out)
+    assert rc == 0
+    text = out.getvalue()
+    assert "serve:" in text and "occupancy" in text
+
+
+def test_diagnose_green_on_healthy_serving_stream(tmp_path):
+    from sheeprl_tpu.obs.diagnose import diagnose_run
+
+    policy = _echo_policy()
+    tel = ServingTelemetry(_Fabric(), _CFG, str(tmp_path), every=8, serve_info={"slots": 4})
+    with PolicyServer(policy, slots=4, max_batch_wait_ms=1.0, telemetry=tel) as server:
+        _drive(server, 3, 16)
+    result = diagnose_run(str(tmp_path))
+    critical = [f for f in result["findings"] if f["severity"] == "critical"]
+    assert not critical, critical
+
+
+def test_crashed_tick_loop_still_flushes_summary(tmp_path):
+    """A step-program crash must not leave the stream without a summary: close()
+    after a loop crash still writes it, with clean_exit=false (watch's exit
+    protocol and the bench depend on the summary always landing)."""
+
+    def bad_step(params, carry, obs):
+        raise RuntimeError("boom")
+
+    policy = _echo_policy()
+    policy.step_slot = bad_step
+    tel = ServingTelemetry(_Fabric(), _CFG, str(tmp_path), every=8, serve_info={"slots": 1})
+    server = PolicyServer(policy, slots=1, max_batch_wait_ms=0.5, telemetry=tel).start()
+    session = server.open_session(seed=0)
+    with pytest.raises(ServerClosed):
+        session.step({"state": np.zeros((2,), np.float32)})
+    server.close()
+    events = [json.loads(line) for line in (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    summary = events[-1]
+    assert summary["event"] == "summary"
+    assert summary["clean_exit"] is False

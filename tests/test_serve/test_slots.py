@@ -1,0 +1,130 @@
+"""Slot-table unit tests: masking, admission/eviction bookkeeping, fixed-shape
+attach (no recompiles), donation/aliasing of the step program."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.obs.compile_monitor import compile_snapshot, install_compile_monitor
+from sheeprl_tpu.serve.policy import ObsSpec, ServePolicy
+from sheeprl_tpu.serve.slots import SlotTable
+from sheeprl_tpu.utils.mfu import abstractify
+
+pytestmark = pytest.mark.serve
+
+
+def _counter_policy() -> ServePolicy:
+    """Deterministic recurrent toy: carry = running obs sum, action = its total."""
+    params = {"w": jnp.ones((3,))}
+
+    def init_slot(params, key):
+        return {"acc": jnp.zeros((3,)), "key": key}
+
+    def step_slot(params, carry, obs):
+        acc = carry["acc"] + obs["state"].astype(jnp.float32)
+        key, _ = jax.random.split(carry["key"])
+        return (acc * params["w"]).sum(), {"acc": acc, "key": key}
+
+    return ServePolicy(
+        algo="counter",
+        params=params,
+        init_slot=init_slot,
+        step_slot=step_slot,
+        obs_spec={"state": ObsSpec((3,), np.float32)},
+        action_shape=(),
+    )
+
+
+def _obs(values) -> dict:
+    return {"state": np.asarray(values, np.float32)}
+
+
+def test_masked_slots_keep_state_bit_exact():
+    table = SlotTable(_counter_policy(), 3)
+    obs = _obs([[1, 1, 1], [2, 2, 2], [5, 5, 5]])
+    both = np.array([True, True, False])
+    actions = table.step(obs, both)
+    assert actions[0] == pytest.approx(3.0) and actions[1] == pytest.approx(6.0)
+    # slot 1 masked out for two ticks: its carry must not advance
+    only0 = np.array([True, False, False])
+    table.step(obs, only0)
+    table.step(obs, only0)
+    actions = table.step(obs, both)
+    assert actions[0] == pytest.approx(12.0)  # 4 ticks of +3
+    assert actions[1] == pytest.approx(12.0)  # 2 ticks of +6 — masked ticks skipped
+
+
+def test_attach_resets_only_masked_slots():
+    table = SlotTable(_counter_policy(), 2)
+    obs = _obs([[1, 1, 1], [1, 1, 1]])
+    both = np.array([True, True])
+    table.step(obs, both)
+    table.step(obs, both)
+    table.attach({1: 123})  # fresh session lands in slot 1; slot 0 keeps its carry
+    actions = table.step(obs, both)
+    assert actions[0] == pytest.approx(9.0)  # third tick
+    assert actions[1] == pytest.approx(3.0)  # first tick after reset
+
+
+def test_admission_eviction_bookkeeping():
+    table = SlotTable(_counter_policy(), 2)
+    a, b = object(), object()
+    sa, sb = table.try_admit(a), table.try_admit(b)
+    assert {sa, sb} == {0, 1} and table.free_slots == 0
+    assert table.try_admit(object()) is None  # full
+    table.evict(sa)
+    assert table.free_slots == 1 and table.active_slots == 1
+    assert table.try_admit(object()) == sa  # freed slot reused
+
+
+def test_attach_and_step_never_recompile():
+    """Admission/eviction between steps is mask-only — ANY subset of slots
+    attaches through the one compiled program."""
+    install_compile_monitor()
+    table = SlotTable(_counter_policy(), 4)
+    obs = _obs(np.ones((4, 3)))
+    table.step(obs, np.array([True, False, False, False]))
+    table.attach({0: 7})
+    base = compile_snapshot()["count"]
+    # different mask patterns, different attach subsets: zero new compiles
+    for mask in ([True] * 4, [False, True, True, False], [True, False, True, True]):
+        table.step(obs, np.array(mask))
+    table.attach({1: 9, 3: 11})
+    table.attach({2: 5})
+    assert compile_snapshot()["count"] == base
+
+
+def test_step_program_donates_and_has_no_host_calls():
+    """The acceptance AOT gate (ISSUE 9): the serving step program donates the
+    slot states (aliasing attr in MLIR, input_output_alias in optimized HLO)
+    and contains no callback/outfeed/infeed custom calls — steady-state serving
+    moves only obs in / actions out."""
+    policy = _counter_policy()
+    table = SlotTable(policy, 4)
+    step, attach = table.aot_programs()
+    obs = {"state": np.zeros((4, 3), np.float32)}
+    mask = np.zeros((4,), np.bool_)
+    for fn, args in (
+        (step, (policy.params, table.states, obs, mask)),
+        (attach, (policy.params, table.states, table._slot_keys([0] * 4), mask)),
+    ):
+        lowered = fn.lower(*abstractify(args))
+        mlir = lowered.as_text()
+        assert ("tf.aliasing_output" in mlir) or ("jax.buffer_donor" in mlir), (
+            "slot-state donation was dropped in lowering"
+        )
+        for marker in ("callback", "outfeed", "infeed", "custom_call_target"):
+            assert marker not in mlir.lower(), f"host-transfer marker {marker!r} in lowering"
+        hlo = lowered.compile().as_text()
+        assert "input_output_alias" in hlo, "XLA dropped the input/output aliasing"
+        for marker in ("callback", "outfeed", "infeed"):
+            assert marker not in hlo.lower(), f"host-transfer marker {marker!r} in optimized HLO"
+
+
+def test_state_bytes_is_o_of_slots():
+    policy = _counter_policy()
+    small, big = SlotTable(policy, 2), SlotTable(policy, 8)
+    assert big.state_bytes() == 4 * small.state_bytes()

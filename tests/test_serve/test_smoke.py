@@ -1,0 +1,141 @@
+"""End-to-end CPU serving smokes (the ISSUE 9 acceptance path): train a real
+checkpoint through the CLI, serve it with ``sheeprl.py serve`` semantics
+(concurrent env sessions to completion), follow the serving run LIVE with
+``watch``, and gate the telemetry with ``diagnose --fail-on critical``."""
+
+from __future__ import annotations
+
+import glob
+import json
+import threading
+
+import pytest
+
+from sheeprl_tpu.cli import diagnose, run, serve
+
+pytestmark = pytest.mark.serve
+
+_PPO_TRAIN = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "algo.rollout_steps=16",
+    "algo.total_steps=64",
+    "algo.update_epochs=1",
+    "algo.cnn_keys.encoder=[]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.run_test=False",
+    "metric.log_level=0",
+    "checkpoint.save_last=True",
+    "root_dir=servesmk",
+    "run_name=ppo",
+]
+
+_DV3_TRAIN = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "metric.log_level=0",
+    "buffer.memmap=False",
+    "buffer.size=512",
+    "env.num_envs=2",
+    "algo.learning_starts=4",
+    "algo.run_test=False",
+    "algo.total_steps=16",
+    "checkpoint.every=8",
+    "checkpoint.save_last=True",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=1",
+    "algo.replay_ratio=1",
+    "algo.horizon=8",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.cnn_keys.decoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.mlp_keys.decoder=[state]",
+    "root_dir=servesmk",
+    "run_name=dv3",
+]
+
+
+def _serve_with_live_watch(run_dir: str, serve_dir: str, sessions: int) -> int:
+    """Start `watch` on the (not yet existing) serving telemetry dir, run the
+    serve verb to completion, and return the watch's exit code."""
+    from sheeprl_tpu.obs.watch import watch_run
+
+    import io
+
+    watch_out = io.StringIO()
+    watch_rc: dict = {}
+
+    def _watch():
+        watch_rc["rc"] = watch_run(
+            serve_dir, interval=0.2, grace=0.4, timeout=120, plain=True, out=watch_out
+        )
+
+    watcher = threading.Thread(target=_watch, daemon=True)
+    watcher.start()
+    rc = serve(
+        [
+            f"checkpoint_path={run_dir}",
+            f"serve.sessions={sessions}",
+            "serve.slots=2",
+            "serve.max_session_steps=20",
+            "serve.telemetry.every=4",
+            f"serve.log_dir={serve_dir}",
+        ]
+    )
+    assert rc == 0, "serve verb reported a failed session"
+    watcher.join(timeout=120)
+    assert watch_rc.get("rc") == 0, f"watch did not follow the serving run: {watch_out.getvalue()}"
+    assert "serve:" in watch_out.getvalue()
+    return rc
+
+
+def _assert_serving_telemetry(serve_dir: str, min_sessions: int) -> None:
+    (stream,) = glob.glob(f"{serve_dir}/telemetry.jsonl")
+    events = [json.loads(line) for line in open(stream)]
+    start = events[0]
+    assert start["event"] == "start" and start["serve"]["slots"] == 2
+    assert start["fingerprint"]["algo"] is not None
+    summary = events[-1]
+    assert summary["event"] == "summary" and summary["clean_exit"] is True
+    assert summary["serve"]["sessions_finished"] >= min_sessions - 1  # final delta may race close
+    assert summary["total_steps"] > 0
+    rc = diagnose([serve_dir, "--quiet", "--fail-on", "critical"])
+    assert rc == 0
+
+
+@pytest.mark.timeout(300)
+def test_ppo_train_serve_watch_diagnose(tmp_path):
+    """3 concurrent sessions over 2 slots on a freshly trained PPO checkpoint:
+    every session runs its episode to completion, watch follows live and exits
+    clean, diagnose is green. checkpoint_path is the RUN DIR — resolution goes
+    through the supervisor's discovery rules."""
+    run(_PPO_TRAIN)
+    serve_dir = str(tmp_path / "ppo-serve")
+    _serve_with_live_watch("logs/runs/servesmk/ppo", serve_dir, sessions=3)
+    _assert_serving_telemetry(serve_dir, min_sessions=3)
+
+
+@pytest.mark.timeout(600)
+def test_dreamer_v3_train_serve_watch_diagnose(tmp_path):
+    """Same e2e for the RSSM family: device-resident recurrent session state
+    through a real trained dreamer_v3 checkpoint."""
+    run(_DV3_TRAIN)
+    serve_dir = str(tmp_path / "dv3-serve")
+    _serve_with_live_watch("logs/runs/servesmk/dv3", serve_dir, sessions=2)
+    _assert_serving_telemetry(serve_dir, min_sessions=2)
