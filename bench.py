@@ -48,6 +48,12 @@ Select a single workload with BENCH_ALGO:
   continuous-batching slot-table server, and reports sessions/sec plus a
   nested p99 step-latency workload ("ms" units gate LOWER-is-better under
   --against). CPU-only; measures the serving machinery, not the model.
+- fleet_ingest — the experience data-plane A/B (sheeprl_tpu/data/service.py):
+  1-actor vs 2-actor service ingestion gangs plus a buffer.backend=local
+  reference, with emulator-paced actors so the scaling number measures the
+  data plane rather than CPU contention. Value = 2-actor ingest rows/sec,
+  vs_baseline = the 2/1-actor scaling ratio (acceptance bar >= 1.5); learner
+  sps, gradient-step rates and service queue depth ride in conditions.
 
 The dreamer_v3 extra also records the MFU of the benchmark-size train program in
 its ``conditions.train_mfu`` block (and mirrors ``mfu`` top-level).
@@ -812,6 +818,157 @@ def _bench_serve_load(
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _bench_fleet_ingest(
+    total_steps: int = 768, step_latency_ms: float = 20.0, num_envs: int = 4
+) -> dict:
+    """``fleet_ingest``: the experience data-plane A/B (sheeprl_tpu/data/service.py,
+    howto/fleet.md). Three tiny sac_decoupled runs on the CPU mesh:
+
+    - ``buffer.backend=local`` (single process, threaded trainer) — the learner
+      gradient-steps/train-second reference;
+    - ``buffer.backend=service`` with 1 actor process + 1 learner (2-process gang);
+    - ``buffer.backend=service`` with 2 actor processes + 1 learner (3-process gang).
+
+    The actors are PACED like real emulators (``env.wrapper.step_latency_ms``,
+    default 20 ms/frame, so the pacing dominates per-iteration compute even on a
+    noisy 1-core host): ingestion scaling then measures the DATA PLANE — can the
+    KV ingest path and the learner's drain keep K paced actors at K×? — instead
+    of CPU contention between co-scheduled actor processes on a small host.
+    ``value`` is the 2-actor ingestion rate (rows/sec from the learner stream's
+    summary — its step axis IS ingested rows); ``vs_baseline`` is the
+    2-actor/1-actor scaling ratio (the acceptance bar is ≥ 1.5). Conditions carry
+    per-config learner sps, ingest rows/sec and service queue depth, so the
+    ``--against`` gate can hold all three."""
+    import shutil
+
+    from sheeprl_tpu.cli import run
+    from sheeprl_tpu.obs.jsonl import read_events
+
+    os.environ.pop("XLA_FLAGS", None)  # gang children must own their device set
+    workdir = tempfile.mkdtemp(prefix="sheeprl-fleet-ingest-")
+    base = [
+        "exp=sac_decoupled",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        f"env.wrapper.step_latency_ms={step_latency_ms}",
+        f"env.num_envs={num_envs}",
+        "fabric.accelerator=cpu",
+        "metric.log_level=0",
+        "buffer.memmap=False",
+        "buffer.size=4096",
+        "buffer.checkpoint=False",
+        "algo.learning_starts=32",
+        "algo.run_test=False",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.per_rank_batch_size=32",
+        "algo.replay_ratio=0.25",
+        f"algo.total_steps={total_steps}",
+        "checkpoint.every=0",
+        "checkpoint.save_last=False",
+        "metric.telemetry.enabled=true",
+        "metric.telemetry.every=64",
+    ]
+
+    def summarize(stream_path: str) -> dict:
+        events = read_events(stream_path)
+        summary = next((e for e in reversed(events) if e.get("event") == "summary"), {})
+        service = next((e for e in reversed(events) if e.get("event") == "service"), {})
+        start = next((e for e in events if e.get("event") == "start"), {})
+        train_seconds = float(summary.get("train_seconds") or 0.0)
+        return {
+            "ingest_rows_per_sec": summary.get("sps"),
+            "gradient_steps": summary.get("train_units"),
+            "learner_gsteps_per_train_sec": (
+                round(summary.get("train_units", 0) / train_seconds, 3)
+                if train_seconds > 0
+                else None
+            ),
+            "queue_depth_mean": service.get("queue_depth_mean"),
+            "queue_depth_max": service.get("queue_depth_max"),
+            "rows_per_actor": service.get("rows_per_actor"),
+            "fingerprint": start.get("fingerprint"),
+        }
+
+    try:
+        # local backend reference: the threaded decoupled learner's train rate
+        local_dir = os.path.join(workdir, "local")
+        run(
+            base
+            + [
+                f"hydra.run.dir={local_dir}",
+                f"metric.telemetry.jsonl_path={os.path.join(local_dir, 'telemetry.jsonl')}",
+            ]
+        )
+        local = summarize(os.path.join(local_dir, "telemetry.jsonl"))
+
+        configs = {}
+        for actors in (1, 2):
+            run_dir = os.path.join(workdir, f"actors{actors}")
+            run(
+                base
+                + [
+                    f"hydra.run.dir={run_dir}",
+                    "buffer.backend=service",
+                    f"buffer.service.actors={actors}",
+                    # amortize the weight plane: publish every 4th round (the
+                    # paced actors refresh at ~env cadence either way)
+                    "buffer.service.publish_every=4",
+                    f"resilience.distributed.gang.processes={actors + 1}",
+                    "resilience.distributed.gang.grace=60",
+                    "resilience.distributed.heartbeat.interval=0.5",
+                    "resilience.distributed.heartbeat.timeout=30",
+                ]
+            )
+            configs[actors] = summarize(os.path.join(run_dir, "telemetry.learner.jsonl"))
+
+        rate_1 = float(configs[1]["ingest_rows_per_sec"] or 0.0)
+        rate_2 = float(configs[2]["ingest_rows_per_sec"] or 0.0)
+        scaling = round(rate_2 / rate_1, 3) if rate_1 > 0 else None
+        conditions = {
+            "total_steps": total_steps,
+            "env_step_latency_ms": step_latency_ms,
+            "num_envs_per_actor": num_envs,
+            "cpu_count": os.cpu_count(),
+            "local": {
+                k: local[k]
+                for k in ("ingest_rows_per_sec", "gradient_steps", "learner_gsteps_per_train_sec")
+            },
+            "actors_1": {k: v for k, v in configs[1].items() if k != "fingerprint"},
+            "actors_2": {k: v for k, v in configs[2].items() if k != "fingerprint"},
+            "scaling_2_actors": scaling,
+            # learner train rate vs the local backend (1.0 = no regression from
+            # moving the buffer behind the service; on a 1-core host the 2-actor
+            # figure additionally absorbs genuine core contention with the
+            # co-scheduled actor processes — see cpu_count)
+            "learner_vs_local": {
+                str(actors): (
+                    round(
+                        configs[actors]["learner_gsteps_per_train_sec"]
+                        / local["learner_gsteps_per_train_sec"],
+                        3,
+                    )
+                    if configs[actors]["learner_gsteps_per_train_sec"]
+                    and local["learner_gsteps_per_train_sec"]
+                    else None
+                )
+                for actors in (1, 2)
+            },
+            "fingerprint": configs[2]["fingerprint"],
+        }
+        return {
+            "metric": "fleet_ingest_rows_per_sec",
+            "value": round(rate_2, 2),
+            "unit": "rows/sec (2-actor service ingestion, emulator-paced)",
+            # scaling vs the 1-actor configuration — the >= 1.5x acceptance bar
+            "vs_baseline": scaling,
+            "conditions": conditions,
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _bench_dv3_mfu_flagship(size: str = "S") -> dict:
     """Standalone extra: flagship-size DV3 train-program MFU on the accelerator."""
     stats = _dv3_train_mfu(size=size)
@@ -866,6 +1023,8 @@ def _bench(algo: str) -> dict:
         result = _bench_sac_steady()
     elif algo == "serve_load":
         result = _bench_serve_load()
+    elif algo == "fleet_ingest":
+        result = _bench_fleet_ingest()
     elif algo.startswith("dreamer_v"):
         result = _bench_dreamer_steady(algo)
     else:
@@ -1063,6 +1222,14 @@ def main() -> int:
         print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:
         result["serve_load_extra_error"] = repr(exc)[:500]
+    # fleet_ingest: the experience data-plane A/B (1-actor vs 2-actor service
+    # ingestion with an emulator-paced env, learner gradient rate vs the local
+    # backend) — CPU-mesh gangs only, never touches the chip
+    try:
+        extras.append(_bench_subprocess("fleet_ingest", timeout=900))
+        print(json.dumps({**result, "extras": extras}), flush=True)
+    except Exception as exc:
+        result["fleet_ingest_extra_error"] = repr(exc)[:500]
     if chip_busy:
         # The abandoned child is still compiling/claiming on the single-tenant
         # chip; further live-chip extras would only queue behind it and time out

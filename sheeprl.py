@@ -16,7 +16,10 @@ Also hosts the offline/observability tooling (howto/observability.md):
   ``howto/fault_tolerance.md``);
 - ``python sheeprl.py serve checkpoint_path=<ckpt>`` — the policy serving
   tier: continuous-batching inference over a device-resident session-slot
-  table (``howto/serving.md``).
+  table (``howto/serving.md``);
+- ``python sheeprl.py fleet <spec.yaml>`` — schedule a fleet of member runs
+  (seed/env sweeps) with per-member restart supervision, a shared persistent
+  XLA compile cache, and leaderboard/compare rollups (``howto/fleet.md``).
 """
 
 import os
@@ -47,6 +50,7 @@ from sheeprl_tpu.cli import (  # noqa: E402
     compare,
     diagnose,
     fault_matrix,
+    fleet,
     run,
     serve,
     watch,
@@ -59,6 +63,7 @@ _SUBCOMMANDS = {
     "bench-diff": bench_diff,
     "fault-matrix": fault_matrix,
     "serve": serve,
+    "fleet": fleet,
 }
 
 if __name__ == "__main__":

@@ -386,6 +386,8 @@ def run_dreamer(
     test_fn=None,
     trainer_factory=None,
     share_log_dir: bool = True,
+    replay_factory=None,
+    telemetry_factory=None,
 ):
     """The full Dreamer-V3 training loop, with the agent/player/train-phase factories
     injectable so forks with the same loop shape (offline_dreamer's CBWM, reference
@@ -394,7 +396,16 @@ def run_dreamer(
     decoupled actor–learner topology (dreamer_v3_decoupled.py) reuses this exact
     loop as its player, passing ``share_log_dir=False`` in the multi-process
     topology: the learner processes never pair the log-dir share collective, so
-    issuing it would desync the channel planes."""
+    issuing it would desync the channel planes.
+
+    ``replay_factory(cfg, log_dir, obs_keys, state, trainer, world_size) ->
+    (rb, sampler)`` swaps the replay construction — the experience-service actor
+    (``buffer.backend=service``) keeps only a tiny local ring for episode
+    bookkeeping and ships rows to the standalone data plane. ``telemetry_factory``
+    likewise overrides ``build_telemetry`` (per-actor role streams). A trainer
+    advertising ``external_checkpoints = True`` (the service actor's — the
+    LEARNER owns checkpoints there) makes this loop skip its checkpoint blocks
+    entirely."""
     build_agent_fn = build_agent_fn or build_agent
     player_cls = player_cls or PlayerDV3
     make_train_phase_fn = make_train_phase_fn or make_train_phase
@@ -415,7 +426,11 @@ def run_dreamer(
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict())
     fabric.print(f"Log dir: {log_dir}")
-    telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
+    telemetry = (
+        telemetry_factory(fabric, cfg, log_dir, logger)
+        if telemetry_factory is not None
+        else build_telemetry(fabric, cfg, log_dir, logger=logger)
+    )
     resilience = build_resilience(fabric, cfg, log_dir, telemetry=telemetry)
 
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -501,17 +516,19 @@ def run_dreamer(
     if not MetricAggregator.disabled:
         aggregator = instantiate(cfg.metric.aggregator)
 
-    buffer_size = cfg.buffer.size // int(num_envs * world_size) if not cfg.dry_run else 8
-    rb = EnvIndependentReplayBuffer(
-        buffer_size,
-        n_envs=num_envs,
-        obs_keys=tuple(obs_keys),
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        buffer_cls=SequentialReplayBuffer,
-    )
-    if state is not None and "rb" in state:
-        rb = state["rb"]
+    rb = None
+    if replay_factory is None:
+        buffer_size = cfg.buffer.size // int(num_envs * world_size) if not cfg.dry_run else 8
+        rb = EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=num_envs,
+            obs_keys=tuple(obs_keys),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+            buffer_cls=SequentialReplayBuffer,
+        )
+        if state is not None and "rb" in state:
+            rb = state["rb"]
 
     from sheeprl_tpu.parallel.sharding import build_state_shardings
 
@@ -566,18 +583,30 @@ def run_dreamer(
 
     # replay hot path: async prefetcher (sampling + sharded staging off-thread) or the
     # exact inline path when buffer.prefetch.enabled=false. Built AFTER the resume
-    # block above so a restored batch size shapes the staged units.
-    sampler = make_replay_sampler(
-        rb,
-        cfg.buffer.get("prefetch"),
-        sample_kwargs=dict(
-            batch_size=cfg.algo.per_rank_batch_size * world_size,
-            sequence_length=cfg.algo.per_rank_sequence_length,
-        ),
-        uint8_keys=cnn_keys,
-        sharding=trainer.data_sharding,
-        name="dv3-replay-prefetch",
-    )
+    # block above so a restored batch size shapes the staged units. A
+    # replay_factory (the experience-service actor) swaps in its own pair — a
+    # tiny bookkeeping ring + an ingest-only sampler facade.
+    if replay_factory is not None:
+        rb, sampler = replay_factory(
+            cfg=cfg,
+            log_dir=log_dir,
+            obs_keys=obs_keys,
+            state=state,
+            trainer=trainer,
+            world_size=world_size,
+        )
+    else:
+        sampler = make_replay_sampler(
+            rb,
+            cfg.buffer.get("prefetch"),
+            sample_kwargs=dict(
+                batch_size=cfg.algo.per_rank_batch_size * world_size,
+                sequence_length=cfg.algo.per_rank_sequence_length,
+            ),
+            uint8_keys=cnn_keys,
+            sharding=trainer.data_sharding,
+            name="dv3-replay-prefetch",
+        )
     telemetry.attach_sampler(sampler)
 
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
@@ -816,8 +845,14 @@ def run_dreamer(
             last_train = train_step
 
         # checkpoint (a deferring trainer only has full state at train rounds; its
-        # last pending checkpoint, if any, is flushed by close() below)
-        if pending_ckpt and (not trainer.defers_checkpoints or trained_this_iter):
+        # last pending checkpoint, if any, is flushed by close() below; a trainer
+        # with external_checkpoints — the service actor, whose LEARNER owns the
+        # full state — never checkpoints from this loop at all)
+        if (
+            pending_ckpt
+            and not getattr(trainer, "external_checkpoints", False)
+            and (not trainer.defers_checkpoints or trained_this_iter)
+        ):
             last_checkpoint = policy_step
             pending_ckpt = False
             ckpt_agent, ckpt_opt, ckpt_moments = trainer.checkpoint_state()

@@ -20,6 +20,7 @@ sheeprl/algos/ppo/ppo_decoupled.py:623-666 for the process topology):
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Any, Dict, Optional
@@ -351,11 +352,451 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
         resilience.finalize()
 
 
+# ---------------------------------------------------------------------------------
+# buffer.backend=service: K dreamer players ingest into a standalone experience
+# plane; one learner process hosts the sequential replay buffer + the SAME fused
+# donated train program (sheeprl_tpu/data/service.py, howto/fleet.md). The actor
+# ranks run run_dreamer's EXACT loop with three swaps: an ingest-only sampler
+# (tiny local ring kept for episode bookkeeping), a trainer whose "train round"
+# is a non-blocking weight refresh, and learner-owned checkpoints.
+# ---------------------------------------------------------------------------------
+
+
+class _ServiceActorTrainer:
+    """run_dreamer trainer for a service-topology actor: never trains, never
+    blocks — each "train round" polls the weight plane and hands back the latest
+    act view. The LEARNER owns checkpoints (``external_checkpoints``)."""
+
+    defers_checkpoints = True
+    external_checkpoints = True
+    data_sharding = None
+
+    def __init__(self, *, fabric, cfg, act, params, writer, subscriber, **_: Any):
+        self.act = act
+        self._writer = writer
+        self._subscriber = subscriber
+        self._act_view = act.view(_act_select(params))
+        self._done_timeout = float(
+            (cfg.buffer.get("service") or {}).get("done_timeout") or 300.0
+        )
+
+    def train(self, data, cum_steps, train_key, want_full_state: bool, want_metrics: bool):
+        payload = self._subscriber.poll()
+        if payload is not None:
+            self._act_view = self.act.place(payload["tree"])
+        return self._act_view, None
+
+    def checkpoint_state(self):
+        raise RuntimeError("service actors never checkpoint (external_checkpoints)")
+
+    def sync_tree(self):
+        return None
+
+    def close(self):
+        from sheeprl_tpu.resilience import preemption_requested
+
+        self._writer.close(preempted=preemption_requested())
+        self._writer.wait_done(timeout_s=self._done_timeout)
+        payload = self._subscriber.poll()
+        if payload is not None:
+            self._act_view = self.act.place(payload["tree"])
+        return None
+
+
+class _IngestSampler:
+    """The replay-sampler surface over an :class:`ExperienceWriter`: ``add``
+    mirrors rows into a tiny local bookkeeping ring (run_dreamer's episode
+    bookkeeping pokes ``rb.buffer[i]``) and ships them — rank/env-tagged — to
+    the service. ``sample`` is never consumed (the service trainer ignores its
+    token); the snapshot speaks the sampler telemetry schema, with the writer's
+    flow-control block time as the honest ``wait``."""
+
+    is_async = False
+
+    def __init__(self, writer, rb, rank: int, num_envs: int) -> None:
+        import threading
+
+        self._writer = writer
+        self._rb = rb
+        self._rank = int(rank)
+        self._num_envs = int(num_envs)
+        self.lock = threading.Lock()
+
+    @property
+    def buffer(self):
+        return self._rb
+
+    def add(self, data, idxes=None, validate_args: bool = False) -> None:
+        with self.lock:
+            self._rb.add(data, idxes, validate_args=validate_args)
+        local = list(idxes) if idxes is not None else list(range(self._num_envs))
+        self._writer.add(data, env_ids=[self._rank * self._num_envs + i for i in local])
+
+    def sample(self, n_samples: int):
+        return {"__service_rows__": n_samples}
+
+    def telemetry_snapshot(self):
+        snap = self._writer.telemetry_snapshot()
+        return {
+            "is_async": False,
+            "wait_seconds": snap["flow_block_seconds"],
+            "sample_calls": snap["messages"],
+            "units": snap["rows"],
+            "occupancy_sum": 0.0,
+            "staleness_sum": 0.0,
+            "empty_waits": 0,
+            "pipeline_len": snap["inflight"],
+            "depth": 0,
+        }
+
+    def close(self) -> None:
+        pass  # EOS is the trainer's close() (it knows the preempt verdict)
+
+
+def _service_actor(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
+    from functools import partial
+
+    from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+    from sheeprl_tpu.data.service import (
+        ExperienceWriter,
+        ServiceError,
+        WeightSubscriber,
+        coordination_kv,
+        service_namespace,
+        service_options,
+    )
+    from sheeprl_tpu.obs import build_role_telemetry, build_telemetry
+    from sheeprl_tpu.parallel import distributed
+
+    rank = distributed.process_index()
+    actors = int(layout["actors"])
+    num_envs = int(cfg.env.num_envs)
+    kv = coordination_kv()
+    if kv is None:
+        raise ServiceError(
+            "buffer.backend=service needs the jax.distributed coordination service"
+        )
+    ns = service_namespace()
+    opts = service_options(cfg)
+    writer = ExperienceWriter(
+        kv,
+        ns,
+        rank,
+        max_inflight=opts["max_inflight"],
+        flush_every=opts["flush_every"],
+        poll_s=opts["poll_s"],
+        timeout_s=opts["timeout_s"],
+        abort_check=opts["abort_check"],
+    )
+    subscriber = WeightSubscriber(
+        kv, ns, poll_s=opts["poll_s"], timeout_s=opts["timeout_s"], abort_check=opts["abort_check"]
+    )
+
+    # per-actor share of the fleet budget: K actors cover total_steps TOGETHER
+    # (the learner counts GLOBAL ingested rows against the global knobs)
+    cfg.algo.total_steps = int(cfg.algo.total_steps) // actors
+    cfg.algo.learning_starts = int(cfg.algo.learning_starts) // actors
+    # the LEARNER owns checkpoints; the loop's blocks are gated off by the
+    # trainer's external_checkpoints, these keep the cadence math quiet
+    cfg.checkpoint.save_last = False
+
+    def replay_factory(*, cfg, log_dir, obs_keys, state, trainer, world_size):
+        # tiny local ring: run_dreamer's episode bookkeeping (crash-restart row
+        # rewrite) needs per-env last rows; the real buffer lives with the learner
+        rb = EnvIndependentReplayBuffer(
+            8,
+            n_envs=int(cfg.env.num_envs),
+            obs_keys=tuple(obs_keys),
+            memmap=False,
+            buffer_cls=SequentialReplayBuffer,
+        )
+        return rb, _IngestSampler(writer, rb, rank, int(cfg.env.num_envs))
+
+    def telemetry_factory(fabric_, cfg_, log_dir_, logger_):
+        if rank == 0:
+            return build_telemetry(fabric_, cfg_, log_dir_, logger=logger_)
+        return build_role_telemetry(fabric_, cfg_, f"actor{rank}", rank=rank)
+
+    return run_dreamer(
+        fabric,
+        cfg,
+        trainer_factory=partial(_ServiceActorTrainer, writer=writer, subscriber=subscriber),
+        share_log_dir=False,
+        replay_factory=replay_factory,
+        telemetry_factory=telemetry_factory,
+    )
+
+
+def _service_learner(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
+    """The dv3 service learner: sequential replay slots per actor env, the SAME
+    fused donated train program (state_shardings pinned), Ratio over globally
+    ingested rows, act-view weight publication, learner-owned checkpoints."""
+    import time as _time
+
+    import gymnasium as gym
+
+    from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+    from sheeprl_tpu.data.prefetch import make_replay_sampler
+    from sheeprl_tpu.data.service import (
+        ExperienceService,
+        ServiceError,
+        WeightPublisher,
+        coordination_kv,
+        service_namespace,
+        service_options,
+    )
+    from sheeprl_tpu.obs import build_role_telemetry
+    from sheeprl_tpu.parallel import distributed
+    from sheeprl_tpu.parallel.sharding import build_state_shardings
+    from sheeprl_tpu.resilience import build_resilience
+    from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
+    from sheeprl_tpu.utils.logger import run_base_dir
+    from sheeprl_tpu.utils.timer import timer
+    from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+    rank = distributed.process_index()
+    actors = int(layout["actors"])
+    num_envs = int(cfg.env.num_envs)
+    total_envs = actors * num_envs
+    policy_steps_per_iter = total_envs
+
+    cfg.env.frame_stack = -1  # match the players' forced setting (run_dreamer)
+    env = make_env(cfg, cfg.seed, 0, None, "learner")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    env.close()
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    key = fabric.seed_everything(cfg.seed)  # rank-0 player init seed
+    key, agent_key = jax.random.split(key)
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_key)
+    world_tx, actor_tx, critic_tx, opt_state = build_optimizers(cfg, params)
+    moments_state = init_moments()
+
+    telemetry = build_role_telemetry(fabric, cfg, "learner", rank=rank, leader=True)
+    resilience = build_resilience(fabric, cfg, None, telemetry=telemetry)
+    try:
+        kv = coordination_kv()
+        if kv is None:
+            raise ServiceError(
+                "buffer.backend=service needs the jax.distributed coordination service"
+            )
+        ns = service_namespace()
+        opts = service_options(cfg)
+
+        state = None
+        if cfg.checkpoint.resume_from:
+            from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+            state = load_checkpoint(cfg.checkpoint.resume_from)
+        if state is not None:
+            params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+            if state.get("opt_state") is not None:
+                opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+            if state.get("moments") is not None:
+                moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
+
+        train_phase = make_train_phase(
+            agent, cfg, world_tx, actor_tx, critic_tx,
+            state_shardings=build_state_shardings(fabric, params, opt_state, init_moments()),
+        )
+        if fabric.num_devices > 1:
+            params = fabric.shard_params(params)
+            opt_state = fabric.shard_params(opt_state)
+            moments_state = fabric.replicate_pytree(moments_state)
+
+        learner_dir = str(run_base_dir(cfg.root_dir, cfg.run_name) / "learner")
+        os.makedirs(learner_dir, exist_ok=True)
+        save_configs(cfg, learner_dir)
+
+        cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+        mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+        obs_keys = cnn_keys + mlp_keys
+        buffer_size = cfg.buffer.size // total_envs if not cfg.dry_run else 8
+        rb = EnvIndependentReplayBuffer(
+            max(buffer_size, 1),
+            n_envs=total_envs,
+            obs_keys=obs_keys,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(learner_dir, "memmap_buffer", f"rank_{rank}"),
+            buffer_cls=SequentialReplayBuffer,
+        )
+        rows_base = 0
+        if state is not None and "rb" in state:
+            rb = state["rb"]
+        if state is not None:
+            rows_base = int(state.get("service_rows") or 0)
+
+        seq_len = int(cfg.algo.per_rank_sequence_length)
+        sampler = make_replay_sampler(
+            rb,
+            cfg.buffer.get("prefetch"),
+            sample_kwargs=dict(
+                batch_size=cfg.algo.per_rank_batch_size * fabric.world_size,
+                sequence_length=seq_len,
+            ),
+            uint8_keys=cnn_keys,
+            sharding=fabric.sharding(None, None, "data") if fabric.num_devices > 1 else None,
+            name="dv3-service-prefetch",
+        )
+        telemetry.attach_sampler(sampler)
+
+        service = ExperienceService(
+            rb,
+            kv,
+            ns,
+            layout["actor_ranks"],
+            lock=sampler.lock,
+            poll_s=opts["poll_s"],
+            env_ids_of=lambda r: list(range(r * num_envs, (r + 1) * num_envs)),
+            validate_args=bool(cfg.buffer.validate_args),
+        ).start()
+        publisher = WeightPublisher(kv, ns)
+        publish_every = max(int((cfg.buffer.get("service") or {}).get("publish_every") or 1), 1)
+        publisher.publish(replicated_to_host(_act_select(params)))
+
+        ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+        if state is not None and "ratio" in state:
+            ratio.load_state_dict(state["ratio"])
+        learning_starts = int(cfg.algo.learning_starts) if not cfg.dry_run else 0
+        if state is not None and "rb" not in state:
+            learning_starts += rows_base
+        prefill_rows = max(learning_starts - policy_steps_per_iter, 0)
+        checkpoint_every = int(cfg.checkpoint.every)
+        last_checkpoint = rows_base
+        window_every = int(
+            (cfg.metric.get("telemetry") or {}).get("every") or cfg.metric.log_every
+        )
+        last_service_event = rows_base
+        cum_gsteps = 0
+        rounds = 0
+        key = jax.random.PRNGKey(cfg.seed + 1)
+        preempted = False
+
+        def sequences_ready() -> bool:
+            # every env slot must hold at least one full training sequence before
+            # the cross-slot sampler can be consulted
+            return all(b.full or b._pos > seq_len for b in rb.buffer)
+
+        def checkpoint(rows: int, *, is_preempt: bool) -> None:
+            ckpt_state = {
+                "agent": replicated_to_host(params),
+                "opt_state": replicated_to_host(opt_state),
+                "moments": replicated_to_host(moments_state),
+                "ratio": ratio.state_dict(),
+                "iter_num": rows // policy_steps_per_iter,
+                "batch_size": cfg.algo.per_rank_batch_size * fabric.world_size,
+                "service_rows": rows,
+                "last_log": 0,
+                "last_checkpoint": rows,
+            }
+            ckpt_path = os.path.join(learner_dir, "checkpoint", f"ckpt_{rows}_{rank}.ckpt")
+            with sampler.lock, timer("Time/checkpoint_time"):
+                fabric.call(
+                    "on_checkpoint_player",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
+            resilience.observe_checkpoint(ckpt_path, rows, preempted=is_preempt)
+
+        while True:
+            service.raise_pending()
+            rows = rows_base + service.rows_total
+            preempted = resilience.preempt_requested()
+            eos = service.eos_all()
+            warm = rows >= learning_starts and rows > 0 and sequences_ready()
+            grant = ratio(max(rows - prefill_rows, 0)) if warm else 0
+            if grant > 0:
+                with timer("Time/train_time"):
+                    data = sampler.sample(grant)
+                    key, train_key = jax.random.split(key)
+                    params, opt_state, moments_state, metrics = train_phase(
+                        params, opt_state, moments_state, data,
+                        jnp.asarray(cum_gsteps), np.asarray(train_key),
+                    )
+                cum_gsteps += grant
+                rounds += 1
+                telemetry.observe_train(grant, metrics)
+                if rounds % publish_every == 0:
+                    publisher.publish(replicated_to_host(_act_select(params)))
+            elif not eos:
+                _time.sleep(opts["poll_s"])
+            telemetry.step(rows)
+            resilience.step(rows)
+            if rows - last_service_event >= window_every:
+                last_service_event = rows
+                telemetry.emit_event(
+                    "service",
+                    step=rows,
+                    role="learner",
+                    gradient_steps=cum_gsteps,
+                    weight_version=publisher.version,
+                    **service.telemetry_snapshot(),
+                )
+            if checkpoint_every > 0 and rows - last_checkpoint >= checkpoint_every:
+                last_checkpoint = rows
+                checkpoint(rows, is_preempt=False)
+            if preempted or (eos and grant == 0):
+                break
+
+        rows = rows_base + service.rows_total
+        if preempted or cfg.checkpoint.save_last or cfg.dry_run:
+            checkpoint(rows, is_preempt=preempted or service.eos_preempted())
+        publisher.publish(replicated_to_host(_act_select(params)), final=True)
+        telemetry.emit_event(
+            "service",
+            step=rows,
+            role="learner",
+            gradient_steps=cum_gsteps,
+            weight_version=publisher.version,
+            **service.telemetry_snapshot(),
+        )
+        service.mark_done()
+        sampler.close()
+        service.stop()
+        wait_for_checkpoint()
+        telemetry.close(rows)
+    finally:
+        resilience.finalize()
+
+
+def _service_main(fabric, cfg: Dict[str, Any]):
+    from sheeprl_tpu.data.service import service_layout
+    from sheeprl_tpu.parallel import distributed
+
+    layout = service_layout(cfg)
+    if layout["learners"] != 1:
+        raise ValueError(
+            f"buffer.backend=service currently takes exactly ONE learner process "
+            f"(got {layout['learners']}) — multi-process learner slices ride "
+            "buffer.backend=local's channel topology"
+        )
+    rank = distributed.process_index()
+    if rank >= layout["actors"]:
+        fabric.process_group = layout["learner_ranks"]
+    fabric.local_mesh = True
+    fabric._setup()
+    if rank >= layout["actors"]:
+        return _service_learner(fabric, cfg, layout)
+    return _service_actor(fabric, cfg, layout)
+
+
 @register_algorithm(decoupled=True)
 def main(fabric, cfg: Dict[str, Any]):
     from functools import partial
 
     from sheeprl_tpu.parallel import distributed
+
+    if str(cfg.buffer.get("backend", "local")) == "service":
+        # standalone experience plane: K dreamer players + 1 learner process
+        # (raises with an actionable message on a single-process launch)
+        return _service_main(fabric, cfg)
 
     # Resume: the player path is run_dreamer's own resume (it hands the resumed
     # params/opt_state/moments to the trainer factory); the learner slice loads

@@ -42,6 +42,96 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import ActPlacement, Ratio, save_configs
 
 
+def _build_sac_train(cfg, actor, critic, target_entropy, policy_steps_per_iter):
+    """The fused multi-gradient-step SAC train program + its optimizer state
+    builder — ONE construction shared by the channel trainer (``_trainer_loop``)
+    and the experience-service learner (``_service_learner``), so the two
+    backends run the bit-identical donated program. ``policy_steps_per_iter`` is
+    the GLOBAL env transitions per driver iteration (it sets the target-EMA
+    period in iterations, exactly as before)."""
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    num_critics = int(cfg.algo.critic.n)
+    target_period = cfg.algo.critic.target_network_frequency // int(policy_steps_per_iter) + 1
+    action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
+    action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
+
+    actor_tx = instantiate(cfg.algo.actor.optimizer)
+    critic_tx = instantiate(cfg.algo.critic.optimizer)
+    alpha_tx = instantiate(cfg.algo.alpha.optimizer)
+
+    def init_opt_state(params):
+        return {
+            "actor": actor_tx.init(params["actor"]),
+            "critic": critic_tx.init(params["critic"]),
+            "alpha": alpha_tx.init(params["log_alpha"]),
+        }
+
+    def critic_loss_fn(critic_params, other, batch, step_key):
+        next_obs = batch["next_observations"]
+        mean, std = actor.apply({"params": other["actor"]}, next_obs)
+        next_actions, next_logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+        target_q = critic.apply({"params": other["target_critic"]}, next_obs, next_actions)
+        alpha = jnp.exp(other["log_alpha"])
+        min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
+        next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
+        qf_values = critic.apply({"params": critic_params}, batch["observations"], batch["actions"])
+        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+
+    def actor_loss_fn(actor_params, other, batch, step_key):
+        mean, std = actor.apply({"params": actor_params}, batch["observations"])
+        actions, logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+        qf_values = critic.apply({"params": other["critic"]}, batch["observations"], actions)
+        min_qf = jnp.min(qf_values, axis=-1, keepdims=True)
+        alpha = jnp.exp(jax.lax.stop_gradient(other["log_alpha"]))
+        return policy_loss(alpha, logprobs, min_qf), logprobs
+
+    def alpha_loss_fn(log_alpha, logprobs):
+        return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
+
+    # donate_argnums: XLA reuses the params/opt-state buffers in place instead
+    # of copying the whole train state every round (the loop always rebinds to
+    # the returned trees, so the invalidated inputs are never read again)
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_phase(params, opt_state, data, iter_num, train_key):
+        do_ema = (iter_num % target_period) == 0
+
+        def step(carry, inp):
+            params, opt_state = carry
+            batch, k = inp
+            k_critic, k_actor = jax.random.split(k)
+            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k_critic)
+            updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
+            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+            opt_state = {**opt_state, "critic": new_copt}
+            params = {
+                **params,
+                "target_critic": jax.tree_util.tree_map(
+                    lambda t, c: jnp.where(do_ema, t * (1 - tau) + c * tau, t),
+                    params["target_critic"],
+                    params["critic"],
+                ),
+            }
+            (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+                params["actor"], params, batch, k_actor
+            )
+            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+            opt_state = {**opt_state, "actor": new_aopt}
+            al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
+            updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
+            params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
+            opt_state = {**opt_state, "alpha": new_alopt}
+            return (params, opt_state), jnp.stack([qf_loss, a_loss, al_loss])
+
+        G = data["rewards"].shape[0]
+        keys = jax.random.split(train_key, G)
+        (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (data, keys))
+        return params, opt_state, losses.mean(axis=0)
+
+    return train_phase, init_opt_state
+
+
 def _trainer_loop(
     fabric, cfg, actor, critic, params, target_entropy, data_q, params_q, error, geometry=None,
     resume_state=None, telemetry=None, resilience=None,
@@ -65,86 +155,13 @@ def _trainer_loop(
             # reference trainer resume (sac_decoupled.py:406-434): restore the
             # slice's params from the checkpoint, not the seed-matched init
             params = jax.tree_util.tree_map(jnp.asarray, resume_state["agent"])
-        gamma = float(cfg.algo.gamma)
-        tau = float(cfg.algo.tau)
-        num_critics = int(cfg.algo.critic.n)
         policy_steps_per_iter = int(cfg.env.num_envs * world_size)
-        target_period = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
-        action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
-        action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
-
-        actor_tx = instantiate(cfg.algo.actor.optimizer)
-        critic_tx = instantiate(cfg.algo.critic.optimizer)
-        alpha_tx = instantiate(cfg.algo.alpha.optimizer)
-        opt_state = {
-            "actor": actor_tx.init(params["actor"]),
-            "critic": critic_tx.init(params["critic"]),
-            "alpha": alpha_tx.init(params["log_alpha"]),
-        }
+        train_phase, init_opt_state = _build_sac_train(
+            cfg, actor, critic, target_entropy, policy_steps_per_iter
+        )
+        opt_state = init_opt_state(params)
         if resume_state is not None and resume_state.get("opt_state") is not None:
             opt_state = jax.tree_util.tree_map(jnp.asarray, resume_state["opt_state"])
-
-        def critic_loss_fn(critic_params, other, batch, step_key):
-            next_obs = batch["next_observations"]
-            mean, std = actor.apply({"params": other["actor"]}, next_obs)
-            next_actions, next_logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
-            target_q = critic.apply({"params": other["target_critic"]}, next_obs, next_actions)
-            alpha = jnp.exp(other["log_alpha"])
-            min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
-            next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
-            qf_values = critic.apply({"params": critic_params}, batch["observations"], batch["actions"])
-            return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
-
-        def actor_loss_fn(actor_params, other, batch, step_key):
-            mean, std = actor.apply({"params": actor_params}, batch["observations"])
-            actions, logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
-            qf_values = critic.apply({"params": other["critic"]}, batch["observations"], actions)
-            min_qf = jnp.min(qf_values, axis=-1, keepdims=True)
-            alpha = jnp.exp(jax.lax.stop_gradient(other["log_alpha"]))
-            return policy_loss(alpha, logprobs, min_qf), logprobs
-
-        def alpha_loss_fn(log_alpha, logprobs):
-            return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
-
-        # donate_argnums: XLA reuses the params/opt-state buffers in place instead
-        # of copying the whole train state every round (the loop always rebinds to
-        # the returned trees, so the invalidated inputs are never read again)
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def train_phase(params, opt_state, data, iter_num, train_key):
-            do_ema = (iter_num % target_period) == 0
-
-            def step(carry, inp):
-                params, opt_state = carry
-                batch, k = inp
-                k_critic, k_actor = jax.random.split(k)
-                qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k_critic)
-                updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
-                params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
-                opt_state = {**opt_state, "critic": new_copt}
-                params = {
-                    **params,
-                    "target_critic": jax.tree_util.tree_map(
-                        lambda t, c: jnp.where(do_ema, t * (1 - tau) + c * tau, t),
-                        params["target_critic"],
-                        params["critic"],
-                    ),
-                }
-                (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
-                    params["actor"], params, batch, k_actor
-                )
-                updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-                params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
-                opt_state = {**opt_state, "actor": new_aopt}
-                al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
-                updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
-                params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
-                opt_state = {**opt_state, "alpha": new_alopt}
-                return (params, opt_state), jnp.stack([qf_loss, a_loss, al_loss])
-
-            G = data["rewards"].shape[0]
-            keys = jax.random.split(train_key, G)
-            (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (data, keys))
-            return params, opt_state, losses.mean(axis=0)
 
         mesh_size = fabric.world_size
         if mesh_size > 1:
@@ -269,6 +286,513 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
         resilience.finalize()
 
 
+# ---------------------------------------------------------------------------------
+# buffer.backend=service: multi-actor ingestion into a standalone experience plane
+# (sheeprl_tpu/data/service.py, howto/fleet.md). Ranks 0..A-1 run env/act loops
+# that ship rows append-only over the KV object plane; the last rank hosts the
+# replay buffer + the SAME fused donated train program and samples exactly like
+# the local backend (sharded staging, prefetch, donation unchanged). Acting and
+# learning are decoupled: K actors' ingestion scales with K while the learner
+# trains at its own pace and publishes weights on a version-keyed plane.
+# ---------------------------------------------------------------------------------
+
+
+def _service_actor(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
+    """One actor process of the service topology: env stepping + acting +
+    append-only row ingestion + non-blocking weight refresh. Never trains, never
+    samples, never blocks on the learner (except the bounded flow-control
+    watermark and the exit gate)."""
+    from sheeprl_tpu.data.service import (
+        ExperienceWriter,
+        ServiceError,
+        WeightSubscriber,
+        coordination_kv,
+        service_namespace,
+        service_options,
+    )
+    from sheeprl_tpu.parallel import distributed
+
+    rank = distributed.process_index()
+    actors = int(layout["actors"])
+    num_envs = int(cfg.env.num_envs)  # per actor; actor PROCESSES are the scale axis
+    policy_steps_per_iter = num_envs * actors  # global transitions per iteration
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        # counters only: params come from the weight plane, the buffer lives
+        # with the learner — don't hold a (potentially buffer-sized) state
+        state = load_checkpoint(cfg.checkpoint.resume_from)
+        state.pop("rb", None)
+
+    log_dir = None
+    logger = None
+    if rank == 0:
+        log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, share=False)
+        logger = get_logger(fabric, cfg, log_dir=log_dir)
+        fabric.logger = logger
+        if logger is not None:
+            logger.log_hyperparams(cfg.as_dict())
+        fabric.print(f"Log dir: {log_dir}")
+        telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
+    else:
+        telemetry = build_role_telemetry(fabric, cfg, f"actor{rank}", rank=rank)
+    resilience = build_resilience(fabric, cfg, log_dir, telemetry=telemetry)
+    preempted = False
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * num_envs + i,
+                rank * num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    mlp_keys = cfg.algo.mlp_keys.encoder
+
+    # agent init from the SHARED seed (identical across every rank, learner
+    # included — the service learner never ships initial weights); the act
+    # key chain then forks per actor so exploration differs
+    key = fabric.seed_everything(cfg.seed)
+    key, agent_key = jax.random.split(key)
+    actor, _critic, params = build_agent(
+        fabric, cfg, observation_space, action_space, agent_key, None
+    )
+    key = jax.random.fold_in(key, rank)
+    action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
+    action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
+    if rank == 0 and fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if rank == 0 and not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    kv = coordination_kv()
+    if kv is None:
+        raise ServiceError(
+            "buffer.backend=service needs the jax.distributed coordination "
+            "service (launch through the gang supervisor or bring up "
+            "jax.distributed before the run)"
+        )
+    ns = service_namespace()
+    opts = service_options(cfg)
+    writer = ExperienceWriter(
+        kv,
+        ns,
+        rank,
+        max_inflight=opts["max_inflight"],
+        flush_every=opts["flush_every"],
+        poll_s=opts["poll_s"],
+        timeout_s=opts["timeout_s"],
+        abort_check=opts["abort_check"],
+    )
+    subscriber = WeightSubscriber(
+        kv,
+        ns,
+        poll_s=opts["poll_s"],
+        timeout_s=opts["timeout_s"],
+        abort_check=opts["abort_check"],
+    )
+
+    act = ActPlacement(fabric, lambda p: p["actor"])
+    act_on_cpu = act.on_cpu
+
+    @partial(jax.jit, backend="cpu" if act_on_cpu else None)
+    def act_fn(actor_params, obs: jax.Array, key):
+        key, step_key = jax.random.split(key)
+        mean, std = actor.apply({"params": actor_params}, obs)
+        actions, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+        return actions, key
+
+    act_params = act.view(params)
+    key = act.place(key)
+    weight_version = 0
+
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    start_iter = int(state["iter_num"]) + 1 if state is not None else 1
+    if state is not None:
+        # re-prefill window (reference sac.py:222-226): a resumed run refills
+        # from the env before the learner trains on a near-empty buffer
+        learning_starts += start_iter
+    policy_step = (start_iter - 1) * policy_steps_per_iter
+    last_log = 0
+
+    step_data: Dict[str, np.ndarray] = {}
+    # disjoint reset-seed spans per actor (SyncVectorEnv seeds env i with
+    # seed + i, so a +rank offset would overlap neighbouring actors)
+    obs = envs.reset(seed=cfg.seed + rank * num_envs)[0]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                flat_obs = prepare_obs(fabric, obs, mlp_keys=mlp_keys, num_envs=num_envs)
+                actions, key = act_fn(act_params, flat_obs, key)
+                actions = np.asarray(actions)
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                np.asarray(actions).reshape(envs.action_space.shape)
+            )
+            rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, -1)
+
+        ep_info = infos.get("final_info", infos)
+        if "episode" in ep_info:
+            ep = ep_info["episode"]
+            mask = ep.get("_r", ep_info.get("_episode", np.ones(num_envs, bool)))
+            rews, lens = ep["r"][mask], ep["l"][mask]
+            if aggregator and not aggregator.disabled and len(rews) > 0:
+                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
+        final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
+        if final_obs_arr is not None:
+            for idx in range(num_envs):
+                if final_obs_arr[idx] is not None:
+                    for k in mlp_keys:
+                        real_next_obs[k][idx] = np.asarray(final_obs_arr[idx][k])
+        flat_real_next = np.concatenate(
+            [real_next_obs[k].reshape(num_envs, -1) for k in mlp_keys], axis=-1
+        ).astype(np.float32)
+
+        step_data["terminated"] = np.asarray(terminated).reshape(1, num_envs, -1).astype(np.float32)
+        step_data["truncated"] = np.asarray(truncated).reshape(1, num_envs, -1).astype(np.float32)
+        step_data["actions"] = np.asarray(actions).reshape(1, num_envs, -1).astype(np.float32)
+        step_data["observations"] = np.concatenate(
+            [np.asarray(obs[k]).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+        ).astype(np.float32)[np.newaxis]
+        if not cfg.buffer.sample_next_obs:
+            step_data["next_observations"] = flat_real_next[np.newaxis]
+        step_data["rewards"] = rewards[np.newaxis]
+        # append-only ingestion (no local buffer): rows land in the learner's
+        # env slots [rank*num_envs, (rank+1)*num_envs)
+        writer.add(step_data, steps=policy_step)
+        obs = next_obs
+
+        # non-blocking weight refresh — the act path never waits on a round
+        payload = subscriber.poll()
+        if payload is not None:
+            act_params = act.place(payload["tree"])
+            weight_version = int(payload["version"])
+
+        preempted = resilience.preempt_requested()
+        telemetry.step(policy_step)
+        resilience.step(policy_step)
+        if (
+            rank == 0
+            and cfg.metric.log_level > 0
+            and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters)
+        ):
+            with timer("Time/logging_time"):
+                metrics_dict = aggregator.compute() if aggregator else {}
+                if logger is not None:
+                    logger.log_metrics(metrics_dict, policy_step)
+                    logger.log_metrics({"Params/weight_version": weight_version}, policy_step)
+                timer.to_dict(reset=True)
+                if aggregator:
+                    aggregator.reset()
+            last_log = policy_step
+        if preempted:
+            break
+
+    writer.close(preempted=preempted)
+    telemetry.emit_event("service", step=policy_step, role="actor", **writer.telemetry_snapshot())
+    # exit gate: leave together with the learner, so the gang's teardown
+    # grace window never SIGTERMs a learner still draining the backlog
+    if not writer.wait_done(timeout_s=float((cfg.buffer.get("service") or {}).get("done_timeout") or 300.0)):
+        warnings.warn("experience service: the learner never published its done marker")
+    payload = subscriber.poll()
+    if payload is not None:
+        act_params = act.place(payload["tree"])
+
+    envs.close()
+    wait_for_checkpoint()
+    if not resilience.finalize(policy_step) and rank == 0 and cfg.algo.run_test:
+        with timer("Time/test_time"):
+            test(actor.apply, jax.tree_util.tree_map(jnp.asarray, act_params), fabric, cfg, log_dir)
+    telemetry.close(policy_step)
+    if logger is not None:
+        logger.finalize()
+
+
+def _service_learner(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
+    """The service learner: hosts the experience buffer (fed by the ingest
+    thread), samples through the UNCHANGED replay sampler (sharded staging +
+    prefetch), runs the same donated fused train program as the local backend,
+    and publishes weights on the version-keyed plane. Owns checkpoints."""
+    import time as _time
+
+    from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer
+    from sheeprl_tpu.data.service import (
+        ExperienceService,
+        ServiceError,
+        WeightPublisher,
+        coordination_kv,
+        service_namespace,
+        service_options,
+    )
+    from sheeprl_tpu.parallel import distributed
+    from sheeprl_tpu.utils.logger import run_base_dir
+
+    rank = distributed.process_index()
+    actors = int(layout["actors"])
+    num_envs = int(cfg.env.num_envs)
+    total_envs = actors * num_envs
+    policy_steps_per_iter = total_envs
+
+    env = make_env(cfg, cfg.seed, 0, None, "learner")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    env.close()
+    key = fabric.seed_everything(cfg.seed)  # same init as every actor
+    key, agent_key = jax.random.split(key)
+    actor, critic, params = build_agent(fabric, cfg, observation_space, action_space, agent_key, None)
+    target_entropy = -float(int(np.prod(action_space.shape)))
+
+    telemetry = build_role_telemetry(fabric, cfg, "learner", rank=rank, leader=True)
+    resilience = build_resilience(fabric, cfg, None, telemetry=telemetry)
+    try:
+        kv = coordination_kv()
+        if kv is None:
+            raise ServiceError(
+                "buffer.backend=service needs the jax.distributed coordination service"
+            )
+        ns = service_namespace()
+        opts = service_options(cfg)
+
+        state = None
+        if cfg.checkpoint.resume_from:
+            from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+            state = load_checkpoint(cfg.checkpoint.resume_from)
+        if state is not None:
+            params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+
+        train_phase, init_opt_state = _build_sac_train(
+            cfg, actor, critic, target_entropy, policy_steps_per_iter
+        )
+        opt_state = init_opt_state(params)
+        if state is not None and state.get("opt_state") is not None:
+            opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+        if fabric.world_size > 1:
+            params = fabric.replicate_pytree(params)
+            opt_state = fabric.replicate_pytree(opt_state)
+
+        # the learner's artifact home: <run base>/learner — config.yaml next to
+        # checkpoint/ so resume/eval resolve it with the standard rules
+        learner_dir = str(run_base_dir(cfg.root_dir, cfg.run_name) / "learner")
+        os.makedirs(learner_dir, exist_ok=True)
+        save_configs(cfg, learner_dir)
+
+        buffer_size = cfg.buffer.size // total_envs if not cfg.dry_run else 8
+        rb = EnvIndependentReplayBuffer(
+            max(buffer_size, 1),
+            n_envs=total_envs,
+            obs_keys=("observations",),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(learner_dir, "memmap_buffer", f"rank_{rank}"),
+        )
+        rows_base = 0
+        if state is not None and "rb" in state:
+            rb = state["rb"]
+        if state is not None:
+            rows_base = int(state.get("service_rows") or 0)
+
+        sampler = make_replay_sampler(
+            rb,
+            cfg.buffer.get("prefetch"),
+            sample_kwargs=dict(
+                batch_size=cfg.algo.per_rank_batch_size * fabric.world_size,
+                sample_next_obs=bool(cfg.buffer.sample_next_obs),
+            ),
+            uint8_keys=(),
+            sharding=fabric.sharding(None, "data") if fabric.num_devices > 1 else None,
+            name="sac-service-prefetch",
+        )
+        telemetry.attach_sampler(sampler)
+
+        service = ExperienceService(
+            rb,
+            kv,
+            ns,
+            layout["actor_ranks"],
+            lock=sampler.lock,
+            poll_s=opts["poll_s"],
+            env_ids_of=lambda r: list(range(r * num_envs, (r + 1) * num_envs)),
+            validate_args=bool(cfg.buffer.validate_args),
+        ).start()
+        publisher = WeightPublisher(kv, ns)
+        publish_every = max(int((cfg.buffer.get("service") or {}).get("publish_every") or 1), 1)
+        # version 1 immediately: resumed/late actors act on restored weights
+        # without waiting for the first train round
+        publisher.publish(replicated_to_host(params)["actor"])
+
+        ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+        if state is not None and "ratio" in state:
+            ratio.load_state_dict(state["ratio"])
+        learning_starts = int(cfg.algo.learning_starts) if not cfg.dry_run else 0
+        if state is not None and "rb" not in state:
+            # re-prefill: without a restored buffer, wait for fresh rows before
+            # training (the actors' learning_starts shift mirrors this)
+            learning_starts += rows_base
+        # feed the governor steps-past-prefill (the local loops' semantics): the
+        # first warm consult then grants ~ratio x per-iteration rows, not a
+        # ratio x learning_starts burst
+        prefill_rows = max(learning_starts - policy_steps_per_iter, 0)
+        checkpoint_every = int(cfg.checkpoint.every)
+        last_checkpoint = rows_base
+        window_every = int(
+            (cfg.metric.get("telemetry") or {}).get("every") or cfg.metric.log_every
+        )
+        last_service_event = rows_base
+        cum_gsteps = 0
+        rounds = 0
+        key = jax.random.PRNGKey(cfg.seed + 1)
+        preempted = False
+        mean_losses = None
+        # fixed-size train rounds: the scan-based fused program compiles per
+        # DISTINCT G, and the async grant cadence would otherwise produce many
+        # one-off G values (each a fresh XLA compile). Accumulate grants into a
+        # debt and train in rounds of the local topology's per-iteration grant —
+        # ONE compiled shape in steady state, directly comparable learner
+        # gradient-steps/sec (the fleet_ingest bench's B-side)
+        round_size = max(int(policy_steps_per_iter * float(cfg.algo.replay_ratio)), 1)
+        grant_debt = 0
+
+        def checkpoint(rows: int, *, is_preempt: bool) -> None:
+            ckpt_state = {
+                "agent": replicated_to_host(params),
+                "opt_state": replicated_to_host(opt_state),
+                "ratio": ratio.state_dict(),
+                "iter_num": rows // policy_steps_per_iter,
+                "batch_size": cfg.algo.per_rank_batch_size * fabric.world_size,
+                "service_rows": rows,
+                "last_log": 0,
+                "last_checkpoint": rows,
+            }
+            ckpt_path = os.path.join(learner_dir, "checkpoint", f"ckpt_{rows}_{rank}.ckpt")
+            # quiesce both the prefetch worker AND the ingest thread writes: the
+            # pickled buffer must not be a torn mid-add/mid-sample snapshot
+            with sampler.lock, timer("Time/checkpoint_time"):
+                fabric.call(
+                    "on_checkpoint_player",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
+            resilience.observe_checkpoint(ckpt_path, rows, preempted=is_preempt)
+
+        while True:
+            service.raise_pending()
+            rows = rows_base + service.rows_total
+            preempted = resilience.preempt_requested()
+            eos = service.eos_all()
+            warm = rows >= learning_starts and rows > 0 and not any(rb.empty)
+            grant_debt += ratio(max(rows - prefill_rows, 0)) if warm else 0
+            # EOS flushes the residual debt as one final (odd-shaped) round
+            grant = (
+                round_size
+                if grant_debt >= round_size
+                else grant_debt
+                if eos
+                else 0
+            )
+            if grant > 0:
+                with timer("Time/train_time"):
+                    data = sampler.sample(grant)
+                    key, train_key = jax.random.split(key)
+                    params, opt_state, mean_losses = train_phase(
+                        params,
+                        opt_state,
+                        data,
+                        jnp.asarray(rows // policy_steps_per_iter),
+                        np.asarray(train_key),
+                    )
+                grant_debt -= grant
+                cum_gsteps += grant
+                rounds += 1
+                telemetry.observe_train(grant, mean_losses)
+                if rounds % publish_every == 0:
+                    publisher.publish(replicated_to_host(params)["actor"])
+            elif not eos:
+                _time.sleep(opts["poll_s"])  # let ingestion land
+            telemetry.step(rows)
+            resilience.step(rows)
+            if rows - last_service_event >= window_every:
+                last_service_event = rows
+                telemetry.emit_event(
+                    "service",
+                    step=rows,
+                    role="learner",
+                    gradient_steps=cum_gsteps,
+                    weight_version=publisher.version,
+                    **service.telemetry_snapshot(),
+                )
+            if checkpoint_every > 0 and rows - last_checkpoint >= checkpoint_every:
+                last_checkpoint = rows
+                checkpoint(rows, is_preempt=False)
+            if preempted or (eos and grant == 0):
+                break
+
+        rows = rows_base + service.rows_total
+        if preempted or cfg.checkpoint.save_last or cfg.dry_run:
+            checkpoint(rows, is_preempt=preempted or service.eos_preempted())
+        publisher.publish(replicated_to_host(params)["actor"], final=True)
+        telemetry.emit_event(
+            "service",
+            step=rows,
+            role="learner",
+            gradient_steps=cum_gsteps,
+            weight_version=publisher.version,
+            **service.telemetry_snapshot(),
+        )
+        service.mark_done()
+        sampler.close()
+        service.stop()
+        wait_for_checkpoint()
+        telemetry.close(rows)
+    finally:
+        resilience.finalize()
+
+
+def _service_main(fabric, cfg: Dict[str, Any]):
+    from sheeprl_tpu.data.service import service_layout
+    from sheeprl_tpu.parallel import distributed
+
+    layout = service_layout(cfg)
+    if layout["learners"] != 1:
+        raise ValueError(
+            f"buffer.backend=service currently takes exactly ONE learner process "
+            f"(got {layout['learners']}: {layout['nprocs']} processes, "
+            f"{layout['actors']} actors) — the learner's own local mesh is the "
+            "train mesh; multi-process learner slices ride buffer.backend=local's "
+            "channel topology"
+        )
+    rank = distributed.process_index()
+    if rank >= layout["actors"]:
+        fabric.process_group = layout["learner_ranks"]
+    fabric.local_mesh = True
+    fabric._setup()
+    if rank >= layout["actors"]:
+        return _service_learner(fabric, cfg, layout)
+    return _service_actor(fabric, cfg, layout)
+
+
 @register_algorithm(decoupled=True)
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.parallel import distributed
@@ -276,6 +800,11 @@ def main(fabric, cfg: Dict[str, Any]):
     if len(cfg.algo.cnn_keys.encoder) > 0:
         warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
         cfg.algo.cnn_keys.encoder = []
+
+    if str(cfg.buffer.get("backend", "local")) == "service":
+        # standalone experience plane: K actor processes + 1 learner process
+        # (raises with an actionable message on a single-process launch)
+        return _service_main(fabric, cfg)
 
     two_process = distributed.process_count() >= 2
     if two_process:

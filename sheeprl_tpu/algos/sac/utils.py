@@ -24,7 +24,7 @@ def prepare_obs(
 ) -> jax.Array:
     """Concatenate the mlp-key observations into one flat float array
     [num_envs, obs_dim] (reference utils.py:prepare_obs)."""
-    with jax.default_device(jax.devices("cpu")[0]):
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
         return jnp.concatenate(
             [np.asarray(obs[k], dtype=np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
         )

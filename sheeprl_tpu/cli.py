@@ -179,6 +179,34 @@ def check_configs(cfg: dotdict) -> None:
                 "consumer — see howto/model_parallel.md."
             )
 
+    # experience-backend sanity (sheeprl_tpu/data/service.py, howto/fleet.md):
+    # fail before launch on a config that cannot form a service plane
+    backend = str(cfg.buffer.get("backend", "local") if cfg.get("buffer") else "local")
+    if backend not in ("local", "service"):
+        raise ValueError(
+            f"unknown buffer.backend {backend!r}; available: local (in-process replay, "
+            "the default) and service (standalone experience data plane for the "
+            "decoupled topologies — see howto/fleet.md)"
+        )
+    if backend == "service":
+        if cfg.algo.name not in ("sac_decoupled", "dreamer_v3_decoupled"):
+            raise ValueError(
+                f"buffer.backend=service is wired for the decoupled actor/learner "
+                f"topologies (sac_decoupled, dreamer_v3_decoupled), not {cfg.algo.name!r}"
+            )
+        service_cfg = cfg.buffer.get("service") or {}
+        actors = int(service_cfg.get("actors") or 1)
+        if actors < 1:
+            raise ValueError(f"buffer.service.actors must be >= 1, got {actors}")
+        from sheeprl_tpu.resilience.distributed import gang_processes
+
+        gang_size = gang_processes(cfg)
+        if gang_size and actors >= gang_size:
+            raise ValueError(
+                f"buffer.service.actors={actors} leaves no learner rank in a "
+                f"{gang_size}-process gang (need actors <= gang.processes - 1)"
+            )
+
     # optional-dependency downgrade (reference cli.py:333-340)
     if not cfg.model_manager.get("disabled", True):
         from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
@@ -525,6 +553,19 @@ def fault_matrix(args: Optional[Sequence[str]] = None) -> int:
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     return subprocess.call(cmd, env=env, cwd=repo_root)
+
+
+def fleet(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py fleet <spec.yaml>`` — schedule N member runs (seed/env
+    sweeps) as one fleet: per-member bounded-restart supervision (resume strictly
+    inside the member's dir), a SHARED persistent XLA compile cache (the first
+    member compiles, the rest cold-start as cache hits), and fleet-level rollups
+    — ``leaderboard.json`` ranked from the members' telemetry fingerprints +
+    summaries, ``obs/compare`` findings across the sweep, ``--fail-on`` CI gate.
+    See ``howto/fleet.md`` for the spec format and the leaderboard schema."""
+    from sheeprl_tpu.fleet.runner import main as fleet_main
+
+    return fleet_main(list(args if args is not None else sys.argv[1:]))
 
 
 def watch(args: Optional[Sequence[str]] = None) -> int:

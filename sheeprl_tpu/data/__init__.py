@@ -10,14 +10,28 @@ from sheeprl_tpu.data.prefetch import (
     SyncReplaySampler,
     make_replay_sampler,
 )
+from sheeprl_tpu.data.service import (
+    ExperienceService,
+    ExperienceWriter,
+    WeightPublisher,
+    WeightSubscriber,
+    service_layout,
+    service_options,
+)
 
 __all__ = [
     "EnvIndependentReplayBuffer",
     "EpisodeBuffer",
+    "ExperienceService",
+    "ExperienceWriter",
     "ReplayBuffer",
     "ReplaySamplePrefetcher",
     "SequentialReplayBuffer",
     "SyncReplaySampler",
+    "WeightPublisher",
+    "WeightSubscriber",
     "get_tensor",
     "make_replay_sampler",
+    "service_layout",
+    "service_options",
 ]

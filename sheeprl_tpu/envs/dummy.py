@@ -4,6 +4,7 @@ episode length."""
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Tuple
 
 import gymnasium as gym
@@ -16,7 +17,12 @@ class BaseDummyEnv(gym.Env):
         image_size: Tuple[int, int, int] = (3, 64, 64),
         n_steps: int = 128,
         vector_shape: Tuple[int, ...] = (10,),
+        step_latency_ms: float = 0.0,
     ):
+        # step_latency_ms > 0 paces each step like a real emulator frame
+        # (Atari ~5-20 ms): the fleet_ingest bench uses it so multi-actor
+        # ingestion scaling measures the DATA PLANE, not single-core contention
+        self._step_latency_s = float(step_latency_ms) / 1000.0
         self.observation_space = gym.spaces.Dict(
             {
                 "rgb": gym.spaces.Box(0, 255, shape=image_size, dtype=np.uint8),
@@ -35,6 +41,8 @@ class BaseDummyEnv(gym.Env):
         }
 
     def step(self, action):
+        if self._step_latency_s > 0:
+            time.sleep(self._step_latency_s)
         done = self._current_step == self._n_steps
         self._current_step += 1
         return self.get_obs(), 0.0, done, False, {}
@@ -58,9 +66,13 @@ class ContinuousDummyEnv(BaseDummyEnv):
         n_steps: int = 128,
         vector_shape: Tuple[int, ...] = (10,),
         action_dim: int = 2,
+        step_latency_ms: float = 0.0,
     ):
         self.action_space = gym.spaces.Box(-1.0, 1.0, shape=(action_dim,))
-        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape)
+        super().__init__(
+            image_size=image_size, n_steps=n_steps, vector_shape=vector_shape,
+            step_latency_ms=step_latency_ms,
+        )
 
 
 class DiscreteDummyEnv(BaseDummyEnv):
@@ -70,9 +82,13 @@ class DiscreteDummyEnv(BaseDummyEnv):
         n_steps: int = 4,
         vector_shape: Tuple[int, ...] = (10,),
         action_dim: int = 2,
+        step_latency_ms: float = 0.0,
     ):
         self.action_space = gym.spaces.Discrete(action_dim)
-        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape)
+        super().__init__(
+            image_size=image_size, n_steps=n_steps, vector_shape=vector_shape,
+            step_latency_ms=step_latency_ms,
+        )
 
 
 class MultiDiscreteDummyEnv(BaseDummyEnv):
@@ -82,6 +98,10 @@ class MultiDiscreteDummyEnv(BaseDummyEnv):
         n_steps: int = 128,
         vector_shape: Tuple[int, ...] = (10,),
         action_dims: List[int] = [2, 2],
+        step_latency_ms: float = 0.0,
     ):
         self.action_space = gym.spaces.MultiDiscrete(action_dims)
-        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape)
+        super().__init__(
+            image_size=image_size, n_steps=n_steps, vector_shape=vector_shape,
+            step_latency_ms=step_latency_ms,
+        )

@@ -87,7 +87,7 @@ class JaxToGymEnv(gym.Env):
         # state stays committed across steps (jit's deprecated backend= kwarg
         # is avoided — the ActPlacement device-split reasoning applies: a
         # per-step dispatch to an accelerator dwarfs a classic-control step)
-        self._cpu = jax.devices("cpu")[0]
+        self._cpu = jax.local_devices(backend="cpu")[0]
         self._reset_fn = jax.jit(self._env.reset)
         self._step_fn = jax.jit(self._env.step)
         self._key = jax.device_put(jax.random.PRNGKey(seed), self._cpu)

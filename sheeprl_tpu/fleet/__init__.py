@@ -1,0 +1,36 @@
+"""Fleet tier: many member runs as one schedulable, comparable unit.
+
+MindSpeed RL (arxiv 2507.19017) argues the unit of production RL is the fleet
+— seed sweeps, env sweeps, PBT-style exploration — not the single run. This
+package generalizes the PR 8 restart-policy supervisors into a fleet runner:
+
+- :mod:`~sheeprl_tpu.fleet.spec` — the fleet spec file (YAML/JSON): base
+  overrides, explicit members or a cartesian ``sweep``, scheduling and
+  restart-policy knobs;
+- :mod:`~sheeprl_tpu.fleet.runner` — ``python sheeprl.py fleet <spec>``:
+  schedules the members as supervised child runs
+  (``resilience/restart_policy.py`` per member) with a SHARED persistent XLA
+  compile cache — the first member compiles, the rest cold-start as cache hits
+  (``compile.cold == 0``, measured from the telemetry compile gauges);
+- :mod:`~sheeprl_tpu.fleet.rollup` — fleet-level rollups from fingerprints +
+  telemetry summaries: ``leaderboard.json`` (ranked members, compile/cold-start
+  accounting, diagnosis verdicts) and ``obs/compare`` across the sweep with a
+  ``--fail-on`` gate.
+
+A fleet dir carries a ``fleet.json`` marker; ``obs/streams.py`` discovery,
+``watch`` and ``diagnose`` recognize it and treat the member runs as one unit.
+See ``howto/fleet.md``.
+"""
+
+from sheeprl_tpu.fleet.rollup import build_leaderboard, member_rollup
+from sheeprl_tpu.fleet.runner import run_fleet
+from sheeprl_tpu.fleet.spec import FLEET_MARKER, expand_members, load_spec
+
+__all__ = [
+    "FLEET_MARKER",
+    "build_leaderboard",
+    "expand_members",
+    "load_spec",
+    "member_rollup",
+    "run_fleet",
+]

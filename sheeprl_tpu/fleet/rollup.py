@@ -1,0 +1,215 @@
+"""Fleet rollups: leaderboard + cross-member comparison from telemetry.
+
+Every member run already streams fingerprinted telemetry (``obs/``); the rollup
+only READS — fingerprints from ``start`` events, throughput/compile/memory from
+``summary`` events, verdicts from the diagnosis catalog, regression findings
+from ``obs/compare`` against the sweep's baseline member. ``leaderboard.json``
+is the fleet-level artifact CI gates on (schema in ``howto/fleet.md``)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+_SEVERITY_RANK = {"critical": 0, "warning": 1, "info": 2}
+
+
+def member_rollup(member_dir: str) -> Dict[str, Any]:
+    """One member's telemetry digest: fingerprint, summary throughput, compile
+    accounting (``cold = count - cache_hits`` — the shared-compile-cache gauge),
+    attempts, diagnosis severity counts."""
+    from sheeprl_tpu.obs.diagnose import run_detectors
+    from sheeprl_tpu.obs.streams import discover_streams, merged_events
+
+    out: Dict[str, Any] = {
+        "dir": str(member_dir),
+        "streams": len(discover_streams(str(member_dir))),
+        "fingerprint": None,
+        "summary": None,
+        "compile": None,
+        "attempts": 0,
+        "clean_exit": None,
+        "diagnosis": None,
+    }
+    if not out["streams"]:
+        return out
+    events = merged_events(str(member_dir))
+    starts = [e for e in events if e.get("event") == "start"]
+    if starts:
+        out["fingerprint"] = starts[-1].get("fingerprint")
+    summaries = [e for e in events if e.get("event") == "summary"]
+    if summaries:
+        summary = summaries[-1]
+        out["summary"] = {
+            k: summary.get(k)
+            for k in ("sps", "total_steps", "wall_seconds", "train_units", "mfu", "windows")
+        }
+        out["clean_exit"] = bool(summary.get("clean_exit", True))
+        compile_ = dict(summary.get("compile") or {})
+        if compile_:
+            count = int(compile_.get("count") or 0)
+            hits = int(compile_.get("cache_hits") or 0)
+            compile_["cold"] = max(count - hits, 0)
+        out["compile"] = compile_ or None
+    out["attempts"] = 1 + max((int(e.get("attempt") or 0) for e in events), default=0)
+    findings = run_detectors(events)
+    out["diagnosis"] = {
+        "critical": sum(1 for f in findings if f.get("severity") == "critical"),
+        "warning": sum(1 for f in findings if f.get("severity") == "warning"),
+        "info": sum(1 for f in findings if f.get("severity") == "info"),
+        "findings": [
+            {k: f.get(k) for k in ("detector", "severity", "summary")} for f in findings
+        ],
+    }
+    return out
+
+
+def compare_member(baseline_dir: str, member_dir: str) -> Optional[Dict[str, Any]]:
+    """``obs/compare`` of one member against the sweep baseline; writes the
+    standard ``comparison.json`` into the member dir. None when either side has
+    no stream (the member then reads as incomparable, not failed)."""
+    from sheeprl_tpu.obs.compare import compare_runs
+
+    try:
+        result = compare_runs(str(baseline_dir), str(member_dir))
+    except FileNotFoundError:
+        return None
+    findings = [
+        {k: f.get(k) for k in ("detector", "severity", "summary")}
+        for f in result.get("findings") or []
+    ]
+    return {
+        "baseline": str(baseline_dir),
+        # fingerprint-INCOMPATIBLE pairs (a seed sweep differs in config_hash by
+        # construction) are different experiments: their deltas are recorded for
+        # the operator but must not drive the gate — compare itself stamps the
+        # mismatch finding, which is the signal the gate keys on
+        "compatible": not any(f.get("detector") == "fingerprint_mismatch" for f in findings),
+        "findings": findings,
+        "json_path": result.get("json_path"),
+    }
+
+
+def _rank_key(entry: Dict[str, Any], rank_by: str):
+    value = ((entry.get("summary") or {}).get(rank_by))
+    # completed members with a number first (descending), the rest last
+    return (value is None, -(value if isinstance(value, (int, float)) else 0.0))
+
+
+def build_leaderboard(
+    fleet_dir: str,
+    spec: Dict[str, Any],
+    results: List[Dict[str, Any]],
+    *,
+    fail_on: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Assemble + write ``<fleet_dir>/leaderboard.json``.
+
+    ``results``: one dict per member from the runner —
+    ``{name, dir, outcome, exit_code, attempts}``. The rollup attaches telemetry
+    digests, ranks by ``spec['rank_by']``, runs the cross-member compare against
+    the baseline, and computes the gate verdict: a member that crashed/gave up
+    fails the fleet; ``fail_on`` additionally gates on diagnosis + compare
+    finding severities across every member."""
+    rank_by = spec.get("rank_by") or "sps"
+    compare_cfg = spec.get("compare") or {}
+    fail_on = fail_on if fail_on is not None else compare_cfg.get("fail_on")
+
+    entries: List[Dict[str, Any]] = []
+    for result in results:
+        entry = dict(result)
+        entry.update(member_rollup(result["dir"]))
+        # the RUNNER's attempt count is authoritative: a member that crashed
+        # before emitting any telemetry still made its attempts, and the
+        # telemetry-derived count (from attempt stamps) would under-report them
+        entry["attempts"] = max(
+            int(result.get("attempts") or 0), int(entry.get("attempts") or 0)
+        )
+        entry["dir"] = os.path.relpath(result["dir"], fleet_dir)
+        entries.append(entry)
+
+    baseline_name = compare_cfg.get("baseline") or "first"
+    if baseline_name == "first":
+        baseline_name = results[0]["name"] if results else None
+    baseline_dir = next((r["dir"] for r in results if r["name"] == baseline_name), None)
+    if baseline_dir is not None:
+        for entry, result in zip(entries, results):
+            if result["name"] == baseline_name:
+                continue
+            entry["compare"] = compare_member(baseline_dir, result["dir"])
+
+    entries.sort(key=lambda e: _rank_key(e, rank_by))
+    for position, entry in enumerate(entries):
+        entry["rank"] = position + 1
+
+    reasons: List[str] = []
+    for entry in entries:
+        if entry.get("outcome") not in ("completed", "preempted"):
+            reasons.append(f"member {entry['name']}: outcome {entry.get('outcome')!r}")
+        if fail_on:
+            gate = _SEVERITY_RANK[fail_on]
+            diagnosis = entry.get("diagnosis") or {}
+            for finding in diagnosis.get("findings") or []:
+                if _SEVERITY_RANK.get(finding.get("severity"), 3) <= gate:
+                    reasons.append(
+                        f"member {entry['name']}: diagnosis {finding.get('severity')} "
+                        f"({finding.get('detector')})"
+                    )
+            compare = entry.get("compare") or {}
+            # only fingerprint-COMPATIBLE pairs gate: cross-seed/cross-config
+            # members are different experiments whose deltas are informational
+            if compare.get("compatible"):
+                for finding in compare.get("findings") or []:
+                    if _SEVERITY_RANK.get(finding.get("severity"), 3) <= gate:
+                        reasons.append(
+                            f"member {entry['name']}: compare {finding.get('severity')} "
+                            f"({finding.get('detector')})"
+                        )
+
+    leaderboard = {
+        "schema": 1,
+        "fleet": spec.get("name"),
+        "generated_at": round(time.time(), 3),
+        "rank_by": rank_by,
+        "baseline": baseline_name,
+        "members": entries,
+        "gate": {"fail_on": fail_on, "failed": bool(reasons), "reasons": reasons},
+    }
+    path = os.path.join(fleet_dir, "leaderboard.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(leaderboard, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, path)
+    leaderboard["json_path"] = path
+    return leaderboard
+
+
+def format_leaderboard(leaderboard: Dict[str, Any]) -> str:
+    """Human summary of a leaderboard (the fleet CLI's report)."""
+    lines = [
+        f"Fleet {leaderboard.get('fleet')} — ranked by {leaderboard.get('rank_by')} "
+        f"(baseline: {leaderboard.get('baseline')})"
+    ]
+    for entry in leaderboard.get("members") or []:
+        summary = entry.get("summary") or {}
+        compile_ = entry.get("compile") or {}
+        diagnosis = entry.get("diagnosis") or {}
+        value = summary.get(leaderboard.get("rank_by"))
+        lines.append(
+            f"  #{entry.get('rank')} {entry['name']:<24} "
+            + (f"{value:>10.1f}" if isinstance(value, (int, float)) else f"{'—':>10}")
+            + f"  outcome={entry.get('outcome')}"
+            + f" attempts={entry.get('attempts')}"
+            + f" compiles={compile_.get('count', '?')}(cold {compile_.get('cold', '?')})"
+            + f" findings={diagnosis.get('critical', 0)}c/{diagnosis.get('warning', 0)}w"
+        )
+    gate = leaderboard.get("gate") or {}
+    if gate.get("failed"):
+        lines.append(f"  GATE FAILED ({gate.get('fail_on')}):")
+        lines.extend(f"    - {reason}" for reason in gate.get("reasons") or [])
+    else:
+        lines.append("  gate: green")
+    return "\n".join(lines)

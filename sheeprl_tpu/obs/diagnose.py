@@ -769,6 +769,72 @@ def diagnose_run(run_dir: str, json_path: Optional[str] = None) -> Dict[str, Any
     return result
 
 
+def diagnose_fleet(
+    fleet_dir: str, members: Dict[str, str], json_path: Optional[str] = None
+) -> Dict[str, Any]:
+    """Diagnose every member run of a fleet dir as ONE unit: per-member
+    ``diagnose_run`` (each member keeps its own ``diagnosis.json``), plus an
+    aggregate ``diagnosis.json`` at the fleet root whose ``findings`` are the
+    union (member-tagged) — so ``--fail-on`` gates the whole sweep."""
+    member_results: Dict[str, Any] = {}
+    findings: List[Finding] = []
+    for name, member_dir in members.items():
+        try:
+            result = diagnose_run(member_dir)
+        except FileNotFoundError:
+            member_results[name] = {"error": "no telemetry stream"}
+            continue
+        member_results[name] = {
+            k: result.get(k) for k in ("findings", "attribution", "counts", "json_path")
+        }
+        for finding in result.get("findings") or []:
+            findings.append({**finding, "member": name})
+    if all("error" in r for r in member_results.values()):
+        raise FileNotFoundError(
+            f"no telemetry*.jsonl stream found under any member of fleet {fleet_dir!r}"
+        )
+    findings.sort(key=lambda f: _SEVERITY_RANK.get(f["severity"], 3))
+    aggregate = {
+        "fleet": str(fleet_dir),
+        "members": member_results,
+        "findings": findings,
+        "counts": {
+            "members": len(members),
+            "diagnosed": sum(1 for r in member_results.values() if "error" not in r),
+        },
+    }
+    out = json_path or os.path.join(str(fleet_dir), "diagnosis.json")
+    with open(out, "w") as fh:
+        json.dump(aggregate, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    aggregate["json_path"] = out
+    return aggregate
+
+
+def format_fleet_report(result: Dict[str, Any]) -> str:
+    """Human report for a fleet diagnosis: one block per member."""
+    lines = [f"Fleet telemetry diagnosis — {result.get('fleet')}"]
+    counts = result.get("counts") or {}
+    lines.append(f"  members : {counts.get('diagnosed', 0)}/{counts.get('members', 0)} diagnosed")
+    for name, member in (result.get("members") or {}).items():
+        if "error" in member:
+            lines.append(f"  [{name}] {member['error']}")
+            continue
+        member_findings = member.get("findings") or []
+        att = member.get("attribution") or {}
+        lines.append(
+            f"  [{name}] {len(member_findings)} finding(s)"
+            + (
+                f", {att['named_fraction']:.0%} attributed over {att['windows']} window(s)"
+                if att
+                else ""
+            )
+        )
+        for f in member_findings:
+            lines.append(f"    [{f['severity'].upper()}] {f['detector']}: {f['summary']}")
+    return "\n".join(lines)
+
+
 def format_report(result: Dict[str, Any]) -> str:
     """Human bottleneck report for one diagnosis result."""
     lines: List[str] = []
@@ -831,13 +897,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="exit 1 when any finding is at least this severe",
     )
     args = parser.parse_args(list(argv) if argv is not None else sys.argv[1:])
+    from sheeprl_tpu.obs.streams import fleet_members
+
+    members = fleet_members(args.run_dir)
     try:
-        result = diagnose_run(args.run_dir, json_path=args.json_path)
+        if members:
+            # a fleet dir diagnoses as ONE unit: per-member reports + an
+            # aggregate whose member-tagged findings drive --fail-on
+            result = diagnose_fleet(args.run_dir, members, json_path=args.json_path)
+        else:
+            result = diagnose_run(args.run_dir, json_path=args.json_path)
     except FileNotFoundError as exc:
         print(f"diagnose: {exc}", file=sys.stderr)
         return 2
     if not args.quiet:
-        print(format_report(result))
+        print(format_fleet_report(result) if members else format_report(result))
         print(f"\nwrote {result['json_path']}")
     if args.fail_on:
         gate = _SEVERITY_RANK[args.fail_on]

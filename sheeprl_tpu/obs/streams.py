@@ -40,8 +40,10 @@ __all__ = [
     "RunFollower",
     "StreamCursor",
     "discover_streams",
+    "fleet_members",
     "is_primary_event",
     "load_stream",
+    "member_of",
     "merge_streams",
     "merged_events",
 ]
@@ -56,6 +58,36 @@ def is_primary_event(event: Dict[str, Any]) -> bool:
     this predicate, which is why it lives here and not in either consumer."""
     stream = str(event.get("stream") or "telemetry.jsonl")
     return int(event.get("rank") or 0) == 0 and os.path.basename(stream) == "telemetry.jsonl"
+
+
+def fleet_members(run_dir: str) -> Optional[Dict[str, str]]:
+    """When ``run_dir`` is a FLEET directory (``sheeprl.py fleet`` writes a
+    ``fleet.json`` marker), the member-name → member-run-dir mapping; None for
+    an ordinary run dir. Flat stream discovery would merge every member's
+    rank-0 ``telemetry.jsonl`` into one confused "run" (N start events, N
+    summaries); consumers that want per-run semantics (``diagnose``, ``watch``)
+    use this to treat the fleet as one unit of N member runs instead."""
+    if not os.path.isdir(str(run_dir)):
+        return None
+    from sheeprl_tpu.fleet.spec import read_marker
+
+    marker = read_marker(str(run_dir))
+    if marker is None:
+        return None
+    members = marker.get("members") or {}
+    return {
+        str(name): os.path.join(str(run_dir), str(rel)) for name, rel in sorted(members.items())
+    }
+
+
+def member_of(stream_label: str) -> Optional[str]:
+    """The fleet member a (relative) stream label belongs to — labels of member
+    streams start with ``members/<name>/`` under a fleet dir — or None for the
+    fleet's own stream (``telemetry.fleet.jsonl``) / a non-fleet label."""
+    parts = str(stream_label).replace(os.sep, "/").split("/")
+    if len(parts) >= 3 and parts[0] == "members":
+        return parts[1]
+    return None
 
 
 def discover_streams(run_dir: str) -> List[str]:

@@ -311,8 +311,8 @@ class RunTelemetry:
         self._prefetch_total: Dict[str, float] = {}
         self._programs: Dict[str, Dict[str, Any]] = {}
         self._mfu_flops_per_unit: Optional[float] = None
-        self._compile_base = {"count": 0, "seconds": 0.0}
-        self._compile_last = {"count": 0, "seconds": 0.0}
+        self._compile_base = {"count": 0, "seconds": 0.0, "cache_hits": 0}
+        self._compile_last = {"count": 0, "seconds": 0.0, "cache_hits": 0}
         self._last_mfu: Optional[float] = None
         self._peak_hbm = 0
         self._last_step: Optional[int] = None
@@ -412,7 +412,7 @@ class RunTelemetry:
             if span is not None and span._start is not None:
                 span._start += spent
             compiles_after = compile_snapshot()
-            for key in ("count", "seconds"):
+            for key in ("count", "seconds", "cache_hits"):
                 own = compiles_after[key] - compiles_before[key]
                 self._compile_base[key] += own
                 self._compile_last[key] += own
@@ -573,6 +573,10 @@ class RunTelemetry:
                 compile={
                     "count": snap["count"] - self._compile_base["count"],
                     "seconds": round(snap["seconds"] - self._compile_base["seconds"], 3),
+                    # persistent-cache hits counted inside `count`: count minus
+                    # cache_hits is the COLD compiles (the fleet cold-start gauge)
+                    "cache_hits": snap.get("cache_hits", 0)
+                    - self._compile_base.get("cache_hits", 0),
                 },
                 hbm_peak_bytes=peak_hbm,
                 rss_peak_bytes=rss_peak_bytes(),

@@ -36,9 +36,14 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Sequence, TextIO
 
-from sheeprl_tpu.obs.streams import RunFollower, is_primary_event as _is_primary
+from sheeprl_tpu.obs.streams import (
+    RunFollower,
+    fleet_members as _fleet_members,
+    is_primary_event as _is_primary,
+    member_of as _member_of,
+)
 
-__all__ = ["WatchState", "main", "watch_run"]
+__all__ = ["FleetWatchState", "WatchState", "main", "watch_run"]
 
 # phase → (bar glyph, short label); order matches the loop's own wall-time layout
 _PHASE_GLYPHS = (
@@ -288,6 +293,88 @@ class WatchState:
         return "\n".join(lines)
 
 
+class FleetWatchState:
+    """Watch a FLEET dir (``sheeprl.py fleet``) as one unit: one
+    :class:`WatchState` per member (events routed by their ``members/<name>/``
+    stream prefix), plus the runner's own ``telemetry.fleet.jsonl`` events
+    (member spawn/exit, restarts, the terminal ``fleet`` ``status=done`` with
+    the gate verdict). The watch ends when the runner publishes its done event
+    — or, if the runner died, when every member's summary landed — and exits
+    with the GATE's verdict when available."""
+
+    def __init__(self, members: Sequence[str]) -> None:
+        self.members: Dict[str, WatchState] = {name: WatchState() for name in members}
+        self.outcomes: Dict[str, str] = {}
+        self.fleet_done: Optional[Dict[str, Any]] = None
+        self.events_seen = 0
+        self.gave_up = False  # a member giveup is a member verdict, not a fleet end
+
+    def consume(self, events: Sequence[Dict[str, Any]]) -> None:
+        for event in events:
+            self.events_seen += 1
+            member = _member_of(event.get("stream") or "")
+            if member is not None:
+                state = self.members.setdefault(member, WatchState())
+                state.consume([event])
+                continue
+            kind = event.get("event")
+            if kind == "fleet" and event.get("status") == "done":
+                self.fleet_done = event
+                self.outcomes.update(event.get("outcomes") or {})
+            elif kind == "member" and event.get("status") == "exit":
+                name = str(event.get("member"))
+                self.outcomes[name] = str(event.get("outcome"))
+            elif kind in ("restart", "giveup") and event.get("member") is not None:
+                name = str(event.get("member"))
+                state = self.members.get(name)
+                if state is not None:
+                    state.consume([event])
+
+    @property
+    def finished(self) -> bool:
+        if self.fleet_done is not None:
+            return True
+        return bool(self.members) and all(s.finished for s in self.members.values())
+
+    @property
+    def exit_code(self) -> int:
+        if self.fleet_done is not None:
+            gate = self.fleet_done.get("gate") or {}
+            return 1 if gate.get("failed") else 0
+        codes = [s.exit_code for s in self.members.values()]
+        return max(codes, default=2)
+
+    @property
+    def status_line(self) -> str:
+        done = sum(1 for s in self.members.values() if s.finished)
+        if self.fleet_done is not None:
+            gate = self.fleet_done.get("gate") or {}
+            return f"fleet done — gate {'FAILED' if gate.get('failed') else 'green'}"
+        return f"fleet running — {done}/{len(self.members)} member(s) finished"
+
+    def render(self, run_dir: str, elapsed: float, streams: Sequence[str]) -> str:
+        lines = [
+            f"watch {run_dir} · {elapsed:.0f}s · {len(streams)} stream(s) · "
+            f"{len(self.members)} member(s) · {self.status_line}"
+        ]
+        for name in sorted(self.members):
+            state = self.members[name]
+            window = state.window or {}
+            outcome = self.outcomes.get(name)
+            bits = [
+                f"step {window.get('step', '—')}",
+                f"{window.get('sps', 0.0):.1f} sps" if window else "no window yet",
+                state.status_line if outcome is None else f"exit: {outcome}",
+            ]
+            if state.restarts:
+                bits.append(f"{state.restarts} restart(s)")
+            findings = [f for f in state.findings if f.get("severity") in ("warning", "critical")]
+            if findings:
+                bits.append(f"{len(findings)} finding(s)")
+            lines.append(f"  [{name}] " + " · ".join(bits))
+        return "\n".join(lines)
+
+
 def watch_run(
     run_dir: str,
     *,
@@ -307,11 +394,21 @@ def watch_run(
         plain = not (hasattr(out, "isatty") and out.isatty())
     grace = grace if grace is not None else max(2.0 * interval, 2.0)
     follower = RunFollower(run_dir)
-    state = WatchState()
+    state: Any = WatchState()
+    fleet = _fleet_members(run_dir)
+    if fleet:
+        state = FleetWatchState(list(fleet))
     began = time.monotonic()
     finished_at: Optional[float] = None
     last_frame = ""
     while True:
+        # a fleet marker can land moments after the watch starts (watch is
+        # typically launched alongside `sheeprl.py fleet`): until the first
+        # event arrives, keep probing and upgrade to the fleet view
+        if not isinstance(state, FleetWatchState) and state.events_seen == 0:
+            fleet = _fleet_members(run_dir)
+            if fleet:
+                state = FleetWatchState(list(fleet))
         batch = follower.poll()
         state.consume(batch)
         now = time.monotonic()

@@ -37,7 +37,18 @@ def _ln_gru_kernel(inp_ref, hx_ref, w_ref, b_ref, scale_ref, bias_ref, out_ref, 
     # operands keep their storage dtype (bf16 inputs feed the MXU natively);
     # accumulation and the layernorm/gating chain run in f32. The per-feature
     # vectors arrive as (1, 3H) blocks — TPU tiling wants >=2-D operands.
-    gates = jnp.dot(inp_ref[...], w_ref[...], preferred_element_type=jnp.float32)
+    # The dot precision is pinned explicitly: Mosaic only lowers DEFAULT/HIGHEST,
+    # so inheriting the repo's global jax_default_matmul_precision="high"
+    # (bf16_3x) makes the WHOLE kernel fail to lower for TPU — caught by the AOT
+    # suite (tests/test_ops/test_tpu_lowering.py). DEFAULT is the MXU-native
+    # pass the kernel was designed around (bf16 multiply, f32 accumulate); the
+    # fused win is VMEM locality, not multiply precision.
+    gates = jnp.dot(
+        inp_ref[...],
+        w_ref[...],
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT,
+    )
     gates = gates + b_ref[...].astype(jnp.float32)
     # LayerNorm over the full 3H feature axis (reference norms the stacked
     # projection before splitting into gates)

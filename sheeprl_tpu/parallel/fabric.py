@@ -170,6 +170,17 @@ class Fabric:
         return self.global_rank == 0
 
     @property
+    def is_group_zero(self) -> bool:
+        """Leader of this fabric's PROCESS GROUP: ``is_global_zero`` on the
+        default whole-job mesh, the lowest member rank under a ``process_group``
+        role split. Gates IO owned by the group rather than the job — e.g. the
+        experience-service learner's checkpoints (``buffer.backend=service``),
+        written by a role whose leader is not process 0."""
+        if self.process_group is None:
+            return self.is_global_zero
+        return self.global_rank == min(self.process_group)
+
+    @property
     def device(self) -> jax.Device:
         return self.devices[0]
 
@@ -376,7 +387,9 @@ class Fabric:
         consolidated file — reference fabric.save semantics) or ``sharded`` (orbax
         directory, optionally async — the XL/pod-scale option). The backend is set
         from ``cfg.checkpoint.backend`` by the CLI."""
-        if self.is_global_zero:
+        # group leader, not global zero: a process_group role whose leader is not
+        # process 0 (the experience-service learner) still owns ITS checkpoints
+        if self.is_group_zero:
             if self.checkpoint_backend == "sharded":
                 from sheeprl_tpu.utils.checkpoint import save_checkpoint_sharded
 

@@ -43,7 +43,7 @@ class CheckpointCallback:
             if replay_buffer is not None:
                 self._experiment_consistent_rb(replay_buffer, true_dones)
                 state.pop("rb", None)
-            if fabric.is_global_zero:
+            if getattr(fabric, "is_group_zero", fabric.is_global_zero):
                 self._delete_old_checkpoints(os.path.dirname(ckpt_path), live=ckpt_path)
 
     def on_checkpoint_player(self, fabric, ckpt_path: str, state: Dict[str, Any], replay_buffer=None) -> None:

@@ -45,8 +45,17 @@ def enable_compile_cache() -> None:
     if cache_dir is None:
         cache_dir = os.path.expanduser(f"~/.cache/sheeprl_tpu/jax-{_cpu_fingerprint()}")
     if cache_dir not in ("0", ""):
+        # Persistence threshold: programs compiling faster than this are not
+        # written to the cache (default 1 s — sub-second CPU programs are cheaper
+        # to recompile than to deserialize on a real chip). The fleet runner
+        # (sheeprl_tpu/fleet) sets the env override to 0 so EVERY member program
+        # persists and the sweep's later members cold-start as pure cache hits.
+        try:
+            min_secs = float(os.environ.get("SHEEPRL_JAX_CACHE_MIN_COMPILE_SECS", "1.0"))
+        except ValueError:
+            min_secs = 1.0
         try:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", min_secs)
         except Exception:
             pass

@@ -239,7 +239,9 @@ class ActPlacement:
     """
 
     def __init__(self, fabric, select: Optional[Callable[[Any], Any]] = None) -> None:
-        self.cpu_device = jax.devices("cpu")[0]
+        # local_devices: jax.devices() spans ALL processes of a multi-process run,
+        # and a non-rank-0 role (a service actor) must pin ITS host device
+        self.cpu_device = jax.local_devices(backend="cpu")[0]
         self.on_cpu = fabric.device.platform != "cpu"
         self._select = select or (lambda p: p)
 

@@ -1,0 +1,266 @@
+"""Experience data-plane unit tests (``sheeprl_tpu/data/service.py``) over the
+in-process :class:`LocalKV` fake — the writer/service/weight-plane mechanics
+without a ``jax.distributed`` session. The multi-process end-to-end path is
+covered by the gang-scale service smoke (tests/test_resilience/
+test_service_smoke.py, ``slow``) and the ``fleet_ingest`` bench workload."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, ReplayBuffer
+from sheeprl_tpu.data.service import (
+    ExperienceService,
+    ExperienceWriter,
+    LocalKV,
+    ServiceError,
+    ServiceTimeout,
+    WeightPublisher,
+    WeightSubscriber,
+    _bounded_wait,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+def _rows(t: int = 1, e: int = 2, v: float = 1.0) -> dict:
+    return {
+        "observations": np.full((t, e, 3), v, np.float32),
+        "rewards": np.full((t, e, 1), v, np.float32),
+    }
+
+
+def _buffer(n_envs: int = 4, size: int = 64) -> EnvIndependentReplayBuffer:
+    return EnvIndependentReplayBuffer(
+        size, n_envs=n_envs, obs_keys=("observations",), memmap=False
+    )
+
+
+def test_writer_service_round_trip_with_provenance():
+    kv = LocalKV()
+    rb = _buffer(n_envs=4)
+    service = ExperienceService(
+        rb, kv, "t", (0, 1), env_ids_of=lambda r: [r * 2, r * 2 + 1]
+    )
+    writers = {
+        r: ExperienceWriter(kv, "t", r, max_inflight=8, flush_every=1) for r in (0, 1)
+    }
+    for step in range(5):
+        for r, w in writers.items():
+            w.add(_rows(v=float(r * 100 + step)), steps=step)
+    assert service.drain_once() == 5 * 2 * 2  # 5 steps x 2 actors x 2 envs
+    # provenance: per-actor row counters and env-slot routing both hold
+    assert service.rows_of(0) == 10 and service.rows_of(1) == 10
+    assert all(not b.empty for b in rb.buffer)
+    # actor 1's rows landed in env slots 2/3 with its own values
+    assert float(rb.buffer[2]["observations"][0, 0, 0]) == 100.0
+    assert float(rb.buffer[0]["observations"][0, 0, 0]) == 0.0
+    # acks advanced to the writers' frontiers and messages were GC'd
+    for r, w in writers.items():
+        assert w.telemetry_snapshot()["inflight"] == 0
+    assert not kv.dir("t/ing/a0/0/")
+
+
+def test_writer_flush_every_batches_rows():
+    kv = LocalKV()
+    writer = ExperienceWriter(kv, "t", 0, flush_every=4)
+    for i in range(7):
+        writer.add(_rows(v=float(i)))
+    # 4 adds flushed as ONE stacked message; 3 still pending
+    assert writer.seq == 1
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(rb, kv, "t", (0,), env_ids_of=lambda r: [0, 1])
+    assert service.drain_once() == 8
+    writer.close()
+    assert service.drain_once() == 6  # the pending tail flushed by close()
+    assert service.eos_all()
+    # time-axis stacking preserved order per env slot
+    got = rb.buffer[0]["observations"][:7, 0, 0]
+    assert list(got) == [float(i) for i in range(7)]
+
+
+def test_writer_copies_rows_against_reused_env_buffers():
+    # vector envs REUSE their observation storage: a writer holding views across
+    # a flush_every>1 window would ship flush_every copies of the LAST step
+    kv = LocalKV()
+    writer = ExperienceWriter(kv, "t", 0, flush_every=3)
+    reused = {
+        "observations": np.zeros((1, 2, 3), np.float32),
+        "rewards": np.zeros((1, 2, 1), np.float32),
+    }
+    for i in range(3):
+        reused["observations"][...] = float(i)  # in-place, like SyncVectorEnv
+        reused["rewards"][...] = float(i)
+        writer.add(reused)
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(rb, kv, "t", (0,), env_ids_of=lambda r: [0, 1])
+    service.drain_once()
+    got = rb.buffer[0]["observations"][:3, 0, 0]
+    assert list(got) == [0.0, 1.0, 2.0], "writer must snapshot rows at add() time"
+
+
+def test_partial_env_ids_rows_keep_alignment():
+    kv = LocalKV()
+    writer = ExperienceWriter(kv, "t", 0, flush_every=2)
+    writer.add(_rows(e=2, v=1.0))  # full span
+    writer.add({"observations": np.full((1, 1, 3), 9.0, np.float32), "rewards": np.full((1, 1, 1), 9.0, np.float32)}, env_ids=[1])
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(rb, kv, "t", (0,), env_ids_of=lambda r: [0, 1])
+    service.drain_once()
+    # the reset row (env_ids=[1]) went ONLY to slot 1, after the full-span row
+    # (ring storage is uninitialized beyond the write cursor: check positions)
+    assert rb.buffer[0]._pos == 1 and rb.buffer[1]._pos == 2
+    assert float(rb.buffer[0]["observations"][0, 0, 0]) == 1.0
+    assert float(rb.buffer[1]["observations"][1, 0, 0]) == 9.0
+
+
+def test_flow_control_blocks_and_releases():
+    kv = LocalKV()
+    writer = ExperienceWriter(kv, "t", 0, max_inflight=2, timeout_s=5.0, poll_s=0.01)
+    writer.add(_rows())
+    writer.add(_rows())
+    released = threading.Event()
+
+    def third_add():
+        writer.add(_rows())  # blocks on credit
+        released.set()
+
+    t = threading.Thread(target=third_add, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    assert not released.is_set(), "writer must block at max_inflight"
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(rb, kv, "t", (0,), env_ids_of=lambda r: [0, 1])
+    service.drain_once()  # acks free the credit
+    t.join(timeout=5.0)
+    assert released.is_set()
+    snap = writer.telemetry_snapshot()
+    assert snap["flow_block_seconds"] > 0.0
+
+
+def test_flow_control_timeout_raises_service_timeout():
+    kv = LocalKV()
+    writer = ExperienceWriter(kv, "t", 0, max_inflight=1, timeout_s=0.2, poll_s=0.01)
+    writer.add(_rows())
+    with pytest.raises(ServiceTimeout):
+        writer.add(_rows())
+
+
+def test_abort_check_breaks_bounded_waits():
+    class Dead(RuntimeError):
+        pass
+
+    def abort():
+        raise Dead("peer died")
+
+    with pytest.raises(Dead):
+        _bounded_wait(
+            lambda: None, timeout_s=10.0, poll_s=0.01, abort_check=abort, what="never"
+        )
+
+
+def test_closed_writer_rejects_adds_and_eos_records_preempt():
+    kv = LocalKV()
+    writer = ExperienceWriter(kv, "t", 0)
+    writer.add(_rows())
+    writer.close(preempted=True)
+    with pytest.raises(ServiceError):
+        writer.add(_rows())
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(rb, kv, "t", (0,), env_ids_of=lambda r: [0, 1])
+    service.drain_once()
+    assert service.eos_all() and service.eos_preempted()
+
+
+def test_ingest_thread_drains_and_surfaces_errors():
+    kv = LocalKV()
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(
+        rb, kv, "t", (0,), poll_s=0.01, env_ids_of=lambda r: [0, 1]
+    ).start()
+    writer = ExperienceWriter(kv, "t", 0)
+    for i in range(10):
+        writer.add(_rows(v=float(i)))
+    deadline = time.monotonic() + 5.0
+    while service.rows_total < 20 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert service.rows_total == 20
+    service.stop()
+
+    # a poisoned buffer surfaces the ingest thread's error on stop()
+    class Broken:
+        def add(self, *a, **k):
+            raise RuntimeError("boom")
+
+    bad = ExperienceService(Broken(), kv, "t2", (0,), poll_s=0.01).start()
+    w2 = ExperienceWriter(kv, "t2", 0)
+    w2.add(_rows())
+    time.sleep(0.2)
+    with pytest.raises(ServiceError):
+        bad.stop()
+
+
+def test_queue_depth_gauge_tracks_backlog():
+    kv = LocalKV()
+    writer = ExperienceWriter(kv, "t", 0, max_inflight=16)
+    for i in range(6):
+        writer.add(_rows())
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(rb, kv, "t", (0,), env_ids_of=lambda r: [0, 1])
+    service.drain_once()
+    snap = service.telemetry_snapshot()
+    assert snap["queue_depth_max"] == 6
+    assert snap["rows"] == 12 and snap["rows_per_actor"] == {"0": 12}
+
+
+def test_weight_plane_versions_gc_and_wait():
+    kv = LocalKV()
+    pub = WeightPublisher(kv, "t")
+    sub = WeightSubscriber(kv, "t", poll_s=0.01, timeout_s=2.0)
+    assert sub.poll() is None
+    pub.publish({"w": np.arange(3)})
+    payload = sub.wait(min_version=1)
+    assert payload["version"] == 1 and not payload["final"]
+    assert list(payload["tree"]["w"]) == [0, 1, 2]
+    assert sub.poll() is None  # nothing newer
+    for v in range(2, 6):
+        pub.publish({"w": np.arange(3) * v}, final=(v == 5))
+    payload = sub.poll()
+    assert payload["version"] == 5 and payload["final"]
+    # versions <= latest-2 are GC'd; the latest two survive
+    assert not kv.dir("t/w/3/")
+    assert kv.dir("t/w/5/") and kv.dir("t/w/4/")
+
+
+def test_weight_wait_times_out():
+    kv = LocalKV()
+    sub = WeightSubscriber(kv, "t", poll_s=0.01, timeout_s=0.2)
+    with pytest.raises(ServiceTimeout):
+        sub.wait(min_version=1)
+
+
+def test_done_marker_gates_actor_exit():
+    kv = LocalKV()
+    writer = ExperienceWriter(kv, "t", 0, poll_s=0.01)
+    assert writer.wait_done(timeout_s=0.2) is False
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(rb, kv, "t", (0,))
+    service.mark_done()
+    assert writer.wait_done(timeout_s=1.0) is True
+
+
+def test_flat_replay_buffer_backend():
+    # sac-style flat buffer: no env_ids routing, rows land as [T, n_envs] blocks
+    kv = LocalKV()
+    rb = ReplayBuffer(32, n_envs=2, obs_keys=("observations",), memmap=False)
+    service = ExperienceService(rb, kv, "t", (0,))
+    writer = ExperienceWriter(kv, "t", 0)
+    for i in range(4):
+        writer.add(_rows(v=float(i)))
+    assert service.drain_once() == 8
+    sample = rb.sample(batch_size=4, n_samples=1)
+    assert sample["observations"].shape[1] == 4

@@ -1,0 +1,110 @@
+"""Checkpoint discovery across a FLEET layout (``resilience/discovery.py``).
+
+A fleet dir holds N sibling member runs (``<fleet>/members/<name>/...``), each
+with its own checkpoints. The contract these tests pin: resolution scoped to a
+member dir NEVER escapes to a sibling — ``resume_from=latest`` inside member A
+must not resolve member B's (possibly newer) checkpoint, and a member with no
+checkpoint must fail loudly instead of silently adopting a sibling's state.
+The fleet runner's retry path resumes via ``find_latest_checkpoint(member_dir)``,
+which inherits the same scoping by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from sheeprl_tpu.config import dotdict
+from sheeprl_tpu.resilience.discovery import (
+    find_latest_checkpoint,
+    resolve_checkpoint_path,
+    resolve_latest,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+def _write_ckpt(member_dir: str, step: int, age: float = 0.0) -> str:
+    ckpt_dir = os.path.join(member_dir, "version_0", "checkpoint")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"ckpt_{step}_0.ckpt")
+    with open(path, "wb") as fh:
+        fh.write(b"x" * 16)
+    if age:
+        stamp = time.time() - age
+        os.utime(path, (stamp, stamp))
+    return path
+
+
+@pytest.fixture()
+def fleet_layout(tmp_path):
+    fleet = tmp_path / "fleet"
+    a = fleet / "members" / "seed-42"
+    b = fleet / "members" / "seed-43"
+    a.mkdir(parents=True)
+    b.mkdir(parents=True)
+    # member B's checkpoint is NEWER and at a HIGHER step than member A's —
+    # the bait a scoping bug would take
+    ckpt_a = _write_ckpt(str(a), step=10, age=60.0)
+    ckpt_b = _write_ckpt(str(b), step=999)
+    return {"fleet": str(fleet), "a": str(a), "b": str(b), "ckpt_a": ckpt_a, "ckpt_b": ckpt_b}
+
+
+def test_member_scoped_find_never_sees_siblings(fleet_layout):
+    assert find_latest_checkpoint(fleet_layout["a"]) == fleet_layout["ckpt_a"]
+    assert find_latest_checkpoint(fleet_layout["b"]) == fleet_layout["ckpt_b"]
+    # the FLEET dir itself (unscoped) sees the global newest — the runner must
+    # therefore always scope retries to the member dir, which is what it does
+    assert find_latest_checkpoint(fleet_layout["fleet"]) == fleet_layout["ckpt_b"]
+
+
+def test_resume_latest_inside_member_dir_stays_inside(fleet_layout):
+    # the fleet runner pins hydra.run.dir=<member dir>; resume_from=latest must
+    # resolve member A's own checkpoint although B's is newer
+    cfg = dotdict(
+        {
+            "root_dir": "ppo/x",
+            "run_name": "irrelevant",
+            "hydra": {"run": {"dir": fleet_layout["a"]}},
+        }
+    )
+    assert resolve_latest(cfg) == fleet_layout["ckpt_a"]
+
+
+def test_resume_latest_empty_member_fails_instead_of_sibling_leak(fleet_layout):
+    empty = os.path.join(fleet_layout["fleet"], "members", "seed-44")
+    os.makedirs(empty)
+    cfg = dotdict(
+        {
+            "root_dir": "ppo/x",
+            "run_name": "irrelevant",
+            "hydra": {"run": {"dir": empty}},
+        }
+    )
+    # an existing-but-checkpointless member dir must raise — NOT walk up to the
+    # fleet dir and adopt seed-43's state
+    with pytest.raises(ValueError, match="no valid checkpoint"):
+        resolve_latest(cfg)
+
+
+def test_resolve_checkpoint_path_member_dir_scoped(fleet_layout):
+    assert resolve_checkpoint_path(fleet_layout["a"]) == fleet_layout["ckpt_a"]
+    empty = os.path.join(fleet_layout["fleet"], "members", "seed-45")
+    os.makedirs(empty)
+    with pytest.raises(FileNotFoundError):
+        resolve_checkpoint_path(empty)
+
+
+def test_fleet_runner_retry_resume_is_member_scoped(fleet_layout, monkeypatch):
+    # the runner's retry path: strip any stale resume override, resolve inside
+    # the member dir only (mirrors runner.run_member.run_attempt)
+    from sheeprl_tpu.resilience.discovery import find_latest_checkpoint as resolver
+
+    args = ["exp=ppo", "checkpoint.resume_from=/stale/path.ckpt"]
+    attempt_args = [a for a in args if not a.startswith("checkpoint.resume_from=")]
+    resume = resolver(fleet_layout["a"])
+    attempt_args.append(f"checkpoint.resume_from={resume}")
+    assert attempt_args[-1].endswith("ckpt_10_0.ckpt")
+    assert "/stale/path.ckpt" not in " ".join(attempt_args)
