@@ -772,6 +772,11 @@ def _bench_serve_load(
             for w in windows
             if (w.get("serve") or {}).get("occupancy") is not None
         ]
+        queue_depths = [
+            (w.get("serve") or {}).get("queue_depth")
+            for w in windows
+            if (w.get("serve") or {}).get("queue_depth") is not None
+        ]
         fingerprint = start.get("fingerprint")
 
         conditions = {
@@ -783,6 +788,15 @@ def _bench_serve_load(
             "load_errors": load["errors"],
             "latency_ms": latency,
             "occupancy_mean": round(sum(occupancy) / len(occupancy), 4) if occupancy else None,
+            # the serving tier's dataflow summary, mirroring the fleet_ingest
+            # shape; latency/occupancy live in the sibling keys above — only
+            # the queue/session view is new here
+            "dataflow": {
+                "queue_depth_mean": (
+                    round(sum(queue_depths) / len(queue_depths), 3) if queue_depths else None
+                ),
+                "sessions_per_sec": serve_summary.get("sessions_per_sec"),
+            },
             "telemetry": {
                 k: v for k, v in summary.items() if k not in ("event", "time", "seq")
             },
@@ -877,6 +891,10 @@ def _bench_fleet_ingest(
         service = next((e for e in reversed(events) if e.get("event") == "service"), {})
         start = next((e for e in events if e.get("event") == "start"), {})
         train_seconds = float(summary.get("train_seconds") or 0.0)
+        # the dataflow lineage block (weight lag, row age p50/p99, ingest
+        # latency) from the learner's summary: conditions carry it so
+        # --against can hold staleness, not just throughput
+        dataflow = summary.get("dataflow") or None
         return {
             "ingest_rows_per_sec": summary.get("sps"),
             "gradient_steps": summary.get("train_units"),
@@ -888,6 +906,7 @@ def _bench_fleet_ingest(
             "queue_depth_mean": service.get("queue_depth_mean"),
             "queue_depth_max": service.get("queue_depth_max"),
             "rows_per_actor": service.get("rows_per_actor"),
+            "dataflow": dataflow,
             "fingerprint": start.get("fingerprint"),
         }
 
@@ -937,6 +956,9 @@ def _bench_fleet_ingest(
             },
             "actors_1": {k: v for k, v in configs[1].items() if k != "fingerprint"},
             "actors_2": {k: v for k, v in configs[2].items() if k != "fingerprint"},
+            # the 2-actor config's dataflow summary, surfaced at the top level
+            # so the staleness gate does not have to dig
+            "dataflow": configs[2].get("dataflow"),
             "scaling_2_actors": scaling,
             # learner train rate vs the local backend (1.0 = no regression from
             # moving the buffer behind the service; on a 1-core host the 2-actor
@@ -957,7 +979,7 @@ def _bench_fleet_ingest(
             },
             "fingerprint": configs[2]["fingerprint"],
         }
-        return {
+        result = {
             "metric": "fleet_ingest_rows_per_sec",
             "value": round(rate_2, 2),
             "unit": "rows/sec (2-actor service ingestion, emulator-paced)",
@@ -965,6 +987,26 @@ def _bench_fleet_ingest(
             "vs_baseline": scaling,
             "conditions": conditions,
         }
+        row_age = ((configs[2].get("dataflow") or {}).get("row_age") or {}).get("seconds") or {}
+        if row_age.get("p99") is not None:
+            # staleness gates independently: "seconds" units are lower-is-better
+            # in bench-diff, so a fresher code version cannot regress row age
+            # inside the throughput threshold unnoticed
+            result["extras"] = [
+                {
+                    "metric": "fleet_ingest_row_age_p99_s",
+                    "value": row_age["p99"],
+                    "unit": "seconds (p99 sampled-row age, 2-actor service)",
+                    "vs_baseline": None,
+                    "conditions": {
+                        "row_age": configs[2]["dataflow"].get("row_age"),
+                        "weight_lag": configs[2]["dataflow"].get("weight_lag"),
+                        "ingest_latency_ms": configs[2]["dataflow"].get("ingest_latency_ms"),
+                        "fingerprint": configs[2]["fingerprint"],
+                    },
+                }
+            ]
+        return result
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 

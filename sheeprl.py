@@ -9,6 +9,9 @@ Also hosts the offline/observability tooling (howto/observability.md):
   the stream(s) of a running (or about-to-start) run and exits with its status;
 - ``python sheeprl.py compare <run_a> <run_b>`` — fingerprint-aware cross-run
   diff with noise-aware regression findings (``comparison.json``);
+- ``python sheeprl.py trace <run_dir|fleet_dir>`` — convert the merged
+  telemetry streams into a Perfetto/Chrome-trace JSON (one track per
+  member/rank/role, phase spans, cross-process dataflow flow events);
 - ``python sheeprl.py bench-diff <old.json> <new.json>`` — the BENCH_*.json
   regression gate (``--fail-on regression`` for CI);
 - ``python sheeprl.py fault-matrix`` — the resilience fault matrix on the CPU
@@ -53,6 +56,7 @@ from sheeprl_tpu.cli import (  # noqa: E402
     fleet,
     run,
     serve,
+    trace,
     watch,
 )
 
@@ -64,6 +68,7 @@ _SUBCOMMANDS = {
     "fault-matrix": fault_matrix,
     "serve": serve,
     "fleet": fleet,
+    "trace": trace,
 }
 
 if __name__ == "__main__":

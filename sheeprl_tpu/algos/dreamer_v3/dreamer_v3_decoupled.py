@@ -376,14 +376,18 @@ class _ServiceActorTrainer:
         self._writer = writer
         self._subscriber = subscriber
         self._act_view = act.view(_act_select(params))
-        self._done_timeout = float(
-            (cfg.buffer.get("service") or {}).get("done_timeout") or 300.0
-        )
+        scfg = cfg.buffer.get("service") or {}
+        self._done_timeout = float(scfg.get("done_timeout") or 300.0)
+        # poll_weights=false freezes the actor on its init weights — the
+        # deliberate stale-actor injection the weight_staleness smoke rides
+        self._poll_weights = bool(scfg.get("poll_weights", True))
 
     def train(self, data, cum_steps, train_key, want_full_state: bool, want_metrics: bool):
-        payload = self._subscriber.poll()
+        payload = self._subscriber.poll() if self._poll_weights else None
         if payload is not None:
             self._act_view = self.act.place(payload["tree"])
+            # rows shipped from here on carry this acting version (lineage)
+            self._writer.weight_version = int(payload["version"])
         return self._act_view, None
 
     def checkpoint_state(self):
@@ -397,7 +401,7 @@ class _ServiceActorTrainer:
 
         self._writer.close(preempted=preemption_requested())
         self._writer.wait_done(timeout_s=self._done_timeout)
-        payload = self._subscriber.poll()
+        payload = self._subscriber.poll() if self._poll_weights else None
         if payload is not None:
             self._act_view = self.act.place(payload["tree"])
         return None
@@ -458,6 +462,7 @@ def _service_actor(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
 
     from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
     from sheeprl_tpu.data.service import (
+        ActorDataflow,
         ExperienceWriter,
         ServiceError,
         WeightSubscriber,
@@ -514,8 +519,12 @@ def _service_actor(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
 
     def telemetry_factory(fabric_, cfg_, log_dir_, logger_):
         if rank == 0:
-            return build_telemetry(fabric_, cfg_, log_dir_, logger=logger_)
-        return build_role_telemetry(fabric_, cfg_, f"actor{rank}", rank=rank)
+            telemetry = build_telemetry(fabric_, cfg_, log_dir_, logger=logger_)
+        else:
+            telemetry = build_role_telemetry(fabric_, cfg_, f"actor{rank}", rank=rank)
+        # dataflow lineage: actor windows carry weight version/lag + ingestion
+        telemetry.attach_dataflow(ActorDataflow(writer, subscriber))
+        return telemetry
 
     return run_dreamer(
         fabric,
@@ -539,6 +548,7 @@ def _service_learner(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
     from sheeprl_tpu.data.prefetch import make_replay_sampler
     from sheeprl_tpu.data.service import (
         ExperienceService,
+        LearnerDataflow,
         ServiceError,
         WeightPublisher,
         coordination_kv,
@@ -658,6 +668,9 @@ def _service_learner(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
         ).start()
         publisher = WeightPublisher(kv, ns)
         publish_every = max(int((cfg.buffer.get("service") or {}).get("publish_every") or 1), 1)
+        # dataflow lineage: learner windows carry per-actor weight lag, the
+        # sampled-row age distribution and ingest latency from the service
+        telemetry.attach_dataflow(LearnerDataflow(service, publisher))
         publisher.publish(replicated_to_host(_act_select(params)))
 
         ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)

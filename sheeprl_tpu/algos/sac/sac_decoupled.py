@@ -303,6 +303,7 @@ def _service_actor(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
     samples, never blocks on the learner (except the bounded flow-control
     watermark and the exit gate)."""
     from sheeprl_tpu.data.service import (
+        ActorDataflow,
         ExperienceWriter,
         ServiceError,
         WeightSubscriber,
@@ -405,6 +406,10 @@ def _service_actor(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
         timeout_s=opts["timeout_s"],
         abort_check=opts["abort_check"],
     )
+    # dataflow lineage: every telemetry window carries this actor's weight
+    # version/lag + ingestion counters (howto/observability.md)
+    telemetry.attach_dataflow(ActorDataflow(writer, subscriber))
+    poll_weights = opts["poll_weights"]
 
     act = ActPlacement(fabric, lambda p: p["actor"])
     act_on_cpu = act.on_cpu
@@ -485,10 +490,13 @@ def _service_actor(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
         obs = next_obs
 
         # non-blocking weight refresh — the act path never waits on a round
-        payload = subscriber.poll()
+        # (poll_weights=false is the deliberate stale-actor injection the
+        # weight_staleness detector smoke rides)
+        payload = subscriber.poll() if poll_weights else None
         if payload is not None:
             act_params = act.place(payload["tree"])
             weight_version = int(payload["version"])
+            writer.weight_version = weight_version  # rows now carry this lineage
 
         preempted = resilience.preempt_requested()
         telemetry.step(policy_step)
@@ -516,7 +524,7 @@ def _service_actor(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
     # grace window never SIGTERMs a learner still draining the backlog
     if not writer.wait_done(timeout_s=float((cfg.buffer.get("service") or {}).get("done_timeout") or 300.0)):
         warnings.warn("experience service: the learner never published its done marker")
-    payload = subscriber.poll()
+    payload = subscriber.poll() if poll_weights else None
     if payload is not None:
         act_params = act.place(payload["tree"])
 
@@ -540,6 +548,7 @@ def _service_learner(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
     from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer
     from sheeprl_tpu.data.service import (
         ExperienceService,
+        LearnerDataflow,
         ServiceError,
         WeightPublisher,
         coordination_kv,
@@ -638,6 +647,9 @@ def _service_learner(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
         ).start()
         publisher = WeightPublisher(kv, ns)
         publish_every = max(int((cfg.buffer.get("service") or {}).get("publish_every") or 1), 1)
+        # dataflow lineage: learner windows carry per-actor weight lag, the
+        # sampled-row age distribution and ingest latency from the service
+        telemetry.attach_dataflow(LearnerDataflow(service, publisher))
         # version 1 immediately: resumed/late actors act on restored weights
         # without waiting for the first train round
         publisher.publish(replicated_to_host(params)["actor"])

@@ -568,6 +568,17 @@ def fleet(args: Optional[Sequence[str]] = None) -> int:
     return fleet_main(list(args if args is not None else sys.argv[1:]))
 
 
+def trace(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py trace <run_dir|fleet_dir>`` — convert the run's
+    merged telemetry stream(s) into a Perfetto/Chrome-trace JSON: one track per
+    member/rank/role, phase spans per window, flow events linking the
+    experience plane's ingest→sample and publish→refresh across process
+    tracks. See ``howto/observability.md`` ("Tracing the dataflow")."""
+    from sheeprl_tpu.obs.trace import main as trace_main
+
+    return trace_main(list(args if args is not None else sys.argv[1:]))
+
+
 def watch(args: Optional[Sequence[str]] = None) -> int:
     """``python sheeprl.py watch <run_dir>`` — live terminal monitor over the
     run's telemetry stream(s) (follow mode: torn lines retried, late per-role

@@ -45,10 +45,17 @@ Wire protocol (namespace ``ns``, all keys GC'd by their consumer):
 ``{ns}/done``                   the learner finished (actors may exit)
 ==============================  ==================================================
 
-Each ingest message carries ``{"rank", "seq", "env_ids", "steps", "rows"}`` —
-rank/stream-tagged provenance the service folds into per-actor counters (and the
-buffer's env slots, keyed by the actor's env ids), so a fleet of actors is
-attributable end-to-end.
+Each ingest message carries ``{"rank", "seq", "env_ids", "steps", "rows",
+"born", "weight_version"}`` — rank/stream-tagged provenance the service folds
+into per-actor counters (and the buffer's env slots, keyed by the actor's env
+ids), so a fleet of actors is attributable end-to-end. The last two fields are
+the dataflow LINEAGE this plane's observability rides on (howto/observability.md
+"Tracing the dataflow"): ``born`` is the wall-clock time the message's oldest
+row left the env (ingest latency = drain time − born), and ``weight_version``
+is the version the acting actor held when it produced the rows — the learner
+derives per-actor weight LAG from it, and the :class:`_AgeBook` turns the
+(rows, born) trail into the sampled-row age distribution (seconds and
+add-rounds) a uniform replay draw would see.
 
 For single-process unit tests :class:`LocalKV` implements the same surface over
 a dict + condition variable; ``tests/test_data/test_service.py`` drives the
@@ -66,8 +73,10 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = [
+    "ActorDataflow",
     "ExperienceService",
     "ExperienceWriter",
+    "LearnerDataflow",
     "LocalKV",
     "ServiceError",
     "ServiceTimeout",
@@ -229,6 +238,10 @@ def service_options(cfg: Any) -> Dict[str, Any]:
         "poll_s": float(scfg.get("poll") or 0.05),
         "timeout_s": float(ccfg.get("timeout") or 1800.0),
         "abort_check": channel_abort_check,
+        # actors refresh weights from the plane by default; false freezes them on
+        # their init weights — the deliberate stale-weight injection the
+        # weight_staleness detector smoke rides (howto/observability.md)
+        "poll_weights": bool(scfg.get("poll_weights", True)),
     }
 
 
@@ -330,8 +343,12 @@ class ExperienceWriter:
         self.timeout_s = float(timeout_s)
         self.abort_check = abort_check
         self._seq = 0
-        self._pending: List[Tuple[Dict[str, np.ndarray], Optional[Sequence[int]]]] = []
+        self._pending: List[Tuple[Dict[str, np.ndarray], Optional[Sequence[int]], float]] = []
         self._closed = False
+        # the weight version this actor currently ACTS with — the loop updates it
+        # after every successful refresh, and every shipped message carries it, so
+        # the learner can account per-actor weight lag (dataflow lineage)
+        self.weight_version = 0
         # consumer-side counters for telemetry (rows = env transitions shipped)
         self._tele_rows = 0
         self._tele_messages = 0
@@ -393,7 +410,9 @@ class ExperienceWriter:
         block = {k: np.array(v) for k, v in rows.items()}
         n_rows = int(next(iter(block.values())).shape[0] * next(iter(block.values())).shape[1])
         self._tele_rows += n_rows
-        self._pending.append((block, tuple(env_ids) if env_ids is not None else None))
+        # birth stamp: when the rows left the env, not when the message ships —
+        # with flush_every > 1 the oldest pending block sets the message's age
+        self._pending.append((block, tuple(env_ids) if env_ids is not None else None, time.time()))
         if len(self._pending) >= self.flush_every:
             self.flush(steps=steps)
 
@@ -404,14 +423,14 @@ class ExperienceWriter:
         # one message per (env_ids) group, preserving order: full-span rows ship
         # together (stacked on the time axis), partial adds (dreamer's SAME_STEP
         # reset rows) ship as their own messages so env alignment survives
-        groups: List[Tuple[Optional[Tuple[int, ...]], List[Dict[str, np.ndarray]]]] = []
-        for block, ids in self._pending:
+        groups: List[Tuple[Optional[Tuple[int, ...]], List[Dict[str, np.ndarray]], float]] = []
+        for block, ids, born in self._pending:
             if groups and groups[-1][0] == ids:
                 groups[-1][1].append(block)
             else:
-                groups.append((ids, [block]))
+                groups.append((ids, [block], born))
         self._pending = []
-        for ids, blocks in groups:
+        for ids, blocks, born in groups:
             rows = (
                 blocks[0]
                 if len(blocks) == 1
@@ -424,6 +443,8 @@ class ExperienceWriter:
                     "env_ids": ids,
                     "steps": int(steps) if steps is not None else None,
                     "rows": rows,
+                    "born": born,
+                    "weight_version": int(self.weight_version),
                 }
             )
             self._put_message(payload)
@@ -468,12 +489,89 @@ class ExperienceWriter:
             "bytes": self._tele_bytes,
             "flow_block_seconds": round(self._tele_block_seconds, 4),
             "inflight": self._seq - self._acked(),
+            "weight_version": int(self.weight_version),
         }
 
 
 # ---------------------------------------------------------------------------------
 # Learner side: the service draining actor streams into a replay buffer
 # ---------------------------------------------------------------------------------
+
+
+def _weighted_percentiles(entries: Sequence[Tuple[int, float]]) -> Optional[Dict[str, float]]:
+    """{p50, p99, mean, max} of a row-weighted value sample: ``entries`` are
+    (rows, value) pairs, each value counting ``rows`` times — the exact
+    distribution a uniform draw over those rows would see, without expanding
+    the sample row-by-row."""
+    pairs = sorted((float(v), int(n)) for n, v in entries if n > 0)
+    total = sum(n for _, n in pairs)
+    if total <= 0:
+        return None
+    out: Dict[str, float] = {}
+    targets = {"p50": 0.5 * total, "p99": 0.99 * total}
+    seen = 0
+    acc = 0.0
+    for value, n in pairs:
+        acc += value * n
+        seen += n
+        for name, target in list(targets.items()):
+            if seen >= target:
+                out[name] = round(value, 4)
+                del targets[name]
+    out["mean"] = round(acc / total, 4)
+    out["max"] = round(pairs[-1][0], 4)
+    return out
+
+
+class _AgeBook:
+    """Capacity-bounded trail of what the replay buffer currently holds, kept by
+    the ingest thread: one entry per ingested message ``(rows, born, round)``
+    where ``round`` is the message's global add-round index. Entries beyond the
+    buffer's row capacity are evicted from the left — the same FIFO the ring
+    buffer overwrites in — so :meth:`age_snapshot` is the age distribution of
+    the rows a uniform sample draws from, in seconds (wall clock since the rows
+    left the env) and in add-rounds (how many ingest messages ago)."""
+
+    def __init__(self, capacity_rows: Optional[int]) -> None:
+        from collections import deque
+
+        # None = unknown capacity: fall back to a generous entry cap so the
+        # book cannot grow without bound on exotic buffers. A deque: eviction
+        # runs on the ingest-drain path (which contends with the sampler lock),
+        # so the FIFO must be O(1) per message even at the entry cap. The lock
+        # covers writer (ingest thread) vs snapshot reader (the learner's
+        # telemetry window emit) — an unguarded deque iteration would raise
+        # "mutated during iteration" under load and freeze the gauges.
+        self.capacity_rows = int(capacity_rows) if capacity_rows else None
+        self._entries: "deque[Tuple[int, float, int]]" = deque()
+        self._lock = threading.Lock()
+        self._rows = 0
+        self._round = 0
+
+    def record(self, rows: int, born: Optional[float]) -> None:
+        with self._lock:
+            self._round += 1
+            if born is None:
+                return  # a pre-lineage writer: age unknown, never guessed
+            self._entries.append((int(rows), float(born), self._round))
+            self._rows += int(rows)
+            cap = self.capacity_rows
+            while (cap is not None and self._rows > cap) or len(self._entries) > 65536:
+                evicted = self._entries.popleft()
+                self._rows -= evicted[0]
+
+    def age_snapshot(self, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            if not self._entries:
+                return None
+            entries = list(self._entries)
+            current_round = self._round
+        now = time.time() if now is None else float(now)
+        seconds = _weighted_percentiles([(n, max(now - born, 0.0)) for n, born, _ in entries])
+        rounds = _weighted_percentiles(
+            [(n, float(current_round - rnd)) for n, _, rnd in entries]
+        )
+        return {"seconds": seconds, "rounds": rounds, "add_rounds": current_round}
 
 
 class ExperienceService:
@@ -525,6 +623,17 @@ class ExperienceService:
         self._depth_polls = 0
         self._depth_max = 0
         self._started_at: Optional[float] = None
+        # dataflow lineage (howto/observability.md "Tracing the dataflow"):
+        # sampled-row ages over the buffer's retained span, per-message ingest
+        # latency (drain − born, bounded reservoir), and each actor's last
+        # reported acting weight version (the learner-side lag source)
+        try:
+            capacity = int(rb.buffer_size) * int(rb.n_envs)
+        except (AttributeError, TypeError, ValueError):
+            capacity = None
+        self._ages = _AgeBook(capacity)
+        self._ingest_latency_s: List[Tuple[int, float]] = []  # (rows, seconds)
+        self._actor_weight_version: Dict[int, int] = {}
 
     # -- draining ----------------------------------------------------------------
 
@@ -588,6 +697,14 @@ class ExperienceService:
                 self._rows[rank] += n_rows
                 ingested += n_rows
                 self._messages += 1
+                born = message.get("born")
+                self._ages.record(n_rows, born)
+                if born is not None:
+                    self._ingest_latency_s.append((n_rows, max(time.time() - float(born), 0.0)))
+                    if len(self._ingest_latency_s) > 4096:
+                        del self._ingest_latency_s[:2048]
+                if message.get("weight_version") is not None:
+                    self._actor_weight_version[rank] = int(message["weight_version"])
                 self._consumed[rank] += 1
                 self.kv.set(f"{self.ns}/ing/ack/r{rank}", str(self._consumed[rank]))
         # end-of-stream markers (poll AFTER draining so eos with a drained
@@ -652,6 +769,21 @@ class ExperienceService:
 
     def eos_preempted(self) -> bool:
         return any(bool(e.get("preempted")) for e in self._eos.values())
+
+    def row_ages(self) -> Optional[Dict[str, Any]]:
+        """Sampled-row age distribution (seconds and add-rounds) over what the
+        buffer currently retains; None before the first lineage-stamped row."""
+        return self._ages.age_snapshot()
+
+    def ingest_latency(self) -> Optional[Dict[str, float]]:
+        """Row-weighted env→buffer latency percentiles in SECONDS (born stamp →
+        drain) over a bounded recent reservoir."""
+        return _weighted_percentiles(list(self._ingest_latency_s))
+
+    def actor_weight_versions(self) -> Dict[int, int]:
+        """Each actor's last reported acting weight version (from the ingest
+        messages) — the learner computes per-actor lag against the publisher."""
+        return dict(self._actor_weight_version)
 
     def telemetry_snapshot(self) -> Dict[str, Any]:
         elapsed = (
@@ -727,6 +859,9 @@ class WeightSubscriber:
         self.kv = kv
         self.ns = ns
         self.version = 0
+        # newest version OBSERVED on the plane (>= self.version): held vs latest
+        # is this actor's weight lag, honest even when the actor never fetches
+        self.latest = 0
         self.poll_s = float(poll_s)
         self.timeout_s = float(timeout_s)
         self.abort_check = abort_check
@@ -745,11 +880,16 @@ class WeightSubscriber:
         payload = pickle.loads(b"".join(chunks))
         return payload if payload.get("version") == version else None
 
-    def poll(self) -> Optional[Dict[str, Any]]:
+    def peek_latest(self) -> int:
+        """Read (and remember) the newest published version WITHOUT fetching a
+        payload — the lag probe for actors that are not refreshing this tick."""
         latest_raw = self.kv.get(f"{self.ns}/w/latest")
-        if latest_raw is None:
-            return None
-        latest = int(latest_raw)
+        if latest_raw is not None:
+            self.latest = max(self.latest, int(latest_raw))
+        return self.latest
+
+    def poll(self) -> Optional[Dict[str, Any]]:
+        latest = self.peek_latest()
         if latest <= self.version:
             return None
         payload = self._fetch(latest)
@@ -757,6 +897,13 @@ class WeightSubscriber:
             return None
         self.version = latest
         return payload
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        return {
+            "version": int(self.version),
+            "latest": int(self.latest),
+            "lag": max(int(self.latest) - int(self.version), 0),
+        }
 
     def wait(self, min_version: int = 1, timeout_s: Optional[float] = None) -> Dict[str, Any]:
         def pred() -> Optional[Dict[str, Any]]:
@@ -772,3 +919,82 @@ class WeightSubscriber:
             abort_check=self.abort_check,
             what=f"weight version >= {min_version}",
         )
+
+
+# ---------------------------------------------------------------------------------
+# Dataflow observability providers: what RunTelemetry.attach_dataflow consumes.
+# One snapshot per telemetry window — the `dataflow` block on window/summary
+# events and the Service/* gauges (obs/telemetry.py) read straight from these,
+# no second bookkeeping path.
+# ---------------------------------------------------------------------------------
+
+
+class ActorDataflow:
+    """The actor role's dataflow view: its ingestion counters (writer) and its
+    weight staleness (held vs newest published — ``peek_latest`` keeps the lag
+    honest even for an actor that never refreshes)."""
+
+    role = "actor"
+
+    def __init__(self, writer: ExperienceWriter, subscriber: WeightSubscriber) -> None:
+        self._writer = writer
+        self._subscriber = subscriber
+
+    def dataflow_snapshot(self) -> Dict[str, Any]:
+        try:
+            self._subscriber.peek_latest()
+        except Exception:
+            pass  # a dying coordinator must not take the telemetry window down
+        w = self._writer.telemetry_snapshot()
+        s = self._subscriber.telemetry_snapshot()
+        return {
+            "role": "actor",
+            "weight_version": s["version"],
+            "weight_latest": s["latest"],
+            "weight_lag": s["lag"],
+            "rows": w["rows"],
+            "messages": w["messages"],
+            "inflight": w["inflight"],
+            "flow_block_seconds": w["flow_block_seconds"],
+        }
+
+
+class LearnerDataflow:
+    """The learner role's dataflow view: ingest latency + sampled-row ages from
+    the service's lineage trail, queue depth, and per-actor weight lag against
+    the publisher's current version."""
+
+    role = "learner"
+
+    def __init__(self, service: ExperienceService, publisher: WeightPublisher) -> None:
+        self._service = service
+        self._publisher = publisher
+
+    def dataflow_snapshot(self) -> Dict[str, Any]:
+        snap = self._service.telemetry_snapshot()
+        current = int(self._publisher.version)
+        versions = self._service.actor_weight_versions()
+        lags = {str(r): max(current - v, 0) for r, v in sorted(versions.items())}
+        latency = self._service.ingest_latency()
+        return {
+            "role": "learner",
+            "weight_version": current,
+            "weight_lag": (
+                {
+                    "per_actor": lags,
+                    "max": max(lags.values()),
+                    "mean": round(sum(lags.values()) / len(lags), 3),
+                }
+                if lags
+                else None
+            ),
+            "row_age": self._service.row_ages(),
+            "ingest_latency_ms": (
+                {k: round(v * 1000.0, 3) for k, v in latency.items()} if latency else None
+            ),
+            "queue_depth": snap["queue_depth_mean"],
+            "queue_depth_max": snap["queue_depth_max"],
+            "rows": snap["rows"],
+            "rows_per_actor": snap["rows_per_actor"],
+            "rows_per_sec": snap["rows_per_sec"],
+        }

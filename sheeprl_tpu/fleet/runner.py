@@ -92,6 +92,70 @@ def run_fleet(
 
     sink = JsonlEventSink(os.path.join(fleet_dir, "telemetry.fleet.jsonl"))
     sink_lock = threading.Lock()
+    live_children: Dict[str, subprocess.Popen] = {}
+    live_lock = threading.Lock()
+
+    # opt-in live metrics endpoint: `metric.telemetry.http_port=N` in the spec's
+    # base overrides makes the RUNNER scrapeable (member counts/outcomes). The
+    # override is NOT forwarded to the members — N co-scheduled children racing
+    # one port would be noise; scrape the fleet at its runner.
+    http_cfg: Dict[str, Any] = {}
+    member_base: List[str] = []
+    for arg in spec["base"]:
+        if arg.startswith("metric.telemetry.http_port="):
+            http_cfg["http_port"] = arg.split("=", 1)[1]
+        elif arg.startswith("metric.telemetry.http_host="):
+            http_cfg["http_host"] = arg.split("=", 1)[1]
+        else:
+            member_base.append(arg)
+    endpoint = None
+    if http_cfg.get("http_port") not in (None, "", "null"):
+        from sheeprl_tpu.obs.metrics_http import build_endpoint
+
+        endpoint = build_endpoint(http_cfg, labels={"fleet": str(spec["name"])})
+    board_lock = threading.Lock()
+    # members_* gauges count TERMINAL member outcomes only — the same taxonomy
+    # leaderboard.json records — while attempts/restarts count per-attempt
+    # events (a restarted member is one member, several attempts)
+    board = {
+        "Fleet/attempts": 0,
+        "Fleet/restarts": 0,
+        "Fleet/members_finished": 0,
+        "Fleet/members_completed": 0,
+        "Fleet/members_preempted": 0,
+        "Fleet/members_crashed": 0,
+    }
+
+    def _publish_board() -> None:
+        if endpoint is None:
+            return
+        with board_lock:
+            gauges = dict(board)
+        with live_lock:
+            gauges["Fleet/members_running"] = float(len(live_children))
+        gauges["Fleet/members_total"] = float(len(spec["members"]))
+        endpoint.update(gauges)
+
+    def _board_event(event: str, fields: Dict[str, Any]) -> None:
+        if endpoint is None:
+            return
+        with board_lock:
+            if event == "member" and fields.get("status") == "spawn":
+                board["Fleet/attempts"] += 1
+            elif event == "restart":
+                board["Fleet/restarts"] += 1
+        _publish_board()
+
+    def _board_result(outcome: str) -> None:
+        if endpoint is None:
+            return
+        with board_lock:
+            board["Fleet/members_finished"] += 1
+            key = {"completed": "completed", "preempted": "preempted"}.get(
+                str(outcome), "crashed"
+            )
+            board[f"Fleet/members_{key}"] += 1
+        _publish_board()
 
     def emit(event: str, **fields: Any) -> None:
         with sink_lock:
@@ -99,6 +163,7 @@ def run_fleet(
                 sink.emit(event, **fields)
             except OSError:
                 pass
+        _board_event(event, fields)
 
     emit(
         "fleet",
@@ -109,8 +174,6 @@ def run_fleet(
         compile_cache=member_env.get("SHEEPRL_JAX_CACHE") if spec["compile_cache"] else None,
     )
 
-    live_children: Dict[str, subprocess.Popen] = {}
-    live_lock = threading.Lock()
     handler_installed = signals.install_preemption_handler()
 
     def forward_preempt() -> None:
@@ -127,7 +190,7 @@ def run_fleet(
         name = member["name"]
         member_dir = _member_dir(fleet_dir, name)
         os.makedirs(member_dir, exist_ok=True)
-        base_args = list(spec["base"]) + list(member["overrides"]) + [
+        base_args = list(member_base) + list(member["overrides"]) + [
             f"hydra.run.dir={member_dir}",
             "metric.telemetry.enabled=true",
             f"metric.telemetry.jsonl_path={os.path.join(member_dir, 'telemetry.jsonl')}",
@@ -238,6 +301,7 @@ def run_fleet(
                  attempt=getattr(policy, "attempt", 0), error=repr(exc)[:300])
             outcome = "crashed"
         restarts_made = getattr(policy, "attempt", 0)
+        _board_result(outcome)
         return {
             "name": name,
             "dir": member_dir,
@@ -324,6 +388,8 @@ def run_fleet(
         leaderboard=os.path.join(fleet_dir, "leaderboard.json"),
     )
     sink.close()
+    if endpoint is not None:
+        endpoint.close()
     print(format_leaderboard(leaderboard))
     print(f"\nfleet dir: {fleet_dir}\nleaderboard: {os.path.join(fleet_dir, 'leaderboard.json')}")
     return 1 if leaderboard["gate"]["failed"] else 0

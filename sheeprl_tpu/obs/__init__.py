@@ -27,7 +27,14 @@ the step-level telemetry layer the Podracer-style throughput work calls for
   (``python sheeprl.py watch <run_dir>``) over the follow-mode stream reader;
 - :mod:`~sheeprl_tpu.obs.compare` — cross-run diff
   (``python sheeprl.py compare``) and the BENCH_*.json regression gate
-  (``python sheeprl.py bench-diff`` / ``bench.py --against``).
+  (``python sheeprl.py bench-diff`` / ``bench.py --against``);
+- :mod:`~sheeprl_tpu.obs.trace` — Perfetto/Chrome-trace export of the merged
+  streams (``python sheeprl.py trace``): phase spans per window, one track per
+  member/rank/role, cross-process dataflow flow events;
+- :mod:`~sheeprl_tpu.obs.schema` — the versioned JSON schema for every
+  ``telemetry.jsonl`` event type (producer/consumer drift fails loudly in CI);
+- :mod:`~sheeprl_tpu.obs.metrics_http` — the opt-in Prometheus text-exposition
+  endpoint (``metric.telemetry.http_port``) the telemetry facades serve.
 
 See ``howto/observability.md`` for the config keys, the JSONL schema and the
 detector catalog.
@@ -38,7 +45,10 @@ from sheeprl_tpu.obs.compile_monitor import compile_snapshot, install_compile_mo
 from sheeprl_tpu.obs.diagnose import diagnose_events, diagnose_run, run_detectors
 from sheeprl_tpu.obs.fingerprint import fingerprint_compatible, run_fingerprint
 from sheeprl_tpu.obs.jsonl import JsonlEventSink
+from sheeprl_tpu.obs.metrics_http import MetricsEndpoint
 from sheeprl_tpu.obs.profiler import ProfilerWindow, resolve_profiler_config
+from sheeprl_tpu.obs.schema import SCHEMA_VERSION, validate_events, validate_stream
+from sheeprl_tpu.obs.trace import build_trace, trace_run
 from sheeprl_tpu.obs.streams import (
     RunFollower,
     StreamCursor,
@@ -56,12 +66,15 @@ from sheeprl_tpu.obs.watch import watch_run
 
 __all__ = [
     "JsonlEventSink",
+    "MetricsEndpoint",
     "NullTelemetry",
     "ProfilerWindow",
     "RunFollower",
     "RunTelemetry",
+    "SCHEMA_VERSION",
     "StreamCursor",
     "bench_diff",
+    "build_trace",
     "build_role_telemetry",
     "build_telemetry",
     "compare_runs",
@@ -77,5 +90,8 @@ __all__ = [
     "resolve_profiler_config",
     "run_detectors",
     "run_fingerprint",
+    "trace_run",
+    "validate_events",
+    "validate_stream",
     "watch_run",
 ]

@@ -42,6 +42,8 @@ PHASE_SHIFT_ABS = 0.05  # a phase must grow ≥5 points of wall share to flag
 MEMORY_GROWTH = 0.10  # ≥10% peak-memory growth flags
 COMPILE_STORM_DELTA = 3  # ≥3 extra compiles escalates to critical
 DEFAULT_BENCH_THRESHOLD = 0.05  # bench-diff per-metric relative threshold
+DATAFLOW_GROWTH = 0.25  # ≥25% staleness/latency growth flags (lower-is-better)
+WEIGHT_LAG_DELTA = 2  # absolute extra weight versions of actor lag that flag
 
 _PHASE_KEYS = (
     "env",
@@ -170,6 +172,44 @@ def profile_run(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         + [_f(summary.get("rss_peak_bytes")) if summary else 0.0]
         + [0.0]
     )
+    # experience-plane dataflow (buffer.backend=service runs): staleness and
+    # latency distributions pulled from EVERY stream's dataflow blocks — the
+    # actor windows carry weight lag, the learner windows row age / ingest
+    # latency / queue depth; ordinary runs profile None here
+    df_windows = [
+        e
+        for e in events
+        if e.get("event") == "window" and not e.get("final") and isinstance(e.get("dataflow"), dict)
+    ]
+    dataflow = None
+    if df_windows:
+        actor = [w["dataflow"] for w in df_windows if w["dataflow"].get("role") == "actor"]
+        learner = [w["dataflow"] for w in df_windows if w["dataflow"].get("role") == "learner"]
+        learner_lag = [
+            _f(d["weight_lag"].get("max")) for d in learner if isinstance(d.get("weight_lag"), dict)
+        ]
+        dataflow = {
+            "weight_lag": _dist(
+                [_f(d.get("weight_lag")) for d in actor if not isinstance(d.get("weight_lag"), dict)]
+                + learner_lag
+            ),
+            "row_age_p50_s": _dist(
+                [
+                    _f(d["row_age"]["seconds"].get("p50"))
+                    for d in learner
+                    if isinstance((d.get("row_age") or {}).get("seconds"), dict)
+                ]
+            ),
+            "ingest_latency_p99_ms": _dist(
+                [
+                    _f(d["ingest_latency_ms"].get("p99"))
+                    for d in learner
+                    if isinstance(d.get("ingest_latency_ms"), dict)
+                ]
+            ),
+            "queue_depth": _dist([_f(d.get("queue_depth")) for d in learner if d.get("queue_depth") is not None]),
+        }
+
     # env restarts: the counter is a per-ATTEMPT running total (each restart
     # attempt's telemetry starts back at 0), so take the max within each attempt
     # and sum across attempts — max over the whole stream would under-report
@@ -197,6 +237,7 @@ def profile_run(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         "hbm_peak_bytes": int(hbm_peak) or None,
         "rss_peak_bytes": int(rss_peak) or None,
         "env_restarts": env_restarts,
+        "dataflow": dataflow,
         "summary_sps": _f(summary.get("sps")) if summary and summary.get("sps") is not None else None,
     }
 
@@ -349,6 +390,43 @@ def compare_profiles(
                 )
             )
         break
+
+    # experience-plane dataflow: staleness/latency regressions (all lower-is-
+    # better). Weight lag gates on an absolute version delta (2 extra versions
+    # of off-policy lag is material whatever the baseline); the wall-clock
+    # metrics gate relatively, beyond the runs' own window spread.
+    dfa, dfb = profile_a.get("dataflow") or {}, profile_b.get("dataflow") or {}
+    if dfa and dfb:
+        metrics["dataflow"] = {}
+        for key, label, unit, absolute in (
+            ("weight_lag", "actor weight lag", "versions", WEIGHT_LAG_DELTA),
+            ("row_age_p50_s", "sampled-row age p50", "s", None),
+            ("ingest_latency_p99_ms", "ingest latency p99", "ms", None),
+            ("queue_depth", "ingest queue depth", "messages", None),
+        ):
+            dm = _delta_metric(dfa.get(key), dfb.get(key))
+            metrics["dataflow"][key] = dm
+            if dm is None or dm["delta"] <= 0 or not dm["beyond_noise"]:
+                continue
+            flagged = (
+                dm["delta"] >= absolute
+                if absolute is not None
+                else dm["rel"] is not None and dm["rel"] >= DATAFLOW_GROWTH
+            )
+            if flagged:
+                findings.append(
+                    _finding(
+                        "dataflow_regression",
+                        "warning",
+                        f"run B's median {label} grew to {dm['b']['median']:g} {unit} "
+                        f"from {dm['a']['median']:g} — the experience plane got staler",
+                        "`sheeprl.py diagnose` run B (weight_staleness / row_age_drift / "
+                        "ingest_backpressure) names the role and the knob; "
+                        "`sheeprl.py trace` shows where the rows' wall time goes",
+                        metric=key,
+                        **{k: dm[k] for k in ("delta", "rel", "noise")},
+                    )
+                )
 
     # env stability
     ra, rb = int(_f(profile_a.get("env_restarts"))), int(_f(profile_b.get("env_restarts")))

@@ -31,11 +31,16 @@ unattributed_time   the phases breakdown leaves too much wall time unnamed
 occupancy_collapse  (serving) batch occupancy fell away with sessions attached
 latency_regression  (serving) window p99 step latency far above the run median
 slot_starvation     (serving) sessions queued while the slot table ran full
+weight_staleness    (service) actors acting with weights far behind the learner
+row_age_drift       (service) the learner trains on increasingly old rows
+ingest_backpressure (service) actors blocked on flow control / ingest backlog
 ==================  ============================================================
 
 The three serving detectors read the ``serve`` block of a serving run's
-windows (``sheeprl_tpu/serve/telemetry.py``); training streams carry none, so
-they are free no-ops there.
+windows (``sheeprl_tpu/serve/telemetry.py``); the three experience-plane
+detectors read the ``dataflow`` block (``data/service.py`` lineage,
+``buffer.backend=service`` runs). Training streams without those blocks carry
+none of either, so all six are free no-ops there.
 """
 
 from __future__ import annotations
@@ -43,7 +48,7 @@ from __future__ import annotations
 import json
 import os
 import sys
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 Finding = Dict[str, Any]
 Events = Sequence[Dict[str, Any]]
@@ -73,6 +78,15 @@ LATENCY_REGRESSION_RATIO = 2.0  # window p99 vs run median p99
 LATENCY_REGRESSION_CRITICAL = 4.0
 SLOT_STARVATION_OCCUPANCY = 0.95  # "table full" occupancy floor
 SLOT_STARVATION_FRACTION = 0.5  # share of windows with a waiting queue
+# experience-plane (dataflow block) detectors — buffer.backend=service runs
+WEIGHT_STALENESS_LAG = 3  # versions behind the publisher that flag an actor
+WEIGHT_STALENESS_WINDOWS = 2  # sustained lagging windows before flagging
+ROW_AGE_MIN_WINDOWS = 4
+ROW_AGE_DRIFT_RATIO = 3.0  # late-half median p50 age vs early-half
+ROW_AGE_MIN_SECONDS = 10.0  # ignore drift while everything is seconds-fresh
+INGEST_BLOCK_WARNING = 0.25  # actor wall share spent blocked on flow control
+INGEST_BLOCK_CRITICAL = 0.50
+INGEST_QUEUE_DEPTH = 4.0  # learner-side sustained backlog (messages)
 
 
 def _ref(event: Dict[str, Any]) -> Dict[str, Any]:
@@ -670,6 +684,237 @@ def detect_slot_starvation(events: Events) -> List[Finding]:
     ]
 
 
+def _dataflow_windows(events: Events, role: str) -> List[Dict[str, Any]]:
+    """Steady windows carrying a ``dataflow`` block of the given role
+    (``buffer.backend=service`` runs only — everything else contributes none,
+    so the experience-plane detectors are free no-ops there)."""
+    return [
+        w
+        for w in _windows(events)
+        if isinstance(w.get("dataflow"), dict) and w["dataflow"].get("role") == role
+    ]
+
+
+def _by_stream(windows: List[Dict[str, Any]]) -> List[Tuple[Any, List[Dict[str, Any]]]]:
+    """Group windows by their writer (stream label, falling back to rank) so a
+    merged multi-actor dir is judged per actor, in stable order."""
+    groups: Dict[Any, List[Dict[str, Any]]] = {}
+    for w in windows:
+        groups.setdefault(w.get("stream") or f"rank{w.get('rank', 0)}", []).append(w)
+    return sorted(groups.items(), key=lambda kv: str(kv[0]))
+
+
+def detect_weight_staleness(events: Events) -> List[Finding]:
+    """Actors acting with weights materially behind the learner's published
+    version: every env step they take trains the learner on off-policy-er data
+    than the topology intends (the Podracer actor/learner-lag failure mode).
+    An actor that NEVER refreshed (held version 0 while the plane advanced) is
+    critical — its refresh path is broken, not slow."""
+    findings: List[Finding] = []
+    for stream, ws in _by_stream(_dataflow_windows(events, "actor")):
+        lagging = [w for w in ws if _f(w["dataflow"].get("weight_lag")) >= WEIGHT_STALENESS_LAG]
+        last = ws[-1]["dataflow"]
+        # "never refreshed" is conclusive from the FINAL window alone: the held
+        # version is cumulative, so 0-while-the-plane-advanced is a broken
+        # refresh path, not a transient blip — no sustain requirement (the
+        # actors may outrun the learner's first publish and still end stale)
+        never = (
+            int(_f(last.get("weight_version"))) == 0
+            and _f(last.get("weight_latest")) >= WEIGHT_STALENESS_LAG
+        )
+        if len(lagging) < WEIGHT_STALENESS_WINDOWS and not never:
+            continue
+        worst = max(_f(w["dataflow"].get("weight_lag")) for w in (lagging or ws))
+        if not lagging:
+            lagging = [ws[-1]]
+        findings.append(
+            _finding(
+                "weight_staleness",
+                "critical" if never else "warning",
+                (
+                    f"actor stream {stream} never refreshed its weights "
+                    f"(still at version 0 with {int(_f(last.get('weight_latest')))} published)"
+                    if never
+                    else f"actor stream {stream} acted {int(worst)} weight version(s) behind "
+                    f"the learner across {len(lagging)} window(s)"
+                ),
+                lagging,
+                "check the actor's weight-refresh path (buffer.service.poll_weights, "
+                "the subscriber poll in its loop) and the learner's "
+                "buffer.service.publish_every cadence",
+                stream=str(stream),
+                worst_lag=int(worst),
+                windows=len(lagging),
+                never_refreshed=never,
+            )
+        )
+    if findings:
+        return findings
+    # learner-side fallback (a learner stream diagnosed alone, e.g. the in-loop
+    # catalog): the ingest messages' held versions tell the same story
+    for stream, ws in _by_stream(_dataflow_windows(events, "learner")):
+        lagging = [
+            w
+            for w in ws
+            if isinstance(w["dataflow"].get("weight_lag"), dict)
+            and _f(w["dataflow"]["weight_lag"].get("max")) >= WEIGHT_STALENESS_LAG
+        ]
+        if not lagging:
+            continue
+        # held version = publisher current − lag: an actor whose lag equals the
+        # whole published history never refreshed — conclusive, same rationale
+        # as the actor-side check. Judged from the FINAL window only: mid-run a
+        # drained backlog of early version-0 messages looks identical while the
+        # actor has long since caught up.
+        final_block = ws[-1]["dataflow"]
+        final_lag = final_block.get("weight_lag") if isinstance(final_block.get("weight_lag"), dict) else {}
+        current = _f(final_block.get("weight_version"))
+        never_actors = sorted(
+            r
+            for r, v in (final_lag.get("per_actor") or {}).items()
+            if _f(v) >= WEIGHT_STALENESS_LAG and current > 0 and _f(v) >= current
+        )
+        if len(lagging) < WEIGHT_STALENESS_WINDOWS and not never_actors:
+            continue
+        last = lagging[-1]["dataflow"]["weight_lag"]
+        stale_actors = sorted(
+            r for r, v in (last.get("per_actor") or {}).items() if _f(v) >= WEIGHT_STALENESS_LAG
+        )
+        worst = max(_f(w["dataflow"]["weight_lag"].get("max")) for w in lagging)
+        findings.append(
+            _finding(
+                "weight_staleness",
+                # same severity rule as the actor-side view of the identical
+                # condition: a broken refresh path is critical from either side
+                "critical" if never_actors else "warning",
+                (
+                    f"actor(s) {', '.join(never_actors)} never refreshed their weights "
+                    f"(lag spans the whole published history, {int(worst)} version(s)) — "
+                    "seen from the learner's ingest lineage"
+                    if never_actors
+                    else f"actor(s) {', '.join(stale_actors) or '?'} acted {int(worst)} weight "
+                    f"version(s) behind the learner across {len(lagging)} window(s) "
+                    "(seen from the learner's ingest lineage)"
+                ),
+                lagging,
+                "check those actors' weight-refresh paths (buffer.service.poll_weights, "
+                "subscriber polls) and buffer.service.publish_every",
+                stream=str(stream),
+                worst_lag=int(worst),
+                actors=stale_actors,
+                never_refreshed=bool(never_actors),
+                windows=len(lagging),
+            )
+        )
+    return findings
+
+
+def detect_row_age_drift(events: Events) -> List[Finding]:
+    """The learner's sampled-row age marching upward: training data is getting
+    older in wall-clock terms — ingestion is outpacing consumption into a deep
+    buffer, or the learner slowed down mid-run. Judged against the run's own
+    early windows, not an absolute bar."""
+    findings: List[Finding] = []
+    for stream, ws in _by_stream(_dataflow_windows(events, "learner")):
+        aged = [
+            w
+            for w in ws
+            if isinstance((w["dataflow"].get("row_age") or {}).get("seconds"), dict)
+        ]
+        if len(aged) < ROW_AGE_MIN_WINDOWS:
+            continue
+        p50s = [_f(w["dataflow"]["row_age"]["seconds"].get("p50")) for w in aged]
+        half = len(p50s) // 2
+        early, late = _median(p50s[:half]), _median(p50s[half:])
+        if late < ROW_AGE_MIN_SECONDS or (early > 0 and late < ROW_AGE_DRIFT_RATIO * early):
+            continue
+        severity = (
+            "critical" if early > 0 and late >= 2 * ROW_AGE_DRIFT_RATIO * early else "warning"
+        )
+        last_age = aged[-1]["dataflow"]["row_age"]
+        findings.append(
+            _finding(
+                "row_age_drift",
+                severity,
+                f"the learner's sampled-row age drifted {early:.1f}s → {late:.1f}s (p50) "
+                f"over {len(aged)} window(s) — it is training on increasingly old data",
+                aged[half:],
+                "raise the learner's consumption (algo.replay_ratio, faster train "
+                "rounds) or shrink buffer.size so the retained span stays fresh; "
+                "check the same windows for ingest backpressure",
+                stream=str(stream),
+                early_p50_s=round(early, 3),
+                late_p50_s=round(late, 3),
+                late_p99_s=_f((last_age.get("seconds") or {}).get("p99")),
+                late_p50_rounds=_f((last_age.get("rounds") or {}).get("p50")),
+            )
+        )
+    return findings
+
+
+def detect_ingest_backpressure(events: Events) -> List[Finding]:
+    """Actors blocked on the flow-control watermark (the learner's drain cannot
+    keep up) or a sustained learner-side ingest backlog: acting throughput is
+    being throttled by the data plane, not by the envs."""
+    findings: List[Finding] = []
+    for stream, ws in _by_stream(_dataflow_windows(events, "actor")):
+        if len(ws) < 2:
+            continue
+        # flow_block_seconds is cumulative: per-window deltas against wall time
+        blocked: List[Tuple[Dict[str, Any], float]] = []
+        prev = _f(ws[0]["dataflow"].get("flow_block_seconds"))
+        for w in ws[1:]:
+            cur = _f(w["dataflow"].get("flow_block_seconds"))
+            wall = _f(w.get("wall_seconds"))
+            frac = (cur - prev) / wall if wall > 0 else 0.0
+            prev = cur
+            if frac >= INGEST_BLOCK_WARNING:
+                blocked.append((w, frac))
+        if len(blocked) < 2:
+            continue
+        worst = max(frac for _, frac in blocked)
+        findings.append(
+            _finding(
+                "ingest_backpressure",
+                "critical" if worst >= INGEST_BLOCK_CRITICAL else "warning",
+                f"actor stream {stream} spent up to {worst:.0%} of window wall time "
+                f"blocked on ingest flow control across {len(blocked)} window(s) — "
+                "the learner's drain cannot keep up",
+                [w for w, _ in blocked],
+                "raise buffer.service.max_inflight (more credit absorbs learner "
+                "hiccups), speed up the learner's drain, or batch ingestion with "
+                "buffer.service.flush_every",
+                stream=str(stream),
+                worst_block_fraction=round(worst, 4),
+                windows=len(blocked),
+            )
+        )
+    if findings:
+        return findings
+    # learner-side signal: a standing message backlog without actor streams in
+    # view (the mean is cumulative — sustained means the backlog never drained)
+    for stream, ws in _by_stream(_dataflow_windows(events, "learner")):
+        deep = [w for w in ws if _f(w["dataflow"].get("queue_depth")) >= INGEST_QUEUE_DEPTH]
+        if len(deep) < max(2, len(ws) // 2):
+            continue
+        worst = max(_f(w["dataflow"].get("queue_depth")) for w in deep)
+        findings.append(
+            _finding(
+                "ingest_backpressure",
+                "warning",
+                f"the learner's ingest backlog held {worst:.1f} message(s) across "
+                f"{len(deep)}/{len(ws)} window(s) — drain is behind publication",
+                deep,
+                "speed up the ingest drain (it contends with the sampler lock) or "
+                "slow the actors (buffer.service.max_inflight bounds the damage)",
+                stream=str(stream),
+                worst_queue_depth=round(worst, 2),
+                windows=len(deep),
+            )
+        )
+    return findings
+
+
 DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "recompile_storm": detect_recompile_storm,
     "prefetch_starvation": detect_prefetch_starvation,
@@ -683,6 +928,9 @@ DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "occupancy_collapse": detect_occupancy_collapse,
     "latency_regression": detect_latency_regression,
     "slot_starvation": detect_slot_starvation,
+    "weight_staleness": detect_weight_staleness,
+    "row_age_drift": detect_row_age_drift,
+    "ingest_backpressure": detect_ingest_backpressure,
 }
 
 

@@ -116,6 +116,9 @@ class NullTelemetry:
     def attach_sampler(self, sampler: Any) -> None:
         pass
 
+    def attach_dataflow(self, provider: Any) -> None:
+        pass
+
     def wants_program(self, name: str) -> bool:
         return False
 
@@ -237,6 +240,7 @@ class RunTelemetry:
         profiler_cfg: Optional[Mapping[str, Any]] = None,
         jsonl_path: Optional[str] = None,
         rank: Optional[int] = None,
+        http: bool = False,
     ) -> None:
         metric_cfg = cfg.metric
         tcfg = dict(metric_cfg.get("telemetry") or {})
@@ -316,6 +320,21 @@ class RunTelemetry:
         self._last_mfu: Optional[float] = None
         self._peak_hbm = 0
         self._last_step: Optional[int] = None
+        self._dataflow: Any = None  # attach_dataflow provider (experience plane)
+        self._last_dataflow: Optional[Dict[str, Any]] = None
+        # opt-in Prometheus endpoint (metric.telemetry.http_port): serves the
+        # SAME gauges the window emit aggregates — no second bookkeeping path.
+        # Only the primary facade binds it (`http=`): per-role streams of a gang
+        # are separate processes that would race one configured port.
+        self.metrics_endpoint = None
+        if self.enabled and http:
+            from sheeprl_tpu.obs.metrics_http import build_endpoint
+
+            labels = {}
+            run_name = getattr(cfg, "run_name", None)
+            if run_name:
+                labels["run"] = str(run_name)
+            self.metrics_endpoint = build_endpoint(tcfg, labels=labels or None)
         _LIVE_TELEMETRY.add(self)
 
         if self.enabled:
@@ -332,7 +351,10 @@ class RunTelemetry:
                 fingerprint: Optional[Dict[str, Any]] = run_fingerprint(cfg, fabric)
             except Exception:
                 fingerprint = None
+            from sheeprl_tpu.obs.schema import SCHEMA_VERSION
+
             start_event: Dict[str, Any] = dict(
+                schema=SCHEMA_VERSION,
                 platform=getattr(dev, "platform", None),
                 device_kind=getattr(dev, "device_kind", None),
                 world_size=self._world_size,
@@ -356,6 +378,15 @@ class RunTelemetry:
         if self.enabled and hasattr(sampler, "telemetry_snapshot"):
             self._sampler = sampler
             self._prefetch_last = None
+
+    def attach_dataflow(self, provider: Any) -> None:
+        """Wire the experience-plane dataflow view (any object exposing
+        ``dataflow_snapshot()`` — ``data/service.py``'s :class:`ActorDataflow` /
+        :class:`LearnerDataflow`). Every window/summary event then carries a
+        ``dataflow`` block (weight version/lag, sampled-row ages, ingest
+        latency, queue depth) and the ``Service/*`` gauges light up."""
+        if self.enabled and hasattr(provider, "dataflow_snapshot"):
+            self._dataflow = provider
 
     def wants_program(self, name: str) -> bool:
         """Cheap per-iteration guard: True until ``name`` has been registered."""
@@ -583,10 +614,17 @@ class RunTelemetry:
                 prefetch=self._prefetch_total or None,
                 env_restarts=self._env_restarts,
                 health=self._health_status,
+                # end-of-run dataflow state (weight lag, row ages, queue): the
+                # numbers bench.py attaches under conditions.dataflow; absent
+                # entirely on runs without an experience plane
+                dataflow=self._dataflow_snapshot() or None,
                 programs={k: v for k, v in self._programs.items()},
             )
             self._sink.close()
             self._sink = None
+        if self.metrics_endpoint is not None:
+            self.metrics_endpoint.close()
+            self.metrics_endpoint = None
         self.enabled = False
 
     # -- internals ---------------------------------------------------------------
@@ -671,6 +709,47 @@ class RunTelemetry:
             "is_async": bool(snap.get("is_async", False)),
         }
 
+    def _dataflow_snapshot(self) -> Optional[Dict[str, Any]]:
+        if self._dataflow is None:
+            return None
+        try:
+            snap = self._dataflow.dataflow_snapshot()
+        except Exception:
+            return self._last_dataflow  # a dying KV plane must not kill the window
+        self._last_dataflow = snap
+        return snap
+
+    @staticmethod
+    def _dataflow_gauges(dataflow: Optional[Mapping[str, Any]]) -> Dict[str, float]:
+        """The ``Service/*`` gauge projection of one dataflow block (only the
+        keys the role actually reports)."""
+        if not dataflow:
+            return {}
+        gauges: Dict[str, float] = {}
+        lag = dataflow.get("weight_lag")
+        if isinstance(lag, Mapping):
+            lag = lag.get("max")
+        if isinstance(lag, (int, float)):
+            gauges["Service/weight_lag"] = float(lag)
+        row_age = (dataflow.get("row_age") or {}).get("seconds") if dataflow.get("row_age") else None
+        if isinstance(row_age, Mapping):
+            if row_age.get("p50") is not None:
+                gauges["Service/row_age_p50"] = float(row_age["p50"])
+            if row_age.get("p99") is not None:
+                gauges["Service/row_age_p99"] = float(row_age["p99"])
+        latency = dataflow.get("ingest_latency_ms")
+        if isinstance(latency, Mapping) and latency.get("p99") is not None:
+            gauges["Service/ingest_latency_p99_ms"] = float(latency["p99"])
+        for key, gauge in (
+            ("queue_depth", "Service/queue_depth"),
+            ("rows_per_sec", "Service/rows_per_sec"),
+            ("inflight", "Service/ingest_inflight"),
+        ):
+            value = dataflow.get(key)
+            if isinstance(value, (int, float)):
+                gauges[gauge] = float(value)
+        return gauges
+
     def _check_health(self, policy_step: int) -> Optional[Dict[str, Any]]:
         if self._window_idx % self.health_every != 0:
             return None
@@ -731,6 +810,7 @@ class RunTelemetry:
         self._last_mfu = mfu
 
         prefetch = self._prefetch_delta()
+        dataflow = self._dataflow_snapshot()
         health = self._check_health(policy_step)
 
         # phase attribution: replay/prefetch wait is carved OUT of the train span
@@ -757,30 +837,33 @@ class RunTelemetry:
             self._total_phases[k] = self._total_phases.get(k, 0.0) + v
         self._total_wall_seconds += wall
 
+        gauges: Dict[str, float] = {
+            "Perf/sps": sps,
+            "Compile/count": float(total_compiles),
+            "Compile/seconds": float(total_compile_seconds),
+        }
+        if hbm is not None:
+            if "bytes_in_use" in hbm:
+                gauges["Mem/hbm_bytes_in_use"] = float(hbm["bytes_in_use"])
+            if "peak_bytes" in hbm:
+                gauges["Mem/hbm_peak"] = float(hbm["peak_bytes"])
+        if rss is not None:
+            gauges["Mem/host_rss_bytes"] = float(rss)
+        if rss_peak is not None:
+            gauges["Mem/host_rss_peak"] = float(rss_peak)
+        if mfu is not None:
+            gauges["Perf/mfu"] = float(mfu)
+        if prefetch is not None:
+            gauges["Time/prefetch_wait"] = float(prefetch["wait_seconds"])
+            gauges["Buffer/pipeline_occupancy"] = float(prefetch["occupancy"])
+            gauges["Buffer/pipeline_staleness"] = float(prefetch["staleness"])
+        if self._env_restarts > 0:
+            gauges["Health/env_restarts"] = float(self._env_restarts)
+        gauges.update(self._dataflow_gauges(dataflow))
         if self._logger is not None:
-            gauges: Dict[str, float] = {
-                "Perf/sps": sps,
-                "Compile/count": float(total_compiles),
-                "Compile/seconds": float(total_compile_seconds),
-            }
-            if hbm is not None:
-                if "bytes_in_use" in hbm:
-                    gauges["Mem/hbm_bytes_in_use"] = float(hbm["bytes_in_use"])
-                if "peak_bytes" in hbm:
-                    gauges["Mem/hbm_peak"] = float(hbm["peak_bytes"])
-            if rss is not None:
-                gauges["Mem/host_rss_bytes"] = float(rss)
-            if rss_peak is not None:
-                gauges["Mem/host_rss_peak"] = float(rss_peak)
-            if mfu is not None:
-                gauges["Perf/mfu"] = float(mfu)
-            if prefetch is not None:
-                gauges["Time/prefetch_wait"] = float(prefetch["wait_seconds"])
-                gauges["Buffer/pipeline_occupancy"] = float(prefetch["occupancy"])
-                gauges["Buffer/pipeline_staleness"] = float(prefetch["staleness"])
-            if self._env_restarts > 0:
-                gauges["Health/env_restarts"] = float(self._env_restarts)
             self._logger.log_metrics(gauges, policy_step)
+        if self.metrics_endpoint is not None:
+            self.metrics_endpoint.update({**gauges, "Run/policy_step": float(policy_step)})
 
         window_event: Dict[str, Any] = dict(
             step=policy_step,
@@ -805,6 +888,8 @@ class RunTelemetry:
             },
             prefetch=prefetch,
         )
+        if dataflow is not None:
+            window_event["dataflow"] = dataflow
         self._append_history("window", window_event)
         if self._sink is not None:
             self._sink.emit("window", **window_event)
@@ -841,7 +926,7 @@ def build_telemetry(fabric: Any, cfg: Any, log_dir: Optional[str], logger: Any =
     pcfg = resolve_profiler_config(metric_cfg)
     if not enabled and pcfg["mode"] != "window":
         return NullTelemetry()
-    return RunTelemetry(fabric, cfg, log_dir, logger, enabled=enabled, profiler_cfg=pcfg)
+    return RunTelemetry(fabric, cfg, log_dir, logger, enabled=enabled, profiler_cfg=pcfg, http=True)
 
 
 def role_stream_path(cfg: Any, role: str) -> str:

@@ -12,8 +12,11 @@ Per refresh the monitor shows: policy step + throughput (window sps), MFU,
 the phase-attribution bar (env / replay wait / train / checkpoint / logging /
 eval / other shares of the last window), device memory (HBM when the backend
 reports it, host RSS otherwise), prefetch pipeline occupancy/staleness, the
-latest health verdict and in-loop diagnosis findings, and the attempt/restart
-state of supervised runs. Multi-process (gang) runs additionally get a per-rank
+experience plane's dataflow line on ``buffer.backend=service`` runs (worst
+actor weight lag, learner row age p50/p99, ingest latency, queue depth — from
+the windows' ``dataflow`` blocks, whatever stream they ride), the latest
+health verdict and in-loop diagnosis findings, and the attempt/restart state
+of supervised runs. Fleet watch adds per-member staleness to the member lines. Multi-process (gang) runs additionally get a per-rank
 liveness board: every stream's rank identity marks its writer alive, a
 ``health`` ``status=rank_dead`` event (heartbeat failure detection,
 ``resilience/distributed.py``) marks the named peer DEAD, and the gang
@@ -86,6 +89,14 @@ class WatchState:
         self.summary: Optional[Dict[str, Any]] = None  # primary-stream summary
         self.gave_up = False
         self.events_seen = 0
+        # experience-plane dataflow state by role (buffer.backend=service runs):
+        # the actor view tracks each actor STREAM's latest block (the render
+        # shows the currently-worst lag — latest per stream, not worst-ever,
+        # so a recovered actor stops being reported stale), the learner view
+        # the learner stream's latest — neither is primary-gated, the whole
+        # point is cross-process visibility
+        self.dataflow: Dict[str, Dict[str, Any]] = {}
+        self._actor_dataflow: Dict[Any, Dict[str, Any]] = {}  # stream -> latest block
         # per-rank liveness of a multi-process (gang) run: every event's rank
         # identity marks its writer alive; a health status=rank_dead names the
         # dead peer; the gang supervisor's attempt_exit carries exit codes. A
@@ -105,6 +116,10 @@ class WatchState:
                     self.ranks.setdefault(int(writer), "alive")
                 except (TypeError, ValueError):
                     pass
+            if kind == "window" and isinstance(event.get("dataflow"), dict):
+                self._consume_dataflow(
+                    event["dataflow"], event.get("stream") or f"rank{event.get('rank', 0)}"
+                )
             if kind == "start" and _is_primary(event):
                 self.start = event
             elif kind == "window" and _is_primary(event):
@@ -144,6 +159,34 @@ class WatchState:
                 self.gave_up = True
             elif kind == "summary" and _is_primary(event):
                 self.summary = event
+
+    def _consume_dataflow(self, dataflow: Dict[str, Any], stream: Any) -> None:
+        role = str(dataflow.get("role") or "")
+        if role == "actor":
+            # several actor streams feed one board: keep each stream's LATEST
+            # block and render the one with the currently-worst lag
+            self._actor_dataflow[stream] = dataflow
+            self.dataflow["actor"] = max(
+                self._actor_dataflow.values(),
+                key=lambda d: float(d.get("weight_lag") or 0.0)
+                if isinstance(d.get("weight_lag"), (int, float))
+                else 0.0,
+            )
+        elif role == "learner":
+            self.dataflow["learner"] = dataflow
+
+    @property
+    def weight_lag(self) -> Optional[float]:
+        """Worst known actor weight lag (versions behind the publisher) — the
+        per-member staleness number the fleet watch renders."""
+        actor = (self.dataflow.get("actor") or {}).get("weight_lag")
+        learner = (self.dataflow.get("learner") or {}).get("weight_lag")
+        candidates = []
+        if isinstance(actor, (int, float)):
+            candidates.append(float(actor))
+        if isinstance(learner, dict) and isinstance(learner.get("max"), (int, float)):
+            candidates.append(float(learner["max"]))
+        return max(candidates) if candidates else None
 
     def _consume_health(self, event: Dict[str, Any]) -> None:
         status = event.get("status")
@@ -262,6 +305,27 @@ class WatchState:
             if isinstance(phases, dict):
                 wall = float(w.get("wall_seconds") or 0.0)
                 lines.append(f"  {self._phase_bar(phases, wall)}")
+        if self.dataflow:
+            # the experience plane's staleness line (service-backend runs):
+            # worst actor weight lag, learner-side row ages and ingest state
+            bits = []
+            lag = self.weight_lag
+            if lag is not None:
+                bits.append(f"weight lag {lag:.0f}")
+            learner = self.dataflow.get("learner") or {}
+            age = (learner.get("row_age") or {}).get("seconds") or {}
+            if age.get("p50") is not None:
+                bits.append(f"row age p50 {float(age['p50']):.1f}s p99 {float(age.get('p99') or 0):.1f}s")
+            lat = learner.get("ingest_latency_ms") or {}
+            if lat.get("p99") is not None:
+                bits.append(f"ingest p99 {float(lat['p99']):.0f}ms")
+            if learner.get("queue_depth") is not None:
+                bits.append(f"queue {float(learner['queue_depth']):.1f}")
+            actor = self.dataflow.get("actor") or {}
+            if actor.get("rows") is not None and not learner:
+                bits.append(f"rows {int(actor['rows'])}")
+            if bits:
+                lines.append("  dataflow: " + " · ".join(bits))
         health_bits = [f"health {self.health}"]
         if self.env_restarts:
             health_bits.append(f"{self.env_restarts} env restart(s)")
@@ -368,6 +432,14 @@ class FleetWatchState:
             ]
             if state.restarts:
                 bits.append(f"{state.restarts} restart(s)")
+            # per-member staleness: worst actor weight lag + learner row age of
+            # service-backend members (plain members contribute nothing)
+            lag = state.weight_lag
+            if lag is not None and lag > 0:
+                bits.append(f"lag {lag:.0f}")
+            age = ((state.dataflow.get("learner") or {}).get("row_age") or {}).get("seconds") or {}
+            if age.get("p50") is not None:
+                bits.append(f"row age {float(age['p50']):.1f}s")
             findings = [f for f in state.findings if f.get("severity") in ("warning", "critical")]
             if findings:
                 bits.append(f"{len(findings)} finding(s)")

@@ -163,12 +163,18 @@ def serve_main(args: Optional[Sequence[str]] = None) -> int:
     log_dir = serve_cfg.get("log_dir") or _default_log_dir(cfg)
     os.makedirs(log_dir, exist_ok=True)
     tcfg = serve_cfg.get("telemetry") or {}
+    # the live metrics endpoint rides the training config surface
+    # (metric.telemetry.http_port — overridable on the serve command line), so
+    # one knob makes trainers AND servers scrapeable the same way
+    metric_tcfg = ((cfg.get("metric") or {}).get("telemetry")) or {}
     telemetry = ServingTelemetry(
         fabric,
         cfg,
         log_dir,
         enabled=bool(tcfg.get("enabled", True)),
         every=int(tcfg.get("every", 256)),
+        http_port=metric_tcfg.get("http_port"),
+        http_host=str(metric_tcfg.get("http_host") or "127.0.0.1"),
         serve_info={
             "slots": int(serve_cfg.slots),
             "max_batch_wait_ms": float(serve_cfg.max_batch_wait_ms),

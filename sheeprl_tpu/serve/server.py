@@ -140,6 +140,13 @@ class PolicyServer:
         for session in list(self._sessions.values()) + list(self._admission):
             session._event.set()
         if self.telemetry is not None:
+            # flush lifecycle deltas no tick observed (sessions that closed
+            # after the final batch tick), then finalize the stream
+            with self._cond:
+                started, finished = self._started_delta, self._finished_delta
+                self._started_delta = self._finished_delta = 0
+            if started or finished:
+                self.telemetry.observe_sessions(started=started, finished=finished)
             self.telemetry.close(clean_exit=clean_exit and self._error is None)
 
     def __enter__(self) -> "PolicyServer":

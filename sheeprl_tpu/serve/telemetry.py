@@ -75,6 +75,8 @@ class ServingTelemetry:
         serve_info: Optional[Dict[str, Any]] = None,
         jsonl_path: Optional[str] = None,
         diagnosis: bool = True,
+        http_port: Optional[int] = None,
+        http_host: str = "127.0.0.1",
     ) -> None:
         self.enabled = bool(enabled)
         self.every = max(int(every), 1)
@@ -83,6 +85,17 @@ class ServingTelemetry:
         self._sink: Optional[JsonlEventSink] = None
         self._history: List[Dict[str, Any]] = []
         self._last_diagnosis_key: Any = None
+        # opt-in Prometheus endpoint (metric.telemetry.http_port): the serving
+        # window gauges — latency p99, occupancy, sessions/sec, queue depth —
+        # scrapeable in place while the server runs; None = no socket at all
+        self.metrics_endpoint = None
+        if self.enabled and http_port is not None:
+            from sheeprl_tpu.obs.metrics_http import build_endpoint
+
+            self.metrics_endpoint = build_endpoint(
+                {"http_port": http_port, "http_host": http_host},
+                labels={"role": "serve", "algo": str(getattr(cfg.algo, "name", "?"))},
+            )
 
         # cumulative counters
         self._steps = 0
@@ -127,7 +140,10 @@ class ServingTelemetry:
             fingerprint: Optional[Dict[str, Any]] = run_fingerprint(cfg, fabric)
         except Exception:
             fingerprint = None
+        from sheeprl_tpu.obs.schema import SCHEMA_VERSION
+
         start_event = dict(
+            schema=SCHEMA_VERSION,
             platform=getattr(self._device, "platform", None),
             device_kind=getattr(self._device, "device_kind", None),
             world_size=1,
@@ -184,6 +200,19 @@ class ServingTelemetry:
         if self._win_steps >= self.every:
             self._emit_window()
 
+    def observe_sessions(self, started: int = 0, finished: int = 0) -> None:
+        """Fold session lifecycle deltas that never rode a tick (sessions
+        closing after the LAST batch tick — e.g. every session finishing its
+        fixed-length episode on the same final step) into the counters, so the
+        summary's ``sessions_finished`` is exact, not tick-sampled. The server
+        calls this once from ``close()``."""
+        if not self.enabled:
+            return
+        self._sessions_started += int(started)
+        self._sessions_finished += int(finished)
+        self._win_sessions_started += int(started)
+        self._win_sessions_finished += int(finished)
+
     # -- window / summary ----------------------------------------------------------
 
     def _serve_block(self, wall: float) -> Dict[str, Any]:
@@ -218,12 +247,18 @@ class ServingTelemetry:
         if hbm and hbm.get("peak_bytes"):
             self._peak_hbm = max(self._peak_hbm, hbm["peak_bytes"])
 
-        step_s = min(self._win_step_seconds, wall)
-        wait_s = min(self._win_wait_seconds, max(wall - step_s, 0.0))
+        # tile the ROUNDED wall exactly: rounding each phase independently can
+        # overshoot a sub-millisecond window by a whole 1e-4 quantum (observed:
+        # sum 0.0019 vs wall 0.0018 on a fast CPU tick), which breaks the
+        # sum(phases) ≈ wall invariant consumers assert — so clamp each rounded
+        # phase into the rounded remainder and derive `other` from it
+        wall_r = round(wall, 4)
+        step_r = min(round(min(self._win_step_seconds, wall), 4), wall_r)
+        wait_r = min(round(self._win_wait_seconds, 4), round(wall_r - step_r, 4))
         phases = {
-            "serve_step": round(step_s, 4),
-            "serve_wait": round(wait_s, 4),
-            "other": round(max(wall - step_s - wait_s, 0.0), 4),
+            "serve_step": step_r,
+            "serve_wait": max(wait_r, 0.0),
+            "other": round(max(wall_r - step_r - max(wait_r, 0.0), 0.0), 4),
         }
 
         window_event: Dict[str, Any] = dict(
@@ -248,6 +283,23 @@ class ServingTelemetry:
         self._append_history("window", window_event)
         if self._sink is not None:
             self._sink.emit("window", **window_event)
+        if self.metrics_endpoint is not None:
+            serve_block = window_event["serve"]
+            lat = serve_block.get("latency_ms") or {}
+            sessions = serve_block.get("sessions") or {}
+            self.metrics_endpoint.update(
+                {
+                    "Perf/sps": window_event["sps"],
+                    "Serve/latency_p50_ms": lat.get("p50"),
+                    "Serve/latency_p99_ms": lat.get("p99"),
+                    "Serve/occupancy": serve_block.get("occupancy"),
+                    "Serve/sessions_active": sessions.get("active"),
+                    "Serve/sessions_per_sec": sessions.get("per_sec"),
+                    "Serve/queue_depth": serve_block.get("queue_depth"),
+                    "Serve/state_bytes": serve_block.get("state_bytes"),
+                    "Compile/count": (window_event.get("compile") or {}).get("count"),
+                }
+            )
         if self.diagnosis:
             self._run_live_diagnosis()
 
@@ -270,6 +322,9 @@ class ServingTelemetry:
         self.enabled = False
         if self._win_steps > 0:
             self._emit_window(final=True)
+        if self.metrics_endpoint is not None:
+            self.metrics_endpoint.close()
+            self.metrics_endpoint = None
         if self._sink is None:
             return
         wall = time.perf_counter() - self._start_time

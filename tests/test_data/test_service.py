@@ -264,3 +264,110 @@ def test_flat_replay_buffer_backend():
     assert service.drain_once() == 8
     sample = rb.sample(batch_size=4, n_samples=1)
     assert sample["observations"].shape[1] == 4
+
+
+# ---------------------------------------------------------------------------------
+# dataflow lineage: birth stamps, weight versions, row ages, lag (ISSUE 12)
+# ---------------------------------------------------------------------------------
+def test_messages_carry_birth_and_weight_version_lineage():
+    kv = LocalKV()
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(rb, kv, "t", (0,), env_ids_of=lambda r: [0, 1])
+    w = ExperienceWriter(kv, "t", 0, flush_every=1)
+    before = time.time()
+    w.add(_rows())
+    w.weight_version = 7  # the actor refreshed; later rows carry the new lineage
+    w.add(_rows())
+    assert service.drain_once() == 4
+    # the service learned the actor's latest acting version from its messages
+    assert service.actor_weight_versions() == {0: 7}
+    # ingest latency measured from the BIRTH stamp, not the drain
+    latency = service.ingest_latency()
+    assert latency is not None and 0.0 <= latency["p99"] < (time.time() - before) + 1.0
+    ages = service.row_ages()
+    assert ages is not None
+    assert ages["seconds"]["p50"] >= 0.0 and ages["seconds"]["max"] < 60.0
+    # two messages ingested: the older rows are 1 add-round old, the newer 0
+    assert ages["rounds"]["max"] == 1.0 and ages["add_rounds"] == 2
+
+
+def test_age_book_evicts_with_buffer_capacity():
+    from sheeprl_tpu.data.service import _AgeBook
+
+    book = _AgeBook(capacity_rows=8)
+    t0 = time.time()
+    for i in range(6):
+        book.record(4, t0 + i)  # 4 rows per round, capacity 8 -> keep last 2
+    snap = book.age_snapshot(now=t0 + 6)
+    # only the 2 newest messages (8 rows) survive: ages 1s and 2s
+    assert snap["seconds"]["max"] == pytest.approx(2.0)
+    assert snap["rounds"]["max"] == 1.0
+    # a pre-lineage message (born=None) advances the round clock silently
+    book.record(4, None)
+    snap = book.age_snapshot(now=t0 + 6)
+    assert snap["rounds"]["max"] == 2.0
+
+
+def test_subscriber_tracks_latest_and_lag_without_fetching():
+    kv = LocalKV()
+    pub = WeightPublisher(kv, "t")
+    sub = WeightSubscriber(kv, "t")
+    pub.publish({"w": np.zeros(2)})
+    pub.publish({"w": np.ones(2)})
+    # peek reads the frontier without consuming a payload
+    assert sub.peek_latest() == 2
+    snap = sub.telemetry_snapshot()
+    assert snap == {"version": 0, "latest": 2, "lag": 2}
+    assert sub.poll()["version"] == 2
+    assert sub.telemetry_snapshot() == {"version": 2, "latest": 2, "lag": 0}
+
+
+def test_actor_and_learner_dataflow_snapshots():
+    from sheeprl_tpu.data.service import ActorDataflow, LearnerDataflow
+
+    kv = LocalKV()
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(rb, kv, "t", (0,), env_ids_of=lambda r: [0, 1])
+    writer = ExperienceWriter(kv, "t", 0, flush_every=1)
+    pub = WeightPublisher(kv, "t")
+    sub = WeightSubscriber(kv, "t")
+
+    pub.publish({"w": 1})
+    payload = sub.poll()
+    writer.weight_version = payload["version"]
+    writer.add(_rows())
+    pub.publish({"w": 2})  # a second version the actor has NOT consumed yet
+    assert service.drain_once() == 2
+
+    actor = ActorDataflow(writer, sub).dataflow_snapshot()
+    assert actor["role"] == "actor"
+    assert actor["weight_version"] == 1 and actor["weight_latest"] == 2
+    assert actor["weight_lag"] == 1
+    assert actor["rows"] == 2 and actor["messages"] == 1
+
+    learner = LearnerDataflow(service, pub).dataflow_snapshot()
+    assert learner["role"] == "learner"
+    assert learner["weight_version"] == 2
+    # the ingested rows carried version 1 -> per-actor lag 1 against the publisher
+    assert learner["weight_lag"] == {"per_actor": {"0": 1}, "max": 1, "mean": 1.0}
+    assert learner["row_age"]["seconds"]["p50"] >= 0.0
+    assert learner["ingest_latency_ms"]["p99"] >= 0.0
+    assert learner["rows"] == 2 and learner["rows_per_actor"] == {"0": 2}
+
+
+def test_dataflow_snapshot_shapes_are_jsonable():
+    """The dataflow block rides telemetry.jsonl: every leaf must serialize."""
+    import json
+
+    from sheeprl_tpu.data.service import ActorDataflow, LearnerDataflow
+
+    kv = LocalKV()
+    rb = _buffer(n_envs=2)
+    service = ExperienceService(rb, kv, "t", (0,), env_ids_of=lambda r: [0, 1])
+    writer = ExperienceWriter(kv, "t", 0)
+    pub = WeightPublisher(kv, "t")
+    sub = WeightSubscriber(kv, "t")
+    writer.add(_rows())
+    service.drain_once()
+    json.dumps(ActorDataflow(writer, sub).dataflow_snapshot())
+    json.dumps(LearnerDataflow(service, pub).dataflow_snapshot())

@@ -46,6 +46,9 @@ base:
   - algo.run_test=False
   - metric.log_level=0
   - checkpoint.save_last=True
+  # the RUNNER binds the metrics endpoint (ephemeral port) and must NOT
+  # forward the override to the members (N children racing one port)
+  - metric.telemetry.http_port=0
 sweep:
   seed: [42, 43]
 restarts: {max_restarts: 1, backoff: 0.05, attempt_timeout: 120, kill_grace: 10}

@@ -440,3 +440,62 @@ def test_serve_load_p99_gates_lower_is_better():
     row = by_metric["serve_load_sessions_per_sec"]
     assert row["direction"] == "higher-is-better"
     assert row["status"] == "regression"
+
+
+def _dataflow_stream(lag, age_p50, latency_p99=20.0, queue=0.5, n=6):
+    """A synthetic service run: actor windows with weight lag + learner windows
+    with row-age/latency/queue dataflow blocks."""
+    events = [{"event": "start", "time": 0.0, "rank": 0, "fingerprint": None}]
+    for s in range(1, n + 1):
+        events.append(
+            {
+                "event": "window",
+                "time": 10.0 * s,
+                "rank": 0,
+                "step": s * 16,
+                "final": False,
+                "wall_seconds": 10.0,
+                "sps": 10.0,
+                "dataflow": {"role": "actor", "weight_version": 5, "weight_latest": 5 + lag, "weight_lag": lag},
+            }
+        )
+        events.append(
+            {
+                "event": "window",
+                "time": 10.0 * s + 1,
+                "rank": 1,
+                "stream": "telemetry.learner.jsonl",
+                "step": s * 16,
+                "final": False,
+                "wall_seconds": 10.0,
+                "dataflow": {
+                    "role": "learner",
+                    "weight_version": 5 + lag,
+                    "weight_lag": {"per_actor": {"0": lag}, "max": lag, "mean": float(lag)},
+                    "row_age": {"seconds": {"p50": age_p50, "p99": age_p50 * 2, "mean": age_p50, "max": age_p50 * 3}},
+                    "ingest_latency_ms": {"p50": 5.0, "p99": latency_p99, "mean": 6.0, "max": 40.0},
+                    "queue_depth": queue,
+                },
+            }
+        )
+    return events
+
+
+def test_profile_and_compare_dataflow_regression():
+    fresh = profile_run(_dataflow_stream(lag=1, age_p50=2.0))
+    assert fresh["dataflow"]["weight_lag"]["median"] == 1
+    assert fresh["dataflow"]["row_age_p50_s"]["median"] == 2.0
+    # same staleness profile: quiet
+    result = compare_profiles(fresh, profile_run(_dataflow_stream(lag=1, age_p50=2.0)))
+    assert "dataflow_regression" not in _names(result["findings"])
+    # B got staler: more actor lag AND older sampled rows -> flagged, lower-is-better
+    stale = profile_run(_dataflow_stream(lag=4, age_p50=9.0, latency_p99=80.0))
+    result = compare_profiles(fresh, stale)
+    flagged = _by(result["findings"], "dataflow_regression")
+    assert {f["metrics"]["metric"] for f in flagged} >= {"weight_lag", "row_age_p50_s"}
+    assert all(f["severity"] == "warning" for f in flagged)
+    # the reverse direction (B fresher than A) never flags
+    result = compare_profiles(stale, fresh)
+    assert "dataflow_regression" not in _names(result["findings"])
+    # runs without an experience plane profile dataflow=None and stay quiet
+    assert profile_run(merged_events(_RUN_A))["dataflow"] is None

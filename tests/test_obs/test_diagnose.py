@@ -378,3 +378,171 @@ def test_serving_detectors_ignore_training_streams():
         events, detectors=("occupancy_collapse", "latency_regression", "slot_starvation")
     )
     assert findings == []
+
+
+# ---------------------------------------------------------------------------------
+# experience-plane (dataflow) detectors — buffer.backend=service runs
+# ---------------------------------------------------------------------------------
+def _actor_window(step, lag=0, version=None, latest=None, block_s=0.0, stream="telemetry.jsonl"):
+    version = version if version is not None else max(10 - lag, 0)
+    latest = latest if latest is not None else version + lag
+    return {
+        "event": "window",
+        "time": 2000.0 + step,
+        "step": step,
+        "final": False,
+        "wall_seconds": 10.0,
+        "stream": stream,
+        "dataflow": {
+            "role": "actor",
+            "weight_version": version,
+            "weight_latest": latest,
+            "weight_lag": lag,
+            "rows": step,
+            "messages": step // 4,
+            "inflight": 0,
+            "flow_block_seconds": block_s,
+        },
+    }
+
+
+def _learner_window(
+    step,
+    lag_max=0,
+    per_actor=None,
+    age_p50=1.0,
+    age_p99=None,
+    queue=0.0,
+    stream="telemetry.learner.jsonl",
+):
+    return {
+        "event": "window",
+        "time": 2000.0 + step,
+        "step": step,
+        "final": False,
+        "wall_seconds": 10.0,
+        "stream": stream,
+        "dataflow": {
+            "role": "learner",
+            "weight_version": 10,
+            "weight_lag": {
+                "per_actor": per_actor or {"0": lag_max},
+                "max": lag_max,
+                "mean": float(lag_max),
+            },
+            "row_age": {
+                "seconds": {"p50": age_p50, "p99": age_p99 or age_p50 * 2, "mean": age_p50, "max": age_p99 or age_p50 * 2},
+                "rounds": {"p50": age_p50 * 3, "p99": age_p50 * 6, "mean": age_p50 * 3, "max": age_p50 * 6},
+                "add_rounds": step,
+            },
+            "ingest_latency_ms": {"p50": 5.0, "p99": 20.0, "mean": 6.0, "max": 30.0},
+            "queue_depth": queue,
+            "queue_depth_max": int(queue) + 1,
+            "rows": step,
+            "rows_per_actor": {"0": step},
+            "rows_per_sec": 10.0,
+        },
+    }
+
+
+def test_weight_staleness_detector_actor_side():
+    fresh = [_actor_window(s * 16, lag=0) for s in range(1, 6)]
+    assert not _by(run_detectors(fresh), "weight_staleness")
+    # one lagging window is a blip, not staleness
+    blip = fresh + [_actor_window(96, lag=4)]
+    assert not _by(run_detectors(blip), "weight_staleness")
+    # sustained lag >= threshold flags the actor's stream
+    lagging = [_actor_window(s * 16, lag=4) for s in range(1, 4)]
+    (f,) = _by(run_detectors(lagging), "weight_staleness")
+    assert f["severity"] == "warning"
+    assert f["metrics"]["worst_lag"] == 4
+    assert "poll_weights" in f["suggestion"]
+    # an actor that NEVER refreshed (version 0 while the plane advanced) is
+    # critical — its refresh path is broken, not slow
+    frozen = [_actor_window(s * 16, lag=s + 2, version=0, latest=s + 2) for s in range(1, 5)]
+    (f,) = _by(run_detectors(frozen), "weight_staleness")
+    assert f["severity"] == "critical"
+    assert f["metrics"]["never_refreshed"] is True
+    # never-refreshed is conclusive from the FINAL window alone (the actors can
+    # outrun the learner's first publish and only see the lag at close): no
+    # sustained-window requirement for the version-0 case
+    outran = [_actor_window(s * 16, lag=1, version=0, latest=1) for s in range(1, 6)] + [
+        _actor_window(96, lag=25, version=0, latest=25)
+    ]
+    (f,) = _by(run_detectors(outran), "weight_staleness")
+    assert f["severity"] == "critical" and f["metrics"]["never_refreshed"] is True
+
+
+def test_weight_staleness_detector_learner_fallback_and_merged_priority():
+    # a learner stream alone (the in-loop catalog's view) still names the actors
+    learner_only = [_learner_window(s * 16, lag_max=5, per_actor={"0": 5, "1": 0}) for s in range(1, 4)]
+    (f,) = _by(run_detectors(learner_only), "weight_staleness")
+    assert f["severity"] == "warning"
+    assert f["metrics"]["actors"] == ["0"]
+    # in a merged dir the actor-side finding wins (no duplicate per view)
+    merged = learner_only + [_actor_window(s * 16, lag=5) for s in range(1, 4)]
+    findings = _by(run_detectors(merged), "weight_staleness")
+    assert len(findings) == 1
+    assert findings[0]["metrics"]["stream"] == "telemetry.jsonl"
+
+
+def test_row_age_drift_detector():
+    fresh = [_learner_window(s * 16, age_p50=2.0) for s in range(1, 9)]
+    assert not _by(run_detectors(fresh), "row_age_drift")
+    # ages grow but stay seconds-fresh: below the absolute floor, no finding
+    shallow = [_learner_window(s * 16, age_p50=0.5 + 0.5 * s) for s in range(1, 9)]
+    assert not _by(run_detectors(shallow), "row_age_drift")
+    # a real drift: early ~2s, late ~30s
+    drifting = [_learner_window(s * 16, age_p50=2.0) for s in range(1, 5)] + [
+        _learner_window((4 + s) * 16, age_p50=30.0) for s in range(1, 5)
+    ]
+    (f,) = _by(run_detectors(drifting), "row_age_drift")
+    assert f["severity"] == "critical"  # 15x >= 2 * ROW_AGE_DRIFT_RATIO
+    assert f["metrics"]["late_p50_s"] == 30.0
+    mild = [_learner_window(s * 16, age_p50=4.0) for s in range(1, 5)] + [
+        _learner_window((4 + s) * 16, age_p50=14.0) for s in range(1, 5)
+    ]
+    (f,) = _by(run_detectors(mild), "row_age_drift")
+    assert f["severity"] == "warning"
+
+
+def test_ingest_backpressure_detector():
+    free = [_actor_window(s * 16, block_s=0.0) for s in range(1, 6)]
+    assert not _by(run_detectors(free), "ingest_backpressure")
+    # flow_block_seconds is CUMULATIVE: +3s per 10s window = 30% blocked
+    blocked = [_actor_window(s * 16, block_s=3.0 * (s - 1)) for s in range(1, 6)]
+    (f,) = _by(run_detectors(blocked), "ingest_backpressure")
+    assert f["severity"] == "warning"
+    assert "max_inflight" in f["suggestion"]
+    # +6s per 10s window = 60% blocked → critical
+    stalled = [_actor_window(s * 16, block_s=6.0 * (s - 1)) for s in range(1, 6)]
+    (f,) = _by(run_detectors(stalled), "ingest_backpressure")
+    assert f["severity"] == "critical"
+    # learner-side fallback: a standing message backlog
+    backlog = [_learner_window(s * 16, queue=6.0) for s in range(1, 5)]
+    (f,) = _by(run_detectors(backlog), "ingest_backpressure")
+    assert f["severity"] == "warning"
+    assert f["metrics"]["worst_queue_depth"] == 6.0
+
+
+def test_dataflow_detectors_ignore_plain_training_streams():
+    """Windows without a `dataflow` block (every pre-service stream) are
+    structural no-ops for all three experience-plane detectors."""
+    events = [_window(s * 100) for s in range(1, 8)]
+    findings = run_detectors(
+        events, detectors=("weight_staleness", "row_age_drift", "ingest_backpressure")
+    )
+    assert findings == []
+
+
+def test_weight_staleness_learner_fallback_never_refreshed_is_critical():
+    """The learner's ingest lineage alone can prove a broken refresh path: an
+    actor whose lag spans the WHOLE published history never refreshed — same
+    critical severity as the actor-side view of the identical condition."""
+    frozen = [
+        _learner_window(s * 16, lag_max=10, per_actor={"0": 10, "1": 0}) for s in range(1, 3)
+    ]
+    # _learner_window publishes weight_version=10: lag 10 == the full history
+    (f,) = _by(run_detectors(frozen), "weight_staleness")
+    assert f["severity"] == "critical"
+    assert f["metrics"]["never_refreshed"] is True and f["metrics"]["actors"] == ["0"]

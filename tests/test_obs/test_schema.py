@@ -1,0 +1,111 @@
+"""Event-schema validator (sheeprl_tpu/obs/schema.py): the recorded fixtures
+and every event family validate, and producer/consumer drift — an undeclared
+field on a core event, an unknown event type, a stream stamped by a newer
+producer — fails LOUDLY instead of silently parsing with defaults."""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import pytest
+
+from sheeprl_tpu.obs.schema import (
+    SCHEMA_VERSION,
+    validate_event,
+    validate_events,
+    validate_stream,
+)
+
+pytestmark = pytest.mark.telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_all_recorded_fixtures_validate():
+    """tests/data/recorded_run* — old identity-less events, 2 attempts, the
+    learner stream, the compile-storm run — all conform."""
+    streams = sorted(glob.glob(os.path.join(_REPO, "tests", "data", "recorded_run*", "telemetry*.jsonl")))
+    assert len(streams) >= 3
+    for path in streams:
+        assert validate_stream(path) == [], path
+
+
+def test_minimal_modern_events_validate():
+    events = [
+        {"event": "start", "time": 1.0, "rank": 0, "attempt": 0, "seq": 0, "schema": SCHEMA_VERSION},
+        {"event": "window", "time": 2.0, "rank": 0, "attempt": 0, "seq": 1, "step": 10, "window": 0, "wall_seconds": 1.0, "sps": 10.0, "dataflow": {"role": "actor"}},
+        {"event": "health", "time": 2.1, "step": 10, "status": "ok"},
+        {"event": "service", "time": 2.2, "role": "learner", "rows": 4},
+        {"event": "profiler", "time": 2.3, "action": "start", "dir": "/tmp/p"},
+        {"event": "summary", "time": 3.0, "clean_exit": True, "windows": 1},
+    ]
+    assert validate_events(events) == []
+
+
+def test_undeclared_field_on_core_event_fails_loudly():
+    window = {"event": "window", "time": 2.0, "step": 1, "window": 0, "wall_seconds": 1.0, "spsx": 1.0}
+    (err,) = validate_event(window)
+    assert "spsx" in err and "obs/schema.py" in err
+    # open families tolerate extras (fault payloads are extensible by design)
+    assert validate_event({"event": "restart", "time": 1.0, "whatever": 1}) == []
+
+
+def test_required_fields_and_types_are_enforced():
+    assert validate_event({"event": "window", "time": 1.0, "window": 0, "wall_seconds": 1.0})  # no step
+    (err,) = validate_event(
+        {"event": "window", "time": 1.0, "step": 1, "window": 0, "wall_seconds": "fast"}
+    )
+    assert "wall_seconds" in err
+    (err,) = validate_event({"event": "summary", "time": 1.0, "clean_exit": "yes"})
+    assert "clean_exit" in err
+    # bool is NOT an int where ints are declared
+    (err,) = validate_event(
+        {"event": "window", "time": 1.0, "step": True, "window": 0, "wall_seconds": 1.0}
+    )
+    assert "step" in err
+
+
+def test_unknown_event_type_and_newer_schema_fail():
+    (err,) = validate_event({"event": "wibble", "time": 1.0})
+    assert "unknown event type" in err
+    (err,) = validate_event({"event": "start", "time": 1.0, "schema": SCHEMA_VERSION + 1})
+    assert "newer" in err
+
+
+def test_identity_fields_stay_optional_for_old_recordings():
+    # the PR 2-era shape: no rank/attempt/seq/schema anywhere
+    assert validate_event({"event": "start", "time": 1.0, "platform": "cpu"}) == []
+
+
+def test_resilience_lifecycle_events_validate():
+    """The fault/preemption stream shape the resilience drives write."""
+    events = [
+        {"event": "fault", "time": 1.0, "step": 50, "kind": "sigterm", "rank": 0},
+        {"event": "preempt", "time": 2.0, "step": 60, "signal": 15},
+        {"event": "checkpoint", "time": 3.0, "step": 60, "reason": "preempt"},
+        {"event": "preempt_exit", "time": 4.0, "step": 60, "exit_code": 75},
+        {"event": "restart", "time": 5.0, "reason": "preempt", "attempt": 1},
+        {"event": "resume", "time": 6.0, "attempt": 1},
+        {"event": "supervisor", "time": 7.0, "status": "completed"},
+    ]
+    assert validate_events(events) == []
+
+
+def test_every_emitted_event_type_is_registered():
+    """Census gate: any `emit*("<type>", ...)` call site in the package must
+    name a registered event type — a new producer cannot ship an event the
+    validator would reject (or, worse, that consumers silently ignore)."""
+    import glob
+    import re
+
+    from sheeprl_tpu.obs import schema
+
+    registered = set(schema._STRICT_EVENTS) | set(schema._OPEN_EVENTS)
+    pattern = re.compile(r'(?:\bemit|\bemit_event|\b_emit)\(\s*\n?\s*"([a-z_]+)"')
+    emitted = set()
+    for path in glob.glob(os.path.join(_REPO, "sheeprl_tpu", "**", "*.py"), recursive=True):
+        emitted.update(pattern.findall(open(path).read()))
+    assert emitted, "the census regex matched nothing — producers moved?"
+    unregistered = sorted(emitted - registered)
+    assert unregistered == [], f"emitted but not in obs/schema.py: {unregistered}"

@@ -67,6 +67,11 @@ def _read_telemetry(root_dir: str, run_name: str):
 
 
 def _assert_stream_shape(events, expect_train: bool):
+    # the versioned event schema (obs/schema.py): a live producer emitting a
+    # field the schema does not declare fails HERE, not in a silent consumer
+    from sheeprl_tpu.obs.schema import validate_events
+
+    assert validate_events(events) == []
     kinds = {e["event"] for e in events}
     assert {"start", "window", "health", "summary"} <= kinds
     # stream identity: every event carries rank/attempt and a monotonic seq

@@ -1,0 +1,253 @@
+"""Trace exporter round-trip (sheeprl_tpu/obs/trace.py): Perfetto-loadable
+Chrome-trace JSON from recorded fixtures (old identity-less + new schema
+events, 2 attempts, learner stream) and from a synthetic service-gang dir,
+asserting cross-track flow-event pairing (ingest→sample, publish→refresh)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.obs.trace import build_trace, main as trace_main, trace_run
+
+pytestmark = pytest.mark.telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_RECORDED = os.path.join(_REPO, "tests", "data", "recorded_run")
+
+_KNOWN_PH = {"X", "M", "C", "i", "s", "f"}
+
+
+def _assert_perfetto_loadable(trace: dict) -> None:
+    """The structural contract Perfetto/chrome://tracing require: a traceEvents
+    list of known-phase events with numeric non-negative timestamps, complete
+    events with durations, and flow endpoints that pair up by (cat, id)."""
+    assert isinstance(trace, dict) and isinstance(trace["traceEvents"], list)
+    assert trace["traceEvents"], "an empty trace renders nothing"
+    starts, finishes = {}, {}
+    for e in trace["traceEvents"]:
+        assert e["ph"] in _KNOWN_PH, e
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert isinstance(e["name"], str) and e["name"]
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], int) and e["ts"] >= 0, e
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], int) and e["dur"] >= 1, e
+        if e["ph"] == "s":
+            starts[(e["cat"], e["id"])] = e
+        if e["ph"] == "f":
+            assert e.get("bp") == "e", "finish must bind to its enclosing slice"
+            finishes[(e["cat"], e["id"])] = e
+    assert set(starts) == set(finishes), "every flow start needs exactly one finish"
+    # the JSON itself must round-trip (numpy leaks etc. would die here)
+    json.loads(json.dumps(trace))
+
+
+def test_trace_recorded_run_round_trip(tmp_path):
+    """The PR 4 fixture: old identity-less events, 2 attempts, a learner
+    stream — every stream gets its own thread track, windows become phase
+    slices, and the output is Perfetto-loadable."""
+    out = trace_run(_RECORDED, out_path=str(tmp_path / "trace.json"))
+    with open(out) as fh:
+        trace = json.load(fh)
+    _assert_perfetto_loadable(trace)
+    threads = {
+        e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert threads == {"rank0", "learner"}
+    slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    # the first fixture window has no phases dict: one opaque "window" slice;
+    # later windows carry attribution and become named phase slices
+    assert {"window", "env", "train", "replay_wait"} <= {e["name"] for e in slices}
+    # phase slices tile their window: widths sum to ~wall_seconds
+    env_plus = sum(e["dur"] for e in slices if e["name"] != "window")
+    assert env_plus > 0
+
+
+def _write_stream(path, events):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+
+
+def _service_run_dir(tmp_path) -> str:
+    """A synthetic 2-actor + learner service run: actor windows carry dataflow
+    weight lag + cumulative rows, learner windows carry drained rows_per_actor
+    and published versions — the shapes sac/dv3 `_service_*` roles emit."""
+    base = str(tmp_path / "svc-run")
+    t0 = 1_700_000_000.0
+
+    def actor_events(rank, stream_rows, version_at):
+        events = [
+            {"event": "start", "time": t0, "rank": rank, "attempt": 0, "seq": 0, "every": 16}
+        ]
+        for i, rows in enumerate(stream_rows):
+            events.append(
+                {
+                    "event": "window",
+                    "time": t0 + 10.0 * (i + 1),
+                    "rank": rank,
+                    "attempt": 0,
+                    "seq": i + 1,
+                    "step": rows,
+                    "window": i,
+                    "final": False,
+                    "wall_seconds": 10.0,
+                    "sps": rows / (10.0 * (i + 1)),
+                    "phases": {"env": 8.0, "train": 0.0, "logging": 0.5, "other": 1.5},
+                    "dataflow": {
+                        "role": "actor",
+                        "weight_version": version_at(i),
+                        "weight_latest": version_at(i) + 1,
+                        "weight_lag": 1,
+                        "rows": rows,
+                        "messages": rows // 4,
+                        "inflight": 0,
+                        "flow_block_seconds": 0.0,
+                    },
+                }
+            )
+        return events
+
+    def learner_events():
+        events = [
+            {"event": "start", "time": t0 + 0.5, "rank": 2, "attempt": 0, "seq": 0, "every": 16}
+        ]
+        for i in range(3):
+            drained = {"0": 16 * (i + 1), "1": 16 * (i + 1)}
+            events.append(
+                {
+                    "event": "window",
+                    "time": t0 + 10.0 * (i + 1) + 2.0,
+                    "rank": 2,
+                    "attempt": 0,
+                    "seq": i + 1,
+                    "step": sum(drained.values()),
+                    "window": i,
+                    "final": False,
+                    "wall_seconds": 10.0,
+                    "sps": 3.2,
+                    "phases": {"train": 6.0, "replay_wait": 1.0, "other": 3.0},
+                    "dataflow": {
+                        "role": "learner",
+                        "weight_version": i + 1,
+                        "weight_lag": {"per_actor": {"0": 0, "1": 1}, "max": 1, "mean": 0.5},
+                        "row_age": {
+                            "seconds": {"p50": 1.0, "p99": 4.0, "mean": 1.5, "max": 5.0},
+                            "rounds": {"p50": 2.0, "p99": 6.0, "mean": 2.5, "max": 8.0},
+                            "add_rounds": 8 * (i + 1),
+                        },
+                        "ingest_latency_ms": {"p50": 4.0, "p99": 15.0, "mean": 5.0, "max": 20.0},
+                        "queue_depth": 0.2,
+                        "queue_depth_max": 1,
+                        "rows": sum(drained.values()),
+                        "rows_per_actor": drained,
+                        "rows_per_sec": 3.2,
+                    },
+                }
+            )
+        return events
+
+    # actor windows land BEFORE the learner window that drains their rows;
+    # actor 0 refreshes to version 1 at its second window (published at t+12)
+    _write_stream(
+        os.path.join(base, "telemetry.jsonl"),
+        actor_events(0, [16, 32, 48], lambda i: 0 if i == 0 else 1),
+    )
+    _write_stream(
+        os.path.join(base, "telemetry.actor1.jsonl"),
+        actor_events(1, [16, 32, 48], lambda i: 0),
+    )
+    _write_stream(os.path.join(base, "telemetry.learner.jsonl"), learner_events())
+    return base
+
+
+def test_trace_service_run_emits_cross_track_flows(tmp_path):
+    """The acceptance shape: flow events connect an actor's ingest span to the
+    learner's sample span ACROSS process tracks, and a published weight version
+    to the actor window that started acting with it."""
+    base = _service_run_dir(tmp_path)
+    trace = build_trace(base)
+    _assert_perfetto_loadable(trace)
+
+    tids = {
+        (e["pid"], e["tid"]): e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert set(tids.values()) == {"rank0", "actor1", "learner"}
+
+    experience = [e for e in trace["traceEvents"] if e.get("cat") == "experience"]
+    starts = [e for e in experience if e["ph"] == "s"]
+    finishes = {(e["cat"], e["id"]): e for e in experience if e["ph"] == "f"}
+    assert starts, "a service run must emit ingest→sample flows"
+    for s in starts:
+        f = finishes[(s["cat"], s["id"])]
+        # start anchors on an actor track, finish on the learner track
+        assert tids[(s["pid"], s["tid"])] in ("rank0", "actor1")
+        assert tids[(f["pid"], f["tid"])] == "learner"
+        assert f["ts"] >= s["ts"], "rows cannot be sampled before they were ingested"
+    # BOTH actors' tracks feed the learner
+    assert {tids[(s["pid"], s["tid"])] for s in starts} == {"rank0", "actor1"}
+
+    # every flow endpoint anchors inside a thin marker slice on its track
+    slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    ingest_tracks = {(e["pid"], e["tid"]) for e in slices if e["name"] == "ingest"}
+    sample_tracks = {(e["pid"], e["tid"]) for e in slices if e["name"] == "sample"}
+    assert {(s["pid"], s["tid"]) for s in starts} <= ingest_tracks
+    assert {(f["pid"], f["tid"]) for f in finishes.values()} <= sample_tracks
+
+    weights = [e for e in trace["traceEvents"] if e.get("cat") == "weights"]
+    w_starts = [e for e in weights if e["ph"] == "s"]
+    assert w_starts, "the refresh at actor window 2 must pair with version 1's publish"
+    for s in w_starts:
+        assert tids[(s["pid"], s["tid"])] == "learner"  # publish side
+
+
+def test_trace_service_run_counts_and_cli(tmp_path):
+    base = _service_run_dir(tmp_path)
+    rc = trace_main([base, "--quiet"])
+    assert rc == 0
+    out = os.path.join(base, "trace.json")
+    with open(out) as fh:
+        _assert_perfetto_loadable(json.load(fh))
+    # no stream -> exit 2, like diagnose/compare
+    assert trace_main([str(tmp_path / "nowhere"), "--quiet"]) == 2
+
+
+def test_trace_serve_stream_gets_session_counter_tracks(tmp_path):
+    base = str(tmp_path / "serve-run")
+    t0 = 1_700_000_100.0
+    events = [{"event": "start", "time": t0, "serve": {"slots": 2}, "every": 4}]
+    for i in range(3):
+        events.append(
+            {
+                "event": "window",
+                "time": t0 + 5.0 * (i + 1),
+                "step": 4 * (i + 1),
+                "window": i,
+                "final": False,
+                "wall_seconds": 5.0,
+                "sps": 0.8,
+                "phases": {"serve_step": 1.0, "serve_wait": 3.5, "other": 0.5},
+                "serve": {
+                    "latency_ms": {"p50": 1.0, "p99": 3.0, "mean": 1.2, "max": 4.0},
+                    "occupancy": 0.75,
+                    "sessions": {"active": 2, "started": 1, "finished": 0, "per_sec": 0.1},
+                    "queue_depth": 1.0,
+                    "ticks": 4,
+                },
+            }
+        )
+    _write_stream(os.path.join(base, "telemetry.jsonl"), events)
+    trace = build_trace(base)
+    _assert_perfetto_loadable(trace)
+    slice_names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert {"serve_step", "serve_wait"} <= slice_names  # the batch-tick track
+    counters = {e["name"] for e in trace["traceEvents"] if e["ph"] == "C"}
+    assert {"sessions", "occupancy"} <= counters  # the session tracks

@@ -225,3 +225,121 @@ def test_watch_follows_live_sac_run(tmp_path):
     text = out.getvalue()
     assert "run finished" in text and "clean exit" in text
     assert "step" in text and "sps" in text  # it rendered live windows
+
+
+def test_dataflow_block_renders_from_any_stream():
+    """Service-backend runs: the dataflow line shows worst actor weight lag +
+    the learner's row age / ingest state, even though the learner stream is
+    not primary."""
+    state = WatchState()
+    state.consume([_event("start", 1.0)])
+    assert "dataflow:" not in state.render("run", 1.0, ["telemetry.jsonl"])
+    # actor 0 (primary) lags 1; actor 1 lags 4 -> the WORST lag renders
+    state.consume(
+        [
+            _window(
+                100,
+                dataflow={"role": "actor", "weight_version": 5, "weight_latest": 6, "weight_lag": 1, "rows": 100},
+            ),
+            {
+                **_window(
+                    96,
+                    dataflow={"role": "actor", "weight_version": 2, "weight_latest": 6, "weight_lag": 4, "rows": 96},
+                ),
+                "rank": 1,
+                "stream": "telemetry.actor1.jsonl",
+            },
+            {
+                **_window(
+                    196,
+                    dataflow={
+                        "role": "learner",
+                        "weight_version": 6,
+                        "weight_lag": {"per_actor": {"0": 1, "1": 4}, "max": 4, "mean": 2.5},
+                        "row_age": {"seconds": {"p50": 2.5, "p99": 9.0, "mean": 3.0, "max": 12.0}},
+                        "ingest_latency_ms": {"p50": 4.0, "p99": 18.0, "mean": 5.0, "max": 25.0},
+                        "queue_depth": 0.7,
+                    },
+                ),
+                "rank": 2,
+                "stream": "telemetry.learner.jsonl",
+            },
+        ]
+    )
+    assert state.weight_lag == 4
+    frame = state.render("run", 12.0, ["telemetry.jsonl"])
+    assert "dataflow: weight lag 4" in frame
+    # the board tracks each stream's LATEST block: when the lagging actor
+    # recovers, the render stops reporting its old worst-ever spike
+    state.consume(
+        [
+            {
+                **_window(
+                    128,
+                    dataflow={"role": "actor", "weight_version": 6, "weight_latest": 6, "weight_lag": 0, "rows": 128},
+                ),
+                "rank": 1,
+                "stream": "telemetry.actor1.jsonl",
+            },
+        ]
+    )
+    # the learner's latest view still claims lag 4 (its cadence lags), so the
+    # merged readout keeps the worst CURRENT claim across both roles...
+    assert state.weight_lag == 4
+    # ...until the learner reports too — then the spike is gone for good
+    state.consume(
+        [
+            {
+                **_window(
+                    224,
+                    dataflow={
+                        "role": "learner",
+                        "weight_version": 6,
+                        "weight_lag": {"per_actor": {"0": 1, "1": 0}, "max": 1, "mean": 0.5},
+                        "row_age": {"seconds": {"p50": 2.5, "p99": 9.0, "mean": 3.0, "max": 12.0}},
+                        "ingest_latency_ms": {"p50": 4.0, "p99": 18.0, "mean": 5.0, "max": 25.0},
+                        "queue_depth": 0.7,
+                    },
+                ),
+                "rank": 2,
+                "stream": "telemetry.learner.jsonl",
+            },
+        ]
+    )
+    recovered = state.render("run", 14.0, ["telemetry.jsonl"])
+    assert "weight lag 1" in recovered  # worst-ever spikes are never sticky
+    assert "row age p50 2.5s p99 9.0s" in frame
+    assert "ingest p99 18ms" in frame and "queue 0.7" in frame
+    # the PRIMARY status line still follows the primary stream's window
+    assert "step 100" in frame
+
+
+def test_fleet_watch_shows_per_member_staleness():
+    from sheeprl_tpu.obs.watch import FleetWatchState
+
+    fleet = FleetWatchState(["a", "b"])
+    window = _window(
+        64,
+        dataflow={"role": "actor", "weight_version": 1, "weight_latest": 5, "weight_lag": 4, "rows": 64},
+    )
+    learner_window = {
+        **_window(
+            64,
+            dataflow={
+                "role": "learner",
+                "weight_version": 5,
+                "weight_lag": {"per_actor": {"0": 4}, "max": 4, "mean": 4.0},
+                "row_age": {"seconds": {"p50": 3.0, "p99": 8.0, "mean": 3.5, "max": 9.0}},
+            },
+        ),
+        "rank": 1,
+        "stream": "telemetry.learner.jsonl",
+    }
+    for e in (window, learner_window):
+        fleet.consume([{**e, "stream": "members/a/" + str(e["stream"])}])
+    fleet.consume([{**_window(64), "stream": "members/b/telemetry.jsonl"}])
+    frame = fleet.render("fleet", 5.0, [])
+    a_line = next(l for l in frame.splitlines() if l.strip().startswith("[a]"))
+    b_line = next(l for l in frame.splitlines() if l.strip().startswith("[b]"))
+    assert "lag 4" in a_line and "row age 3.0s" in a_line
+    assert "lag" not in b_line  # plain members contribute no staleness bits

@@ -8,7 +8,14 @@ Asserts the tentpole's acceptance semantics end-to-end:
 - the learner trains from the service buffer (gradient steps > 0), publishes
   weight versions, and owns a manifest-valid checkpoint;
 - every role exits 0 and ``diagnose --fail-on critical`` is green over the
-  merged multi-stream dir.
+  merged multi-stream dir;
+- the dataflow lineage (ISSUE 12) is live end-to-end: actor AND learner
+  telemetry windows carry non-null weight-lag / row-age gauges,
+  ``sheeprl.py trace`` emits a Perfetto-loadable JSON whose flow events
+  connect an actor's ingest span to the learner's sample span across process
+  tracks, and an injected stale-weight condition (an actor that never
+  refreshes, ``buffer.service.poll_weights=false``) trips the
+  ``weight_staleness`` detector under ``diagnose --fail-on warning``.
 
 Marked ``fleet`` + ``resilience`` + ``slow``: a multi-process gang is too heavy
 for the bounded tier-1 sweep — ``python sheeprl.py fault-matrix`` (which runs
@@ -54,6 +61,11 @@ _BASE = [
     "metric.telemetry.every=16",
     "buffer.backend=service",
     "buffer.service.actors=2",
+    # generous flow-control credit: on a 1-core box the 3 co-scheduled
+    # processes contend and actors WOULD block on the default watermark, which
+    # the ingest_backpressure detector now (correctly) flags — this smoke pins
+    # the clean path, the backpressure path has its own detector unit tests
+    "buffer.service.max_inflight=64",
     "resilience.distributed.gang.processes=3",
     "resilience.distributed.gang.grace=60",
     "resilience.distributed.heartbeat.interval=0.2",
@@ -131,3 +143,113 @@ def test_service_two_actors_one_learner_completes_with_provenance():
     # the diagnosis engine over the merged 3-stream dir: nothing critical
     findings = run_detectors(list(merged_events(base)))
     assert all(f["severity"] != "critical" for f in findings), findings
+
+    # live-smoke schema gate: every stream the gang wrote conforms
+    from sheeprl_tpu.obs.schema import validate_stream
+
+    for name in streams:
+        assert validate_stream(os.path.join(base, name)) == [], name
+
+    # dataflow lineage gauges (ISSUE 12): non-null weight lag on BOTH actor
+    # streams' windows and non-null weight lag + row age on the learner's
+    for actor_stream in ("telemetry.jsonl", "telemetry.actor1.jsonl"):
+        events = [json.loads(line) for line in open(os.path.join(base, actor_stream))]
+        windows = [e for e in events if e.get("event") == "window"]
+        blocks = [w["dataflow"] for w in windows if isinstance(w.get("dataflow"), dict)]
+        assert blocks, f"{actor_stream}: no dataflow block on any window"
+        assert all(b["role"] == "actor" and b["weight_lag"] is not None for b in blocks)
+        # actors refreshed: acting weight version advanced past init
+        assert any(b["weight_version"] > 0 for b in blocks), blocks
+    learner_windows = [e for e in learner if e.get("event") == "window"]
+    learner_blocks = [
+        w["dataflow"] for w in learner_windows if isinstance(w.get("dataflow"), dict)
+    ]
+    assert learner_blocks, "learner windows carry no dataflow block"
+    aged = [b for b in learner_blocks if b.get("row_age")]
+    assert aged, "learner never reported a sampled-row age distribution"
+    assert all(b["row_age"]["seconds"]["p50"] is not None for b in aged)
+    assert all(b["row_age"]["rounds"]["p99"] is not None for b in aged)
+    lagged = [b for b in learner_blocks if b.get("weight_lag")]
+    assert lagged, "learner never reported per-actor weight lag"
+    assert set(lagged[-1]["weight_lag"]["per_actor"]) == {"0", "1"}
+    assert all(b["ingest_latency_ms"]["p99"] is not None for b in aged)
+
+    # the trace acceptance: Perfetto-loadable JSON whose flow events connect an
+    # actor's ingest span to the learner's sample span ACROSS process tracks
+    from sheeprl_tpu.obs.trace import trace_run
+
+    trace_path = trace_run(base)
+    with open(trace_path) as fh:
+        trace = json.load(fh)
+    tids = {
+        (e["pid"], e["tid"]): e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert {"rank0", "actor1", "learner"} <= set(tids.values())
+    flows = [e for e in trace["traceEvents"] if e.get("cat") == "experience"]
+    starts = [e for e in flows if e["ph"] == "s"]
+    finishes = {e["id"]: e for e in flows if e["ph"] == "f"}
+    assert starts, "no ingest→sample flow events in the trace"
+    for s in starts:
+        f = finishes[s["id"]]
+        assert tids[(s["pid"], s["tid"])] in ("rank0", "actor1")
+        assert tids[(f["pid"], f["tid"])] == "learner"
+        assert f["ts"] >= s["ts"]
+    # ingestion from BOTH actor tracks reached the learner track
+    assert {tids[(s["pid"], s["tid"])] for s in starts} == {"rank0", "actor1"}
+
+
+@pytest.mark.timeout(480)
+def test_stale_weight_injection_trips_weight_staleness_detector():
+    """buffer.service.poll_weights=false freezes the actors on their init
+    weights while the learner keeps publishing: the injected stale-weight
+    condition must trip the weight_staleness detector under
+    ``diagnose --fail-on warning`` (the ISSUE 12 acceptance gate)."""
+    from sheeprl_tpu.obs.diagnose import main as diagnose_main
+
+    total = 96
+    result = _run_gang(
+        _BASE
+        + [
+            f"algo.total_steps={total}",
+            "checkpoint.every=0",
+            "checkpoint.save_last=False",
+            "buffer.service.poll_weights=false",
+            "buffer.service.publish_every=1",
+            "run_name=svc-stale",
+        ]
+    )
+    out = result.stdout.decode(errors="replace")
+    assert result.returncode == 0, f"stale-weight gang failed ({result.returncode}):\n{out[-4000:]}"
+    base = os.path.join(os.getcwd(), "logs", "runs", "tsvc", "svc-stale")
+
+    # the actors never refreshed: every actor window holds version 0
+    actor = [json.loads(line) for line in open(os.path.join(base, "telemetry.jsonl"))]
+    blocks = [
+        w["dataflow"]
+        for w in actor
+        if w.get("event") == "window" and isinstance(w.get("dataflow"), dict)
+    ]
+    assert blocks and all(b["weight_version"] == 0 for b in blocks)
+
+    # the detector trips from whichever side saw the staleness first — the
+    # actor's own windows (version 0 while the plane advanced) or the
+    # learner's ingest lineage (per-actor lag spanning the whole published
+    # history); scheduling on a 1-core box decides which, both are correct
+    findings = run_detectors(list(merged_events(base)))
+    staleness = [f for f in findings if f["detector"] == "weight_staleness"]
+    assert staleness, findings
+    assert any(
+        f["metrics"].get("never_refreshed") or f["metrics"].get("actors")
+        for f in staleness
+    ), staleness
+
+    # the CLI gate the acceptance names: diagnose --fail-on warning exits 1
+    assert diagnose_main([base, "--quiet", "--fail-on", "warning"]) == 1
+    # ... and the healthy severity floor still passes --fail-on critical only
+    # if nothing ELSE went critical (the stale actors are warnings or critical
+    # by design — never silently green)
+    with open(os.path.join(base, "diagnosis.json")) as fh:
+        diagnosis = json.load(fh)
+    assert any(f["detector"] == "weight_staleness" for f in diagnosis["findings"])

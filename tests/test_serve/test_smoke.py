@@ -72,48 +72,74 @@ _DV3_TRAIN = [
 
 
 def _serve_with_live_watch(run_dir: str, serve_dir: str, sessions: int) -> int:
-    """Start `watch` on the (not yet existing) serving telemetry dir, run the
-    serve verb to completion, and return the watch's exit code."""
+    """Run the serve verb with `watch` following it live.
+
+    The serve verb runs in a background thread while the MAIN thread first
+    waits for the serving telemetry stream to EXIST (the explicit readiness
+    signal: the server writes its `start` event before serving a request) and
+    only then starts the bounded watch. Starting watch's timeout clock before
+    readiness was a timing assumption — under full-suite load on a 1-core box
+    the dv3 checkpoint load + RSSM step compile alone could eat the budget and
+    the watch timed out (exit 2) on a perfectly healthy serve. Watch reads the
+    stream from offset 0, so attaching after readiness misses nothing."""
+    import time
+
     from sheeprl_tpu.obs.watch import watch_run
 
     import io
 
-    watch_out = io.StringIO()
-    watch_rc: dict = {}
+    serve_rc: dict = {}
 
-    def _watch():
-        watch_rc["rc"] = watch_run(
-            serve_dir, interval=0.2, grace=0.4, timeout=120, plain=True, out=watch_out
+    def _serve():
+        serve_rc["rc"] = serve(
+            [
+                f"checkpoint_path={run_dir}",
+                f"serve.sessions={sessions}",
+                "serve.slots=2",
+                "serve.max_session_steps=20",
+                "serve.telemetry.every=4",
+                f"serve.log_dir={serve_dir}",
+            ]
         )
 
-    watcher = threading.Thread(target=_watch, daemon=True)
-    watcher.start()
-    rc = serve(
-        [
-            f"checkpoint_path={run_dir}",
-            f"serve.sessions={sessions}",
-            "serve.slots=2",
-            "serve.max_session_steps=20",
-            "serve.telemetry.every=4",
-            f"serve.log_dir={serve_dir}",
-        ]
+    server = threading.Thread(target=_serve, daemon=True)
+    server.start()
+    # readiness wait: generous (load-tolerant) but bounded — a serve that never
+    # opens its stream is a real failure, not a slow box
+    deadline = time.monotonic() + 240
+    stream = f"{serve_dir}/telemetry.jsonl"
+    while not glob.glob(stream) and time.monotonic() < deadline:
+        assert server.is_alive() or serve_rc.get("rc") == 0, "serve died before its stream appeared"
+        time.sleep(0.1)
+    assert glob.glob(stream), "serving telemetry stream never appeared (readiness wait)"
+
+    watch_out = io.StringIO()
+    watch_rc = watch_run(
+        serve_dir, interval=0.2, grace=0.4, timeout=180, plain=True, out=watch_out
     )
-    assert rc == 0, "serve verb reported a failed session"
-    watcher.join(timeout=120)
-    assert watch_rc.get("rc") == 0, f"watch did not follow the serving run: {watch_out.getvalue()}"
+    server.join(timeout=180)
+    assert not server.is_alive(), "serve verb did not finish"
+    assert serve_rc.get("rc") == 0, "serve verb reported a failed session"
+    assert watch_rc == 0, f"watch did not follow the serving run: {watch_out.getvalue()}"
     assert "serve:" in watch_out.getvalue()
-    return rc
+    return serve_rc["rc"]
 
 
 def _assert_serving_telemetry(serve_dir: str, min_sessions: int) -> None:
+    from sheeprl_tpu.obs.schema import validate_events
+
     (stream,) = glob.glob(f"{serve_dir}/telemetry.jsonl")
     events = [json.loads(line) for line in open(stream)]
+    # live-smoke schema gate: serving producers drift loudly too
+    assert validate_events(events) == []
     start = events[0]
     assert start["event"] == "start" and start["serve"]["slots"] == 2
     assert start["fingerprint"]["algo"] is not None
     summary = events[-1]
     assert summary["event"] == "summary" and summary["clean_exit"] is True
-    assert summary["serve"]["sessions_finished"] >= min_sessions - 1  # final delta may race close
+    # exact, not tick-sampled: server.close() folds post-final-tick session
+    # finishes into the summary (every fixed-length session can end at once)
+    assert summary["serve"]["sessions_finished"] >= min_sessions
     assert summary["total_steps"] > 0
     rc = diagnose([serve_dir, "--quiet", "--fail-on", "critical"])
     assert rc == 0
