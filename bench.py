@@ -1209,6 +1209,15 @@ def main() -> int:
     # Default: PPO headline, flushed IMMEDIATELY, then the Dreamer-V3 north star as a
     # budgeted extra; the final combined line repeats the headline plus the extra.
     result = _bench_subprocess("ppo", timeout=600)
+    # code-health fingerprint: the static graftlint pass (findings/waived/rules,
+    # howto/static_analysis.md) rides the combined JSON so BENCH_r*.json records
+    # which rule catalog the measured code passed — cheap (no AOT sweep here)
+    try:
+        from sheeprl_tpu.analysis.engine import lint_summary, run_lint
+
+        result.setdefault("conditions", {})["lint"] = lint_summary(run_lint())
+    except Exception as exc:  # noqa: BLE001 — lint must never block a bench
+        result.setdefault("conditions", {})["lint"] = {"error": repr(exc)[:300]}
     print(json.dumps(result), flush=True)
     # probe once HERE so the cached result rides SHEEPRL_BENCH_PROBE into every
     # workload subprocess — on a wedged tunnel each probe burns up to 90 s

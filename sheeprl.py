@@ -22,11 +22,39 @@ Also hosts the offline/observability tooling (howto/observability.md):
   table (``howto/serving.md``);
 - ``python sheeprl.py fleet <spec.yaml>`` — schedule a fleet of member runs
   (seed/env sweeps) with per-member restart supervision, a shared persistent
-  XLA compile cache, and leaderboard/compare rollups (``howto/fleet.md``).
+  XLA compile cache, and leaderboard/compare rollups (``howto/fleet.md``);
+- ``python sheeprl.py lint [--aot]`` — the JAX-aware static-analysis +
+  AOT program-contract gate (``howto/static_analysis.md``).
 """
 
 import os
 import sys
+
+
+def _lint_pin() -> None:
+    """``lint`` is an offline gate: pin the CPU platform (it must never claim —
+    or hang on — a wedged accelerator tunnel) and force the 8-device virtual
+    host mesh BEFORE jax initializes, so the ``--aot`` sweep can lower the
+    data-parallel mesh programs. Must run before the sheeprl_tpu import below,
+    which executes jax computations."""
+    if len(sys.argv) > 1 and sys.argv[1] == "lint":
+        # FORCE the pins — not setdefault: a user's exported JAX_PLATFORMS=tpu
+        # would otherwise initialize (and possibly hang on) the accelerator the
+        # verb promises never to touch, and an exported
+        # --xla_force_host_platform_device_count=1 would silently skip the
+        # 8-device anakin contract while the gate reports green. Pre-existing
+        # unrelated XLA_FLAGS (e.g. --xla_dump_to) are preserved; any existing
+        # device-count flag is REPLACED with 8.
+        import re as _re
+
+        flags = _re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", os.environ.get("XLA_FLAGS", "")
+        ).strip()
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+_lint_pin()
 
 
 def _gang_parent_pin() -> None:
@@ -54,6 +82,7 @@ from sheeprl_tpu.cli import (  # noqa: E402
     diagnose,
     fault_matrix,
     fleet,
+    lint,
     run,
     serve,
     trace,
@@ -69,6 +98,7 @@ _SUBCOMMANDS = {
     "serve": serve,
     "fleet": fleet,
     "trace": trace,
+    "lint": lint,
 }
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ import optax
 
 from sheeprl_tpu.algos.dreamer_v1.agent import DV1Agent, PlayerDV1, build_agent
 from sheeprl_tpu.algos.dreamer_v1.loss import reconstruction_loss
+from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values, prepare_obs, test
 from sheeprl_tpu.algos.dreamer_v2.utils import (
     _HALF_LOG_2PI,
@@ -181,6 +182,33 @@ def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx, state_
     # the compiled unit, exposed for FLOPs/MFU accounting (utils/mfu.py, obs/)
     train_phase.train_step = train_step
     return train_phase
+
+
+@register_fused_program(
+    "dreamer_v1.train_step",
+    min_donated=2,
+    doc="fused single-gradient-step Dreamer-V1 world/actor/critic update",
+)
+def _aot_train_step():
+    """Tiny DV1 agent through the loop's own factory (optimizer construction is
+    identical across the dreamer family — shared via dv3's build_optimizers)."""
+    from sheeprl_tpu.algos.dreamer_v1.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import build_optimizers
+    from sheeprl_tpu.analysis.programs import (
+        tiny_dreamer_batch,
+        tiny_dreamer_cfg,
+        tiny_fabric,
+        tiny_obs_space,
+    )
+
+    cfg = tiny_dreamer_cfg("dreamer_v1")
+    fabric = tiny_fabric()
+    agent, params = build_agent(fabric, (4,), False, cfg, tiny_obs_space(), jax.random.PRNGKey(0))
+    world_tx, actor_tx, critic_tx, opt_state = build_optimizers(cfg, params)
+    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+    batch = tiny_dreamer_batch(cfg)
+    args = (params, opt_state, batch, np.asarray(jax.random.PRNGKey(1)))
+    return train_phase.train_step, args
 
 
 @register_algorithm()

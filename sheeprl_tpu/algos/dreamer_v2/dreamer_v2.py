@@ -28,6 +28,7 @@ from sheeprl_tpu.algos.dreamer_v2.agent import (
     build_agent,
 )
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
+from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.algos.dreamer_v2.utils import (
     bernoulli_logprob as _bernoulli_logprob,
     compute_lambda_values,
@@ -218,6 +219,32 @@ def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx, state_
     # the compiled unit, exposed for FLOPs/MFU accounting (utils/mfu.py, obs/)
     train_phase.train_step = train_step
     return train_phase
+
+
+@register_fused_program(
+    "dreamer_v2.train_step",
+    min_donated=2,
+    doc="fused single-gradient-step Dreamer-V2 world/actor/critic update",
+)
+def _aot_train_step():
+    """Tiny DV2 agent through the loop's own factory."""
+    from sheeprl_tpu.algos.dreamer_v2.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import build_optimizers
+    from sheeprl_tpu.analysis.programs import (
+        tiny_dreamer_batch,
+        tiny_dreamer_cfg,
+        tiny_fabric,
+        tiny_obs_space,
+    )
+
+    cfg = tiny_dreamer_cfg("dreamer_v2", extra=("algo.world_model.discrete_size=4",))
+    fabric = tiny_fabric()
+    agent, params = build_agent(fabric, (4,), False, cfg, tiny_obs_space(), jax.random.PRNGKey(0))
+    world_tx, actor_tx, critic_tx, opt_state = build_optimizers(cfg, params)
+    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+    batch = tiny_dreamer_batch(cfg)
+    args = (params, opt_state, batch, jnp.asarray(0), np.asarray(jax.random.PRNGKey(1)))
+    return train_phase.train_step, args
 
 
 @register_algorithm()

@@ -38,6 +38,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (
 )
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import init_moments, prepare_obs, test, update_moments
+from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
@@ -315,6 +316,32 @@ def build_optimizers(cfg, params):
         "critic": critic_tx.init(params["critic"]),
     }
     return world_tx, actor_tx, critic_tx, opt_state
+
+
+@register_fused_program(
+    "dreamer_v3.train_step",
+    min_donated=3,
+    doc="fused single-gradient-step Dreamer-V3 world/actor/critic update",
+)
+def _aot_train_step():
+    """Tiny DV3 agent through the loop's own factory (the __graft_entry__
+    dryrun recipe at AOT scale)."""
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.analysis.programs import (
+        tiny_dreamer_batch,
+        tiny_dreamer_cfg,
+        tiny_fabric,
+        tiny_obs_space,
+    )
+
+    cfg = tiny_dreamer_cfg("dreamer_v3", extra=("algo.world_model.discrete_size=4",))
+    fabric = tiny_fabric()
+    agent, params = build_agent(fabric, (4,), False, cfg, tiny_obs_space(), jax.random.PRNGKey(0))
+    world_tx, actor_tx, critic_tx, opt_state = build_optimizers(cfg, params)
+    train_phase = make_train_phase(agent, cfg, world_tx, actor_tx, critic_tx)
+    batch = tiny_dreamer_batch(cfg)
+    args = (params, opt_state, init_moments(), batch, jnp.asarray(0), np.asarray(jax.random.PRNGKey(1)))
+    return train_phase.train_step, args
 
 
 class _InlineTrainer:

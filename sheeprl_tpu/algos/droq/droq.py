@@ -35,7 +35,12 @@ import optax
 from sheeprl_tpu.algos.droq.agent import build_agent
 from sheeprl_tpu.algos.sac.agent import squash_and_logprob
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+
+# DroQ's optimizer/opt-state layout is SAC's (same actor/critic/alpha triple,
+# same config keys) — one construction, shared with the AOT registry
+from sheeprl_tpu.algos.sac.sac import build_optimizers, init_opt_state
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
@@ -48,6 +53,148 @@ from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import ActPlacement, Ratio, save_configs
+
+
+def make_train_phase(cfg, actor, critic, target_entropy, txs=None, jit_kwargs=None):
+    """Build the fused DroQ train program: G critic updates via ``lax.scan``
+    (EMA folded into each step), then a single actor + alpha update — the whole
+    reference train() (droq.py:30-137) as one device program. ONE factory
+    shared by the loop and the AOT contract registry. ``jit_kwargs`` carries the
+    multi-device ``out_shardings`` pin (see the donation note below)."""
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    num_critics = int(cfg.algo.critic.n)
+    action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
+    action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
+    txs = txs if txs is not None else build_optimizers(cfg)
+    actor_tx, critic_tx, alpha_tx = txs["actor"], txs["critic"], txs["alpha"]
+
+    def critic_loss_fn(critic_params, other, batch, step_key):
+        k_pi, k_tgt, k_online = jax.random.split(step_key, 3)
+        next_obs = batch["next_observations"]
+        mean, std = actor.apply({"params": other["actor"]}, next_obs)
+        next_actions, next_logprobs = squash_and_logprob(mean, std, k_pi, action_scale, action_bias)
+        # dropout stays on for the target ensemble too (reference modules are in
+        # train mode inside train(), droq.py:94-99)
+        target_q = critic.apply(
+            {"params": other["target_critic"]}, next_obs, next_actions, False, rngs={"dropout": k_tgt}
+        )
+        alpha = jnp.exp(other["log_alpha"])
+        min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
+        next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
+        qf_values = critic.apply(
+            {"params": critic_params}, batch["observations"], batch["actions"], False, rngs={"dropout": k_online}
+        )
+        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+
+    def actor_loss_fn(actor_params, other, batch, step_key):
+        k_pi, k_q = jax.random.split(step_key)
+        mean, std = actor.apply({"params": actor_params}, batch["observations"])
+        actions, logprobs = squash_and_logprob(mean, std, k_pi, action_scale, action_bias)
+        qf_values = critic.apply(
+            {"params": other["critic"]}, batch["observations"], actions, False, rngs={"dropout": k_q}
+        )
+        # DroQ uses the ensemble MEAN in the policy loss (reference droq.py:124)
+        mean_qf = jnp.mean(qf_values, axis=-1, keepdims=True)
+        alpha = jnp.exp(jax.lax.stop_gradient(other["log_alpha"]))
+        return policy_loss(alpha, logprobs, mean_qf), logprobs
+
+    def alpha_loss_fn(log_alpha, logprobs):
+        return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
+
+    # donate_argnums: XLA reuses the params/opt-state buffers in place instead of
+    # copying the whole train state every round (callers always rebind to the
+    # returned trees, so the invalidated inputs are never read again).
+    # out_shardings (via jit_kwargs) pins the state outputs on multi-device
+    # meshes — see the sac.py note (PR 8 residual; build_state_shardings).
+    @partial(jax.jit, donate_argnums=(0, 1), **(jit_kwargs or {}))
+    def train_phase(params, opt_state, critic_data, actor_data, train_key):
+        """G critic updates via lax.scan (EMA folded into each step), then a single
+        actor + alpha update — the whole reference train() (droq.py:30-137) as one
+        device program."""
+
+        def critic_step(carry, inp):
+            params, opt_state = carry
+            batch, k = inp
+            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k)
+            updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
+            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+            opt_state = {**opt_state, "critic": new_copt}
+            params = {
+                **params,
+                "target_critic": jax.tree_util.tree_map(
+                    lambda t, c: t * (1 - tau) + c * tau, params["target_critic"], params["critic"]
+                ),
+            }
+            return (params, opt_state), qf_loss
+
+        G = critic_data["rewards"].shape[0]
+        k_scan, k_actor = jax.random.split(train_key)
+        keys = jax.random.split(k_scan, G)
+        (params, opt_state), qf_losses = jax.lax.scan(critic_step, (params, opt_state), (critic_data, keys))
+
+        (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+            params["actor"], params, actor_data, k_actor
+        )
+        updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+        opt_state = {**opt_state, "actor": new_aopt}
+
+        al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
+        updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
+        params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
+        opt_state = {**opt_state, "alpha": new_alopt}
+
+        # log the per-member MSE (the reference logs each member's loss into a
+        # MeanMetric, droq.py:113-115), not the summed ensemble loss
+        return params, opt_state, jnp.stack([qf_losses.mean() / num_critics, a_loss, al_loss])
+
+    return train_phase
+
+
+@register_fused_program(
+    "droq.train_phase",
+    min_donated=2,
+    doc="fused DroQ update (scanned critic ensemble steps + actor/alpha)",
+)
+def _aot_train_program():
+    """Tiny MLP DroQ agent through the loop's own factory."""
+    from sheeprl_tpu.analysis.programs import tiny_fabric
+    from sheeprl_tpu.config import compose
+
+    cfg = compose(
+        [
+            "exp=droq",
+            "env=dummy",
+            "fabric.accelerator=cpu",
+            "env.num_envs=2",
+            "env.capture_video=False",
+            "algo.per_rank_batch_size=4",
+            "buffer.memmap=False",
+            "metric.log_level=0",
+        ]
+    )
+    fabric = tiny_fabric()
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (8,), np.float32)})
+    action_space = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+    actor, critic, params = build_agent(fabric, cfg, obs_space, action_space, jax.random.PRNGKey(0), None)
+    txs = build_optimizers(cfg)
+    opt_state = init_opt_state(txs, params)
+    train_phase = make_train_phase(cfg, actor, critic, target_entropy=-2.0, txs=txs)
+    G, B = 1, int(cfg.algo.per_rank_batch_size)
+    rng = np.random.default_rng(0)
+
+    def _batch(leading):
+        return {
+            "observations": rng.normal(size=(*leading, B, 8)).astype(np.float32),
+            "next_observations": rng.normal(size=(*leading, B, 8)).astype(np.float32),
+            "actions": rng.normal(size=(*leading, B, 2)).astype(np.float32),
+            "rewards": rng.normal(size=(*leading, B, 1)).astype(np.float32),
+            "terminated": np.zeros((*leading, B, 1), np.float32),
+        }
+
+    args = (params, opt_state, _batch((G,)), _batch(()), np.asarray(jax.random.PRNGKey(1)))
+    return train_phase, args
 
 
 @register_algorithm()
@@ -115,14 +262,8 @@ def main(fabric, cfg: Dict[str, Any]):
     action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
     action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
 
-    actor_tx = instantiate(cfg.algo.actor.optimizer)
-    critic_tx = instantiate(cfg.algo.critic.optimizer)
-    alpha_tx = instantiate(cfg.algo.alpha.optimizer)
-    opt_state = {
-        "actor": actor_tx.init(params["actor"]),
-        "critic": critic_tx.init(params["critic"]),
-        "alpha": alpha_tx.init(params["log_alpha"]),
-    }
+    txs = build_optimizers(cfg)
+    opt_state = init_opt_state(txs, params)
     if state is not None:
         opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
 
@@ -173,9 +314,6 @@ def main(fabric, cfg: Dict[str, Any]):
         )
 
     # ---------------- jitted programs ----------------
-    gamma = float(cfg.algo.gamma)
-    tau = float(cfg.algo.tau)
-    num_critics = int(cfg.algo.critic.n)
     sample_next_obs = bool(cfg.buffer.sample_next_obs)
 
     act = ActPlacement(fabric, lambda p: p["actor"])
@@ -190,92 +328,19 @@ def main(fabric, cfg: Dict[str, Any]):
         actions, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
         return actions, key
 
-    def critic_loss_fn(critic_params, other, batch, step_key):
-        k_pi, k_tgt, k_online = jax.random.split(step_key, 3)
-        next_obs = batch["next_observations"]
-        mean, std = actor.apply({"params": other["actor"]}, next_obs)
-        next_actions, next_logprobs = squash_and_logprob(mean, std, k_pi, action_scale, action_bias)
-        # dropout stays on for the target ensemble too (reference modules are in
-        # train mode inside train(), droq.py:94-99)
-        target_q = critic.apply(
-            {"params": other["target_critic"]}, next_obs, next_actions, False, rngs={"dropout": k_tgt}
-        )
-        alpha = jnp.exp(other["log_alpha"])
-        min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
-        next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
-        qf_values = critic.apply(
-            {"params": critic_params}, batch["observations"], batch["actions"], False, rngs={"dropout": k_online}
-        )
-        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
-
-    def actor_loss_fn(actor_params, other, batch, step_key):
-        k_pi, k_q = jax.random.split(step_key)
-        mean, std = actor.apply({"params": actor_params}, batch["observations"])
-        actions, logprobs = squash_and_logprob(mean, std, k_pi, action_scale, action_bias)
-        qf_values = critic.apply(
-            {"params": other["critic"]}, batch["observations"], actions, False, rngs={"dropout": k_q}
-        )
-        # DroQ uses the ensemble MEAN in the policy loss (reference droq.py:124)
-        mean_qf = jnp.mean(qf_values, axis=-1, keepdims=True)
-        alpha = jnp.exp(jax.lax.stop_gradient(other["log_alpha"]))
-        return policy_loss(alpha, logprobs, mean_qf), logprobs
-
-    def alpha_loss_fn(log_alpha, logprobs):
-        return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
-
-    # donate_argnums: XLA reuses the params/opt-state buffers in place instead of
-    # copying the whole train state every round (callers always rebind to the
-    # returned trees, so the invalidated inputs are never read again).
-    # out_shardings pins the state outputs on multi-device meshes — see the
-    # sac.py note (PR 8 residual; parallel/sharding.py build_state_shardings).
+    # the fused train program — ONE factory (make_train_phase) shared with the
+    # AOT contract registry, so the program `sheeprl.py lint --aot` lowers is
+    # the program this loop runs. out_shardings pins the state outputs on
+    # multi-device meshes — see make_train_phase's donation note.
     from sheeprl_tpu.parallel.sharding import build_state_shardings
 
     _state_shardings = build_state_shardings(fabric, params, opt_state)
     _train_jit_kwargs = (
         {"out_shardings": tuple(_state_shardings)} if _state_shardings is not None else {}
     )
-
-    @partial(jax.jit, donate_argnums=(0, 1), **_train_jit_kwargs)
-    def train_phase(params, opt_state, critic_data, actor_data, train_key):
-        """G critic updates via lax.scan (EMA folded into each step), then a single
-        actor + alpha update — the whole reference train() (droq.py:30-137) as one
-        device program."""
-
-        def critic_step(carry, inp):
-            params, opt_state = carry
-            batch, k = inp
-            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k)
-            updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
-            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
-            opt_state = {**opt_state, "critic": new_copt}
-            params = {
-                **params,
-                "target_critic": jax.tree_util.tree_map(
-                    lambda t, c: t * (1 - tau) + c * tau, params["target_critic"], params["critic"]
-                ),
-            }
-            return (params, opt_state), qf_loss
-
-        G = critic_data["rewards"].shape[0]
-        k_scan, k_actor = jax.random.split(train_key)
-        keys = jax.random.split(k_scan, G)
-        (params, opt_state), qf_losses = jax.lax.scan(critic_step, (params, opt_state), (critic_data, keys))
-
-        (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
-            params["actor"], params, actor_data, k_actor
-        )
-        updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-        params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
-        opt_state = {**opt_state, "actor": new_aopt}
-
-        al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
-        updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
-        params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
-        opt_state = {**opt_state, "alpha": new_alopt}
-
-        # log the per-member MSE (the reference logs each member's loss into a
-        # MeanMetric, droq.py:113-115), not the summed ensemble loss
-        return params, opt_state, jnp.stack([qf_losses.mean() / num_critics, a_loss, al_loss])
+    train_phase = make_train_phase(
+        cfg, actor, critic, target_entropy, txs=txs, jit_kwargs=_train_jit_kwargs
+    )
 
     if world_size > 1:
         params = fabric.replicate_pytree(params)

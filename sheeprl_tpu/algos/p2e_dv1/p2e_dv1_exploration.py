@@ -26,6 +26,7 @@ from sheeprl_tpu.algos.dreamer_v2.utils import (
     normal1_logprob as _normal1_logprob,
 )
 from sheeprl_tpu.algos.p2e_dv1.agent import build_agent, player_params
+from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.algos.p2e_dv1.utils import prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv3.agent import EnsembleHeads
 from sheeprl_tpu.config import instantiate
@@ -246,6 +247,64 @@ def make_train_phase(
     return train_phase
 
 
+def build_txs(cfg) -> Dict[str, Any]:
+    """The six P2E optimizer groups with per-group clipping — ONE construction
+    shared by the training loops (P2E-DV1 and DV2) and the AOT registry, so the
+    program the ``lint --aot`` gate lowers is built from the exact optimizer
+    chain the loop runs."""
+    from sheeprl_tpu.config import instantiate
+
+    def _tx(opt_cfg, clip):
+        base = instantiate(opt_cfg)
+        if clip is not None and clip > 0:
+            return optax.chain(optax.clip_by_global_norm(clip), base)
+        return base
+
+    return {
+        "world_model": _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
+        "actor_task": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+        "critic_task": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+        "actor_exploration": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+        "critic_exploration": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+        "ensembles": _tx(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
+    }
+
+
+@register_fused_program(
+    "p2e_dv1.train_step",
+    min_donated=2,
+    doc="fused single-gradient-step P2E-DV1 world/ensemble/task+exploration heads update",
+)
+def _aot_train_step():
+    """Tiny P2E-DV1 agent (incl. the disagreement ensembles) through the loop's
+    own factory."""
+    from sheeprl_tpu.analysis.programs import (
+        tiny_dreamer_batch,
+        tiny_dreamer_cfg,
+        tiny_fabric,
+        tiny_obs_space,
+    )
+
+    cfg = tiny_dreamer_cfg("p2e_dv1_exploration", extra=("algo.ensembles.n=2",))
+    fabric = tiny_fabric()
+    agent, ensembles, params = build_agent(
+        fabric, (4,), False, cfg, tiny_obs_space(), jax.random.PRNGKey(0)
+    )
+    txs = build_txs(cfg)
+    opt_state = {
+        "world_model": txs["world_model"].init(params["world_model"]),
+        "actor_task": txs["actor_task"].init(params["actor_task"]),
+        "critic_task": txs["critic_task"].init(params["critic_task"]),
+        "actor_exploration": txs["actor_exploration"].init(params["actor_exploration"]),
+        "critic_exploration": txs["critic_exploration"].init(params["critic_exploration"]),
+        "ensembles": txs["ensembles"].init(params["ensembles"]),
+    }
+    train_phase = make_train_phase(agent, ensembles, cfg, txs)
+    batch = tiny_dreamer_batch(cfg)
+    args = (params, opt_state, batch, np.asarray(jax.random.PRNGKey(1)))
+    return train_phase.train_step, args
+
+
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     rank = fabric.global_rank
@@ -313,20 +372,7 @@ def main(fabric, cfg: Dict[str, Any]):
     player = PlayerDV1(agent, num_envs, cnn_keys, mlp_keys)
     actor_type = cfg.algo.player.actor_type
 
-    def _tx(opt_cfg, clip):
-        base = instantiate(opt_cfg)
-        if clip is not None and clip > 0:
-            return optax.chain(optax.clip_by_global_norm(clip), base)
-        return base
-
-    txs = {
-        "world_model": _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
-        "actor_task": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
-        "critic_task": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
-        "actor_exploration": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
-        "critic_exploration": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
-        "ensembles": _tx(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
-    }
+    txs = build_txs(cfg)  # shared with the AOT registry — one construction
     opt_state = {
         "world_model": txs["world_model"].init(params["world_model"]),
         "actor_task": txs["actor_task"].init(params["actor_task"]),

@@ -28,7 +28,9 @@ from sheeprl_tpu.algos.dreamer_v2.utils import (
     compute_lambda_values,
     normal1_logprob as _normal1_logprob,
 )
+from sheeprl_tpu.algos.p2e_dv1.p2e_dv1_exploration import build_txs
 from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, player_params
+from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.algos.p2e_dv2.utils import prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv3.agent import EnsembleHeads
 from sheeprl_tpu.config import instantiate
@@ -288,6 +290,37 @@ def make_train_phase(
     return train_phase
 
 
+@register_fused_program(
+    "p2e_dv2.train_step",
+    min_donated=2,
+    doc="fused single-gradient-step P2E-DV2 world/ensemble/task+exploration heads update",
+)
+def _aot_train_step():
+    """Tiny P2E-DV2 agent (incl. the disagreement ensembles) through the loop's
+    own factory."""
+    from sheeprl_tpu.analysis.programs import (
+        tiny_dreamer_batch,
+        tiny_dreamer_cfg,
+        tiny_fabric,
+        tiny_obs_space,
+    )
+
+    cfg = tiny_dreamer_cfg(
+        "p2e_dv2_exploration",
+        extra=("algo.ensembles.n=2", "algo.world_model.discrete_size=4"),
+    )
+    fabric = tiny_fabric()
+    agent, ensembles, params = build_agent(
+        fabric, (4,), False, cfg, tiny_obs_space(), jax.random.PRNGKey(0)
+    )
+    txs = build_txs(cfg)  # same six-group layout as P2E-DV1
+    opt_state = {name: txs[name].init(params[name]) for name in txs}
+    train_phase = make_train_phase(agent, ensembles, cfg, txs)
+    batch = tiny_dreamer_batch(cfg)
+    args = (params, opt_state, batch, jnp.asarray(0), np.asarray(jax.random.PRNGKey(1)))
+    return train_phase.train_step, args
+
+
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     rank = fabric.global_rank
@@ -355,20 +388,8 @@ def main(fabric, cfg: Dict[str, Any]):
     player = PlayerDV2(agent, num_envs, cnn_keys, mlp_keys)
     actor_type = cfg.algo.player.actor_type
 
-    def _tx(opt_cfg, clip):
-        base = instantiate(opt_cfg)
-        if clip is not None and clip > 0:
-            return optax.chain(optax.clip_by_global_norm(clip), base)
-        return base
-
-    txs = {
-        "world_model": _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
-        "actor_task": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
-        "critic_task": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
-        "actor_exploration": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
-        "critic_exploration": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
-        "ensembles": _tx(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
-    }
+    # shared with P2E-DV1 and the AOT registry — one six-group construction
+    txs = build_txs(cfg)
     opt_state = {
         "world_model": txs["world_model"].init(params["world_model"]),
         "actor_task": txs["actor_task"].init(params["actor_task"]),

@@ -23,6 +23,7 @@ import optax
 from sheeprl_tpu.algos.dreamer_v3.agent import DV3Agent, PlayerDV3, actor_logprob_entropy
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.p2e_dv3.agent import EnsembleHeads, build_agent, player_params
+from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.algos.p2e_dv3.utils import init_moments, prepare_obs, test, update_moments
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
@@ -376,6 +377,74 @@ def make_train_phase(
     return train_phase
 
 
+def build_txs(cfg) -> Dict[str, Any]:
+    """The P2E-DV3 optimizer groups (shared heads + one optimizer per
+    exploration critic) with per-group clipping — ONE construction shared by
+    the training loop and the AOT registry."""
+
+    def _tx(opt_cfg, clip):
+        base = instantiate(opt_cfg)
+        if clip is not None and clip > 0:
+            return optax.chain(optax.clip_by_global_norm(clip), base)
+        return base
+
+    txs = {
+        "world_model": _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
+        "actor_task": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+        "critic_task": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+        "actor_exploration": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+        "ensembles": _tx(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
+    }
+    for ck in dict(cfg.algo.critics_exploration):
+        txs[f"critic_exploration_{ck}"] = _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    return txs
+
+
+@register_fused_program(
+    "p2e_dv3.train_step",
+    min_donated=3,
+    doc="fused single-gradient-step P2E-DV3 world/ensemble/task+exploration critics update",
+)
+def _aot_train_step():
+    """Tiny P2E-DV3 agent (ensembles + per-critic exploration heads) through
+    the loop's own factory."""
+    from sheeprl_tpu.analysis.programs import (
+        tiny_dreamer_batch,
+        tiny_dreamer_cfg,
+        tiny_fabric,
+        tiny_obs_space,
+    )
+
+    cfg = tiny_dreamer_cfg(
+        "p2e_dv3_exploration",
+        extra=("algo.ensembles.n=2", "algo.world_model.discrete_size=4"),
+    )
+    fabric = tiny_fabric()
+    agent, ensembles, params = build_agent(
+        fabric, (4,), False, cfg, tiny_obs_space(), jax.random.PRNGKey(0)
+    )
+    txs = build_txs(cfg)
+    opt_state = {
+        "world_model": txs["world_model"].init(params["world_model"]),
+        "actor_task": txs["actor_task"].init(params["actor_task"]),
+        "critic_task": txs["critic_task"].init(params["critic_task"]),
+        "actor_exploration": txs["actor_exploration"].init(params["actor_exploration"]),
+        "ensembles": txs["ensembles"].init(params["ensembles"]),
+    }
+    for ck in dict(cfg.algo.critics_exploration):
+        opt_state[f"critic_exploration_{ck}"] = txs[f"critic_exploration_{ck}"].init(
+            params["critics_exploration"][ck]["module"]
+        )
+    moments_state = {
+        "task": init_moments(),
+        "exploration": {ck: init_moments() for ck in dict(cfg.algo.critics_exploration)},
+    }
+    train_phase = make_train_phase(agent, ensembles, cfg, txs)
+    batch = tiny_dreamer_batch(cfg)
+    args = (params, opt_state, moments_state, batch, jnp.asarray(0), np.asarray(jax.random.PRNGKey(1)))
+    return train_phase.train_step, args
+
+
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     rank = fabric.global_rank
@@ -446,21 +515,7 @@ def main(fabric, cfg: Dict[str, Any]):
     player = PlayerDV3(agent, num_envs, cnn_keys, mlp_keys)
     actor_type = cfg.algo.player.actor_type
 
-    def _tx(opt_cfg, clip):
-        base = instantiate(opt_cfg)
-        if clip is not None and clip > 0:
-            return optax.chain(optax.clip_by_global_norm(clip), base)
-        return base
-
-    txs = {
-        "world_model": _tx(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
-        "actor_task": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
-        "critic_task": _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
-        "actor_exploration": _tx(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
-        "ensembles": _tx(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
-    }
-    for ck in dict(cfg.algo.critics_exploration):
-        txs[f"critic_exploration_{ck}"] = _tx(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    txs = build_txs(cfg)  # shared with the AOT registry — one construction
     opt_state = {
         "world_model": txs["world_model"].init(params["world_model"]),
         "actor_task": txs["actor_task"].init(params["actor_task"]),

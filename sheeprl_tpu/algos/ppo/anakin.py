@@ -54,6 +54,7 @@ from sheeprl_tpu.algos.a2c.loss import value_loss as a2c_value_loss
 from sheeprl_tpu.algos.ppo.agent import build_agent, make_dists, policy_output
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import test
+from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.envs.jax import make_jax_env
 from sheeprl_tpu.obs import build_telemetry
@@ -411,6 +412,63 @@ def make_anakin_program(
     fused = jax.jit(anakin_step, donate_argnums=(0, 1, 2, 3, 4))
     rollout_only = jax.jit(rollout_phase)
     return fused, rollout_only, updates_per_iter
+
+
+@register_fused_program(
+    "ppo.anakin_step",
+    min_donated=10,
+    expect_collectives=("all-reduce",),
+    compile_on_cpu=True,
+    devices=8,
+    doc="Anakin fused rollout+train PPO step on the 8-device dp mesh",
+)
+def _aot_anakin_program():
+    """The fused Anakin program on the 8-device CPU mesh — the TPU-readiness
+    build the hand-written AOT test used, now shared through the registry:
+    donation must survive (params/opt-state/env-state/obs/key), the steady-state
+    program must carry NO host callbacks/outfeeds (zero per-step host<->device
+    traffic by construction), and the dp gradient psum must appear as an
+    all-reduce in the optimized HLO."""
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.envs.jax import make_jax_env
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    devices = 8
+    cfg = compose(
+        [
+            "exp=ppo_anakin_benchmarks",
+            "fabric.accelerator=cpu",
+            f"fabric.devices={devices}",
+            "fabric.strategy=dp",
+            "env.num_envs=16",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=32",
+        ]
+    )
+    fabric = Fabric(devices=devices, accelerator="cpu", strategy="dp")
+    fabric._setup()
+    total_envs = 16 * devices
+    env = make_jax_env(cfg, total_envs)
+    spec = env.spec
+    obs_space = gym.spaces.Dict({"state": spec.to_gym_obs_space()})
+    agent, params = build_agent(
+        fabric, spec.action.actions_dim, False, cfg, obs_space, jax.random.PRNGKey(0)
+    )
+    tx = _build_optimizer(cfg, 10, 1)
+    opt_state = tx.init(params)
+    fused, rollout_only, _ = make_anakin_program(
+        agent, env, cfg, fabric, tx, spec.action.actions_dim, False, "state", total_envs
+    )
+    env_state, obs = jax.jit(env.reset)(jax.random.PRNGKey(1))
+    stats = {
+        "ep_return_sum": jnp.float32(0),
+        "ep_length_sum": jnp.float32(0),
+        "ep_count": jnp.float32(0),
+        "losses": jnp.zeros((3,), jnp.float32),
+    }
+    args = (params, opt_state, env_state, obs, jax.random.PRNGKey(2), stats, np.float32(0.2), np.float32(0.0))
+    return fused, args
 
 
 def _build_optimizer(cfg, total_iters: int, updates_per_iter: int):

@@ -30,6 +30,7 @@ import optax
 from sheeprl_tpu.algos.sac.agent import build_agent, squash_and_logprob
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
@@ -42,6 +43,166 @@ from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import ActPlacement, BenchWindow, Ratio, save_configs
+
+
+def build_optimizers(cfg) -> Dict[str, Any]:
+    """The three SAC optimizers (reference sac.py:151-173) — ONE construction
+    shared by the coupled loop, the decoupled trainer/service learner
+    (sac_decoupled._build_sac_train) and the AOT program registry."""
+    return {
+        "actor": instantiate(cfg.algo.actor.optimizer),
+        "critic": instantiate(cfg.algo.critic.optimizer),
+        "alpha": instantiate(cfg.algo.alpha.optimizer),
+    }
+
+
+def init_opt_state(txs: Dict[str, Any], params) -> Dict[str, Any]:
+    return {
+        "actor": txs["actor"].init(params["actor"]),
+        "critic": txs["critic"].init(params["critic"]),
+        "alpha": txs["alpha"].init(params["log_alpha"]),
+    }
+
+
+def make_train_phase(cfg, actor, critic, target_entropy, policy_steps_per_iter, txs=None, jit_kwargs=None):
+    """Build the fused multi-gradient-step SAC train program: a ``lax.scan``
+    over the ``[G, B, ...]`` replay block running critic -> EMA -> actor ->
+    alpha per step (one device program per iteration; reference train(),
+    sac.py:32-81). Shared verbatim by the coupled loop, the decoupled
+    trainer/service learner and the AOT contract registry — the program that
+    lowers in the gate is the program that trains.
+
+    ``jit_kwargs`` carries the multi-device ``out_shardings`` pin (see the
+    donation note below); ``policy_steps_per_iter`` sets the target-EMA period
+    in iterations, exactly as before."""
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    num_critics = int(cfg.algo.critic.n)
+    target_period = cfg.algo.critic.target_network_frequency // int(policy_steps_per_iter) + 1
+    action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
+    action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
+    txs = txs if txs is not None else build_optimizers(cfg)
+    actor_tx, critic_tx, alpha_tx = txs["actor"], txs["critic"], txs["alpha"]
+
+    def critic_loss_fn(critic_params, other, batch, step_key):
+        next_obs = batch["next_observations"]
+        mean, std = actor.apply({"params": other["actor"]}, next_obs)
+        next_actions, next_logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+        target_q = critic.apply({"params": other["target_critic"]}, next_obs, next_actions)
+        alpha = jnp.exp(other["log_alpha"])
+        min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
+        next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
+        qf_values = critic.apply({"params": critic_params}, batch["observations"], batch["actions"])
+        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+
+    def actor_loss_fn(actor_params, other, batch, step_key):
+        mean, std = actor.apply({"params": actor_params}, batch["observations"])
+        actions, logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+        qf_values = critic.apply({"params": other["critic"]}, batch["observations"], actions)
+        min_qf = jnp.min(qf_values, axis=-1, keepdims=True)
+        alpha = jnp.exp(jax.lax.stop_gradient(other["log_alpha"]))
+        return policy_loss(alpha, logprobs, min_qf), logprobs
+
+    def alpha_loss_fn(log_alpha, logprobs):
+        return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
+
+    # donate_argnums: XLA reuses the params/opt-state buffers in place instead of
+    # copying the whole train state every round (callers always rebind to the
+    # returned trees, so the invalidated inputs are never read again).
+    # out_shardings (via jit_kwargs) pins the state outputs on multi-device
+    # meshes (replicated on dp) — without the pin GSPMD propagation may
+    # re-scatter small state leaves on output, silently degrading the donation
+    # aliasing (the PR 8 residual; parallel/sharding.py build_state_shardings).
+    @partial(jax.jit, donate_argnums=(0, 1), **(jit_kwargs or {}))
+    def train_phase(params, opt_state, data, iter_num, train_key):
+        """scan over the [G, B, ...] gradient-step axis: critic -> EMA -> actor -> alpha
+        (one fused device program per iteration; reference train(), sac.py:32-81)."""
+        # reference gates EMA on the iteration counter (sac.py:57-59 with update=iter_num)
+        do_ema = (iter_num % target_period) == 0
+
+        def step(carry, inp):
+            params, opt_state = carry
+            batch, k = inp
+            k_critic, k_actor = jax.random.split(k)
+
+            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k_critic)
+            updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
+            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+            opt_state = {**opt_state, "critic": new_copt}
+            params = {
+                **params,
+                "target_critic": jax.tree_util.tree_map(
+                    lambda t, c: jnp.where(do_ema, t * (1 - tau) + c * tau, t),
+                    params["target_critic"],
+                    params["critic"],
+                ),
+            }
+
+            (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+                params["actor"], params, batch, k_actor
+            )
+            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+            opt_state = {**opt_state, "actor": new_aopt}
+
+            al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
+            updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
+            params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
+            opt_state = {**opt_state, "alpha": new_alopt}
+
+            return (params, opt_state), jnp.stack([qf_loss, a_loss, al_loss])
+
+        G = data["rewards"].shape[0]
+        keys = jax.random.split(train_key, G)
+        (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (data, keys))
+        return params, opt_state, losses.mean(axis=0)
+
+    return train_phase
+
+
+@register_fused_program(
+    "sac.train_phase",
+    min_donated=2,
+    doc="fused SAC multi-gradient-step update (critic -> EMA -> actor -> alpha scan)",
+)
+def _aot_train_program():
+    """Tiny MLP SAC agent through the loop's own factory."""
+    from sheeprl_tpu.analysis.programs import tiny_fabric
+    from sheeprl_tpu.config import compose
+
+    cfg = compose(
+        [
+            "exp=sac",
+            "env=dummy",
+            "fabric.accelerator=cpu",
+            "env.num_envs=2",
+            "env.capture_video=False",
+            "algo.hidden_size=16",
+            "algo.per_rank_batch_size=4",
+            "buffer.memmap=False",
+            "metric.log_level=0",
+        ]
+    )
+    fabric = tiny_fabric()
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (8,), np.float32)})
+    action_space = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+    actor, critic, params = build_agent(fabric, cfg, obs_space, action_space, jax.random.PRNGKey(0), None)
+    txs = build_optimizers(cfg)
+    opt_state = init_opt_state(txs, params)
+    train_phase = make_train_phase(
+        cfg, actor, critic, target_entropy=-2.0, policy_steps_per_iter=2, txs=txs
+    )
+    G, B = 1, int(cfg.algo.per_rank_batch_size)
+    rng = np.random.default_rng(0)
+    data = {
+        "observations": rng.normal(size=(G, B, 8)).astype(np.float32),
+        "next_observations": rng.normal(size=(G, B, 8)).astype(np.float32),
+        "actions": rng.normal(size=(G, B, 2)).astype(np.float32),
+        "rewards": rng.normal(size=(G, B, 1)).astype(np.float32),
+        "terminated": np.zeros((G, B, 1), np.float32),
+    }
+    args = (params, opt_state, data, jnp.asarray(1), np.asarray(jax.random.PRNGKey(1)))
+    return train_phase, args
 
 
 @register_algorithm()
@@ -109,15 +270,10 @@ def main(fabric, cfg: Dict[str, Any]):
     action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
     action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
 
-    # three optimizers, one per parameter group (reference sac.py:151-173)
-    actor_tx = instantiate(cfg.algo.actor.optimizer)
-    critic_tx = instantiate(cfg.algo.critic.optimizer)
-    alpha_tx = instantiate(cfg.algo.alpha.optimizer)
-    opt_state = {
-        "actor": actor_tx.init(params["actor"]),
-        "critic": critic_tx.init(params["critic"]),
-        "alpha": alpha_tx.init(params["log_alpha"]),
-    }
+    # three optimizers, one per parameter group (reference sac.py:151-173) —
+    # shared construction with the decoupled learner and the AOT registry
+    txs = build_optimizers(cfg)
+    opt_state = init_opt_state(txs, params)
     if state is not None:
         opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
 
@@ -169,10 +325,6 @@ def main(fabric, cfg: Dict[str, Any]):
         )
 
     # ---------------- jitted programs ----------------
-    gamma = float(cfg.algo.gamma)
-    tau = float(cfg.algo.tau)
-    num_critics = int(cfg.algo.critic.n)
-    target_period = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
     sample_next_obs = bool(cfg.buffer.sample_next_obs)
 
     act = ActPlacement(fabric, lambda p: p["actor"])
@@ -187,85 +339,26 @@ def main(fabric, cfg: Dict[str, Any]):
         actions, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
         return actions, key
 
-    def critic_loss_fn(critic_params, other, batch, step_key):
-        next_obs = batch["next_observations"]
-        mean, std = actor.apply({"params": other["actor"]}, next_obs)
-        next_actions, next_logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
-        target_q = critic.apply({"params": other["target_critic"]}, next_obs, next_actions)
-        alpha = jnp.exp(other["log_alpha"])
-        min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
-        next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
-        qf_values = critic.apply({"params": critic_params}, batch["observations"], batch["actions"])
-        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
-
-    def actor_loss_fn(actor_params, other, batch, step_key):
-        mean, std = actor.apply({"params": actor_params}, batch["observations"])
-        actions, logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
-        qf_values = critic.apply({"params": other["critic"]}, batch["observations"], actions)
-        min_qf = jnp.min(qf_values, axis=-1, keepdims=True)
-        alpha = jnp.exp(jax.lax.stop_gradient(other["log_alpha"]))
-        return policy_loss(alpha, logprobs, min_qf), logprobs
-
-    def alpha_loss_fn(log_alpha, logprobs):
-        return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
-
-    # donate_argnums: XLA reuses the params/opt-state buffers in place instead of
-    # copying the whole train state every round (callers always rebind to the
-    # returned trees, so the invalidated inputs are never read again).
+    # the fused train program — ONE factory (make_train_phase) shared with the
+    # decoupled trainer/service learner and the AOT contract registry, so the
+    # program `sheeprl.py lint --aot` lowers is the program this loop runs.
     # out_shardings pins the state outputs on multi-device meshes (replicated on
-    # dp) — without the pin GSPMD propagation may re-scatter small state leaves
-    # on output, silently degrading the donation aliasing (the PR 8 residual;
-    # parallel/sharding.py build_state_shardings).
+    # dp) — see make_train_phase's donation note.
     from sheeprl_tpu.parallel.sharding import build_state_shardings
 
     _state_shardings = build_state_shardings(fabric, params, opt_state)
     _train_jit_kwargs = (
         {"out_shardings": tuple(_state_shardings)} if _state_shardings is not None else {}
     )
-
-    @partial(jax.jit, donate_argnums=(0, 1), **_train_jit_kwargs)
-    def train_phase(params, opt_state, data, iter_num, train_key):
-        """scan over the [G, B, ...] gradient-step axis: critic -> EMA -> actor -> alpha
-        (one fused device program per iteration; reference train(), sac.py:32-81)."""
-        # reference gates EMA on the iteration counter (sac.py:57-59 with update=iter_num)
-        do_ema = (iter_num % target_period) == 0
-
-        def step(carry, inp):
-            params, opt_state = carry
-            batch, k = inp
-            k_critic, k_actor = jax.random.split(k)
-
-            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k_critic)
-            updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
-            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
-            opt_state = {**opt_state, "critic": new_copt}
-            params = {
-                **params,
-                "target_critic": jax.tree_util.tree_map(
-                    lambda t, c: jnp.where(do_ema, t * (1 - tau) + c * tau, t),
-                    params["target_critic"],
-                    params["critic"],
-                ),
-            }
-
-            (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
-                params["actor"], params, batch, k_actor
-            )
-            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
-            opt_state = {**opt_state, "actor": new_aopt}
-
-            al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
-            updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
-            params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
-            opt_state = {**opt_state, "alpha": new_alopt}
-
-            return (params, opt_state), jnp.stack([qf_loss, a_loss, al_loss])
-
-        G = data["rewards"].shape[0]
-        keys = jax.random.split(train_key, G)
-        (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (data, keys))
-        return params, opt_state, losses.mean(axis=0)
+    train_phase = make_train_phase(
+        cfg,
+        actor,
+        critic,
+        target_entropy,
+        policy_steps_per_iter,
+        txs=txs,
+        jit_kwargs=_train_jit_kwargs,
+    )
 
     if world_size > 1:
         params = fabric.replicate_pytree(params)

@@ -22,10 +22,8 @@ import gymnasium as gym
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from sheeprl_tpu.algos.sac.agent import build_agent, squash_and_logprob
-from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -44,92 +42,17 @@ from sheeprl_tpu.utils.utils import ActPlacement, Ratio, save_configs
 
 def _build_sac_train(cfg, actor, critic, target_entropy, policy_steps_per_iter):
     """The fused multi-gradient-step SAC train program + its optimizer state
-    builder — ONE construction shared by the channel trainer (``_trainer_loop``)
-    and the experience-service learner (``_service_learner``), so the two
-    backends run the bit-identical donated program. ``policy_steps_per_iter`` is
-    the GLOBAL env transitions per driver iteration (it sets the target-EMA
-    period in iterations, exactly as before)."""
-    gamma = float(cfg.algo.gamma)
-    tau = float(cfg.algo.tau)
-    num_critics = int(cfg.algo.critic.n)
-    target_period = cfg.algo.critic.target_network_frequency // int(policy_steps_per_iter) + 1
-    action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
-    action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
+    builder — ONE construction shared by the channel trainer (``_trainer_loop``),
+    the experience-service learner (``_service_learner``), the coupled loop AND
+    the AOT contract registry: everything delegates to ``sac.make_train_phase``,
+    so every backend runs (and ``lint --aot`` lowers) the bit-identical donated
+    program. ``policy_steps_per_iter`` is the GLOBAL env transitions per driver
+    iteration (it sets the target-EMA period in iterations, exactly as before)."""
+    from sheeprl_tpu.algos.sac.sac import build_optimizers, init_opt_state, make_train_phase
 
-    actor_tx = instantiate(cfg.algo.actor.optimizer)
-    critic_tx = instantiate(cfg.algo.critic.optimizer)
-    alpha_tx = instantiate(cfg.algo.alpha.optimizer)
-
-    def init_opt_state(params):
-        return {
-            "actor": actor_tx.init(params["actor"]),
-            "critic": critic_tx.init(params["critic"]),
-            "alpha": alpha_tx.init(params["log_alpha"]),
-        }
-
-    def critic_loss_fn(critic_params, other, batch, step_key):
-        next_obs = batch["next_observations"]
-        mean, std = actor.apply({"params": other["actor"]}, next_obs)
-        next_actions, next_logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
-        target_q = critic.apply({"params": other["target_critic"]}, next_obs, next_actions)
-        alpha = jnp.exp(other["log_alpha"])
-        min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
-        next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
-        qf_values = critic.apply({"params": critic_params}, batch["observations"], batch["actions"])
-        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
-
-    def actor_loss_fn(actor_params, other, batch, step_key):
-        mean, std = actor.apply({"params": actor_params}, batch["observations"])
-        actions, logprobs = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
-        qf_values = critic.apply({"params": other["critic"]}, batch["observations"], actions)
-        min_qf = jnp.min(qf_values, axis=-1, keepdims=True)
-        alpha = jnp.exp(jax.lax.stop_gradient(other["log_alpha"]))
-        return policy_loss(alpha, logprobs, min_qf), logprobs
-
-    def alpha_loss_fn(log_alpha, logprobs):
-        return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
-
-    # donate_argnums: XLA reuses the params/opt-state buffers in place instead
-    # of copying the whole train state every round (the loop always rebinds to
-    # the returned trees, so the invalidated inputs are never read again)
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_phase(params, opt_state, data, iter_num, train_key):
-        do_ema = (iter_num % target_period) == 0
-
-        def step(carry, inp):
-            params, opt_state = carry
-            batch, k = inp
-            k_critic, k_actor = jax.random.split(k)
-            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k_critic)
-            updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
-            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
-            opt_state = {**opt_state, "critic": new_copt}
-            params = {
-                **params,
-                "target_critic": jax.tree_util.tree_map(
-                    lambda t, c: jnp.where(do_ema, t * (1 - tau) + c * tau, t),
-                    params["target_critic"],
-                    params["critic"],
-                ),
-            }
-            (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
-                params["actor"], params, batch, k_actor
-            )
-            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
-            opt_state = {**opt_state, "actor": new_aopt}
-            al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
-            updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
-            params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
-            opt_state = {**opt_state, "alpha": new_alopt}
-            return (params, opt_state), jnp.stack([qf_loss, a_loss, al_loss])
-
-        G = data["rewards"].shape[0]
-        keys = jax.random.split(train_key, G)
-        (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (data, keys))
-        return params, opt_state, losses.mean(axis=0)
-
-    return train_phase, init_opt_state
+    txs = build_optimizers(cfg)
+    train_phase = make_train_phase(cfg, actor, critic, target_entropy, policy_steps_per_iter, txs=txs)
+    return train_phase, partial(init_opt_state, txs)
 
 
 def _trainer_loop(

@@ -26,6 +26,7 @@ from sheeprl_tpu.algos.sac.agent import squash_and_logprob
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac_ae.agent import build_agent
 from sheeprl_tpu.algos.sac_ae.utils import prepare_obs, preprocess_obs, test
+from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
@@ -53,129 +54,46 @@ def _masked_update(tx, grads, opt_state, group, apply_flag):
     )
 
 
-@register_algorithm()
-def main(fabric, cfg: Dict[str, Any]):
-    rank = fabric.global_rank
-    world_size = fabric.world_size
+def critic_group(p):
+    return {k: p[k] for k in ("conv", "mlp_enc", "critic_cnn_fc", "qfs") if k in p}
 
-    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
 
-    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
-    logger = get_logger(fabric, cfg, log_dir=log_dir)
-    fabric.logger = logger
-    if logger is not None:
-        logger.log_hyperparams(cfg.as_dict())
-    fabric.print(f"Log dir: {log_dir}")
-    telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
-    resilience = build_resilience(fabric, cfg, log_dir, telemetry=telemetry)
+def actor_group(p):
+    return {k: p[k] for k in ("actor", "actor_cnn_fc") if k in p}
 
-    total_num_envs = int(cfg.env.num_envs * world_size)
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = vectorized_env(
-        [
-            make_env(
-                cfg,
-                cfg.seed + rank * total_num_envs + i,
-                rank * total_num_envs,
-                log_dir if rank == 0 else None,
-                "train",
-                vector_env_idx=i,
-            )
-            for i in range(total_num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
-    action_space = envs.single_action_space
-    observation_space = envs.single_observation_space
-    if not isinstance(action_space, gym.spaces.Box):
-        raise ValueError("Only continuous action space is supported for the SAC-AE agent")
-    if not isinstance(observation_space, gym.spaces.Dict):
-        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
-    cnn_keys = list(cfg.algo.cnn_keys.encoder)
-    mlp_keys = list(cfg.algo.mlp_keys.encoder)
-    if len(cnn_keys) + len(mlp_keys) == 0:
-        raise RuntimeError("You should specify at least one CNN or MLP key for the encoder")
-    obs_keys = cnn_keys + mlp_keys
-    if cfg.metric.log_level > 0:
-        fabric.print("Encoder CNN keys:", cnn_keys)
-        fabric.print("Encoder MLP keys:", mlp_keys)
 
-    key = fabric.seed_everything(cfg.seed + rank)
-    key, agent_key = jax.random.split(key)
-    agent, params = build_agent(
-        fabric, cfg, observation_space, action_space, agent_key, state["agent"] if state else None
-    )
-    act_dim = int(np.prod(action_space.shape))
-    target_entropy = -float(act_dim)
+def encoder_group(p):
+    return {k: p[k] for k in ("conv", "mlp_enc", "critic_cnn_fc") if k in p}
 
-    # five optimizers (reference sac_ae.py:211-248)
-    actor_tx = instantiate(cfg.algo.actor.optimizer)
-    critic_tx = instantiate(cfg.algo.critic.optimizer)
-    alpha_tx = instantiate(cfg.algo.alpha.optimizer)
-    encoder_tx = instantiate(cfg.algo.encoder.optimizer)
-    decoder_tx = instantiate(cfg.algo.decoder.optimizer)
 
-    def critic_group(p):
-        return {k: p[k] for k in ("conv", "mlp_enc", "critic_cnn_fc", "qfs") if k in p}
-
-    def actor_group(p):
-        return {k: p[k] for k in ("actor", "actor_cnn_fc") if k in p}
-
-    def encoder_group(p):
-        return {k: p[k] for k in ("conv", "mlp_enc", "critic_cnn_fc") if k in p}
-
-    opt_state = {
-        "critic": critic_tx.init(critic_group(params)),
-        "actor": actor_tx.init(actor_group(params)),
-        "alpha": alpha_tx.init(params["log_alpha"]),
-        "encoder": encoder_tx.init(encoder_group(params)),
-        "decoder": decoder_tx.init(params["decoder"]),
+def build_optimizers(cfg) -> Dict[str, Any]:
+    """The five SAC-AE optimizers (reference sac_ae.py:211-248) — shared by the
+    loop and the AOT registry."""
+    return {
+        "actor": instantiate(cfg.algo.actor.optimizer),
+        "critic": instantiate(cfg.algo.critic.optimizer),
+        "alpha": instantiate(cfg.algo.alpha.optimizer),
+        "encoder": instantiate(cfg.algo.encoder.optimizer),
+        "decoder": instantiate(cfg.algo.decoder.optimizer),
     }
-    if state is not None:
-        opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
 
-    if fabric.is_global_zero:
-        save_configs(cfg, log_dir)
 
-    aggregator = None
-    if not MetricAggregator.disabled:
-        aggregator = instantiate(cfg.metric.aggregator)
+def init_opt_state(txs: Dict[str, Any], params) -> Dict[str, Any]:
+    return {
+        "critic": txs["critic"].init(critic_group(params)),
+        "actor": txs["actor"].init(actor_group(params)),
+        "alpha": txs["alpha"].init(params["log_alpha"]),
+        "encoder": txs["encoder"].init(encoder_group(params)),
+        "decoder": txs["decoder"].init(params["decoder"]),
+    }
 
-    buffer_size = cfg.buffer.size // total_num_envs if not cfg.dry_run else 1
-    rb = ReplayBuffer(
-        buffer_size,
-        total_num_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        obs_keys=tuple(obs_keys),
-    )
-    if state is not None and "rb" in state:
-        rb = state["rb"]
 
-    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
-    policy_step = state["iter_num"] * cfg.env.num_envs if state is not None else 0
-    last_log = state["last_log"] if state is not None else 0
-    last_checkpoint = state["last_checkpoint"] if state is not None else 0
-    policy_steps_per_iter = int(total_num_envs)
-    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
-    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
-    prefill_steps = learning_starts - int(learning_starts > 0)
-    if state is not None:
-        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
-        learning_starts += start_iter
-        prefill_steps += start_iter
-
-    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
-    if state is not None:
-        ratio.load_state_dict(state["ratio"])
-
-    if cfg.checkpoint.every % policy_steps_per_iter != 0:
-        warnings.warn(
-            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
-            f"policy_steps_per_iter value ({policy_steps_per_iter})."
-        )
-
-    # ---------------- jitted programs ----------------
+def make_train_phase(agent, cfg, txs, target_entropy, jit_kwargs=None):
+    """Build the fused SAC-AE train program: a ``lax.scan`` over the ``[G, B,
+    ...]`` replay block running critic -> targets EMA -> (gated) actor/alpha ->
+    (gated) encoder/decoder reconstruction per step. ONE factory shared by the
+    loop and the AOT contract registry. ``jit_kwargs`` carries the multi-device
+    ``out_shardings`` pin (see the donation note below)."""
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     encoder_tau = float(cfg.algo.encoder.tau)
@@ -184,8 +102,12 @@ def main(fabric, cfg: Dict[str, Any]):
     actor_freq = int(cfg.algo.actor.per_rank_update_freq)
     decoder_freq = int(cfg.algo.decoder.per_rank_update_freq)
     l2_lambda = float(cfg.algo.decoder.l2_lambda)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     cnn_dec_keys = tuple(cfg.algo.cnn_keys.decoder)
     mlp_dec_keys = tuple(cfg.algo.mlp_keys.decoder)
+    actor_tx, critic_tx, alpha_tx = txs["actor"], txs["critic"], txs["alpha"]
+    encoder_tx, decoder_tx = txs["encoder"], txs["decoder"]
 
     def _flat_img(x):
         # fold frame-stack dims into channels: [..., S, C, H, W] -> [..., S*C, H, W]
@@ -198,24 +120,6 @@ def main(fabric, cfg: Dict[str, Any]):
         for k in mlp_keys:
             out[k] = batch[prefix + k]
         return out
-
-    @jax.jit
-    def act_fn(params, obs: Dict[str, jax.Array], key):
-        # PRNG chain advances inside the jitted program (un-jitted per-step
-        # jax.random.split costs ~0.5 ms of host dispatch)
-        key, step_key = jax.random.split(key)
-        feat = agent.features(params, obs, side="actor")
-        mean, std = agent.actor.apply({"params": params["actor"]}, feat)
-        actions, _ = squash_and_logprob(mean, std, step_key, agent.action_scale, agent.action_bias)
-        return actions, key
-
-    # act/train placement split (shared ActPlacement design): the act view carries
-    # exactly what act_fn reads — the shared conv trunk, the actor-side cnn fc,
-    # the mlp encoder and the actor head (agent.features(side="actor") + actor).
-    act = ActPlacement(
-        fabric,
-        lambda p: {k: p[k] for k in ("conv", "actor_cnn_fc", "mlp_enc", "actor") if k in p},
-    )
 
     def critic_loss_fn(cg, params, batch, step_key):
         p = {**params, **cg}
@@ -267,16 +171,9 @@ def main(fabric, cfg: Dict[str, Any]):
     # donate_argnums: XLA reuses the params/opt-state buffers in place instead of
     # copying the whole train state every round (callers always rebind to the
     # returned trees, so the invalidated inputs are never read again).
-    # out_shardings pins the state outputs on multi-device meshes — see the
-    # sac.py note (PR 8 residual; parallel/sharding.py build_state_shardings).
-    from sheeprl_tpu.parallel.sharding import build_state_shardings
-
-    _state_shardings = build_state_shardings(fabric, params, opt_state)
-    _train_jit_kwargs = (
-        {"out_shardings": tuple(_state_shardings)} if _state_shardings is not None else {}
-    )
-
-    @partial(jax.jit, donate_argnums=(0, 1), **_train_jit_kwargs)
+    # out_shardings (via jit_kwargs) pins the state outputs on multi-device
+    # meshes — see the sac.py note (PR 8 residual; build_state_shardings).
+    @partial(jax.jit, donate_argnums=(0, 1), **(jit_kwargs or {}))
     def train_phase(params, opt_state, data, cum_steps, train_key):
         G = data["rewards"].shape[0]
         keys = jax.random.split(jnp.asarray(train_key), G)
@@ -341,6 +238,204 @@ def main(fabric, cfg: Dict[str, Any]):
 
         (params, opt_state, _), losses = jax.lax.scan(step, (params, opt_state, cum_steps), (data, keys))
         return params, opt_state, losses.mean(axis=0)
+
+    return train_phase
+
+
+@register_fused_program(
+    "sac_ae.train_phase",
+    min_donated=2,
+    doc="fused SAC-AE update (critic/actor/alpha + gated encoder-decoder reconstruction)",
+)
+def _aot_train_program():
+    """Tiny pixel SAC-AE agent through the loop's own factory."""
+    from sheeprl_tpu.analysis.programs import tiny_fabric
+    from sheeprl_tpu.config import compose
+
+    cfg = compose(
+        [
+            "exp=sac_ae",
+            "env=dummy",
+            "fabric.accelerator=cpu",
+            "env.num_envs=2",
+            "env.capture_video=False",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.mlp_keys.decoder=[state]",
+            "algo.dense_units=16",
+            "algo.mlp_layers=1",
+            "algo.per_rank_batch_size=2",
+            "buffer.memmap=False",
+            "metric.log_level=0",
+        ]
+    )
+    fabric = tiny_fabric()
+    obs_space = gym.spaces.Dict(
+        {
+            "rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8),
+            "state": gym.spaces.Box(-np.inf, np.inf, (8,), np.float32),
+        }
+    )
+    action_space = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+    agent, params = build_agent(fabric, cfg, obs_space, action_space, jax.random.PRNGKey(0), None)
+    txs = build_optimizers(cfg)
+    opt_state = init_opt_state(txs, params)
+    train_phase = make_train_phase(agent, cfg, txs, target_entropy=-2.0)
+    G, B = 1, int(cfg.algo.per_rank_batch_size)
+    rng = np.random.default_rng(0)
+
+    def _obs(prefix=""):
+        return {
+            prefix + "rgb": rng.integers(0, 255, (G, B, 3, 64, 64)).astype(np.uint8),
+            prefix + "state": rng.normal(size=(G, B, 8)).astype(np.float32),
+        }
+
+    data = {
+        **_obs(),
+        **_obs("next_"),
+        "actions": rng.normal(size=(G, B, 2)).astype(np.float32),
+        "rewards": rng.normal(size=(G, B, 1)).astype(np.float32),
+        "terminated": np.zeros((G, B, 1), np.float32),
+    }
+    args = (params, opt_state, data, jnp.asarray(0), np.asarray(jax.random.PRNGKey(1)))
+    return train_phase, args
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    rank = fabric.global_rank
+    world_size = fabric.world_size
+
+    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
+    logger = get_logger(fabric, cfg, log_dir=log_dir)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict())
+    fabric.print(f"Log dir: {log_dir}")
+    telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
+    resilience = build_resilience(fabric, cfg, log_dir, telemetry=telemetry)
+
+    total_num_envs = int(cfg.env.num_envs * world_size)
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * total_num_envs + i,
+                rank * total_num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(total_num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC-AE agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    if len(cnn_keys) + len(mlp_keys) == 0:
+        raise RuntimeError("You should specify at least one CNN or MLP key for the encoder")
+    obs_keys = cnn_keys + mlp_keys
+    if cfg.metric.log_level > 0:
+        fabric.print("Encoder CNN keys:", cnn_keys)
+        fabric.print("Encoder MLP keys:", mlp_keys)
+
+    key = fabric.seed_everything(cfg.seed + rank)
+    key, agent_key = jax.random.split(key)
+    agent, params = build_agent(
+        fabric, cfg, observation_space, action_space, agent_key, state["agent"] if state else None
+    )
+    act_dim = int(np.prod(action_space.shape))
+    target_entropy = -float(act_dim)
+
+    # five optimizers (reference sac_ae.py:211-248) — shared construction with
+    # the AOT registry (build_optimizers/init_opt_state, module level)
+    txs = build_optimizers(cfg)
+    opt_state = init_opt_state(txs, params)
+    if state is not None:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // total_num_envs if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        buffer_size,
+        total_num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        obs_keys=tuple(obs_keys),
+    )
+    if state is not None and "rb" in state:
+        rb = state["rb"]
+
+    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(total_num_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state is not None:
+        ratio.load_state_dict(state["ratio"])
+
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    # ---------------- jitted programs ----------------
+
+    @jax.jit
+    def act_fn(params, obs: Dict[str, jax.Array], key):
+        # PRNG chain advances inside the jitted program (un-jitted per-step
+        # jax.random.split costs ~0.5 ms of host dispatch)
+        key, step_key = jax.random.split(key)
+        feat = agent.features(params, obs, side="actor")
+        mean, std = agent.actor.apply({"params": params["actor"]}, feat)
+        actions, _ = squash_and_logprob(mean, std, step_key, agent.action_scale, agent.action_bias)
+        return actions, key
+
+    # act/train placement split (shared ActPlacement design): the act view carries
+    # exactly what act_fn reads — the shared conv trunk, the actor-side cnn fc,
+    # the mlp encoder and the actor head (agent.features(side="actor") + actor).
+    act = ActPlacement(
+        fabric,
+        lambda p: {k: p[k] for k in ("conv", "actor_cnn_fc", "mlp_enc", "actor") if k in p},
+    )
+
+    # the fused train program — ONE factory (make_train_phase) shared with the
+    # AOT contract registry, so the program `sheeprl.py lint --aot` lowers is
+    # the program this loop runs. out_shardings pins the state outputs on
+    # multi-device meshes — see make_train_phase's donation note.
+    from sheeprl_tpu.parallel.sharding import build_state_shardings
+
+    _state_shardings = build_state_shardings(fabric, params, opt_state)
+    _train_jit_kwargs = (
+        {"out_shardings": tuple(_state_shardings)} if _state_shardings is not None else {}
+    )
+    train_phase = make_train_phase(agent, cfg, txs, target_entropy, jit_kwargs=_train_jit_kwargs)
 
     if world_size > 1:
         params = fabric.replicate_pytree(params)

@@ -1,0 +1,253 @@
+"""The lint engine: parse the package once, run the rule catalog, apply waivers.
+
+``run_lint`` is the library surface (the tests and ``bench.py``/``fleet`` call
+it); ``lint_main`` is ``python sheeprl.py lint``:
+
+.. code-block:: text
+
+    python sheeprl.py lint                      # human report, exit 0
+    python sheeprl.py lint --fail-on warning    # CI gate: unwaived warning+ fails
+    python sheeprl.py lint --aot                # + the AOT program-contract sweep
+    python sheeprl.py lint --json               # machine-readable report on stdout
+
+The engine itself imports no jax and runs in a few seconds (most of it the
+``cfg-key-unresolved`` rule composing every experiment config); ``--aot``
+builds and lowers every registered fused program (seconds to minutes — the
+same work the tier-1 AOT tests do).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+from sheeprl_tpu.analysis.rules import SEVERITIES, Rule, default_rules
+from sheeprl_tpu.analysis.waivers import apply_waivers, load_waivers
+
+Finding = Dict[str, Any]
+
+_SEVERITY_RANK = {name: i for i, name in enumerate(SEVERITIES)}
+
+
+class SourceModule:
+    """One parsed source file. Parsing is lazy and cached; a file with a syntax
+    error yields a synthetic ``parse-error`` finding instead of crashing the
+    whole lint run."""
+
+    def __init__(self, path: Path, rel: str) -> None:
+        self.path = path
+        self.rel = rel
+        self._source: Optional[str] = None
+        self._tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+
+    @property
+    def source(self) -> str:
+        if self._source is None:
+            self._source = self.path.read_text()
+        return self._source
+
+    @property
+    def tree(self) -> ast.AST:
+        if self._tree is None:
+            try:
+                self._tree = ast.parse(self.source, filename=str(self.path))
+            except SyntaxError as err:
+                self.parse_error = err
+                self._tree = ast.parse("")
+        return self._tree
+
+
+class Package:
+    """The walked package: every ``*.py`` under ``root/sheeprl_tpu`` (or an
+    explicit subtree for fixture tests), indexed by repo-relative path."""
+
+    def __init__(self, root: Path, package_dir: Optional[Path] = None) -> None:
+        self.root = Path(root)
+        package_dir = package_dir or (self.root / "sheeprl_tpu")
+        self.modules: List[SourceModule] = []
+        self._by_rel: Dict[str, SourceModule] = {}
+        if package_dir.is_dir():
+            for path in sorted(package_dir.rglob("*.py")):
+                rel = path.relative_to(self.root).as_posix()
+                module = SourceModule(path, rel)
+                self.modules.append(module)
+                self._by_rel[rel] = module
+
+    def module(self, rel: str) -> Optional[SourceModule]:
+        return self._by_rel.get(rel)
+
+
+def repo_root() -> Path:
+    """The checkout root: the directory holding the ``sheeprl_tpu`` package."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def run_lint(
+    root: Optional[str] = None,
+    rules: Optional[Sequence[Rule]] = None,
+    waivers_path: Optional[str] = None,
+    use_waivers: bool = True,
+) -> Dict[str, Any]:
+    """Walk the package, run the rules, apply the waiver file.
+
+    Returns ``{"findings", "waived", "unused_waivers", "rules_run",
+    "counts"}`` — ``findings`` are the ACTIVE (unwaived) ones, most severe
+    first. Pass ``use_waivers=False`` to see the raw catalog output."""
+    package = Package(Path(root) if root else repo_root())
+    rules = list(rules) if rules is not None else default_rules()
+
+    raw: List[Finding] = []
+    for module in package.modules:
+        module.tree  # force the parse so parse errors surface deterministically
+        if module.parse_error is not None:
+            raw.append(
+                {
+                    "rule": "parse-error",
+                    "severity": "critical",
+                    "file": module.rel,
+                    "line": int(module.parse_error.lineno or 0),
+                    "summary": f"file does not parse: {module.parse_error.msg}",
+                    "suggestion": "fix the syntax error; every other rule skipped this file",
+                }
+            )
+    for rule in rules:
+        raw.extend(rule.run(package))
+
+    waivers = load_waivers(waivers_path) if use_waivers else []
+    active, waived, unused = apply_waivers(raw, waivers)
+    # aot-contract waivers can only match when the AOT sweep runs (lint --aot,
+    # the tier-1 sweep test) — a static-only pass must not misread them as
+    # stale; lint_main's --aot branch judges their staleness instead
+    unused = [w for w in unused if w["rule"] != "aot-contract"]
+    for w in unused:
+        # a stale waiver is itself a finding: it no longer waives anything and
+        # should be deleted (or its rule/file/line corrected)
+        active.append(
+            {
+                "rule": "stale-waiver",
+                "severity": "warning",
+                "file": w["file"],
+                "line": int(w.get("line", 0) or 0),
+                "summary": f"waiver for rule {w['rule']!r} matches no finding "
+                f"(reason was: {w['reason']})",
+                "suggestion": "delete the stale entry from analysis/waivers.toml",
+            }
+        )
+
+    active.sort(key=lambda f: (_SEVERITY_RANK.get(f["severity"], 9), f["file"], f["line"]))
+    counts = {sev: sum(1 for f in active if f["severity"] == sev) for sev in SEVERITIES}
+    return {
+        "findings": active,
+        "waived": waived,
+        "unused_waivers": unused,
+        "rules_run": [r.name for r in rules],
+        "counts": counts,
+    }
+
+
+def lint_summary(report: Dict[str, Any]) -> Dict[str, Any]:
+    """The compact code-health fingerprint ``bench.py`` and the fleet runner
+    attach: {findings, waived, rules_run}."""
+    return {
+        "findings": len(report["findings"]),
+        "waived": len(report["waived"]),
+        "rules_run": list(report["rules_run"]),
+    }
+
+
+def _severity_gate(findings: Sequence[Finding], fail_on: Optional[str]) -> int:
+    if not fail_on:
+        return 0
+    threshold = _SEVERITY_RANK[fail_on]
+    return 1 if any(_SEVERITY_RANK.get(f["severity"], 9) <= threshold for f in findings) else 0
+
+
+def _print_report(report: Dict[str, Any], aot: Optional[Dict[str, Any]]) -> None:
+    findings = report["findings"]
+    print(f"graftlint: {len(report['rules_run'])} rules over the package", end="")
+    if aot is not None:
+        print(f" + AOT sweep over {aot['programs']} registered programs", end="")
+    print()
+    for f in findings:
+        loc = f"{f['file']}:{f['line']}" if f.get("line") else f["file"]
+        print(f"  [{f['severity']:>8}] {f['rule']}: {loc}")
+        print(f"             {f['summary']}")
+        if f.get("suggestion"):
+            print(f"             -> {f['suggestion']}")
+    waived = report["waived"]
+    if waived:
+        print(f"  ({len(waived)} finding(s) waived by analysis/waivers.toml)")
+    if not findings:
+        print("  no unwaived findings")
+    counts = ", ".join(f"{v} {k}" for k, v in report["counts"].items() if v)
+    print(f"graftlint: {len(findings)} finding(s){' (' + counts + ')' if counts else ''}")
+
+
+def lint_main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py lint [--aot] [--json] [--fail-on warning|critical]
+    [--no-waivers]``."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="sheeprl.py lint",
+        description="JAX-aware static analysis + AOT program-contract gate "
+        "(howto/static_analysis.md)",
+    )
+    parser.add_argument(
+        "--aot",
+        action="store_true",
+        help="also run the AOT contract sweep over every registered fused program "
+        "(lowers each for cpu+tpu on the host mesh; needs jax)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable report on stdout")
+    parser.add_argument(
+        "--fail-on",
+        choices=["warning", "critical"],
+        default=None,
+        help="exit 1 when any unwaived finding at (or above) this severity exists",
+    )
+    parser.add_argument(
+        "--no-waivers", action="store_true", help="ignore analysis/waivers.toml (raw catalog output)"
+    )
+    args = parser.parse_args(list(argv) if argv is not None else sys.argv[1:])
+
+    report = run_lint(use_waivers=not args.no_waivers)
+    aot_summary: Optional[Dict[str, Any]] = None
+    if args.aot:
+        from sheeprl_tpu.analysis.programs import aot_sweep
+
+        aot_findings, programs_run = aot_sweep()
+        waivers = [] if args.no_waivers else load_waivers()
+        active, waived, unused = apply_waivers(aot_findings, waivers)
+        # only NOW can an aot-contract waiver's staleness be judged (run_lint
+        # deliberately skipped them — they cannot match static findings)
+        for w in unused:
+            if w["rule"] == "aot-contract":
+                active.append(
+                    {
+                        "rule": "stale-waiver",
+                        "severity": "warning",
+                        "file": w["file"],
+                        "line": int(w.get("line", 0) or 0),
+                        "summary": f"waiver for rule {w['rule']!r} matches no finding "
+                        f"(reason was: {w['reason']})",
+                        "suggestion": "delete the stale entry from analysis/waivers.toml",
+                    }
+                )
+        report["findings"].extend(active)
+        report["waived"].extend(waived)
+        for f in active:
+            report["counts"][f["severity"]] = report["counts"].get(f["severity"], 0) + 1
+        report["rules_run"].append("aot-contract")
+        aot_summary = {"programs": programs_run, "violations": len(active)}
+        report["aot"] = aot_summary
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        _print_report(report, aot_summary)
+    return _severity_gate(report["findings"], args.fail_on)

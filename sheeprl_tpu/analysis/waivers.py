@@ -1,0 +1,139 @@
+"""Waiver file for the lint gate (``sheeprl_tpu/analysis/waivers.toml``).
+
+The gate's contract is ZERO unwaived findings: a finding that is a deliberate,
+understood exception gets a checked-in waiver **with a reason** instead of a
+silent rule carve-out — so every exception is visible in review and re-audited
+whenever the file churns. Format (a small TOML subset — this image's Python is
+3.10, no ``tomllib``, and no third-party toml parser is installed):
+
+.. code-block:: toml
+
+    [[waiver]]
+    rule = "host-sync-in-jit"           # required: the rule name
+    file = "sheeprl_tpu/algos/x.py"     # required: finding's repo-relative file
+    line = 123                          # optional: pin to a line (omit = whole file)
+    reason = "why this is deliberate"   # required, non-empty
+
+The parser accepts exactly what the file needs: ``[[waiver]]`` array-of-table
+headers, ``key = "string" | integer | true/false`` pairs, and ``#`` comments.
+Anything else is a hard error — a malformed waiver must never silently waive
+nothing (or everything).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["WaiverError", "load_waivers", "match_waiver", "apply_waivers"]
+
+DEFAULT_WAIVERS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "waivers.toml")
+
+_KV_RE = re.compile(r"^([A-Za-z_][A-Za-z0-9_]*)\s*=\s*(.+)$")
+
+
+class WaiverError(ValueError):
+    pass
+
+
+def _parse_value(raw: str, where: str) -> Any:
+    raw = raw.strip()
+    if raw.startswith('"'):
+        if not raw.endswith('"') or len(raw) < 2:
+            raise WaiverError(f"{where}: unterminated string {raw!r}")
+        body = raw[1:-1]
+        if '"' in body:
+            raise WaiverError(f"{where}: embedded quotes are not supported: {raw!r}")
+        return body
+    if raw in ("true", "false"):
+        return raw == "true"
+    if re.fullmatch(r"[+-]?\d+", raw):
+        return int(raw)
+    raise WaiverError(f"{where}: unsupported value {raw!r} (use a quoted string or an integer)")
+
+
+def parse_waivers_toml(text: str, path: str = "<waivers>") -> List[Dict[str, Any]]:
+    waivers: List[Dict[str, Any]] = []
+    current: Optional[Dict[str, Any]] = None
+    for lineno, raw_line in enumerate(text.splitlines(), start=1):
+        line = raw_line.strip()
+        if "#" in line:
+            # comments: full-line, or trailing after a value (never inside the
+            # one-double-quote-delimited strings this subset allows... unless the
+            # string itself contains '#', which _parse_value would then reject)
+            head = line.split("#", 1)[0].rstrip()
+            if head or not line.startswith("#"):
+                line = head
+            else:
+                continue
+        if not line:
+            continue
+        where = f"{path}:{lineno}"
+        if line == "[[waiver]]":
+            current = {}
+            waivers.append(current)
+            continue
+        if line.startswith("["):
+            raise WaiverError(f"{where}: only [[waiver]] tables are supported, got {line!r}")
+        m = _KV_RE.match(line)
+        if m is None:
+            raise WaiverError(f"{where}: cannot parse line {raw_line!r}")
+        if current is None:
+            raise WaiverError(f"{where}: key/value pair outside a [[waiver]] table")
+        current[m.group(1)] = _parse_value(m.group(2), where)
+    for i, w in enumerate(waivers):
+        for required in ("rule", "file", "reason"):
+            if not isinstance(w.get(required), str) or not w[required].strip():
+                raise WaiverError(
+                    f"{path}: waiver #{i + 1} needs a non-empty string {required!r} "
+                    "(every waiver must name its rule, its file, and carry a reason)"
+                )
+        if "line" in w and not isinstance(w["line"], int):
+            raise WaiverError(f"{path}: waiver #{i + 1} 'line' must be an integer")
+        unknown = set(w) - {"rule", "file", "line", "reason"}
+        if unknown:
+            raise WaiverError(f"{path}: waiver #{i + 1} has unknown keys {sorted(unknown)}")
+    return waivers
+
+
+def load_waivers(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Parse the waiver file (the checked-in default when ``path`` is None).
+    A missing file is an empty waiver list, not an error."""
+    path = path or DEFAULT_WAIVERS_PATH
+    if not os.path.isfile(path):
+        return []
+    with open(path) as f:
+        return parse_waivers_toml(f.read(), path=path)
+
+
+def match_waiver(finding: Dict[str, Any], waivers: Sequence[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    for w in waivers:
+        if w["rule"] != finding.get("rule") or w["file"] != finding.get("file"):
+            continue
+        if "line" in w and w["line"] != finding.get("line"):
+            continue
+        return w
+    return None
+
+
+def apply_waivers(
+    findings: Sequence[Dict[str, Any]], waivers: Sequence[Dict[str, Any]]
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """Split ``findings`` into (active, waived) and report unused waivers.
+
+    Waived findings carry their waiver's reason under ``waived_reason``. Unused
+    waivers (matching nothing) are returned so the gate can flag stale entries —
+    a waiver that outlived its finding should be deleted, not accumulated."""
+    active: List[Dict[str, Any]] = []
+    waived: List[Dict[str, Any]] = []
+    used: set = set()
+    for finding in findings:
+        w = match_waiver(finding, waivers)
+        if w is None:
+            active.append(dict(finding))
+        else:
+            used.add(id(w))
+            waived.append({**finding, "waived_reason": w["reason"]})
+    unused = [w for w in waivers if id(w) not in used]
+    return active, waived, unused

@@ -555,6 +555,23 @@ def fault_matrix(args: Optional[Sequence[str]] = None) -> int:
     return subprocess.call(cmd, env=env, cwd=repo_root)
 
 
+def lint(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py lint [--aot] [--json] [--fail-on warning|critical]``
+    — the JAX-aware static-analysis gate (howto/static_analysis.md): ~8 AST
+    rules codifying the repo's known JAX/TPU hazard classes (global
+    ``jax.devices()`` views, ungated ``platform_dependent`` TPU branches,
+    unpinned Pallas dot precisions, host views feeding donated programs,
+    host syncs inside jitted programs, unregistered telemetry events,
+    training-loop hook completeness, config/code key drift), plus — with
+    ``--aot`` — the fused-program contract sweep: every registered donated
+    program is lowered for cpu+tpu off-chip and its donation/no-host-callback/
+    collective contract asserted. Exceptions live in ``analysis/waivers.toml``,
+    each with a reason; the gate holds at zero unwaived findings."""
+    from sheeprl_tpu.analysis.engine import lint_main
+
+    return lint_main(list(args if args is not None else sys.argv[1:]))
+
+
 def fleet(args: Optional[Sequence[str]] = None) -> int:
     """``python sheeprl.py fleet <spec.yaml>`` — schedule N member runs (seed/env
     sweeps) as one fleet: per-member bounded-restart supervision (resume strictly

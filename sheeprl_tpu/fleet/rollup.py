@@ -177,6 +177,21 @@ def build_leaderboard(
         "members": entries,
         "gate": {"fail_on": fail_on, "failed": bool(reasons), "reasons": reasons},
     }
+    # the fleet runner writes its startup `lint --json` report next to the
+    # members; surface the code-health fingerprint in the rollup so cross-fleet
+    # comparisons see which rule catalog the sweep's code passed
+    lint_path = os.path.join(fleet_dir, "lint.json")
+    if os.path.isfile(lint_path):
+        try:
+            with open(lint_path) as fh:
+                lint_report = json.load(fh)
+            leaderboard["lint"] = {
+                "findings": len(lint_report.get("findings") or []),
+                "waived": len(lint_report.get("waived") or []),
+                "rules_run": lint_report.get("rules_run") or [],
+            }
+        except (OSError, ValueError):
+            pass
     path = os.path.join(fleet_dir, "leaderboard.json")
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:

@@ -29,6 +29,7 @@ the parent's signal to wind down, not to relaunch.
 
 from __future__ import annotations
 
+import json
 import os
 import signal as _signal
 import subprocess
@@ -173,6 +174,21 @@ def run_fleet(
         max_parallel=parallel,
         compile_cache=member_env.get("SHEEPRL_JAX_CACHE") if spec["compile_cache"] else None,
     )
+
+    # code-health fingerprint for the whole sweep: one `lint --json` at startup
+    # into the fleet dir (static rules only — the AOT sweep is a test/CI gate,
+    # not a per-fleet cost), so leaderboard rollups record exactly which rule
+    # catalog the fleet's code passed and what was waived (howto/static_analysis.md)
+    try:
+        from sheeprl_tpu.analysis.engine import lint_summary, run_lint
+
+        lint_report = run_lint()
+        with open(os.path.join(fleet_dir, "lint.json"), "w") as fh:
+            json.dump(lint_report, fh, indent=2)
+            fh.write("\n")
+        emit("fleet", status="lint", **lint_summary(lint_report))
+    except Exception as exc:  # noqa: BLE001 — lint must never take the fleet down
+        emit("fleet", status="lint", error=repr(exc)[:300])
 
     handler_installed = signals.install_preemption_handler()
 

@@ -166,3 +166,62 @@ class SlotTable:
         """The (step, attach) jitted callables for AOT lowering/priming — the
         TPU-readiness tests lower exactly what serving runs."""
         return self._step, self._attach
+
+
+# ---- AOT contract registration (sheeprl_tpu/analysis/programs.py) -------------
+# The serving acceptance gate as registry entries: the donated step program
+# (slot-state aliasing in MLIR, input_output_alias in optimized HLO, no host
+# callbacks — steady-state serving moves only obs in / actions out) and the
+# fixed-shape attach program, built over a deterministic toy recurrent policy.
+
+from sheeprl_tpu.analysis.programs import register_fused_program  # noqa: E402
+
+
+def _aot_table() -> "SlotTable":
+    from sheeprl_tpu.serve.policy import ObsSpec, ServePolicy
+
+    params = {"w": jnp.ones((3,))}
+
+    def init_slot(params, key):
+        return {"acc": jnp.zeros((3,)), "key": key}
+
+    def step_slot(params, carry, obs):
+        acc = carry["acc"] + obs["state"].astype(jnp.float32)
+        key, _ = jax.random.split(carry["key"])
+        return (acc * params["w"]).sum(), {"acc": acc, "key": key}
+
+    policy = ServePolicy(
+        algo="counter",
+        params=params,
+        init_slot=init_slot,
+        step_slot=step_slot,
+        obs_spec={"state": ObsSpec((3,), np.float32)},
+        action_shape=(),
+    )
+    return SlotTable(policy, 4)
+
+
+@register_fused_program(
+    "serve.slot_step",
+    compile_on_cpu=True,
+    doc="donated fixed-shape serving tick over the device-resident slot table",
+)
+def _aot_slot_step():
+    table = _aot_table()
+    step, _attach = table.aot_programs()
+    obs = {"state": np.zeros((table.num_slots, 3), np.float32)}
+    mask = np.zeros((table.num_slots,), np.bool_)
+    return step, (table.policy.params, table.states, obs, mask)
+
+
+@register_fused_program(
+    "serve.slot_attach",
+    compile_on_cpu=True,
+    doc="donated fixed-shape session-admission program (masked carry init)",
+)
+def _aot_slot_attach():
+    table = _aot_table()
+    _step, attach = table.aot_programs()
+    keys = table._slot_keys([0] * table.num_slots)
+    mask = np.zeros((table.num_slots,), np.bool_)
+    return attach, (table.policy.params, table.states, keys, mask)

@@ -208,7 +208,11 @@ def measure_mfu(
 
     if device is None:
         leaves = jax.tree_util.tree_leaves(out)
-        device = next(iter(leaves[0].devices())) if leaves else jax.devices()[0]
+        # local_devices, not jax.devices(): the global list spans every process
+        # of a multi-process run, so index 0 may be ANOTHER process's chip — a
+        # non-rank-0 caller must fall back to a device it actually owns
+        # (graftlint jax-devices-global-view)
+        device = next(iter(leaves[0].devices())) if leaves else jax.local_devices()[0]
     peak = peak_flops(device)
     flops_per_sec = (flops / step_seconds) if flops else None
     return {

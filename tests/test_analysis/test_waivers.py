@@ -1,0 +1,141 @@
+"""Waiver mechanics: the gate's exceptions are checked-in, reasoned, and can
+never silently rot (stale waivers become findings themselves)."""
+
+from __future__ import annotations
+
+import textwrap
+
+import pytest
+
+from sheeprl_tpu.analysis.engine import run_lint
+from sheeprl_tpu.analysis.rules import JaxDevicesRule
+from sheeprl_tpu.analysis.waivers import (
+    WaiverError,
+    apply_waivers,
+    load_waivers,
+    parse_waivers_toml,
+)
+
+pytestmark = pytest.mark.lint
+
+
+def _waiver_file(tmp_path, text):
+    path = tmp_path / "waivers.toml"
+    path.write_text(textwrap.dedent(text))
+    return str(path)
+
+
+def test_parse_roundtrip():
+    waivers = parse_waivers_toml(
+        textwrap.dedent(
+            """
+            # header comment
+            [[waiver]]
+            rule = "host-sync-in-jit"
+            file = "sheeprl_tpu/x.py"
+            line = 12  # trailing comment
+            reason = "trace-time constant"
+
+            [[waiver]]
+            rule = "jax-devices-global-view"
+            file = "sheeprl_tpu/y.py"
+            reason = "single-process tool"
+            """
+        )
+    )
+    assert len(waivers) == 2
+    assert waivers[0] == {
+        "rule": "host-sync-in-jit",
+        "file": "sheeprl_tpu/x.py",
+        "line": 12,
+        "reason": "trace-time constant",
+    }
+    assert "line" not in waivers[1]
+
+
+@pytest.mark.parametrize(
+    "text, match",
+    [
+        ('[[waiver]]\nrule = "r"\nfile = "f"\n', "reason"),  # reason required
+        ('[[waiver]]\nrule = "r"\nfile = "f"\nreason = ""\n', "reason"),  # non-empty
+        ('rule = "r"\n', "outside"),  # kv before any table
+        ('[waiver]\nrule = "r"\n', "only \\[\\[waiver\\]\\]"),
+        ('[[waiver]]\nrule = "r"\nfile = "f"\nreason = "ok"\nline = "12"\n', "integer"),
+        ('[[waiver]]\nrule = "r"\nfile = "f"\nreason = "ok"\nextra = "x"\n', "unknown keys"),
+    ],
+)
+def test_malformed_waivers_are_hard_errors(text, match):
+    with pytest.raises(WaiverError, match=match):
+        parse_waivers_toml(text)
+
+
+def test_apply_waivers_splits_and_reports_stale():
+    findings = [
+        {"rule": "r1", "file": "f1", "line": 3, "summary": "s"},
+        {"rule": "r1", "file": "f2", "line": 9, "summary": "s"},
+    ]
+    waivers = [
+        {"rule": "r1", "file": "f1", "reason": "deliberate"},  # no line: whole file
+        {"rule": "r9", "file": "nowhere", "reason": "stale"},
+    ]
+    active, waived, unused = apply_waivers(findings, waivers)
+    assert [f["file"] for f in active] == ["f2"]
+    assert waived[0]["waived_reason"] == "deliberate"
+    assert unused == [waivers[1]]
+
+
+def test_line_pinned_waiver_only_matches_that_line():
+    findings = [{"rule": "r", "file": "f", "line": 3, "summary": "s"}]
+    active, waived, _ = apply_waivers(findings, [{"rule": "r", "file": "f", "line": 4, "reason": "x"}])
+    assert len(active) == 1 and waived == []
+
+
+def test_run_lint_applies_waiver_file_and_flags_stale(tmp_path):
+    pkg = tmp_path / "sheeprl_tpu" / "utils"
+    pkg.mkdir(parents=True)
+    (pkg / "x.py").write_text("import jax\nd = jax.devices()[0]\n")
+    waivers = _waiver_file(
+        tmp_path,
+        """
+        [[waiver]]
+        rule = "jax-devices-global-view"
+        file = "sheeprl_tpu/utils/x.py"
+        reason = "fixture: deliberate global view"
+
+        [[waiver]]
+        rule = "jax-devices-global-view"
+        file = "sheeprl_tpu/utils/gone.py"
+        reason = "points at a deleted file"
+        """,
+    )
+    report = run_lint(root=str(tmp_path), rules=[JaxDevicesRule()], waivers_path=waivers)
+    # the real finding is waived; the dead entry surfaces as stale-waiver
+    assert [f["rule"] for f in report["findings"]] == ["stale-waiver"]
+    assert len(report["waived"]) == 1
+    assert report["waived"][0]["waived_reason"] == "fixture: deliberate global view"
+
+
+def test_aot_contract_waivers_are_not_stale_in_a_static_run(tmp_path):
+    # an aot-contract waiver can only match when the AOT sweep runs — the
+    # static pass must not flag it stale (lint --aot judges it instead)
+    (tmp_path / "sheeprl_tpu").mkdir()
+    waivers = _waiver_file(
+        tmp_path,
+        """
+        [[waiver]]
+        rule = "aot-contract"
+        file = "sheeprl_tpu/algos/x.py"
+        reason = "known contract exception, only visible under --aot"
+        """,
+    )
+    report = run_lint(root=str(tmp_path), rules=[], waivers_path=waivers)
+    assert report["findings"] == []
+
+
+def test_missing_waiver_file_is_empty(tmp_path):
+    assert load_waivers(str(tmp_path / "absent.toml")) == []
+
+
+def test_checked_in_waiver_file_parses_and_every_entry_has_a_reason():
+    for waiver in load_waivers():
+        assert waiver["reason"].strip()

@@ -79,6 +79,10 @@ def test_fleet_completes_and_gate_green(fleet_run):
     # ranked: every member has a rank and the rank metric populated
     assert [m["rank"] for m in lb["members"]] == [1, 2]
     assert all(isinstance((m["summary"] or {}).get("sps"), (int, float)) for m in lb["members"])
+    # code-health fingerprint: the runner's startup `lint --json` pass landed in
+    # the fleet dir and the rollup surfaced its summary (howto/static_analysis.md)
+    assert os.path.isfile(os.path.join(fleet_run["dir"], "lint.json"))
+    assert lb["lint"]["findings"] == 0 and len(lb["lint"]["rules_run"]) >= 8
 
 
 def test_shared_cache_second_member_cold_compiles_zero(fleet_run):

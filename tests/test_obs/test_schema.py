@@ -95,17 +95,23 @@ def test_resilience_lifecycle_events_validate():
 def test_every_emitted_event_type_is_registered():
     """Census gate: any `emit*("<type>", ...)` call site in the package must
     name a registered event type — a new producer cannot ship an event the
-    validator would reject (or, worse, that consumers silently ignore)."""
-    import glob
-    import re
+    validator would reject (or, worse, that consumers silently ignore).
 
-    from sheeprl_tpu.obs import schema
+    Driven by the graftlint rule engine (the PR 11 grep census promoted to
+    ``sheeprl_tpu/analysis/rules.py::TelemetryEventSchemaRule``), so this test
+    and ``sheeprl.py lint`` are the SAME checker and cannot drift: both the
+    rule's finding list and its shared emit-site walker are asserted here."""
+    from sheeprl_tpu.analysis.engine import Package, repo_root
+    from sheeprl_tpu.analysis.rules import TelemetryEventSchemaRule
 
-    registered = set(schema._STRICT_EVENTS) | set(schema._OPEN_EVENTS)
-    pattern = re.compile(r'(?:\bemit|\bemit_event|\b_emit)\(\s*\n?\s*"([a-z_]+)"')
-    emitted = set()
-    for path in glob.glob(os.path.join(_REPO, "sheeprl_tpu", "**", "*.py"), recursive=True):
-        emitted.update(pattern.findall(open(path).read()))
-    assert emitted, "the census regex matched nothing — producers moved?"
-    unregistered = sorted(emitted - registered)
-    assert unregistered == [], f"emitted but not in obs/schema.py: {unregistered}"
+    package = Package(repo_root())
+    rule = TelemetryEventSchemaRule()
+    # the AST walker actually found the producers (regex-era sanity check kept)
+    sites = rule.emitted_events(package)
+    assert sites, "the emit-site walker matched nothing — producers moved?"
+    registered = rule.registered_names(package)
+    assert registered and {"start", "window", "summary"} <= registered
+    findings = list(rule.run(package))
+    assert findings == [], "emitted but not in obs/schema.py: " + ", ".join(
+        f"{f['file']}:{f['line']} {f['summary']}" for f in findings
+    )

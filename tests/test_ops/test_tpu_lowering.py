@@ -1,53 +1,56 @@
 """TPU-readiness AOT lowering tests (ROADMAP item 5 off-chip prep).
 
-Every ``jax.lax.platform_dependent`` branch in the tree must produce a VALID
-TPU lowering path — verified here WITHOUT a TPU and without executing anything:
-``jax.jit(fn).trace(args).lower(lowering_platforms=("tpu",))`` runs the full
-jaxpr→StableHLO pipeline for the TPU platform on the CPU mesh (the Pallas GRU
-kernel lowers through Mosaic to a ``tpu_custom_call``). A branch that only ever
-lowered on CPU could hide a TPU-side trace error until the first paid chip
-window; these tests pin the lowering path per platform:
+The per-platform lowering assertions this file used to hand-write (Pallas GRU
+step / dispatch / gradients, conv + deconv gates, for cpu and tpu alike) now
+run as the fused-program registry sweep — ``sheeprl_tpu/ops/aot.py`` registers
+the programs, ``tests/test_analysis/test_aot_contracts.py`` (and ``python
+sheeprl.py lint --aot``) lowers and asserts each contract. What stays HERE is
+what the registry deliberately does not encode:
 
-- the fused Pallas LayerNorm-GRU step (``ops/gru.py``) lowers for TPU with the
-  Mosaic custom call present, and the ``platform_dependent`` dispatch the
-  models build (tpu=Pallas / default=XLA reference) lowers for BOTH platforms
-  in one multi-platform lowering;
-- the s2d fast-conv gate (``ops/conv.py`` ``FastConv2x``: cpu=s2d decomposition
-  / default=native) and the im2col/phase deconv gate (``ops/deconv.py``) lower
-  for TPU (native path) and CPU (decomposed path) alike;
-- gradients THROUGH the dispatch lower for TPU too (the train programs
-  differentiate these ops).
+- the matmul-precision parametrization: Mosaic only lowers DEFAULT/HIGHEST
+  dots, and the repo's DEFAULT CONFIG is "high" (bf16_3x) — an unpinned kernel
+  dot inherited it and failed to lower for TPU at all (the bug this suite
+  caught; the kernel now pins its own precision, and the graftlint
+  ``pallas-dot-precision`` rule polices new kernels);
+- the KNOWN-limitation NEGATIVE: ``platform_dependent`` lowers EVERY branch
+  for every requested platform, so a CPU lowering of the Pallas dispatch must
+  FAIL — which is exactly why models.py gates the dispatch on
+  ``jax.default_backend()`` (the graftlint ``platform-dependent-ungated`` rule)
+  and why the ``ops.gru_platform_dispatch`` registry entry is tpu-only;
+- the lower-only contract: the suite (and the sweep) must never backend-compile
+  the TPU programs on a real chip's clock.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from sheeprl_tpu import ops
-from sheeprl_tpu.ops.conv import FastConv2x
-from sheeprl_tpu.ops.deconv import FusedConvTranspose4x4S2
+from sheeprl_tpu.analysis.programs import FUSED_PROGRAMS, ensure_registry
+from sheeprl_tpu.ops.aot import _gru_args
+
+ensure_registry()
 
 
 def _lower(fn, *args, platforms=("tpu",)):
     return jax.jit(fn).trace(*args).lower(lowering_platforms=tuple(platforms))
 
 
-def _gru_args(B=16, K=128, H=128):
-    return (
-        jnp.ones((B, K), jnp.float32),
-        jnp.ones((B, H), jnp.float32),
-        jnp.ones((K, 3 * H), jnp.float32),
-        jnp.ones((3 * H,), jnp.float32),
-        jnp.ones((3 * H,), jnp.float32),
-        jnp.ones((3 * H,), jnp.float32),
-    )
+def test_ops_lowering_contracts_are_registered():
+    """The registry sweep covers every program this file used to lower by hand
+    — pin the entries and the contracts so the sweep can never lose them."""
+    for name in ("ops.gru_pallas_step", "ops.gru_platform_dispatch", "ops.gru_step_grad"):
+        spec = FUSED_PROGRAMS[name]
+        assert spec.contract.platforms == ("tpu",)
+        assert "tpu_custom_call" in spec.contract.allow_custom_calls
+    for name in ("ops.fast_conv", "ops.fast_conv_grad", "ops.fast_deconv"):
+        assert set(FUSED_PROGRAMS[name].contract.platforms) == {"cpu", "tpu"}
 
 
 @pytest.mark.parametrize("matmul_precision", ["default", "high", "highest"])
-def test_pallas_gru_lowers_for_tpu_with_mosaic_kernel(matmul_precision):
+def test_pallas_gru_lowers_for_tpu_under_every_precision_config(matmul_precision):
     # parametrized over the global matmul-precision knob: Mosaic only lowers
     # DEFAULT/HIGHEST dots, and the repo's DEFAULT CONFIG is "high" (bf16_3x) —
     # an unpinned kernel dot inherited it and failed to lower for TPU at all
@@ -57,24 +60,7 @@ def test_pallas_gru_lowers_for_tpu_with_mosaic_kernel(matmul_precision):
 
     with jax.default_matmul_precision(matmul_precision):
         lowered = _lower(step, *_gru_args())
-    mlir = lowered.as_text()
-    assert "tpu_custom_call" in mlir, "the Pallas GRU must lower to a Mosaic custom call"
-
-
-def _gru_dispatch(inp, hx, w, b, scale, bias):
-    # the exact dispatch LayerNormGRUCell builds on a TPU process: the tpu
-    # branch is the Pallas kernel, every other platform the XLA reference
-    return jax.lax.platform_dependent(
-        tpu=lambda: ops.fused_ln_gru_step(inp, hx, w, b, scale, bias, eps=1e-3),
-        default=lambda: ops.ln_gru_step_reference(inp, hx, w, b, scale, bias, eps=1e-3),
-    )
-
-
-def test_gru_platform_dispatch_lowers_for_tpu():
-    lowered = _lower(_gru_dispatch, *_gru_args(), platforms=("tpu",))
-    # the TPU lowering carries the Mosaic kernel; the default branch (reference
-    # math) lowers for TPU too, so the whole dispatch is TPU-valid
-    assert "tpu_custom_call" in lowered.as_text()
+    assert "tpu_custom_call" in lowered.as_text(), "the Pallas GRU must lower to a Mosaic custom call"
 
 
 def test_gru_dispatch_cpu_lowering_needs_the_backend_gate():
@@ -82,33 +68,11 @@ def test_gru_dispatch_cpu_lowering_needs_the_backend_gate():
     # EVERY branch for every requested platform, and the Pallas TPU kernel
     # refuses a CPU lowering — which is exactly why LayerNormGRUCell only
     # builds the dispatch when the process backend is TPU. If this ever starts
-    # passing, that gate (and SHEEPRL_DISABLE_PALLAS) can be retired.
+    # passing, that gate (and SHEEPRL_DISABLE_PALLAS) can be retired — and the
+    # ops.gru_platform_dispatch registry entry can widen to ("cpu", "tpu").
+    fn, args = FUSED_PROGRAMS["ops.gru_platform_dispatch"].builder()
     with pytest.raises(Exception, match="interpret mode"):
-        _lower(_gru_dispatch, *_gru_args(), platforms=("cpu",))
-
-
-def test_gru_dispatch_gradient_lowers_for_tpu():
-    args = _gru_args()
-
-    def loss(w):
-        inp, hx, _, b, scale, bias = args
-        return ops.fused_ln_gru_step(inp, hx, w, b, scale, bias, eps=1e-3).sum()
-
-    # the custom-VJP backward recomputes in reference math — the property that
-    # matters is that the WHOLE gradient program lowers cleanly for TPU
-    lowered = _lower(jax.grad(loss), args[2])
-    assert "stablehlo" in lowered.as_text()
-
-
-@pytest.mark.parametrize("platforms", [("tpu",), ("cpu",), ("cpu", "tpu")])
-def test_fast_conv_gate_lowers_per_platform(platforms):
-    module = FastConv2x(features=8, kernel_size=4, max_fast_cin=8)
-    x = jnp.ones((2, 16, 16, 3), jnp.float32)
-    params = module.init(jax.random.PRNGKey(0), x)
-
-    lowered = _lower(lambda p, x: module.apply(p, x), params, x, platforms=platforms)
-    hlo = lowered.as_text()
-    assert "convolution" in hlo  # some conv reached the lowering on every path
+        fn.trace(*args).lower(lowering_platforms=("cpu",))
 
 
 def test_fast_conv_tpu_lowering_carries_both_branches():
@@ -117,33 +81,9 @@ def test_fast_conv_tpu_lowering_carries_both_branches():
     # BOTH the s2d decomposition's conv and the native conv — and the test's
     # point is that the s2d branch is TPU-lowerable at all (valid StableHLO),
     # so the gate can never trip a trace error on a real chip
-    module = FastConv2x(features=8, kernel_size=4, max_fast_cin=8)
-    x = jnp.ones((2, 16, 16, 3), jnp.float32)
-    params = module.init(jax.random.PRNGKey(0), x)
-    fn = lambda p, x: module.apply(p, x)  # noqa: E731
-    tpu_hlo = _lower(fn, params, x, platforms=("tpu",)).as_text()
+    fn, args = FUSED_PROGRAMS["ops.fast_conv"].builder()
+    tpu_hlo = fn.trace(*args).lower(lowering_platforms=("tpu",)).as_text()
     assert tpu_hlo.count("stablehlo.convolution") >= 2, "both conv branches must lower"
-
-
-@pytest.mark.parametrize("platforms", [("tpu",), ("cpu",), ("cpu", "tpu")])
-def test_fast_deconv_gate_lowers_per_platform(platforms):
-    module = FusedConvTranspose4x4S2(features=6)
-    x = jnp.ones((2, 8, 8, 4), jnp.float32)
-    params = module.init(jax.random.PRNGKey(0), x)
-    lowered = _lower(lambda p, x: module.apply(p, x), params, x, platforms=platforms)
-    assert "convolution" in lowered.as_text()
-
-
-def test_fast_conv_gradient_lowers_for_tpu():
-    module = FastConv2x(features=8, kernel_size=4, max_fast_cin=8)
-    x = jnp.ones((2, 16, 16, 3), jnp.float32)
-    params = module.init(jax.random.PRNGKey(0), x)
-
-    def loss(p):
-        return module.apply(p, x).sum()
-
-    lowered = _lower(jax.grad(loss), params)
-    assert "convolution" in lowered.as_text()
 
 
 def test_tpu_lowering_compiles_nothing(monkeypatch):

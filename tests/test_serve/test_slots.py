@@ -98,30 +98,28 @@ def test_attach_and_step_never_recompile():
 
 
 def test_step_program_donates_and_has_no_host_calls():
-    """The acceptance AOT gate (ISSUE 9): the serving step program donates the
-    slot states (aliasing attr in MLIR, input_output_alias in optimized HLO)
-    and contains no callback/outfeed/infeed custom calls — steady-state serving
-    moves only obs in / actions out."""
-    policy = _counter_policy()
-    table = SlotTable(policy, 4)
-    step, attach = table.aot_programs()
-    obs = {"state": np.zeros((4, 3), np.float32)}
-    mask = np.zeros((4,), np.bool_)
-    for fn, args in (
-        (step, (policy.params, table.states, obs, mask)),
-        (attach, (policy.params, table.states, table._slot_keys([0] * 4), mask)),
-    ):
-        lowered = fn.lower(*abstractify(args))
-        mlir = lowered.as_text()
-        assert ("tf.aliasing_output" in mlir) or ("jax.buffer_donor" in mlir), (
-            "slot-state donation was dropped in lowering"
-        )
-        for marker in ("callback", "outfeed", "infeed", "custom_call_target"):
-            assert marker not in mlir.lower(), f"host-transfer marker {marker!r} in lowering"
-        hlo = lowered.compile().as_text()
-        assert "input_output_alias" in hlo, "XLA dropped the input/output aliasing"
+    """The acceptance AOT gate (ISSUE 9), now run as the fused-program registry
+    sweep (tests/test_analysis/test_aot_contracts.py, ``sheeprl.py lint
+    --aot``): the serving step program donates the slot states (aliasing attr
+    in MLIR, input_output_alias in optimized HLO) and contains no
+    callback/outfeed/infeed custom calls — steady-state serving moves only obs
+    in / actions out. This pins the ``serve.slot_step``/``serve.slot_attach``
+    registrations and their contracts so the sweep can never lose them."""
+    from sheeprl_tpu.analysis.programs import FUSED_PROGRAMS, ensure_registry
+
+    ensure_registry()
+    for name in ("serve.slot_step", "serve.slot_attach"):
+        spec = FUSED_PROGRAMS[name]
+        assert spec.contract.donated and spec.contract.compile_on_cpu
+        assert set(spec.contract.platforms) == {"cpu", "tpu"}
         for marker in ("callback", "outfeed", "infeed"):
-            assert marker not in hlo.lower(), f"host-transfer marker {marker!r} in optimized HLO"
+            assert marker in spec.contract.forbidden
+        # the registered builder programs ARE the table's own aot_programs —
+        # same vmapped policy step, same donated jit (spot-check by lowering
+        # the registered step builder's output once, cheaply)
+    fn, args = FUSED_PROGRAMS["serve.slot_step"].builder()
+    mlir = fn.lower(*abstractify(args)).as_text()
+    assert ("tf.aliasing_output" in mlir) or ("jax.buffer_donor" in mlir)
 
 
 def test_state_bytes_is_o_of_slots():
