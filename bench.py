@@ -269,9 +269,15 @@ def _steady_window_run(args: list, steady_start: int) -> dict:
             events = read_events(telemetry_file)
             summaries = [e for e in events if e.get("event") == "summary"]
             if summaries:
+                # the learning rollup is surfaced as its own conditions.learning
+                # block (below), so it is excluded from the telemetry copy
                 steady["telemetry"] = {
-                    k: v for k, v in summaries[-1].items() if k not in ("event", "time")
+                    k: v
+                    for k, v in summaries[-1].items()
+                    if k not in ("event", "time", "learning")
                 }
+                if summaries[-1].get("learning"):
+                    steady["learning"] = summaries[-1]["learning"]
             # the run's own fingerprint (exact resolved config + live device) —
             # this is what bench-diff matches workloads on
             starts = [e for e in events if e.get("event") == "start"]
@@ -343,13 +349,56 @@ def _steady_ab_result(
         # the diagnose verdicts for the same run: detector findings + the share
         # of steady wall time attributed to named phases (obs/diagnose.py)
         conditions["diagnosis"] = steady["diagnosis"]
-    return {
+    if "learning" in steady:
+        # the run's training-health rollup (grad norms, entropy, episode
+        # returns — obs/telemetry.py learning summary): BENCH JSONs gate on
+        # whether the run LEARNS, not just how fast it steps
+        conditions["learning"] = steady["learning"]
+    result = {
         "metric": metric,
         "value": round(sps, 2),
         "unit": "env-steps/sec (steady-state)",
         "vs_baseline": round(sps / baseline_sps, 3),
         "conditions": conditions,
     }
+    extras = _learning_extras(metric, steady, conditions.get("fingerprint"))
+    if extras:
+        result["extras"] = extras
+    return result
+
+
+def _learning_extras(metric: str, steady: dict, fingerprint) -> list:
+    """Nested gated learning workloads derived from the steady run's learning
+    rollup: episode-return mean (unit "return", higher-is-better) and policy
+    entropy (unit "nats", higher-is-better — bench-diff's direction is pinned
+    by unit, so entropy can never be gated backwards). Each rides the parent's
+    fingerprint so --against matches them like any workload."""
+    learning = steady.get("learning") or {}
+    stats = learning.get("stats") or {}
+    episodes = learning.get("episodes") or {}
+    extras = []
+    cond = {"fingerprint": fingerprint} if fingerprint else {}
+    if isinstance(episodes.get("return_mean"), (int, float)):
+        extras.append(
+            {
+                "metric": f"{metric}_ep_return",
+                "value": round(float(episodes["return_mean"]), 4),
+                "unit": "return (mean episode return, steady run)",
+                "vs_baseline": None,
+                "conditions": dict(cond, episodes=episodes.get("count")),
+            }
+        )
+    if isinstance(stats.get("entropy"), (int, float)):
+        extras.append(
+            {
+                "metric": f"{metric}_entropy",
+                "value": round(float(stats["entropy"]), 4),
+                "unit": "nats (mean policy entropy, steady run)",
+                "vs_baseline": None,
+                "conditions": dict(cond),
+            }
+        )
+    return extras
 
 
 def _bench_dreamer_steady(algo: str = "dreamer_v3") -> dict:
@@ -566,16 +615,20 @@ def _bench_ppo_anakin() -> dict:
             else probe["platform"]
         ),
     }
-    for key in ("telemetry", "fingerprint", "diagnosis"):
+    for key in ("telemetry", "fingerprint", "diagnosis", "learning"):
         if key in steady:
             conditions[key] = steady[key]
-    return {
+    result = {
         "metric": "ppo_anakin_env_steps_per_sec",
         "value": round(sps, 2),
         "unit": "env-steps/sec (steady-state)",
         "vs_baseline": round(sps / baseline_sps, 3),
         "conditions": conditions,
     }
+    extras = _learning_extras("ppo_anakin", steady, conditions.get("fingerprint"))
+    if extras:
+        result["extras"] = extras
+    return result
 
 
 def _bench_dv3_2d_mesh(size: str = "L") -> dict:

@@ -25,7 +25,8 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -159,6 +160,9 @@ def main(fabric, cfg: Dict[str, Any]):
         _, values = agent.apply({"params": params}, obs)
         return values
 
+    # compile the Learn/* stats only when the telemetry learning plane is on
+    learn_on = learn_stats.enabled(cfg)
+
     def loss_fn(params, batch):
         obs = {k: batch[k] for k in obs_keys}
         actor_outs, values = agent.apply({"params": params}, obs)
@@ -167,7 +171,14 @@ def main(fabric, cfg: Dict[str, Any]):
         )
         pg = policy_loss(out["logprob"], batch["advantages"], loss_reduction)
         vl = value_loss(out["values"], batch["returns"], loss_reduction)
-        return pg + vl, (pg, vl)
+        # learn-stats aux (scalars only): value statistics, value residual vs
+        # the GAE return, policy entropy (utils/learn_stats.py)
+        stats = learn_stats.maybe(learn_on, lambda: {
+            **learn_stats.value_stats(jax.lax.stop_gradient(out["values"])),
+            **learn_stats.td_quantiles(jax.lax.stop_gradient(batch["returns"] - out["values"])),
+            **learn_stats.entropy_stats(jax.lax.stop_gradient(out["entropy"])),
+        })
+        return pg + vl, (pg, vl, stats)
 
     # out_shardings pins the state outputs on multi-device meshes — see the
     # ppo make_train_phase note (PR 8 residual; build_state_shardings)
@@ -194,10 +205,24 @@ def main(fabric, cfg: Dict[str, Any]):
         batch = {k: v.reshape(-1, *v.shape[2:]) for k, v in data.items()}
         batch["returns"] = returns.reshape(-1, 1)
         batch["advantages"] = advantages.reshape(-1, 1)
-        grads, (pg, vl) = jax.grad(loss_fn, has_aux=True)(params, batch)
+        grads, (pg, vl, stats) = jax.grad(loss_fn, has_aux=True)(params, batch)
         updates, new_opt_state = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt_state, {"pg": pg, "vl": vl}
+        # the Learn/ keys ride the metrics dict (RunTelemetry.observe_learn
+        # extracts them — utils/learn_stats.py); a2c's tx has no clip transform
+        metrics = {
+            "pg": pg,
+            "vl": vl,
+            **stats,
+            **learn_stats.maybe(learn_on, lambda: {
+                **learn_stats.group_stats(
+                    "policy", grads=grads, updates=updates, params=new_params, opt_state=new_opt_state
+                ),
+                "Learn/loss/policy": pg,
+                "Learn/loss/value": vl,
+            }),
+        }
+        return new_params, new_opt_state, metrics
 
     if world_size > 1:
         params = fabric.replicate_pytree(params)
@@ -263,9 +288,11 @@ def main(fabric, cfg: Dict[str, Any]):
                     ep = ep_info["episode"]
                     mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
                     rews, lens = ep["r"][mask], ep["l"][mask]
-                    if aggregator and not aggregator.disabled and len(rews) > 0:
-                        aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                        aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+                    if len(rews) > 0:
+                        telemetry.observe_episodes(rews, lens)
+                        if aggregator and not aggregator.disabled:
+                            aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                            aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
         next_values = np.asarray(get_values(act_params, obs_host))
@@ -274,9 +301,13 @@ def main(fabric, cfg: Dict[str, Any]):
             data = {k: np.asarray(rb[k]) for k in rb.buffer.keys() if k not in ("returns", "advantages")}
             if world_size > 1:
                 data = jax.device_put(data, fabric.sharding(None, "data"))
+            # one-shot injected learning pathology (resilience.fault=lr_spike):
+            # identity unless the fault armed this iteration
+            params = apply_armed_learn_fault(params)
             params, opt_state, metrics = train_phase(params, opt_state, data, next_values)
             act_params = act.view(params)
             telemetry.observe_train(1, metrics)
+            telemetry.observe_learn(metrics)
             if telemetry.wants_program("train_phase"):
                 telemetry.register_program(
                     "train_phase", train_phase, (params, opt_state, data, next_values), units=1

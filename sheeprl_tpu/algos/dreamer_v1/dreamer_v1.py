@@ -33,7 +33,8 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialRepla
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.mfu import unit_avals
 from sheeprl_tpu.utils.env import make_env
@@ -54,6 +55,15 @@ def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx, state_
     lmbda = float(cfg.algo.lmbda)
     horizon = int(cfg.algo.horizon)
     use_continues = bool(wm_cfg.use_continues)
+    # compile the Learn/* stats only when the telemetry learning plane is on
+    learn_on = learn_stats.enabled(cfg)
+    # static clip thresholds for the learn-stats post-clip norms (the txs chain
+    # clip_by_global_norm with exactly these values — dv3.build_optimizers)
+    clips = {
+        "world_model": float(cfg.algo.world_model.clip_gradients or 0) or None,
+        "actor": float(cfg.algo.actor.clip_gradients or 0) or None,
+        "critic": float(cfg.algo.critic.clip_gradients or 0) or None,
+    }
 
     def world_loss_fn(wm_params, batch, key):
         batch_obs = {k: batch[k] / 255.0 - 0.5 for k in cnn_keys}
@@ -128,7 +138,15 @@ def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx, state_
             )
         )
         policy_loss = -jnp.mean(discount * lambda_values)
-        return policy_loss, (latents, lambda_values, discount)
+        # learn-stats aux (scalars only): imagined-value statistics + the raw
+        # lambda-vs-baseline TD error (dv1's actor has no entropy term)
+        aux_stats = learn_stats.maybe(learn_on, lambda: {
+            **learn_stats.value_stats(jax.lax.stop_gradient(predicted_values)),
+            **learn_stats.td_quantiles(
+                jax.lax.stop_gradient(lambda_values - predicted_values[: lambda_values.shape[0]])
+            ),
+        })
+        return policy_loss, (latents, lambda_values, discount, aux_stats)
 
     def critic_loss_fn(critic_params, latents, lambda_values, discount):
         pred = agent.critic.apply({"params": critic_params}, latents[:-1])
@@ -149,23 +167,23 @@ def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx, state_
         (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
             params["world_model"], batch, k_world
         )
-        updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
-        params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
+        w_updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
+        params = {**params, "world_model": optax.apply_updates(params["world_model"], w_updates)}
         opt_state = {**opt_state, "world_model": new_wopt}
 
-        (a_loss, (latents, lambda_values, discount)), a_grads = jax.value_and_grad(
+        (a_loss, (latents, lambda_values, discount, aux_stats)), a_grads = jax.value_and_grad(
             actor_loss_fn, has_aux=True
         )(params["actor"], params, zs, hs, k_img)
-        updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-        params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+        a_updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], a_updates)}
         opt_state = {**opt_state, "actor": new_aopt}
 
         latents_sg = jax.lax.stop_gradient(latents)
         c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
             params["critic"], latents_sg, lambda_values, discount
         )
-        updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
-        params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+        c_updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
+        params = {**params, "critic": optax.apply_updates(params["critic"], c_updates)}
         opt_state = {**opt_state, "critic": new_copt}
 
         metrics = dict(w_metrics)
@@ -174,6 +192,30 @@ def make_train_phase(agent: DV1Agent, cfg, world_tx, actor_tx, critic_tx, state_
         metrics["Grads/world_model"] = optax.global_norm(w_grads)
         metrics["Grads/actor"] = optax.global_norm(a_grads)
         metrics["Grads/critic"] = optax.global_norm(c_grads)
+        # training-health block, riding the metrics dict (Learn/ prefix —
+        # utils/learn_stats.py; extracted by RunTelemetry.observe_learn)
+        if learn_on:
+            metrics.update(aux_stats)
+            metrics.update(learn_stats.group_stats(
+                "world_model", grads=w_grads, updates=w_updates,
+                params=params["world_model"], opt_state=new_wopt, clip=clips["world_model"],
+            ))
+            metrics.update(learn_stats.group_stats(
+                "actor", grads=a_grads, updates=a_updates,
+                params=params["actor"], opt_state=new_aopt, clip=clips["actor"],
+            ))
+            metrics.update(learn_stats.group_stats(
+                "critic", grads=c_grads, updates=c_updates,
+                params=params["critic"], opt_state=new_copt, clip=clips["critic"],
+            ))
+            metrics.update(learn_stats.kl_stats(
+                w_metrics["State/kl"],
+                w_metrics["State/post_entropy"],
+                w_metrics["State/prior_entropy"],
+            ))
+            metrics["Learn/loss/world_model"] = w_loss
+            metrics["Learn/loss/actor"] = a_loss
+            metrics["Learn/loss/critic"] = c_loss
         return params, opt_state, metrics
 
     def train_phase(params, opt_state, data, train_key):
@@ -439,13 +481,15 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             telemetry.observe_env_restart(int(np.sum(infos["restart_on_exception"])))
 
         ep_info = infos.get("final_info", infos)
-        if cfg.metric.log_level > 0 and "episode" in ep_info:
+        if (cfg.metric.log_level > 0 or telemetry.enabled) and "episode" in ep_info:
             ep = ep_info["episode"]
             mask = ep.get("_r", ep_info.get("_episode", np.ones(num_envs, bool)))
             rews, lens = ep["r"][mask], ep["l"][mask]
-            if aggregator and not aggregator.disabled and len(rews) > 0:
-                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+            if len(rews) > 0:
+                telemetry.observe_episodes(rews, lens)
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                    aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
         final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
@@ -489,6 +533,9 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                 with timer("Time/train_time"):
                     data = sampler.sample(per_rank_gradient_steps)
                     key, train_key = jax.random.split(key)
+                    # one-shot injected learning pathology (resilience.fault=
+                    # lr_spike): identity unless armed this iteration
+                    params = apply_armed_learn_fault(params)
                     params, opt_state, metrics = train_phase(
                         params, opt_state, data, np.asarray(train_key)
                     )
@@ -496,6 +543,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                     train_step += world_size * per_rank_gradient_steps
                     act_params = act.view(params)
                     telemetry.observe_train(per_rank_gradient_steps, metrics)
+                    telemetry.observe_learn(metrics)
                     if telemetry.wants_program("train_step"):
                         batch_avals = unit_avals(data)
                         telemetry.register_program(

@@ -41,7 +41,8 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, 
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.mfu import unit_avals
 from sheeprl_tpu.utils.env import make_env
@@ -66,6 +67,15 @@ def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx, state_
     discrete_size = agent.discrete_size
     target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
     use_continues = bool(wm_cfg.use_continues)
+    # compile the Learn/* stats only when the telemetry learning plane is on
+    learn_on = learn_stats.enabled(cfg)
+    # static clip thresholds for the learn-stats post-clip norms (the txs chain
+    # clip_by_global_norm with exactly these values — dv3.build_optimizers)
+    clips = {
+        "world_model": float(cfg.algo.world_model.clip_gradients or 0) or None,
+        "actor": float(cfg.algo.actor.clip_gradients or 0) or None,
+        "critic": float(cfg.algo.critic.clip_gradients or 0) or None,
+    }
     act_dim = int(np.sum(agent.actions_dim))
 
     def world_loss_fn(wm_params, batch, key):
@@ -155,7 +165,14 @@ def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx, state_
         objective = objective_mix * reinforce + (1 - objective_mix) * dynamics
         entropy = ent_coef * ent[..., None]
         policy_loss = -jnp.mean(discount[:-2] * (objective + entropy))
-        return policy_loss, (latents, lambda_values, discount)
+        # learn-stats aux (scalars only): imagined-value statistics, the raw
+        # lambda-vs-baseline TD error, policy entropy
+        aux_stats = learn_stats.maybe(learn_on, lambda: {
+            **learn_stats.value_stats(jax.lax.stop_gradient(predicted_target_values)),
+            **learn_stats.td_quantiles(jax.lax.stop_gradient(advantage)),
+            **learn_stats.entropy_stats(jax.lax.stop_gradient(ent)),
+        })
+        return policy_loss, (latents, lambda_values, discount, aux_stats)
 
     def critic_loss_fn(critic_params, latents, lambda_values, discount):
         pred = agent.critic.apply({"params": critic_params}, latents[:-1])
@@ -185,24 +202,24 @@ def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx, state_
         (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
             params["world_model"], batch, k_world
         )
-        updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
-        params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
+        w_updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
+        params = {**params, "world_model": optax.apply_updates(params["world_model"], w_updates)}
         opt_state = {**opt_state, "world_model": new_wopt}
 
         true_continue = (1 - batch["terminated"]).reshape(-1, 1)
-        (a_loss, (latents, lambda_values, discount)), a_grads = jax.value_and_grad(
+        (a_loss, (latents, lambda_values, discount, aux_stats)), a_grads = jax.value_and_grad(
             actor_loss_fn, has_aux=True
         )(params["actor"], params, zs, hs, true_continue, k_img)
-        updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-        params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+        a_updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], a_updates)}
         opt_state = {**opt_state, "actor": new_aopt}
 
         latents_sg = jax.lax.stop_gradient(latents)
         c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
             params["critic"], latents_sg, lambda_values, discount
         )
-        updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
-        params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+        c_updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
+        params = {**params, "critic": optax.apply_updates(params["critic"], c_updates)}
         opt_state = {**opt_state, "critic": new_copt}
 
         metrics = dict(w_metrics)
@@ -211,6 +228,30 @@ def make_train_phase(agent: DV2Agent, cfg, world_tx, actor_tx, critic_tx, state_
         metrics["Grads/world_model"] = optax.global_norm(w_grads)
         metrics["Grads/actor"] = optax.global_norm(a_grads)
         metrics["Grads/critic"] = optax.global_norm(c_grads)
+        # training-health block, riding the metrics dict (Learn/ prefix —
+        # utils/learn_stats.py; extracted by RunTelemetry.observe_learn)
+        if learn_on:
+            metrics.update(aux_stats)
+            metrics.update(learn_stats.group_stats(
+                "world_model", grads=w_grads, updates=w_updates,
+                params=params["world_model"], opt_state=new_wopt, clip=clips["world_model"],
+            ))
+            metrics.update(learn_stats.group_stats(
+                "actor", grads=a_grads, updates=a_updates,
+                params=params["actor"], opt_state=new_aopt, clip=clips["actor"],
+            ))
+            metrics.update(learn_stats.group_stats(
+                "critic", grads=c_grads, updates=c_updates,
+                params=params["critic"], opt_state=new_copt, clip=clips["critic"],
+            ))
+            metrics.update(learn_stats.kl_stats(
+                w_metrics["State/kl"],
+                w_metrics["State/post_entropy"],
+                w_metrics["State/prior_entropy"],
+            ))
+            metrics["Learn/loss/world_model"] = w_loss
+            metrics["Learn/loss/actor"] = a_loss
+            metrics["Learn/loss/critic"] = c_loss
         return params, opt_state, metrics
 
     def train_phase(params, opt_state, data, cum_steps, train_key):
@@ -495,13 +536,15 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
             telemetry.observe_env_restart(int(np.sum(infos["restart_on_exception"])))
 
         ep_info = infos.get("final_info", infos)
-        if cfg.metric.log_level > 0 and "episode" in ep_info:
+        if (cfg.metric.log_level > 0 or telemetry.enabled) and "episode" in ep_info:
             ep = ep_info["episode"]
             mask = ep.get("_r", ep_info.get("_episode", np.ones(num_envs, bool)))
             rews, lens = ep["r"][mask], ep["l"][mask]
-            if aggregator and not aggregator.disabled and len(rews) > 0:
-                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+            if len(rews) > 0:
+                telemetry.observe_episodes(rews, lens)
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                    aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
         final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
@@ -545,6 +588,9 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                 with timer("Time/train_time"):
                     data = sampler.sample(per_rank_gradient_steps)
                     key, train_key = jax.random.split(key)
+                    # one-shot injected learning pathology (resilience.fault=
+                    # lr_spike): identity unless armed this iteration
+                    params = apply_armed_learn_fault(params)
                     params, opt_state, metrics = train_phase(
                         params,
                         opt_state,
@@ -556,6 +602,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_actor_params=None):
                     train_step += world_size * per_rank_gradient_steps
                     act_params = act.view(params)
                     telemetry.observe_train(per_rank_gradient_steps, metrics)
+                    telemetry.observe_learn(metrics)
                     if telemetry.wants_program("train_step"):
                         batch_avals = unit_avals(data)
                         telemetry.register_program(

@@ -44,7 +44,8 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialRepla
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.mfu import unit_avals
 from sheeprl_tpu.utils.distribution import (
@@ -107,6 +108,16 @@ def make_train_phase(
         percentile_low=float(cfg.algo.actor.moments.percentile.low),
         percentile_high=float(cfg.algo.actor.moments.percentile.high),
     )
+    # static clip thresholds for the learn-stats post-clip norms (the txs from
+    # build_optimizers chain clip_by_global_norm with exactly these values).
+    # learn_on: compile the Learn/* stats only when the telemetry learning
+    # plane is on — the off path lowers byte-identically to the pre-plane program
+    learn_on = learn_stats.enabled(cfg)
+    clips = {
+        "world_model": float(cfg.algo.world_model.clip_gradients or 0) or None,
+        "actor": float(cfg.algo.actor.clip_gradients or 0) or None,
+        "critic": float(cfg.algo.critic.clip_gradients or 0) or None,
+    }
 
     def world_loss_fn(wm_params, batch, key):
         key, hook_key = jax.random.split(jnp.asarray(key))
@@ -210,7 +221,14 @@ def make_train_phase(
             objective = lp[:-1] * jax.lax.stop_gradient(advantage)
         entropy = ent_coef * ent[..., None]
         policy_loss = -jnp.mean(discount[:-1] * (objective + entropy[:-1]))
-        return policy_loss, (latents, lambda_values, discount, new_moments)
+        # learn-stats aux (scalars only): imagined-value statistics, the raw
+        # (un-normalized) lambda-vs-baseline TD error, policy entropy
+        aux_stats = learn_stats.maybe(learn_on, lambda: {
+            **learn_stats.value_stats(jax.lax.stop_gradient(predicted_values)),
+            **learn_stats.td_quantiles(jax.lax.stop_gradient(lambda_values - baseline)),
+            **learn_stats.entropy_stats(jax.lax.stop_gradient(ent)),
+        })
+        return policy_loss, (latents, lambda_values, discount, new_moments, aux_stats)
 
     def critic_loss_fn(critic_params, target_params, latents, lambda_values, discount):
         qv_logits = agent.critic.apply({"params": critic_params}, latents[:-1])
@@ -256,16 +274,18 @@ def make_train_phase(
         (w_loss, (zs, hs, w_metrics)), w_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
             params["world_model"], batch, k_world
         )
-        updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
-        params = {**params, "world_model": optax.apply_updates(params["world_model"], updates)}
+        w_updates, new_wopt = world_tx.update(w_grads, opt_state["world_model"], params["world_model"])
+        params = {**params, "world_model": optax.apply_updates(params["world_model"], w_updates)}
         opt_state = {**opt_state, "world_model": new_wopt}
 
         true_continue = (1 - batch["terminated"]).reshape(-1, 1)
-        (a_loss, (latents, lambda_values, discount, new_moments)), a_grads = jax.value_and_grad(
-            actor_loss_fn, has_aux=True
-        )(params["actor"], params, zs, hs, true_continue, moments_state, k_img)
-        updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-        params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+        (a_loss, (latents, lambda_values, discount, new_moments, aux_stats)), a_grads = (
+            jax.value_and_grad(actor_loss_fn, has_aux=True)(
+                params["actor"], params, zs, hs, true_continue, moments_state, k_img
+            )
+        )
+        a_updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], a_updates)}
         opt_state = {**opt_state, "actor": new_aopt}
         moments_state = new_moments
 
@@ -273,8 +293,8 @@ def make_train_phase(
         c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
             params["critic"], params["target_critic"], latents_sg, lambda_values, discount
         )
-        updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
-        params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+        c_updates, new_copt = critic_tx.update(c_grads, opt_state["critic"], params["critic"])
+        params = {**params, "critic": optax.apply_updates(params["critic"], c_updates)}
         opt_state = {**opt_state, "critic": new_copt}
 
         metrics = dict(w_metrics)
@@ -283,6 +303,50 @@ def make_train_phase(
         metrics["Grads/world_model"] = optax.global_norm(w_grads)
         metrics["Grads/actor"] = optax.global_norm(a_grads)
         metrics["Grads/critic"] = optax.global_norm(c_grads)
+        # training-health block, riding the metrics dict (the Learn/ prefix is
+        # what RunTelemetry.observe_learn extracts — utils/learn_stats.py)
+        if learn_on:
+            metrics.update(aux_stats)
+            metrics.update(
+                learn_stats.group_stats(
+                    "world_model",
+                    grads=w_grads,
+                    updates=w_updates,
+                    params=params["world_model"],
+                    opt_state=new_wopt,
+                    clip=clips["world_model"],
+                )
+            )
+            metrics.update(
+                learn_stats.group_stats(
+                    "actor",
+                    grads=a_grads,
+                    updates=a_updates,
+                    params=params["actor"],
+                    opt_state=new_aopt,
+                    clip=clips["actor"],
+                )
+            )
+            metrics.update(
+                learn_stats.group_stats(
+                    "critic",
+                    grads=c_grads,
+                    updates=c_updates,
+                    params=params["critic"],
+                    opt_state=new_copt,
+                    clip=clips["critic"],
+                )
+            )
+            metrics.update(
+                learn_stats.kl_stats(
+                    w_metrics["State/kl"],
+                    w_metrics["State/post_entropy"],
+                    w_metrics["State/prior_entropy"],
+                )
+            )
+            metrics["Learn/loss/world_model"] = w_loss
+            metrics["Learn/loss/actor"] = a_loss
+            metrics["Learn/loss/critic"] = c_loss
         return params, opt_state, moments_state, metrics
 
     def train_phase(params, opt_state, moments_state, data, cum_steps, train_key):
@@ -374,6 +438,9 @@ class _InlineTrainer:
         """One train round over the ``[G, T, B, ...]`` block (already staged with
         ``data_sharding`` by the replay sampler). Returns
         ``(act_params, host_metrics_or_None)``."""
+        # one-shot injected learning pathology (resilience.fault=lr_spike):
+        # identity unless the fault armed this iteration
+        self.params = apply_armed_learn_fault(self.params)
         self.params, self.opt_state, self.moments_state, metrics = self.train_phase(
             self.params,
             self.opt_state,
@@ -726,13 +793,15 @@ def run_dreamer(
                         step_data["is_first"][:, i] = np.ones_like(step_data["is_first"][:, i])
 
         ep_info = infos.get("final_info", infos)
-        if cfg.metric.log_level > 0 and "episode" in ep_info:
+        if (cfg.metric.log_level > 0 or telemetry.enabled) and "episode" in ep_info:
             ep = ep_info["episode"]
             mask = ep.get("_r", ep_info.get("_episode", np.ones(num_envs, bool)))
             rews, lens = ep["r"][mask], ep["l"][mask]
-            if aggregator and not aggregator.disabled and len(rews) > 0:
-                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+            if len(rews) > 0:
+                telemetry.observe_episodes(rews, lens)
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                    aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         # real next obs of finished episodes (reference dreamer_v3.py:701-708)
         real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
@@ -806,6 +875,11 @@ def run_dreamer(
                     telemetry.observe_train(
                         per_rank_gradient_steps,
                         host_metrics if host_metrics is not None else getattr(trainer, "last_metrics", None),
+                    )
+                    # the Learn/ keys ride the metrics dict; device refs are
+                    # fine — telemetry only fetches them at window cadence
+                    telemetry.observe_learn(
+                        host_metrics if host_metrics is not None else getattr(trainer, "last_metrics", None)
                     )
                     if telemetry.wants_program("train_step") and getattr(trainer, "params", None) is not None:
                         # the compiled unit is the single fused gradient step the

@@ -31,6 +31,7 @@ import numpy as np
 
 from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import build_optimizers, make_train_phase, run_dreamer
+from sheeprl_tpu.resilience import apply_armed_learn_fault
 from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
 from sheeprl_tpu.parallel.distributed import (
     BroadcastChannel,
@@ -138,6 +139,9 @@ def _trainer_loop(
                     # host G-loop inside train_phase slices global arrays eagerly,
                     # which all slice members execute in lockstep.
                     data = jax.device_put(data, fabric.sharding(None, None, "data"))
+                # one-shot injected learning pathology (resilience.fault=lr_spike
+                # targeting the learner process): identity unless armed
+                params = apply_armed_learn_fault(params)
                 params, opt_state, moments_state, metrics = train_phase(
                     params, opt_state, moments_state, data, jnp.asarray(cum_steps), np.asarray(train_key)
                 )
@@ -149,6 +153,8 @@ def _trainer_loop(
             params_q.put(reply)
             last_step = int(cum_steps) + units
             telemetry.observe_train(units, reply[2])
+            # device metrics carry the Learn/ keys; refs only, fetched at window
+            telemetry.observe_learn(metrics)
             telemetry.step(last_step)
             # publishes this rank's preempt request / heartbeat step and raises
             # RankFailureError on a declared-dead peer (never hang on one)
@@ -729,6 +735,7 @@ def _service_learner(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
                 with timer("Time/train_time"):
                     data = sampler.sample(grant)
                     key, train_key = jax.random.split(key)
+                    params = apply_armed_learn_fault(params)
                     params, opt_state, moments_state, metrics = train_phase(
                         params, opt_state, moments_state, data,
                         jnp.asarray(cum_gsteps), np.asarray(train_key),
@@ -736,6 +743,7 @@ def _service_learner(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
                 cum_gsteps += grant
                 rounds += 1
                 telemetry.observe_train(grant, metrics)
+                telemetry.observe_learn(metrics)
                 if rounds % publish_every == 0:
                     publisher.publish(replicated_to_host(_act_select(params)))
             elif not eos:

@@ -45,7 +45,8 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -68,6 +69,8 @@ def make_train_phase(cfg, actor, critic, target_entropy, txs=None, jit_kwargs=No
     action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
     txs = txs if txs is not None else build_optimizers(cfg)
     actor_tx, critic_tx, alpha_tx = txs["actor"], txs["critic"], txs["alpha"]
+    # compile the Learn/* stats only when the telemetry learning plane is on
+    learn_on = learn_stats.enabled(cfg)
 
     def critic_loss_fn(critic_params, other, batch, step_key):
         k_pi, k_tgt, k_online = jax.random.split(step_key, 3)
@@ -85,7 +88,9 @@ def make_train_phase(cfg, actor, critic, target_entropy, txs=None, jit_kwargs=No
         qf_values = critic.apply(
             {"params": critic_params}, batch["observations"], batch["actions"], False, rngs={"dropout": k_online}
         )
-        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+        loss = critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+        # aux for the learn-stats block: Q statistics + per-sample TD error
+        return loss, (qf_values, qf_values - next_qf_value)
 
     def actor_loss_fn(actor_params, other, batch, step_key):
         k_pi, k_q = jax.random.split(step_key)
@@ -116,7 +121,9 @@ def make_train_phase(cfg, actor, critic, target_entropy, txs=None, jit_kwargs=No
         def critic_step(carry, inp):
             params, opt_state = carry
             batch, k = inp
-            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k)
+            (qf_loss, (qf_values, td_error)), qf_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True
+            )(params["critic"], params, batch, k)
             updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
             params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
             opt_state = {**opt_state, "critic": new_copt}
@@ -126,28 +133,53 @@ def make_train_phase(cfg, actor, critic, target_entropy, txs=None, jit_kwargs=No
                     lambda t, c: t * (1 - tau) + c * tau, params["target_critic"], params["critic"]
                 ),
             }
-            return (params, opt_state), qf_loss
+            critic_learn = learn_stats.maybe(learn_on, lambda: {
+                **learn_stats.group_stats(
+                    "critic",
+                    grads=qf_grads,
+                    updates=updates,
+                    params=params["critic"],
+                    opt_state=new_copt,
+                ),
+                **learn_stats.value_stats(qf_values, prefix="q"),
+                **learn_stats.td_quantiles(td_error),
+            })
+            return (params, opt_state), (qf_loss, critic_learn)
 
         G = critic_data["rewards"].shape[0]
         k_scan, k_actor = jax.random.split(train_key)
         keys = jax.random.split(k_scan, G)
-        (params, opt_state), qf_losses = jax.lax.scan(critic_step, (params, opt_state), (critic_data, keys))
+        (params, opt_state), (qf_losses, critic_learn) = jax.lax.scan(
+            critic_step, (params, opt_state), (critic_data, keys)
+        )
 
         (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
             params["actor"], params, actor_data, k_actor
         )
-        updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-        params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+        a_updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], a_updates)}
         opt_state = {**opt_state, "actor": new_aopt}
 
         al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
-        updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
-        params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
+        al_updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
+        params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], al_updates)}
         opt_state = {**opt_state, "alpha": new_alopt}
 
+        learn = learn_stats.maybe(learn_on, lambda: {
+            **learn_stats.reduce_stacked(critic_learn),
+            **learn_stats.group_stats(
+                "actor", grads=a_grads, updates=a_updates, params=params["actor"], opt_state=new_aopt
+            ),
+            **learn_stats.group_stats("alpha", grads=al_grads),
+            **learn_stats.entropy_stats(-logprobs),
+            "Learn/alpha": jnp.exp(params["log_alpha"]).reshape(()),
+            "Learn/loss/critic": qf_losses.mean() / num_critics,
+            "Learn/loss/actor": a_loss,
+            "Learn/loss/alpha": al_loss,
+        })
         # log the per-member MSE (the reference logs each member's loss into a
         # MeanMetric, droq.py:113-115), not the summed ensemble loss
-        return params, opt_state, jnp.stack([qf_losses.mean() / num_critics, a_loss, al_loss])
+        return params, opt_state, jnp.stack([qf_losses.mean() / num_critics, a_loss, al_loss]), learn
 
     return train_phase
 
@@ -172,6 +204,8 @@ def _aot_train_program():
             "algo.per_rank_batch_size=4",
             "buffer.memmap=False",
             "metric.log_level=0",
+            # lower the GROWN program (Learn/* stats compile in under telemetry)
+            "metric.telemetry.enabled=true",
         ]
     )
     fabric = tiny_fabric()
@@ -334,7 +368,8 @@ def main(fabric, cfg: Dict[str, Any]):
     # multi-device meshes — see make_train_phase's donation note.
     from sheeprl_tpu.parallel.sharding import build_state_shardings
 
-    _state_shardings = build_state_shardings(fabric, params, opt_state)
+    # extra_outputs=2: the losses vector AND the Learn/* stats block
+    _state_shardings = build_state_shardings(fabric, params, opt_state, extra_outputs=2)
     _train_jit_kwargs = (
         {"out_shardings": tuple(_state_shardings)} if _state_shardings is not None else {}
     )
@@ -389,9 +424,11 @@ def main(fabric, cfg: Dict[str, Any]):
             ep = ep_info["episode"]
             mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
             rews, lens = ep["r"][mask], ep["l"][mask]
-            if aggregator and not aggregator.disabled and len(rews) > 0:
-                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+            if len(rews) > 0:
+                telemetry.observe_episodes(rews, lens)
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                    aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
         final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
@@ -428,12 +465,16 @@ def main(fabric, cfg: Dict[str, Any]):
                     # [1, B, ...] block keeps the batch-axis sharding
                     actor_data = jax.tree_util.tree_map(lambda v: v[0], sampler.sample(1))
                     key, train_key = jax.random.split(key)
-                    params, opt_state, mean_losses = train_phase(
+                    # one-shot injected learning pathology (resilience.fault=
+                    # lr_spike): identity unless armed this iteration
+                    params = apply_armed_learn_fault(params)
+                    params, opt_state, mean_losses, learn = train_phase(
                         params, opt_state, critic_data, actor_data, np.asarray(train_key)
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     act_params = act.view(params)
                     telemetry.observe_train(per_rank_gradient_steps, mean_losses)
+                    telemetry.observe_learn(learn)
                     if telemetry.wants_program("train_phase"):
                         telemetry.register_program(
                             "train_phase",

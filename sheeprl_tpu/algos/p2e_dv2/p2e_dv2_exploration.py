@@ -39,7 +39,8 @@ from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.utils.distribution import MSEDistribution
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.mfu import unit_avals
 from sheeprl_tpu.utils.env import make_env
@@ -68,6 +69,9 @@ def make_train_phase(
     use_continues = bool(wm_cfg.use_continues)
     intrinsic_mult = float(cfg.algo.intrinsic_reward_multiplier)
     act_dim = int(np.sum(agent.actions_dim))
+
+    # compile the Learn/* stats only when the telemetry learning plane is on
+    learn_on = learn_stats.enabled(cfg)
 
     def world_loss_fn(wm_params, batch, key):
         batch_obs = {k: batch[k] / 255.0 - 0.5 for k in cnn_keys}
@@ -280,6 +284,33 @@ def make_train_phase(
         metrics["Grads/critic_exploration"] = optax.global_norm(ce_grads)
         metrics["Grads/actor_task"] = optax.global_norm(at_grads)
         metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
+        if learn_on:
+            # training-health block, riding the metrics dict (Learn/ prefix —
+            # utils/learn_stats.py; extracted by RunTelemetry.observe_learn)
+            metrics.update(learn_stats.group_stats(
+                "world_model", grads=w_grads, params=params["world_model"]))
+            metrics.update(learn_stats.group_stats(
+                "ensemble", grads=e_grads, params=params["ensembles"]))
+            metrics.update(learn_stats.group_stats(
+                "actor_exploration", grads=ae_grads, params=params["actor_exploration"]))
+            metrics.update(learn_stats.group_stats(
+                "actor_task", grads=at_grads, params=params["actor_task"]))
+            metrics.update(learn_stats.group_stats(
+                "critic_task", grads=ct_grads, params=params["critic_task"]))
+            metrics.update(learn_stats.kl_stats(
+                w_metrics["State/kl"],
+                w_metrics["State/post_entropy"],
+                w_metrics["State/prior_entropy"],
+            ))
+            metrics.update(learn_stats.value_stats(jax.lax.stop_gradient(lambda_e)))
+            metrics["Learn/loss/world_model"] = w_loss
+            metrics["Learn/loss/ensemble"] = e_loss
+            metrics["Learn/loss/actor_exploration"] = pe_loss
+            metrics["Learn/loss/actor_task"] = pt_loss
+            metrics["Learn/loss/critic_task"] = ct_loss
+            metrics.update(learn_stats.group_stats(
+                "critic_exploration", grads=ce_grads, params=params["critic_exploration"]))
+            metrics["Learn/loss/critic_exploration"] = ce_loss
         return params, opt_state, metrics
 
     def train_phase(params, opt_state, data, cum_steps, train_key):
@@ -547,13 +578,15 @@ def main(fabric, cfg: Dict[str, Any]):
             telemetry.observe_env_restart(int(np.sum(infos["restart_on_exception"])))
 
         ep_info = infos.get("final_info", infos)
-        if cfg.metric.log_level > 0 and "episode" in ep_info:
+        if (cfg.metric.log_level > 0 or telemetry.enabled) and "episode" in ep_info:
             ep = ep_info["episode"]
             mask = ep.get("_r", ep_info.get("_episode", np.ones(num_envs, bool)))
             rews, lens = ep["r"][mask], ep["l"][mask]
-            if aggregator and not aggregator.disabled and len(rews) > 0:
-                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+            if len(rews) > 0:
+                telemetry.observe_episodes(rews, lens)
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                    aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
         final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
@@ -597,6 +630,9 @@ def main(fabric, cfg: Dict[str, Any]):
                 with timer("Time/train_time"):
                     data = sampler.sample(per_rank_gradient_steps)
                     key, train_key = jax.random.split(key)
+                    # one-shot injected learning pathology (resilience.fault=
+                    # lr_spike): identity unless armed this iteration
+                    params = apply_armed_learn_fault(params)
                     params, opt_state, metrics = train_phase(
                         params,
                         opt_state,
@@ -608,6 +644,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     train_step += world_size * per_rank_gradient_steps
                     act_params = act.view(params)
                     telemetry.observe_train(per_rank_gradient_steps, metrics)
+                    telemetry.observe_learn(metrics)
                     if telemetry.wants_program("train_step"):
                         batch_avals = unit_avals(data)
                         telemetry.register_program(

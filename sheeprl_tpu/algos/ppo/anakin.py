@@ -58,7 +58,8 @@ from sheeprl_tpu.analysis.programs import register_fused_program
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.envs.jax import make_jax_env
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -189,6 +190,11 @@ def make_anakin_program(
     clip_vloss = bool(cfg.algo.get("clip_vloss", False))
     normalize_advantages = bool(cfg.algo.get("normalize_advantages", False))
     share_data = bool(cfg.buffer.share_data)
+    # static clip threshold for the learn-stats post-clip norms (_build_optimizer
+    # chains clip_by_global_norm with exactly this value)
+    max_grad_norm = float(cfg.algo.get("max_grad_norm", 0.0) or 0) or None
+    # compile the Learn/* stats only when the telemetry learning plane is on
+    learn_on = learn_stats.enabled(cfg)
     # episodes can only truncate when the autoreset wrapper carries a step
     # budget; without one the truncation-bootstrap value pass is dead code and
     # is statically skipped
@@ -296,7 +302,16 @@ def make_anakin_program(
         )
         ent_loss = entropy_loss(out["entropy"], loss_reduction)
         loss = pg_loss + vf_coef * v_loss + ent_coef * ent_loss
-        return loss, (pg_loss, v_loss, ent_loss)
+        return loss, (pg_loss, v_loss, ent_loss, _loss_stats(out, batch))
+
+    def _loss_stats(out, batch):
+        # learn-stats aux (scalars only): value statistics, value residual vs
+        # the GAE return, policy entropy (utils/learn_stats.py)
+        return learn_stats.maybe(learn_on, lambda: {
+            **learn_stats.value_stats(jax.lax.stop_gradient(out["values"])),
+            **learn_stats.td_quantiles(jax.lax.stop_gradient(batch["returns"] - out["values"])),
+            **learn_stats.entropy_stats(jax.lax.stop_gradient(out["entropy"])),
+        })
 
     def a2c_loss_fn(params, batch, clip_coef, ent_coef):
         actor_outs, new_values = agent.apply({"params": params}, {mlp_key: batch[mlp_key]})
@@ -311,7 +326,7 @@ def make_anakin_program(
         pg_loss = a2c_policy_loss(out["logprob"], batch["advantages"], loss_reduction)
         v_loss = a2c_value_loss(out["values"], batch["returns"], loss_reduction)
         ent_loss = entropy_loss(out["entropy"], loss_reduction)
-        return pg_loss + v_loss + ent_coef * ent_loss, (pg_loss, v_loss, ent_loss)
+        return pg_loss + v_loss + ent_coef * ent_loss, (pg_loss, v_loss, ent_loss, _loss_stats(out, batch))
 
     loss_fn = ppo_loss_fn if flavor == "ppo" else a2c_loss_fn
 
@@ -345,12 +360,26 @@ def make_anakin_program(
             flat = jax.lax.with_sharding_constraint(flat, data_sharding)
 
         def grad_step(params, opt_state, batch):
-            grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
+            grads, (pg, vl, ent, stats) = jax.grad(loss_fn, has_aux=True)(
                 params, batch, clip_coef, ent_coef
             )
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, jnp.stack([pg, vl, ent])
+            learn = learn_stats.maybe(learn_on, lambda: {
+                **stats,
+                **learn_stats.group_stats(
+                    "policy",
+                    grads=grads,
+                    updates=updates,
+                    params=params,
+                    opt_state=opt_state,
+                    clip=max_grad_norm,
+                ),
+                "Learn/loss/policy": pg,
+                "Learn/loss/value": vl,
+                "Learn/loss/entropy": ent,
+            })
+            return params, opt_state, (jnp.stack([pg, vl, ent]), learn)
 
         # single full-batch update (the a2c flavor, or ppo with one epoch over
         # one minibatch): any permutation is the identity up to reduction order,
@@ -364,8 +393,8 @@ def make_anakin_program(
         def epoch_body(carry, epoch_key):
             params, opt_state = carry
             if single_full_batch:
-                params, opt_state, losses = grad_step(params, opt_state, flat)
-                return (params, opt_state), losses
+                params, opt_state, (losses, learn) = grad_step(params, opt_state, flat)
+                return (params, opt_state), (losses, learn)
             if use_prp:
                 perm = prp_permutation(epoch_key, num_rows)
             else:
@@ -378,15 +407,18 @@ def make_anakin_program(
             def mb_body(carry, idx):
                 params, opt_state = carry
                 batch = {k: jnp.take(v, idx, axis=0) for k, v in flat.items()}
-                params, opt_state, losses = grad_step(params, opt_state, batch)
-                return (params, opt_state), losses
+                params, opt_state, out = grad_step(params, opt_state, batch)
+                return (params, opt_state), out
 
-            (params, opt_state), losses = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
-            return (params, opt_state), losses.mean(axis=0)
+            # learn stays [minibatches]-stacked: reduce_stacked takes the true
+            # max over every fused step, so a one-minibatch gradient spike is
+            # not averaged below the explosion detector's threshold
+            (params, opt_state), (losses, learn) = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
+            return (params, opt_state), (losses.mean(axis=0), learn)
 
         epoch_keys = jax.random.split(train_key, update_epochs)
-        (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
-        return params, opt_state, losses.mean(axis=0)
+        (params, opt_state), (losses, learn) = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
+        return params, opt_state, losses.mean(axis=0), learn_stats.reduce_stacked(learn)
 
     def anakin_step(params, opt_state, env_state, obs, key, stats, clip_coef, ent_coef):
         if data_sharding is not None:
@@ -395,7 +427,7 @@ def make_anakin_program(
         key, train_key = jax.random.split(key)
         env_state, obs, key, traj, ep_stats = rollout_phase(params, env_state, obs, key)
         next_values = _values(params, obs)
-        params, opt_state, losses = train_phase(
+        params, opt_state, losses, learn = train_phase(
             params, opt_state, traj, next_values, train_key, clip_coef, ent_coef
         )
         new_stats = {
@@ -404,7 +436,10 @@ def make_anakin_program(
             "ep_count": stats["ep_count"] + ep_stats[2],
             "losses": losses,
         }
-        return params, opt_state, env_state, obs, key, new_stats
+        # the Learn/* block is a SEPARATE output (not folded into the carried
+        # stats dict): the input stats template stays shape-stable across
+        # calls, and telemetry holds only these fresh scalar buffers
+        return params, opt_state, env_state, obs, key, new_stats, learn
 
     # stats (argnum 5) is NOT donated: telemetry holds the losses reference for
     # its window-cadence health sync, and a donated buffer would be deleted
@@ -444,6 +479,8 @@ def _aot_anakin_program():
             "env.num_envs=16",
             "algo.rollout_steps=8",
             "algo.per_rank_batch_size=32",
+            # lower the GROWN program (Learn/* stats compile in under telemetry)
+            "metric.telemetry.enabled=true",
         ]
     )
     fabric = Fabric(devices=devices, accelerator="cpu", strategy="dp")
@@ -616,6 +653,9 @@ def run_anakin(fabric, cfg: Dict[str, Any]):
         "losses": jnp.zeros((3,), jnp.float32),
     }
     _zero = jnp.float32(0.0)
+    # host-side shadow of the on-device episode accumulators (the telemetry
+    # episode feed reads deltas against it; reset alongside the device reset)
+    last_ep_stats = {"ep_return_sum": 0.0, "ep_length_sum": 0.0, "ep_count": 0.0}
 
     ent_coef = initial_ent_coef
     clip_coef = initial_clip_coef
@@ -633,7 +673,10 @@ def run_anakin(fabric, cfg: Dict[str, Any]):
         policy_step += policy_steps_per_iter
 
         t0 = time.perf_counter()
-        params, opt_state, env_state, obs, key, stats = anakin_step(
+        # one-shot injected learning pathology (resilience.fault=lr_spike):
+        # identity unless the fault armed this iteration
+        params = apply_armed_learn_fault(params)
+        params, opt_state, env_state, obs, key, stats, learn = anakin_step(
             params,
             opt_state,
             env_state,
@@ -661,6 +704,21 @@ def run_anakin(fabric, cfg: Dict[str, Any]):
         timer("Time/train_time").add(elapsed * (1.0 - split_frac))
 
         telemetry.observe_train(updates_per_iter, stats["losses"])
+        telemetry.observe_learn(learn)
+        if telemetry.enabled:
+            # the on-device episode accumulators double as the episode feed:
+            # three scalar pulls per iteration, already behind the per-iteration
+            # block_until_ready above (telemetry off pays nothing). Per-episode
+            # returns never leave the device — the window sees the batch MEAN
+            # (one sample) with the exact episode count.
+            ep_count = float(stats["ep_count"]) - last_ep_stats["ep_count"]
+            if ep_count >= 1.0:
+                mean_ret = (float(stats["ep_return_sum"]) - last_ep_stats["ep_return_sum"]) / ep_count
+                mean_len = (float(stats["ep_length_sum"]) - last_ep_stats["ep_length_sum"]) / ep_count
+                telemetry.observe_episodes([mean_ret], [mean_len], count=int(ep_count))
+                last_ep_stats = {
+                    k: float(stats[k]) for k in ("ep_return_sum", "ep_length_sum", "ep_count")
+                }
         if telemetry.wants_program("anakin_step"):
             telemetry.register_program(
                 "anakin_step",
@@ -690,6 +748,7 @@ def run_anakin(fabric, cfg: Dict[str, Any]):
                     aggregator.update("Loss/value_loss", float(losses_np[1]))
                     aggregator.update("Loss/entropy_loss", float(losses_np[2]))
                 stats = dict(stats, ep_return_sum=_zero, ep_length_sum=_zero, ep_count=_zero)
+                last_ep_stats = {"ep_return_sum": 0.0, "ep_length_sum": 0.0, "ep_count": 0.0}
                 metrics_dict = aggregator.compute() if aggregator else {}
                 if logger is not None:
                     logger.log_metrics(metrics_dict, policy_step)

@@ -31,7 +31,8 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -96,6 +97,11 @@ def make_train_phase(
     num_rows = int(cfg.algo.rollout_steps * total_num_envs)
     num_minibatches = -(-num_rows // global_bs)  # ceil: partial minibatches pad-wrap
     share_data = bool(cfg.buffer.share_data)
+    # static clip threshold for the learn-stats post-clip norms (the tx chains
+    # clip_by_global_norm with exactly this value — _build_optimizer)
+    max_grad_norm = float(cfg.algo.max_grad_norm or 0) or None
+    # compile the Learn/* stats only when the telemetry learning plane is on
+    learn_on = learn_stats.enabled(cfg)
 
     def loss_fn(params, batch, clip_coef, ent_coef):
         norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
@@ -112,7 +118,14 @@ def make_train_phase(
         )
         ent_loss = entropy_loss(out["entropy"], loss_reduction)
         loss = pg_loss + vf_coef * v_loss + ent_coef * ent_loss
-        return loss, (pg_loss, v_loss, ent_loss)
+        # learn-stats aux (scalars only): value statistics, the value residual
+        # vs the GAE return (the PPO analogue of a TD error), policy entropy
+        stats = learn_stats.maybe(learn_on, lambda: {
+            **learn_stats.value_stats(jax.lax.stop_gradient(out["values"])),
+            **learn_stats.td_quantiles(jax.lax.stop_gradient(batch["returns"] - out["values"])),
+            **learn_stats.entropy_stats(jax.lax.stop_gradient(out["entropy"])),
+        })
+        return loss, (pg_loss, v_loss, ent_loss, stats)
 
     jit_kwargs = {"out_shardings": tuple(state_shardings)} if state_shardings is not None else {}
 
@@ -153,20 +166,35 @@ def make_train_phase(
             def mb_body(carry, idx):
                 params, opt_state = carry
                 batch = {k: jnp.take(v, idx, axis=0) for k, v in flat.items()}
-                grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
+                grads, (pg, vl, ent, stats) = jax.grad(loss_fn, has_aux=True)(
                     params, batch, clip_coef, ent_coef
                 )
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
-                return (params, opt_state), jnp.stack([pg, vl, ent])
+                learn = learn_stats.maybe(learn_on, lambda: {
+                    **stats,
+                    **learn_stats.group_stats(
+                        "policy",
+                        grads=grads,
+                        updates=updates,
+                        params=params,
+                        opt_state=opt_state,
+                        clip=max_grad_norm,
+                    ),
+                    "Learn/loss/policy": pg,
+                    "Learn/loss/value": vl,
+                    "Learn/loss/entropy": ent,
+                })
+                return (params, opt_state), (jnp.stack([pg, vl, ent]), learn)
 
-            (params, opt_state), losses = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
-            return (params, opt_state), losses.mean(axis=0)
+            (params, opt_state), (losses, learn) = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
+            return (params, opt_state), (losses.mean(axis=0), learn)
 
         epoch_keys = jax.random.split(train_key, cfg.algo.update_epochs)
-        (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
+        (params, opt_state), (losses, learn) = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
         mean_losses = losses.mean(axis=0)
-        return params, opt_state, mean_losses
+        # learn is [epochs, minibatches]-stacked: reduce to window-ready scalars
+        return params, opt_state, mean_losses, learn_stats.reduce_stacked(learn)
 
     return train_phase
 
@@ -336,7 +364,8 @@ def main(fabric, cfg: Dict[str, Any]):
         cnn_keys,
         obs_keys,
         total_num_envs,
-        state_shardings=build_state_shardings(fabric, params, opt_state),
+        # extra_outputs=2: the losses vector AND the Learn/* stats block
+        state_shardings=build_state_shardings(fabric, params, opt_state, extra_outputs=2),
     )
 
     # replicate params/opt_state over the mesh once; rollout data arrives data-sharded
@@ -416,9 +445,11 @@ def main(fabric, cfg: Dict[str, Any]):
                     ep = ep_info["episode"]
                     mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
                     rews, lens = ep["r"][mask], ep["l"][mask]
-                    if aggregator and not aggregator.disabled and len(rews) > 0:
-                        aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                        aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+                    if len(rews) > 0:
+                        telemetry.observe_episodes(rews, lens)
+                        if aggregator and not aggregator.disabled:
+                            aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                            aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         # bootstrap value for the last step
         obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
@@ -430,10 +461,14 @@ def main(fabric, cfg: Dict[str, Any]):
             if world_size > 1:
                 data = jax.device_put(data, fabric.sharding(None, "data"))
             key, train_key = jax.random.split(key)
-            params, opt_state, mean_losses = train_phase(
+            # one-shot injected learning pathology (resilience.fault=lr_spike):
+            # identity unless the fault armed this iteration
+            params = apply_armed_learn_fault(params)
+            params, opt_state, mean_losses, learn = train_phase(
                 params, opt_state, data, next_values, np.asarray(train_key), clip_coef, ent_coef
             )
             telemetry.observe_train(1, mean_losses)
+            telemetry.observe_learn(learn)
             if telemetry.wants_program("train_phase"):
                 telemetry.register_program(
                     "train_phase",

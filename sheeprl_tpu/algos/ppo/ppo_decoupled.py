@@ -44,7 +44,8 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, space_actions_info, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import NullTelemetry, build_role_telemetry, build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -125,6 +126,8 @@ def _trainer_loop(
         batch_sharding = None
         if fabric.world_size > 1 and global_bs % fabric.world_size == 0:
             batch_sharding = fabric.data_sharding
+        # compile the Learn/* stats only when the telemetry learning plane is on
+        learn_on = learn_stats.enabled(cfg)
 
         def loss_fn(params, batch, clip_coef, ent_coef):
             norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
@@ -141,7 +144,14 @@ def _trainer_loop(
                 out["values"], batch["values"], batch["returns"], clip_coef, clip_vloss, loss_reduction
             )
             ent_loss = entropy_loss(out["entropy"], loss_reduction)
-            return pg_loss + vf_coef * v_loss + ent_coef * ent_loss, (pg_loss, v_loss, ent_loss)
+            # learn-stats aux (scalars only): value statistics, value residual
+            # vs the GAE return, policy entropy (utils/learn_stats.py)
+            stats = learn_stats.maybe(learn_on, lambda: {
+                **learn_stats.value_stats(jax.lax.stop_gradient(out["values"])),
+                **learn_stats.td_quantiles(jax.lax.stop_gradient(batch["returns"] - out["values"])),
+                **learn_stats.entropy_stats(jax.lax.stop_gradient(out["entropy"])),
+            })
+            return pg_loss + vf_coef * v_loss + ent_coef * ent_loss, (pg_loss, v_loss, ent_loss, stats)
 
         @jax.jit
         def train_phase(params, opt_state, flat, train_key, clip_coef, ent_coef):
@@ -161,19 +171,33 @@ def _trainer_loop(
                         # (XLA's propagation may otherwise replicate it, making the
                         # slice's DP redundant compute)
                         batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
-                    grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
+                    grads, (pg, vl, ent, stats) = jax.grad(loss_fn, has_aux=True)(
                         params, batch, clip_coef, ent_coef
                     )
                     updates, opt_state = tx.update(grads, opt_state, params)
                     params = optax.apply_updates(params, updates)
-                    return (params, opt_state), jnp.stack([pg, vl, ent])
+                    learn = learn_stats.maybe(learn_on, lambda: {
+                        **stats,
+                        **learn_stats.group_stats(
+                            "policy",
+                            grads=grads,
+                            updates=updates,
+                            params=params,
+                            opt_state=opt_state,
+                            clip=float(cfg.algo.max_grad_norm or 0) or None,
+                        ),
+                        "Learn/loss/policy": pg,
+                        "Learn/loss/value": vl,
+                        "Learn/loss/entropy": ent,
+                    })
+                    return (params, opt_state), (jnp.stack([pg, vl, ent]), learn)
 
-                (params, opt_state), losses = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
-                return (params, opt_state), losses.mean(axis=0)
+                (params, opt_state), (losses, learn) = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
+                return (params, opt_state), (losses.mean(axis=0), learn)
 
             epoch_keys = jax.random.split(train_key, cfg.algo.update_epochs)
-            (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
-            return params, opt_state, losses.mean(axis=0)
+            (params, opt_state), (losses, learn) = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
+            return params, opt_state, losses.mean(axis=0), learn_stats.reduce_stacked(learn)
 
         # sharding/replication follow the learner's OWN mesh, not the data geometry
         mesh_size = fabric.world_size
@@ -196,21 +220,28 @@ def _trainer_loop(
                     # device_put forms the GLOBAL sharded array across the slice mesh
                     flat = jax.device_put(flat, fabric.data_sharding)
                 key, train_key = jax.random.split(key)
-                params, opt_state, mean_losses = train_phase(
+                # one-shot injected learning pathology (resilience.fault=lr_spike
+                # targeting the learner process): identity unless armed
+                params = apply_armed_learn_fault(params)
+                params, opt_state, mean_losses, learn = train_phase(
                     params, opt_state, flat, np.asarray(train_key), clip_coef, ent_coef
                 )
                 # weight plane: the player needs the full agent each round (it predicts
                 # values during the rollout); opt_state only crosses when a checkpoint
                 # is due. replicated_to_host handles the multi-process slice mesh, where
                 # np.asarray refuses non-addressable (but replicated) outputs.
+                # the Learn/* block rides host-side so the PLAYER's stream (the
+                # run's primary) carries the learning window too
                 reply = (
                     replicated_to_host(params),
                     replicated_to_host(opt_state) if want_opt_state else None,
                     replicated_to_host(mean_losses),
+                    replicated_to_host(learn),
                 )
             params_q.put(reply)
             rounds += 1
             telemetry.observe_train(1, reply[2])
+            telemetry.observe_learn(reply[3])
             telemetry.step(rounds * policy_steps_per_iter)
             # publishes this rank's preempt request / heartbeat step and raises
             # RankFailureError on a declared-dead peer (never hang on one)
@@ -541,9 +572,11 @@ def main(fabric, cfg: Dict[str, Any]):
                         ep = ep_info["episode"]
                         mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
                         rews, lens = ep["r"][mask], ep["l"][mask]
-                        if aggregator and not aggregator.disabled and len(rews) > 0:
-                            aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                            aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+                        if len(rews) > 0:
+                            telemetry.observe_episodes(rews, lens)
+                            if aggregator and not aggregator.disabled:
+                                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
             # GAE on the player (reference ppo_decoupled.py:277-289), then ship the block
             obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
@@ -579,9 +612,10 @@ def main(fabric, cfg: Dict[str, Any]):
                             "sentinel before the player finished); see its log"
                         )
                     break
-                params_host, opt_state_host, mean_losses = msg
+                params_host, opt_state_host, mean_losses, learn = msg
                 act_params = act.view(params_host)
                 telemetry.observe_train(1, mean_losses)
+                telemetry.observe_learn(learn)
                 if aggregator and not aggregator.disabled:
                     aggregator.update("Loss/policy_loss", float(mean_losses[0]))
                     aggregator.update("Loss/value_loss", float(mean_losses[1]))

@@ -35,7 +35,8 @@ from sheeprl_tpu.algos.ppo_recurrent.utils import test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -195,6 +196,9 @@ def main(fabric, cfg: Dict[str, Any]):
         _, values, _ = agent.forward(params, norm, prev_actions[None], hx, cx)
         return values[0]
 
+    # compile the Learn/* stats only when the telemetry learning plane is on
+    learn_on = learn_stats.enabled(cfg)
+
     def loss_fn(params, batch, clip_coef, ent_coef):
         mask = batch["mask"]  # [sl, B, 1]
         norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
@@ -226,7 +230,13 @@ def main(fabric, cfg: Dict[str, Any]):
         v_loss = _masked_mean(jnp.square(v_pred - batch["returns"]), mask)
         ent_loss = -_masked_mean(out["entropy"], mask)
         loss = pg_loss + vf_coef * v_loss + ent_coef * ent_loss
-        return loss, (pg_loss, v_loss, ent_loss)
+        # learn-stats aux (scalars only; padding masked out of the means)
+        stats = learn_stats.maybe(learn_on, lambda: {
+            **learn_stats.value_stats(jax.lax.stop_gradient(out["values"])),
+            **learn_stats.td_quantiles(jax.lax.stop_gradient(batch["returns"] - out["values"])),
+            "Learn/entropy": jax.lax.stop_gradient(_masked_mean(out["entropy"], mask)),
+        })
+        return loss, (pg_loss, v_loss, ent_loss, stats)
 
     @jax.jit
     def train_phase(params, opt_state, seqs, train_key, clip_coef, ent_coef):
@@ -244,7 +254,7 @@ def main(fabric, cfg: Dict[str, Any]):
             def mb_body(carry, idx):
                 params, opt_state = carry
                 batch = {k: jnp.take(v, idx, axis=1) for k, v in seqs.items()}
-                grads, (pg, vl, ent) = jax.grad(loss_fn, has_aux=True)(
+                grads, (pg, vl, ent, stats) = jax.grad(loss_fn, has_aux=True)(
                     params, batch, clip_coef, ent_coef
                 )
                 # a minibatch drawn entirely from padding has exactly-zero gradients
@@ -255,14 +265,28 @@ def main(fabric, cfg: Dict[str, Any]):
                 new_params = optax.apply_updates(params, new_updates)
                 params = jax.tree_util.tree_map(pick, new_params, params)
                 opt_state = jax.tree_util.tree_map(pick, new_opt, opt_state)
-                return (params, opt_state), jnp.stack([pg, vl, ent])
+                learn = learn_stats.maybe(learn_on, lambda: {
+                    **stats,
+                    **learn_stats.group_stats(
+                        "policy",
+                        grads=grads,
+                        updates=new_updates,
+                        params=params,
+                        opt_state=opt_state,
+                        clip=float(cfg.algo.max_grad_norm or 0) or None,
+                    ),
+                    "Learn/loss/policy": pg,
+                    "Learn/loss/value": vl,
+                    "Learn/loss/entropy": ent,
+                })
+                return (params, opt_state), (jnp.stack([pg, vl, ent]), learn)
 
-            (params, opt_state), losses = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
-            return (params, opt_state), losses.mean(axis=0)
+            (params, opt_state), (losses, learn) = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
+            return (params, opt_state), (losses.mean(axis=0), learn)
 
         epoch_keys = jax.random.split(train_key, cfg.algo.update_epochs)
-        (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
-        return params, opt_state, losses.mean(axis=0)
+        (params, opt_state), (losses, learn) = jax.lax.scan(epoch_body, (params, opt_state), epoch_keys)
+        return params, opt_state, losses.mean(axis=0), learn_stats.reduce_stacked(learn)
 
     if world_size > 1:
         params = fabric.replicate_pytree(params)
@@ -353,9 +377,11 @@ def main(fabric, cfg: Dict[str, Any]):
                     ep = ep_info["episode"]
                     mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
                     rews, lens = ep["r"][mask], ep["l"][mask]
-                    if aggregator and not aggregator.disabled and len(rews) > 0:
-                        aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                        aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+                    if len(rews) > 0:
+                        telemetry.observe_episodes(rews, lens)
+                        if aggregator and not aggregator.disabled:
+                            aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                            aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         # bootstrap + GAE on host arrays
         obs_host = {k: np.asarray(next_obs[k], dtype=np.float32) for k in obs_keys}
@@ -419,10 +445,14 @@ def main(fabric, cfg: Dict[str, Any]):
             if world_size > 1:
                 seqs = jax.device_put(seqs, fabric.sharding(None, "data"))
             key, train_key = jax.random.split(key)
-            params, opt_state, mean_losses = train_phase(
+            # one-shot injected learning pathology (resilience.fault=lr_spike):
+            # identity unless the fault armed this iteration
+            params = apply_armed_learn_fault(params)
+            params, opt_state, mean_losses, learn = train_phase(
                 params, opt_state, seqs, np.asarray(train_key), clip_coef, ent_coef
             )
             telemetry.observe_train(1, mean_losses)
+            telemetry.observe_learn(learn)
             if telemetry.wants_program("train_phase"):
                 telemetry.register_program(
                     "train_phase",

@@ -35,7 +35,8 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -83,6 +84,9 @@ def make_train_phase(cfg, actor, critic, target_entropy, policy_steps_per_iter, 
     action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
     txs = txs if txs is not None else build_optimizers(cfg)
     actor_tx, critic_tx, alpha_tx = txs["actor"], txs["critic"], txs["alpha"]
+    # compile the Learn/* stats only when the telemetry learning plane is on:
+    # the off path lowers byte-identically to the pre-plane program
+    learn_on = learn_stats.enabled(cfg)
 
     def critic_loss_fn(critic_params, other, batch, step_key):
         next_obs = batch["next_observations"]
@@ -93,7 +97,10 @@ def make_train_phase(cfg, actor, critic, target_entropy, policy_steps_per_iter, 
         min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprobs
         next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
         qf_values = critic.apply({"params": critic_params}, batch["observations"], batch["actions"])
-        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+        loss = critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+        # aux for the learn-stats block: Q statistics + the per-sample TD error
+        # (value_overestimation / td-quantile detectors read them per window)
+        return loss, (qf_values, qf_values - next_qf_value)
 
     def actor_loss_fn(actor_params, other, batch, step_key):
         mean, std = actor.apply({"params": actor_params}, batch["observations"])
@@ -125,9 +132,11 @@ def make_train_phase(cfg, actor, critic, target_entropy, policy_steps_per_iter, 
             batch, k = inp
             k_critic, k_actor = jax.random.split(k)
 
-            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(params["critic"], params, batch, k_critic)
-            updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
-            params = {**params, "critic": optax.apply_updates(params["critic"], updates)}
+            (qf_loss, (qf_values, td_error)), qf_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True
+            )(params["critic"], params, batch, k_critic)
+            c_updates, new_copt = critic_tx.update(qf_grads, opt_state["critic"], params["critic"])
+            params = {**params, "critic": optax.apply_updates(params["critic"], c_updates)}
             opt_state = {**opt_state, "critic": new_copt}
             params = {
                 **params,
@@ -141,21 +150,47 @@ def make_train_phase(cfg, actor, critic, target_entropy, policy_steps_per_iter, 
             (a_loss, logprobs), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
                 params["actor"], params, batch, k_actor
             )
-            updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
-            params = {**params, "actor": optax.apply_updates(params["actor"], updates)}
+            a_updates, new_aopt = actor_tx.update(a_grads, opt_state["actor"], params["actor"])
+            params = {**params, "actor": optax.apply_updates(params["actor"], a_updates)}
             opt_state = {**opt_state, "actor": new_aopt}
 
             al_loss, al_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"], logprobs)
-            updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
-            params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], updates)}
+            al_updates, new_alopt = alpha_tx.update(al_grads, opt_state["alpha"], params["log_alpha"])
+            params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], al_updates)}
             opt_state = {**opt_state, "alpha": new_alopt}
 
-            return (params, opt_state), jnp.stack([qf_loss, a_loss, al_loss])
+            # device-side training-health block (utils/learn_stats.py): scalars
+            # only, computed from values already materialized by the update
+            learn = learn_stats.maybe(learn_on, lambda: {
+                **learn_stats.group_stats(
+                    "critic",
+                    grads=qf_grads,
+                    updates=c_updates,
+                    params=params["critic"],
+                    opt_state=new_copt,
+                ),
+                **learn_stats.group_stats(
+                    "actor",
+                    grads=a_grads,
+                    updates=a_updates,
+                    params=params["actor"],
+                    opt_state=new_aopt,
+                ),
+                **learn_stats.group_stats("alpha", grads=al_grads),
+                **learn_stats.value_stats(qf_values, prefix="q"),
+                **learn_stats.td_quantiles(td_error),
+                **learn_stats.entropy_stats(-logprobs),
+                "Learn/alpha": jnp.exp(params["log_alpha"]).reshape(()),
+                "Learn/loss/critic": qf_loss,
+                "Learn/loss/actor": a_loss,
+                "Learn/loss/alpha": al_loss,
+            })
+            return (params, opt_state), (jnp.stack([qf_loss, a_loss, al_loss]), learn)
 
         G = data["rewards"].shape[0]
         keys = jax.random.split(train_key, G)
-        (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (data, keys))
-        return params, opt_state, losses.mean(axis=0)
+        (params, opt_state), (losses, learn) = jax.lax.scan(step, (params, opt_state), (data, keys))
+        return params, opt_state, losses.mean(axis=0), learn_stats.reduce_stacked(learn)
 
     return train_phase
 
@@ -181,6 +216,8 @@ def _aot_train_program():
             "algo.per_rank_batch_size=4",
             "buffer.memmap=False",
             "metric.log_level=0",
+            # lower the GROWN program (Learn/* stats compile in under telemetry)
+            "metric.telemetry.enabled=true",
         ]
     )
     fabric = tiny_fabric()
@@ -346,7 +383,8 @@ def main(fabric, cfg: Dict[str, Any]):
     # dp) — see make_train_phase's donation note.
     from sheeprl_tpu.parallel.sharding import build_state_shardings
 
-    _state_shardings = build_state_shardings(fabric, params, opt_state)
+    # extra_outputs=2: the losses vector AND the Learn/* stats block
+    _state_shardings = build_state_shardings(fabric, params, opt_state, extra_outputs=2)
     _train_jit_kwargs = (
         {"out_shardings": tuple(_state_shardings)} if _state_shardings is not None else {}
     )
@@ -410,9 +448,11 @@ def main(fabric, cfg: Dict[str, Any]):
             ep = ep_info["episode"]
             mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
             rews, lens = ep["r"][mask], ep["l"][mask]
-            if aggregator and not aggregator.disabled and len(rews) > 0:
-                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+            if len(rews) > 0:
+                telemetry.observe_episodes(rews, lens)
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                    aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         # real next obs for done envs (reference sac.py:281-289); the transition
         # assembly + buffer add is rollout work — timed as env interaction like
@@ -449,12 +489,16 @@ def main(fabric, cfg: Dict[str, Any]):
                 with timer("Time/train_time"):
                     data = sampler.sample(per_rank_gradient_steps)
                     key, train_key = jax.random.split(key)
-                    params, opt_state, mean_losses = train_phase(
+                    # one-shot injected learning pathology (resilience.fault=
+                    # lr_spike): identity unless the fault armed this iteration
+                    params = apply_armed_learn_fault(params)
+                    params, opt_state, mean_losses, learn = train_phase(
                         params, opt_state, data, jnp.asarray(iter_num), np.asarray(train_key)
                     )
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     act_params = act.view(params)
                     telemetry.observe_train(per_rank_gradient_steps, mean_losses)
+                    telemetry.observe_learn(learn)
                     if telemetry.wants_program("train_phase"):
                         # post-call registration: params/opt_state are the REBOUND
                         # outputs (the donated inputs are dead), and registration

@@ -30,7 +30,12 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.parallel.distributed import BroadcastChannel, ChannelError, replicated_to_host
 from sheeprl_tpu.obs import NullTelemetry, build_role_telemetry, build_telemetry
-from sheeprl_tpu.resilience import NullResilience, build_resilience, channel_options
+from sheeprl_tpu.resilience import (
+    NullResilience,
+    apply_armed_learn_fault,
+    build_resilience,
+    channel_options,
+)
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -108,21 +113,29 @@ def _trainer_loop(
                     # leading axis stays unsharded)
                     data = jax.device_put(data, fabric.sharding(None, "data"))
                 key, train_key = jax.random.split(key)
-                params, opt_state, mean_losses = train_phase(
+                # one-shot injected learning pathology (resilience.fault=lr_spike
+                # targeting the learner process): identity unless armed
+                params = apply_armed_learn_fault(params)
+                params, opt_state, mean_losses, learn = train_phase(
                     params, opt_state, data, jnp.asarray(iter_num), np.asarray(train_key)
                 )
                 # opt_state only crosses when the player is about to checkpoint
                 # (reference parity with the PPO weight plane's want_opt_state).
                 # replicated_to_host handles the multi-process slice mesh, where
                 # np.asarray refuses non-addressable (but replicated) outputs.
+                # The Learn/* block rides host-side so the PLAYER's stream (the
+                # run's primary) carries the learning window too — it is a
+                # handful of scalars next to the losses the reply already syncs.
                 reply = (
                     replicated_to_host(params),
                     replicated_to_host(opt_state) if want_opt_state else None,
                     replicated_to_host(mean_losses),
+                    replicated_to_host(learn),
                 )
             params_q.put(reply)
             last_step = int(iter_num) * policy_steps_per_iter
             telemetry.observe_train(units, reply[2])
+            telemetry.observe_learn(reply[3])
             telemetry.step(last_step)
             # publishes this rank's preempt request / heartbeat step and raises
             # RankFailureError on a declared-dead peer (never hang on one)
@@ -383,9 +396,11 @@ def _service_actor(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
             ep = ep_info["episode"]
             mask = ep.get("_r", ep_info.get("_episode", np.ones(num_envs, bool)))
             rews, lens = ep["r"][mask], ep["l"][mask]
-            if aggregator and not aggregator.disabled and len(rews) > 0:
-                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+            if len(rews) > 0:
+                telemetry.observe_episodes(rews, lens)
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                    aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
         final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
@@ -651,7 +666,8 @@ def _service_learner(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
                 with timer("Time/train_time"):
                     data = sampler.sample(grant)
                     key, train_key = jax.random.split(key)
-                    params, opt_state, mean_losses = train_phase(
+                    params = apply_armed_learn_fault(params)
+                    params, opt_state, mean_losses, learn = train_phase(
                         params,
                         opt_state,
                         data,
@@ -662,6 +678,7 @@ def _service_learner(fabric, cfg: Dict[str, Any], layout: Dict[str, Any]):
                 cum_gsteps += grant
                 rounds += 1
                 telemetry.observe_train(grant, mean_losses)
+                telemetry.observe_learn(learn)
                 if rounds % publish_every == 0:
                     publisher.publish(replicated_to_host(params)["actor"])
             elif not eos:
@@ -932,9 +949,11 @@ def main(fabric, cfg: Dict[str, Any]):
                 ep = ep_info["episode"]
                 mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
                 rews, lens = ep["r"][mask], ep["l"][mask]
-                if aggregator and not aggregator.disabled and len(rews) > 0:
-                    aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                    aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+                if len(rews) > 0:
+                    telemetry.observe_episodes(rews, lens)
+                    if aggregator and not aggregator.disabled:
+                        aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                        aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
             real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
             final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
@@ -992,10 +1011,11 @@ def main(fabric, cfg: Dict[str, Any]):
                                     "sentinel before the player finished); see its log"
                                 )
                             break
-                        params_host, opt_state_host, mean_losses = msg
+                        params_host, opt_state_host, mean_losses, learn = msg
                         act_params = act.view(params_host)
                         cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                         telemetry.observe_train(per_rank_gradient_steps, mean_losses)
+                        telemetry.observe_learn(learn)
                         if aggregator and not aggregator.disabled:
                             aggregator.update("Loss/value_loss", float(mean_losses[0]))
                             aggregator.update("Loss/policy_loss", float(mean_losses[1]))

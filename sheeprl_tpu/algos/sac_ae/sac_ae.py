@@ -31,7 +31,8 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
 from sheeprl_tpu.obs import build_telemetry
-from sheeprl_tpu.resilience import build_resilience
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils import learn_stats
 from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -108,6 +109,8 @@ def make_train_phase(agent, cfg, txs, target_entropy, jit_kwargs=None):
     mlp_dec_keys = tuple(cfg.algo.mlp_keys.decoder)
     actor_tx, critic_tx, alpha_tx = txs["actor"], txs["critic"], txs["alpha"]
     encoder_tx, decoder_tx = txs["encoder"], txs["decoder"]
+    # compile the Learn/* stats only when the telemetry learning plane is on
+    learn_on = learn_stats.enabled(cfg)
 
     def _flat_img(x):
         # fold frame-stack dims into channels: [..., S, C, H, W] -> [..., S*C, H, W]
@@ -137,7 +140,9 @@ def make_train_phase(agent, cfg, txs, target_entropy, jit_kwargs=None):
         next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_target
         feat = agent.features(p, obs)
         qf_values = agent.qfs.apply({"params": cg["qfs"]}, feat, batch["actions"])
-        return critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+        loss = critic_loss(qf_values, jax.lax.stop_gradient(next_qf_value), num_critics)
+        # aux for the learn-stats block: Q statistics + per-sample TD error
+        return loss, (qf_values, qf_values - next_qf_value)
 
     def actor_loss_fn(ag, params, batch, step_key):
         p = {**params, **ag}
@@ -185,7 +190,9 @@ def make_train_phase(agent, cfg, txs, target_entropy, jit_kwargs=None):
 
             # critic
             cg = critic_group(params)
-            qf_loss, qf_grads = jax.value_and_grad(critic_loss_fn)(cg, params, batch, k_critic)
+            (qf_loss, (qf_values, td_error)), qf_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True
+            )(cg, params, batch, k_critic)
             new_cg, new_copt = _masked_update(critic_tx, qf_grads, opt_state["critic"], cg, 1)
             params = {**params, **new_cg}
             opt_state = {**opt_state, "critic": new_copt}
@@ -234,10 +241,37 @@ def make_train_phase(agent, cfg, txs, target_entropy, jit_kwargs=None):
             params = {**params, **new_eg, "decoder": new_dg}
             opt_state = {**opt_state, "encoder": new_eopt, "decoder": new_dopt}
 
-            return (params, opt_state, cum + 1), jnp.stack([qf_loss, a_loss, al_loss, rec_loss])
+            # device-side training-health block (utils/learn_stats.py). Update
+            # ratios are omitted here: _masked_update folds the gate into the
+            # returned params, so the raw update magnitude is not materialized.
+            learn = learn_stats.maybe(learn_on, lambda: {
+                **learn_stats.group_stats(
+                    "critic", grads=qf_grads, params=new_cg, opt_state=new_copt
+                ),
+                **learn_stats.group_stats(
+                    "actor", grads=a_grads, params=new_ag, opt_state=new_aopt
+                ),
+                **learn_stats.group_stats("alpha", grads=al_grads),
+                **learn_stats.group_stats("encoder", grads=enc_grads, params=new_eg),
+                **learn_stats.group_stats("decoder", grads=rec_grads["decoder"], params=new_dg),
+                **learn_stats.value_stats(qf_values, prefix="q"),
+                **learn_stats.td_quantiles(td_error),
+                **learn_stats.entropy_stats(-logprobs),
+                "Learn/alpha": jnp.exp(params["log_alpha"]).reshape(()),
+                "Learn/loss/critic": qf_loss,
+                "Learn/loss/actor": a_loss,
+                "Learn/loss/alpha": al_loss,
+                "Learn/loss/reconstruction": rec_loss,
+            })
+            return (params, opt_state, cum + 1), (
+                jnp.stack([qf_loss, a_loss, al_loss, rec_loss]),
+                learn,
+            )
 
-        (params, opt_state, _), losses = jax.lax.scan(step, (params, opt_state, cum_steps), (data, keys))
-        return params, opt_state, losses.mean(axis=0)
+        (params, opt_state, _), (losses, learn) = jax.lax.scan(
+            step, (params, opt_state, cum_steps), (data, keys)
+        )
+        return params, opt_state, losses.mean(axis=0), learn_stats.reduce_stacked(learn)
 
     return train_phase
 
@@ -268,6 +302,8 @@ def _aot_train_program():
             "algo.per_rank_batch_size=2",
             "buffer.memmap=False",
             "metric.log_level=0",
+            # lower the GROWN program (Learn/* stats compile in under telemetry)
+            "metric.telemetry.enabled=true",
         ]
     )
     fabric = tiny_fabric()
@@ -431,7 +467,8 @@ def main(fabric, cfg: Dict[str, Any]):
     # multi-device meshes — see make_train_phase's donation note.
     from sheeprl_tpu.parallel.sharding import build_state_shardings
 
-    _state_shardings = build_state_shardings(fabric, params, opt_state)
+    # extra_outputs=2: the losses vector AND the Learn/* stats block
+    _state_shardings = build_state_shardings(fabric, params, opt_state, extra_outputs=2)
     _train_jit_kwargs = (
         {"out_shardings": tuple(_state_shardings)} if _state_shardings is not None else {}
     )
@@ -483,9 +520,11 @@ def main(fabric, cfg: Dict[str, Any]):
             ep = ep_info["episode"]
             mask = ep.get("_r", ep_info.get("_episode", np.ones(total_num_envs, bool)))
             rews, lens = ep["r"][mask], ep["l"][mask]
-            if aggregator and not aggregator.disabled and len(rews) > 0:
-                aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
-                aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
+            if len(rews) > 0:
+                telemetry.observe_episodes(rews, lens)
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", float(np.mean(rews)))
+                    aggregator.update("Game/ep_len_avg", float(np.mean(lens)))
 
         real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
         final_obs_arr = infos.get("final_observation", infos.get("final_obs"))
@@ -512,7 +551,10 @@ def main(fabric, cfg: Dict[str, Any]):
                 with timer("Time/train_time"):
                     data = sampler.sample(per_rank_gradient_steps)
                     key, train_key = jax.random.split(key)
-                    params, opt_state, mean_losses = train_phase(
+                    # one-shot injected learning pathology (resilience.fault=
+                    # lr_spike): identity unless armed this iteration
+                    params = apply_armed_learn_fault(params)
+                    params, opt_state, mean_losses, learn = train_phase(
                         params,
                         opt_state,
                         data,
@@ -522,6 +564,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     act_params = act.view(params)
                     telemetry.observe_train(per_rank_gradient_steps, mean_losses)
+                    telemetry.observe_learn(learn)
                     if telemetry.wants_program("train_phase"):
                         telemetry.register_program(
                             "train_phase",

@@ -359,6 +359,9 @@ DREAMER_TINY_OVERRIDES = (
     "algo.world_model.representation_model.hidden_size=8",
     "buffer.memmap=False",
     "metric.log_level=0",
+    # the AOT gate must lower the GROWN programs: the Learn/* stats block is
+    # compiled in only under the telemetry learning plane (utils/learn_stats.py)
+    "metric.telemetry.enabled=true",
 )
 
 

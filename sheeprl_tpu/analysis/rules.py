@@ -539,18 +539,28 @@ class LoopHooksRule(Rule):
 
     PR 2/3 threaded 4 telemetry hooks (build, observe_train, step, close) and
     4 resilience hooks (build, step, preempt poll, finalize) through all
-    training loops; a NEW algo registered without them trains blind (no
-    phases/MFU/diagnosis) and cannot be preempted safely. The rule finds every
-    ``@register_algorithm``-decorated def, follows its intra-package call graph
-    (local defs + ``from sheeprl_tpu... import`` helpers, so delegation through
-    ``run_dreamer``/``run_anakin`` counts), and requires each hook to appear
-    somewhere in the reachable set."""
+    training loops, and the learning-health plane added ``observe_learn`` (the
+    fused program's ``Learn/*`` stats threading) as a fifth telemetry hook; a
+    NEW algo registered without them trains blind (no phases/MFU/diagnosis, no
+    learning-health detectors) and cannot be preempted safely. The rule finds
+    every ``@register_algorithm``-decorated def, follows its intra-package call
+    graph (local defs + ``from sheeprl_tpu... import`` helpers, so delegation
+    through ``run_dreamer``/``run_anakin`` counts), and requires each hook to
+    appear somewhere in the reachable set. A loop where a hook is structurally
+    N/A (e.g. a driver with no train rounds of its own) waives it per file in
+    ``analysis/waivers.toml`` with a reason, like any other rule."""
 
     name = "loop-hooks-incomplete"
     severity = "critical"
     doc = "registered algo entrypoint missing telemetry/resilience hooks"
 
-    TELEMETRY_HOOKS = ("build_telemetry", "observe_train", "telemetry.step", "telemetry.close")
+    TELEMETRY_HOOKS = (
+        "build_telemetry",
+        "observe_train",
+        "observe_learn",
+        "telemetry.step",
+        "telemetry.close",
+    )
     RESILIENCE_HOOKS = (
         "build_resilience",
         "resilience.step",
@@ -670,7 +680,7 @@ class LoopHooksRule(Rule):
                     attr = node.func.attr
                     owner = dotted_name(node.func.value) or ""
                     owner_leaf = owner.split(".")[-1]
-                    if attr in ("observe_train", "preempt_requested"):
+                    if attr in ("observe_train", "observe_learn", "preempt_requested"):
                         present.add(attr)
                     if attr in ("step", "close", "finalize") and (
                         "telemetry" in owner_leaf or "resilience" in owner_leaf
@@ -694,9 +704,10 @@ class LoopHooksRule(Rule):
                     f"registered entrypoint {entry.name!r} does not thread "
                     f"{len(missing)} required loop hook(s): {', '.join(missing)}",
                     "thread the telemetry hooks (build_telemetry / observe_train / "
-                    "telemetry.step / telemetry.close) and resilience hooks "
-                    "(build_resilience / resilience.step / preempt_requested / "
-                    "resilience.finalize) — see any existing loop, e.g. sac.py",
+                    "observe_learn / telemetry.step / telemetry.close) and resilience "
+                    "hooks (build_resilience / resilience.step / preempt_requested / "
+                    "resilience.finalize) — see any existing loop, e.g. sac.py; waive "
+                    "per file in analysis/waivers.toml where a hook is structurally N/A",
                 )
 
 

@@ -46,6 +46,17 @@ def member_rollup(member_dir: str) -> Dict[str, Any]:
             k: summary.get(k)
             for k in ("sps", "total_steps", "wall_seconds", "train_units", "mfu", "windows")
         }
+        # learning rollup (the training-health plane): mean episode return +
+        # policy entropy land FLAT in the summary so `rank_by: ep_return`
+        # ranks a sweep on sample efficiency, not just throughput
+        learning = summary.get("learning") or {}
+        episodes = learning.get("episodes") or {}
+        if isinstance(episodes.get("return_mean"), (int, float)):
+            out["summary"]["ep_return"] = episodes["return_mean"]
+        stats = learning.get("stats") or {}
+        if isinstance(stats.get("entropy"), (int, float)):
+            out["summary"]["entropy"] = stats["entropy"]
+        out["learning"] = learning or None
         out["clean_exit"] = bool(summary.get("clean_exit", True))
         compile_ = dict(summary.get("compile") or {})
         if compile_:
@@ -213,6 +224,7 @@ def format_leaderboard(leaderboard: Dict[str, Any]) -> str:
         compile_ = entry.get("compile") or {}
         diagnosis = entry.get("diagnosis") or {}
         value = summary.get(leaderboard.get("rank_by"))
+        ep_return = summary.get("ep_return")
         lines.append(
             f"  #{entry.get('rank')} {entry['name']:<24} "
             + (f"{value:>10.1f}" if isinstance(value, (int, float)) else f"{'—':>10}")
@@ -220,6 +232,7 @@ def format_leaderboard(leaderboard: Dict[str, Any]) -> str:
             + f" attempts={entry.get('attempts')}"
             + f" compiles={compile_.get('count', '?')}(cold {compile_.get('cold', '?')})"
             + f" findings={diagnosis.get('critical', 0)}c/{diagnosis.get('warning', 0)}w"
+            + (f" ret={ep_return:.1f}" if isinstance(ep_return, (int, float)) else "")
         )
     gate = leaderboard.get("gate") or {}
     if gate.get("failed"):

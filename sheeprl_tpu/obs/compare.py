@@ -44,6 +44,7 @@ COMPILE_STORM_DELTA = 3  # ≥3 extra compiles escalates to critical
 DEFAULT_BENCH_THRESHOLD = 0.05  # bench-diff per-metric relative threshold
 DATAFLOW_GROWTH = 0.25  # ≥25% staleness/latency growth flags (lower-is-better)
 WEIGHT_LAG_DELTA = 2  # absolute extra weight versions of actor lag that flag
+LEARNING_LOSS_GROWTH = 0.25  # ≥25% median loss growth flags (lower-is-better)
 
 _PHASE_KEYS = (
     "env",
@@ -126,6 +127,65 @@ def _finding(
 # ---------------------------------------------------------------------------------
 # run profiling
 # ---------------------------------------------------------------------------------
+def learning_curves(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Noise-banded learning-curve extraction: one point per steady window
+    carrying a ``learning`` block — policy step, the window's episode-return
+    median with its own p10/p90 band, and the per-group loss means. This is
+    the per-step sample-efficiency trace ``compare`` judges (and writes into
+    ``comparison.json`` so CI artifacts carry the curves, not just verdicts)."""
+    from sheeprl_tpu.obs.streams import is_primary_event as _primary
+
+    points: List[Dict[str, Any]] = []
+    for e in events:
+        if e.get("event") != "window" or e.get("final") or not _primary(e):
+            continue
+        learning = e.get("learning")
+        if not isinstance(learning, dict):
+            continue
+        point: Dict[str, Any] = {"step": e.get("step")}
+        episodes = learning.get("episodes") or {}
+        for src, dst in (
+            ("return_p50", "return_p50"),
+            ("return_p10", "return_p10"),
+            ("return_p90", "return_p90"),
+            ("count", "episodes"),
+        ):
+            if isinstance(episodes.get(src), (int, float)):
+                point[dst] = episodes[src]
+        losses = {
+            k.split("/", 1)[1]: v
+            for k, v in (learning.get("stats") or {}).items()
+            if k.startswith("loss/") and isinstance(v, (int, float))
+        }
+        if losses:
+            point["loss"] = losses
+        if isinstance((learning.get("stats") or {}).get("entropy"), (int, float)):
+            point["entropy"] = learning["stats"]["entropy"]
+        if len(point) > 1:
+            points.append(point)
+    return points
+
+
+def _profile_learning(events: Sequence[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The learning half of a run profile: window distributions of the
+    episode-return median, per-group losses and entropy, plus the raw curve."""
+    curve = learning_curves(events)
+    if not curve:
+        return None
+    loss_keys = sorted({k for p in curve for k in (p.get("loss") or {})})
+    return {
+        "ep_return": _dist([p["return_p50"] for p in curve if "return_p50" in p]),
+        "entropy": _dist([p["entropy"] for p in curve if "entropy" in p]),
+        "losses": {
+            k: _dist([p["loss"][k] for p in curve if k in (p.get("loss") or {})])
+            for k in loss_keys
+        },
+        "episodes": sum(int(p.get("episodes") or 0) for p in curve),
+        "curve": curve,
+    }
+
+
+
 def profile_run(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     """Distill one merged event stream into the comparison profile: fingerprint,
     per-window distributions, totals. Only the run's PRIMARY stream (rank-0
@@ -238,6 +298,9 @@ def profile_run(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         "rss_peak_bytes": int(rss_peak) or None,
         "env_restarts": env_restarts,
         "dataflow": dataflow,
+        # training-health curves (windows carrying a `learning` block): the
+        # sample-efficiency half of the comparison — None on old/serving runs
+        "learning": _profile_learning(events),
         "summary_sps": _f(summary.get("sps")) if summary and summary.get("sps") is not None else None,
     }
 
@@ -428,6 +491,65 @@ def compare_profiles(
                     )
                 )
 
+    # learning curves: sample-efficiency regressions. Episode return gates
+    # higher-is-better, the per-group losses lower-is-better; entropy is
+    # REPORTED but never gated alone (a lower entropy with an equal-or-better
+    # return is convergence, not a defect — the direction is ambiguous).
+    la, lb = profile_a.get("learning") or {}, profile_b.get("learning") or {}
+    if la and lb:
+        metrics["learning"] = {}
+        dm = _delta_metric(la.get("ep_return"), lb.get("ep_return"))
+        metrics["learning"]["ep_return"] = dm
+        if dm is not None and dm["beyond_noise"] and dm["delta"] < 0:
+            scale = max(abs(_f((dm.get("a") or {}).get("median"))), 1.0)
+            pct = abs(dm["delta"]) / scale
+            if pct >= REL_FLOOR:
+                findings.append(
+                    _finding(
+                        "learning_regression",
+                        "critical" if pct >= CRITICAL_DROP else "warning",
+                        f"run B's median per-window episode return is "
+                        f"{dm['b']['median']:g} vs run A's {dm['a']['median']:g} — "
+                        "beyond both runs' window spread: B learns less from the "
+                        "same steps",
+                        "`sheeprl.py diagnose` run B for the causal finding "
+                        "(entropy_collapse / grad_explosion / value_overestimation); "
+                        "the comparison.json learning curves localize where the "
+                        "trajectories diverge",
+                        metric="ep_return",
+                        **{k: dm[k] for k in ("delta", "rel", "noise")},
+                    )
+                )
+        for key in sorted(set(la.get("losses") or {}) & set(lb.get("losses") or {})):
+            dm = _delta_metric((la.get("losses") or {}).get(key), (lb.get("losses") or {}).get(key))
+            metrics["learning"][f"loss/{key}"] = dm
+            if dm is None:
+                continue
+            # growth over |A's median| (floored): policy/actor/alpha losses are
+            # routinely NEGATIVE, so the signed rel would never cross a positive
+            # threshold for half the loss keys — same scaling as the ep_return
+            # gate above
+            loss_scale = max(abs(_f((dm.get("a") or {}).get("median"))), 1.0)
+            if (
+                dm["beyond_noise"]
+                and dm["delta"] > 0
+                and dm["delta"] / loss_scale >= LEARNING_LOSS_GROWTH
+            ):
+                findings.append(
+                    _finding(
+                        "learning_regression",
+                        "warning",
+                        f"run B's median {key} loss grew {dm['delta'] / loss_scale:.0%} "
+                        f"of run A's scale ({dm['b']['median']:g} vs {dm['a']['median']:g}) "
+                        "— beyond both runs' window spread",
+                        "diff the two configs' optimizer/clip settings and diagnose "
+                        "run B (grad_explosion / kl_balance_drift name the group)",
+                        metric=f"loss/{key}",
+                        **{k: dm[k] for k in ("delta", "rel", "noise")},
+                    )
+                )
+        metrics["learning"]["entropy"] = _delta_metric(la.get("entropy"), lb.get("entropy"))
+
     # env stability
     ra, rb = int(_f(profile_a.get("env_restarts"))), int(_f(profile_b.get("env_restarts")))
     metrics["env_restarts"] = {"a": ra, "b": rb}
@@ -471,6 +593,14 @@ def compare_runs(
     result = compare_profiles(profiles["a"], profiles["b"])
     result["run_a"] = {"dir": str(run_a), **{k: profiles["a"][k] for k in ("windows", "attempts", "clean_exit")}}
     result["run_b"] = {"dir": str(run_b), **{k: profiles["b"][k] for k in ("windows", "attempts", "clean_exit")}}
+    # the raw noise-banded learning curves ride the artifact (CI plots them;
+    # the findings above only carry the verdict)
+    curves = {
+        label: (profiles[label].get("learning") or {}).get("curve")
+        for label in ("a", "b")
+    }
+    if any(curves.values()):
+        result["learning_curves"] = curves
     base = run_b if os.path.isdir(run_b) else os.path.dirname(run_b)
     out = json_path or os.path.join(base, "comparison.json")
     with open(out, "w") as fh:
@@ -510,6 +640,14 @@ def format_comparison(result: Mapping[str, Any]) -> str:
         lines.append(
             f"  compiles    : {int(_f(a.get('count')))} ({_f(a.get('seconds')):.1f}s) → "
             f"{int(_f(b.get('count')))} ({_f(b.get('seconds')):.1f}s)"
+        )
+    learning_m = metrics.get("learning") or {}
+    dm = learning_m.get("ep_return")
+    if dm:
+        flag = "  ← beyond noise" if dm.get("beyond_noise") else ""
+        lines.append(
+            f"  ep return   : median {dm['a']['median']:g} → {dm['b']['median']:g}"
+            f"  [p10–p90 A: {dm['a']['p10']:g}–{dm['a']['p90']:g}]{flag}"
         )
     findings = result.get("findings") or []
     if not findings:
@@ -631,7 +769,10 @@ def _lower_is_better(unit: str) -> bool:
     # the serve_load p99 step-latency workload gates in "ms" and dv3_2d_mesh
     # gates per-device parameter bytes. The "_ms"/" ms" suffix forms cover
     # metric-style units ("latency_ms") without false-matching substrings in
-    # rate units ("items/sec").
+    # rate units ("items/sec"). The learning metrics gate by unit too: "loss"
+    # regresses UP, while "return" (episode return) and "nats" (policy
+    # entropy) are higher-is-better — the default — so an entropy workload can
+    # never be gated backwards (direction-pinned in tests/test_obs/test_compare.py).
     unit = (unit or "").lower()
     return (
         unit.startswith("seconds")
@@ -642,6 +783,7 @@ def _lower_is_better(unit: str) -> bool:
         or unit.startswith("milliseconds")
         or unit.endswith("_ms")
         or "_ms " in unit
+        or unit.startswith("loss")
     )
 
 
@@ -697,7 +839,10 @@ def bench_diff(
             row["status"] = "unreadable"
             rows.append(row)
             continue
-        rel = (new_v - old_v) / old_v if old_v else None
+        # signed change over |old|: a negative baseline (differential entropy
+        # in nats, negative episode returns) must not flip the direction —
+        # (new-old)/old would call an entropy collapse an "improvement"
+        rel = (new_v - old_v) / abs(old_v) if old_v else None
         row["rel_change"] = round(rel, 4) if rel is not None else None
         lower_better = _lower_is_better(str(w.get("unit") or prev.get("unit") or ""))
         row["direction"] = "lower-is-better" if lower_better else "higher-is-better"

@@ -34,6 +34,14 @@ slot_starvation     (serving) sessions queued while the slot table ran full
 weight_staleness    (service) actors acting with weights far behind the learner
 row_age_drift       (service) the learner trains on increasingly old rows
 ingest_backpressure (service) actors blocked on flow control / ingest backlog
+grad_explosion      (learning) gradient norms far above the run median / nonfinite
+entropy_collapse    (learning) policy entropy fell off a cliff vs early training
+value_overestimation (learning) value estimates grew far past the return scale
+update_ratio_anomaly (learning) update-to-param ratio spiked vs the run median
+kl_balance_drift    (learning, dreamer) KL collapsed/exploded or the posterior/
+                    prior entropy balance drifted (posterior collapse signal)
+reward_plateau      (learning) episode returns rose, then flattened for the
+                    rest of the run (advisory — sample-efficiency signal)
 ==================  ============================================================
 
 The three serving detectors read the ``serve`` block of a serving run's
@@ -87,6 +95,21 @@ ROW_AGE_MIN_SECONDS = 10.0  # ignore drift while everything is seconds-fresh
 INGEST_BLOCK_WARNING = 0.25  # actor wall share spent blocked on flow control
 INGEST_BLOCK_CRITICAL = 0.50
 INGEST_QUEUE_DEPTH = 4.0  # learner-side sustained backlog (messages)
+# training-health (learning block) detectors — utils/learn_stats.py producers
+LEARN_MIN_WINDOWS = 4  # windows with learning stats before judging trends
+GRAD_EXPLOSION_RATIO = 10.0  # window grad norm vs run median that flags
+GRAD_EXPLOSION_CRITICAL = 100.0  # ...and that escalates to critical
+ENTROPY_COLLAPSE_DROP = 0.5  # late-half entropy drop vs max(|early median|, 1)
+VALUE_OVER_SCALE = 5.0  # late value mean vs max(|ep-return median|, 1)
+VALUE_OVER_GROWTH = 3.0  # ...and vs the early-half value mean
+VALUE_OVER_CRITICAL = 20.0  # value/return ratio that escalates to critical
+UPDATE_RATIO_ANOMALY = 10.0  # window update/param ratio vs run median
+KL_BALANCE_DRIFT = 0.25  # |late - early| posterior/prior balance shift
+KL_COLLAPSE_RATIO = 0.1  # late-half KL vs early-half (posterior collapse)
+KL_EXPLOSION_RATIO = 10.0  # late-half KL vs early-half (dynamics divergence)
+REWARD_PLATEAU_MIN_WINDOWS = 8  # windows with episode stats before judging
+REWARD_PLATEAU_EPS = 0.05  # late improvement below this fraction of the climb
+REWARD_PLATEAU_MIN_CLIMB = 0.2  # climb must exceed this fraction of max(|peak|, 1)
 
 
 def _ref(event: Dict[str, Any]) -> Dict[str, Any]:
@@ -915,6 +938,349 @@ def detect_ingest_backpressure(events: Events) -> List[Finding]:
     return findings
 
 
+def _learning_windows(events: Events) -> List[Dict[str, Any]]:
+    """Steady windows carrying a ``learning`` block (training runs with the
+    learning plane on — everything else contributes none, so the training-
+    health detectors are free no-ops on serving/old streams).
+
+    Decoupled topologies MIRROR the learner's Learn block onto the player's
+    primary stream (the channel reply ships it host-side), so a merged run dir
+    would otherwise present every real window twice — doubling the affected
+    counts the escalation thresholds key on. Judge ONE stream: the primary when
+    it carries learning windows, else the stream with the most (the service
+    learner's, whose player never trains)."""
+    wins = [w for w in _windows(events) if isinstance(w.get("learning"), dict)]
+    if not wins:
+        return []
+    groups: Dict[Any, List[Dict[str, Any]]] = {}
+    for w in wins:
+        groups.setdefault(w.get("stream") or f"rank{w.get('rank', 0)}", []).append(w)
+    if len(groups) == 1:
+        return wins
+    from sheeprl_tpu.obs.streams import is_primary_event
+
+    primary = [w for w in wins if is_primary_event(w)]
+    if primary:
+        return primary
+    return max(groups.values(), key=len)
+
+
+def _learn_stat(window: Dict[str, Any], key: str) -> Optional[float]:
+    stats = (window.get("learning") or {}).get("stats") or {}
+    value = stats.get(key)
+    if isinstance(value, (int, float)) and value == value:  # NaN-safe
+        return float(value)
+    return None
+
+
+def _learn_keys(windows: List[Dict[str, Any]], prefix: str) -> List[str]:
+    keys: set = set()
+    for w in windows:
+        for k in ((w.get("learning") or {}).get("stats") or {}):
+            if k.startswith(prefix):
+                keys.add(k)
+    return sorted(keys)
+
+
+def _ep_return_series(events: Events) -> List[Tuple[Dict[str, Any], float]]:
+    out: List[Tuple[Dict[str, Any], float]] = []
+    for w in _learning_windows(events):
+        ep = (w.get("learning") or {}).get("episodes") or {}
+        ret = ep.get("return_p50", ep.get("return_mean"))
+        if isinstance(ret, (int, float)):
+            out.append((w, float(ret)))
+    return out
+
+
+def detect_grad_explosion(events: Events) -> List[Finding]:
+    """Gradient norms far above the run's own median (or non-finite): the
+    first casualty of a mis-scaled update, a bad batch, or an lr spike. Judged
+    per module group on the window-max series (a one-step spike inside a fused
+    multi-step round is exactly what must not be averaged away)."""
+    windows = _learning_windows(events)
+    findings: List[Finding] = []
+    # non-finite gradient stats are conclusive from a single window
+    bad = [
+        (w, k)
+        for w in windows
+        for k in (w["learning"].get("nonfinite") or [])
+        if k.startswith("grad_norm")
+    ]
+    if bad:
+        names = sorted({k for _, k in bad})
+        findings.append(
+            _finding(
+                "grad_explosion",
+                "critical",
+                f"non-finite gradient norm(s) ({', '.join(names)}) in "
+                f"{len({id(w) for w, _ in bad})} window(s) — training is diverging",
+                [w for w, _ in bad],
+                "lower the learning rate / tighten gradient clipping; "
+                "metric.telemetry.abort_on_nonfinite=true fails the run fast",
+                stats=names,
+            )
+        )
+    for key in _learn_keys(windows, "grad_norm_max/"):
+        series = [(w, v) for w in windows if (v := _learn_stat(w, key)) is not None]
+        if len(series) < LEARN_MIN_WINDOWS:
+            continue
+        median = _median([v for _, v in series])
+        if median <= 0:
+            continue
+        affected = [(w, v) for w, v in series if v >= GRAD_EXPLOSION_RATIO * median]
+        if not affected:
+            continue
+        group = key.split("/", 1)[1]
+        worst = max(v for _, v in affected)
+        severity = (
+            "critical"
+            if worst >= GRAD_EXPLOSION_CRITICAL * median or len(affected) >= 3
+            else "warning"
+        )
+        findings.append(
+            _finding(
+                "grad_explosion",
+                severity,
+                f"the {group} gradient norm spiked to {worst:.3g} — "
+                f"{worst / median:.0f}x the run median ({median:.3g}) across "
+                f"{len(affected)} window(s)",
+                [w for w, _ in affected],
+                "look for an lr spike / bad batch at those steps (the window "
+                "events' step field); tighten the group's clip_gradients, or "
+                "lower its learning rate",
+                group=group,
+                worst=round(worst, 4),
+                median=round(median, 4),
+                windows=len(affected),
+            )
+        )
+    return findings
+
+
+def detect_entropy_collapse(events: Events) -> List[Finding]:
+    """Policy entropy fell off a cliff relative to early training: the policy
+    went (near-)deterministic long before the return justified it — exploration
+    is dead and learning will plateau. Judged on DELTAS (continuous policies
+    report differential entropy, which is legitimately negative)."""
+    windows = _learning_windows(events)
+    series = [(w, v) for w in windows if (v := _learn_stat(w, "entropy")) is not None]
+    if len(series) < LEARN_MIN_WINDOWS:
+        return []
+    half = len(series) // 2
+    early = _median([v for _, v in series[:half]])
+    late = _median([v for _, v in series[half:]])
+    drop = early - late
+    scale = max(abs(early), 1.0)
+    if drop < ENTROPY_COLLAPSE_DROP * scale:
+        return []
+    last = series[-1][1]
+    severity = "critical" if drop >= 2 * ENTROPY_COLLAPSE_DROP * scale else "warning"
+    return [
+        _finding(
+            "entropy_collapse",
+            severity,
+            f"policy entropy collapsed {early:.3g} → {late:.3g} (late-half median; "
+            f"last window {last:.3g}) — the policy went near-deterministic",
+            [w for w, _ in series[half:]],
+            "raise the entropy coefficient (algo.ent_coef / actor.ent_coef), "
+            "check the reward scale, and compare the episode-return curve — a "
+            "collapse without a matching return rise is premature convergence",
+            early=round(early, 4),
+            late=round(late, 4),
+            drop=round(drop, 4),
+        )
+    ]
+
+
+def detect_value_overestimation(events: Events) -> List[Finding]:
+    """Value/Q estimates growing far past the scale of anything the agent has
+    actually collected: optimistic bootstrapping feeding on itself (the classic
+    off-policy overestimation spiral). Needs both value stats and episode
+    returns — without a return scale, big values might be legitimate."""
+    windows = _learning_windows(events)
+    key = next((k for k in ("q_mean", "value_mean") if any(_learn_stat(w, k) is not None for w in windows)), None)
+    if key is None:
+        return []
+    series = [(w, v) for w in windows if (v := _learn_stat(w, key)) is not None]
+    returns = _ep_return_series(events)
+    if len(series) < LEARN_MIN_WINDOWS or not returns:
+        return []
+    half = len(series) // 2
+    early = _median([v for _, v in series[:half]])
+    late = _median([v for _, v in series[half:]])
+    ret_scale = max(abs(_median([r for _, r in returns])), 1.0)
+    if late < VALUE_OVER_SCALE * ret_scale or late < VALUE_OVER_GROWTH * max(abs(early), 1e-9):
+        return []
+    severity = "critical" if late >= VALUE_OVER_CRITICAL * ret_scale else "warning"
+    return [
+        _finding(
+            "value_overestimation",
+            severity,
+            f"the {key.split('_')[0]} estimate grew {early:.3g} → {late:.3g} while episode "
+            f"returns sit around {ret_scale:.3g} — bootstrapped optimism is "
+            "feeding on itself",
+            [w for w, _ in series[half:]],
+            "check the TD-error quantiles in the same windows (a fat positive "
+            "tail confirms it); lower gamma/learning rate, or strengthen the "
+            "pessimism mechanism (twin critics, target-network cadence)",
+            early=round(early, 4),
+            late=round(late, 4),
+            return_scale=round(ret_scale, 4),
+        )
+    ]
+
+
+def detect_update_ratio_anomaly(events: Events) -> List[Finding]:
+    """Update-to-param ratio of a module group spiking far above the run
+    median: the optimizer briefly rewrote a material fraction of the weights —
+    an lr-schedule bug, a moment-state corruption, or an unclipped spike that
+    got through."""
+    windows = _learning_windows(events)
+    findings: List[Finding] = []
+    for key in _learn_keys(windows, "update_ratio/"):
+        series = [(w, v) for w in windows if (v := _learn_stat(w, key)) is not None]
+        if len(series) < LEARN_MIN_WINDOWS:
+            continue
+        median = _median([v for _, v in series])
+        if median <= 0:
+            continue
+        affected = [(w, v) for w, v in series if v >= UPDATE_RATIO_ANOMALY * median]
+        if not affected:
+            continue
+        group = key.split("/", 1)[1]
+        worst = max(v for _, v in affected)
+        findings.append(
+            _finding(
+                "update_ratio_anomaly",
+                "critical" if len(affected) >= 3 else "warning",
+                f"the {group} update-to-param ratio spiked to {worst:.3g} — "
+                f"{worst / median:.0f}x the run median across {len(affected)} window(s)",
+                [w for w, _ in affected],
+                "inspect the lr schedule around those steps and the matching "
+                "grad_norm windows (an unclipped gradient spike shows in both)",
+                group=group,
+                worst=round(worst, 6),
+                median=round(median, 6),
+                windows=len(affected),
+            )
+        )
+    return findings
+
+
+def detect_kl_balance_drift(events: Events) -> List[Finding]:
+    """Dreamer-family latent-dynamics health: the posterior/prior KL collapsing
+    toward zero (posterior collapse — the representation stops carrying
+    information) or exploding (the prior never catches the dynamics), or the
+    posterior/prior entropy balance drifting materially."""
+    windows = _learning_windows(events)
+    series = [(w, v) for w in windows if (v := _learn_stat(w, "kl")) is not None]
+    if len(series) < LEARN_MIN_WINDOWS:
+        return []
+    findings: List[Finding] = []
+    half = len(series) // 2
+    early = _median([v for _, v in series[:half]])
+    late = _median([v for _, v in series[half:]])
+    if early > 0 and late <= KL_COLLAPSE_RATIO * early:
+        findings.append(
+            _finding(
+                "kl_balance_drift",
+                "warning",
+                f"the posterior/prior KL collapsed {early:.3g} → {late:.3g} — the "
+                "posterior is converging onto the prior (representation collapse)",
+                [w for w, _ in series[half:]],
+                "lower kl_regularizer / raise kl_free_nats, and check the "
+                "reconstruction losses — a collapsed KL with flat recon means "
+                "the world model stopped learning",
+                early=round(early, 4),
+                late=round(late, 4),
+                mode="collapse",
+            )
+        )
+    elif early > 0 and late >= KL_EXPLOSION_RATIO * early:
+        findings.append(
+            _finding(
+                "kl_balance_drift",
+                "warning",
+                f"the posterior/prior KL exploded {early:.3g} → {late:.3g} — the "
+                "prior is not tracking the dynamics",
+                [w for w, _ in series[half:]],
+                "check kl_dynamic/kl_representation weighting and the world "
+                "model's learning rate; a grad_explosion finding in the same "
+                "windows points at the same root cause",
+                early=round(early, 4),
+                late=round(late, 4),
+                mode="explosion",
+            )
+        )
+    balance = [(w, v) for w in windows if (v := _learn_stat(w, "kl_balance")) is not None]
+    if len(balance) >= LEARN_MIN_WINDOWS:
+        bhalf = len(balance) // 2
+        b_early = _median([v for _, v in balance[:bhalf]])
+        b_late = _median([v for _, v in balance[bhalf:]])
+        if abs(b_late - b_early) >= KL_BALANCE_DRIFT:
+            findings.append(
+                _finding(
+                    "kl_balance_drift",
+                    "warning",
+                    f"the posterior/prior entropy balance drifted {b_early:.2f} → "
+                    f"{b_late:.2f} — toward "
+                    + ("posterior collapse" if b_late < b_early else "an uninformative prior"),
+                    [w for w, _ in balance[bhalf:]],
+                    "rebalance kl_dynamic vs kl_representation (dv3) or "
+                    "kl_balancing_alpha (dv2); watch post/prior entropies in the "
+                    "learning block",
+                    early=round(b_early, 4),
+                    late=round(b_late, 4),
+                    mode="balance",
+                )
+            )
+    return findings
+
+
+def detect_reward_plateau(events: Events) -> List[Finding]:
+    """Episode returns climbed, then flattened for the rest of the run: the
+    sample-efficiency signal. Advisory (info): a plateau can be the task
+    ceiling — the finding points at the step where improvement stopped so the
+    learning-curve comparison (`compare`) can judge against another run."""
+    returns = _ep_return_series(events)
+    if len(returns) < REWARD_PLATEAU_MIN_WINDOWS:
+        return []
+    values = [r for _, r in returns]
+    third = max(len(values) // 3, 1)
+    early = _median(values[:third])
+    peak = max(values)
+    peak_idx = values.index(peak)
+    mid = _median(values[-2 * third : -third])
+    late = _median(values[-third:])
+    climb = peak - early
+    # the peak is a sample MAX against an early MEDIAN, so pure noise always
+    # shows a small positive "climb" — require a material one (relative to the
+    # curve's own scale) before claiming the run ever improved
+    if climb < REWARD_PLATEAU_MIN_CLIMB * max(abs(peak), 1.0):
+        return []
+    # plateau = the curve climbed, then the final third stopped improving over
+    # the third before it (a still-climbing run has late >> mid and never fires)
+    if (late - mid) > REWARD_PLATEAU_EPS * climb:
+        return []
+    plateau_step = returns[peak_idx][0].get("step")
+    return [
+        _finding(
+            "reward_plateau",
+            "info",
+            f"episode returns climbed {early:.3g} → {peak:.3g} (around step "
+            f"{plateau_step}) then flattened at {late:.3g} for the rest of the run",
+            [w for w, _ in returns[-third:]],
+            "if this is below the task's known ceiling: check entropy_collapse "
+            "(dead exploration) and the replay ratio; `sheeprl.py compare` "
+            "against a healthy run gates the sample-efficiency regression",
+            early=round(early, 4),
+            peak=round(peak, 4),
+            late=round(late, 4),
+            peak_step=plateau_step,
+        )
+    ]
+
+
 DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "recompile_storm": detect_recompile_storm,
     "prefetch_starvation": detect_prefetch_starvation,
@@ -931,6 +1297,12 @@ DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "weight_staleness": detect_weight_staleness,
     "row_age_drift": detect_row_age_drift,
     "ingest_backpressure": detect_ingest_backpressure,
+    "grad_explosion": detect_grad_explosion,
+    "entropy_collapse": detect_entropy_collapse,
+    "value_overestimation": detect_value_overestimation,
+    "update_ratio_anomaly": detect_update_ratio_anomaly,
+    "kl_balance_drift": detect_kl_balance_drift,
+    "reward_plateau": detect_reward_plateau,
 }
 
 

@@ -95,6 +95,10 @@ _WINDOW: Dict[str, Tuple[tuple, bool]] = {
     "prefetch": (_DICT, False),
     "dataflow": (_DICT, False),  # experience-plane lineage (data/service.py)
     "serve": (_DICT, False),
+    # training-health block (utils/learn_stats.py → RunTelemetry.observe_learn):
+    # {rounds, stats: {grad_norm/<g>, entropy, td_error_p50, ...},
+    #  episodes: {count, return_mean, return_p10/p50/p90, len_mean}, nonfinite}
+    "learning": (_DICT, False),
 }
 
 _SUMMARY: Dict[str, Tuple[tuple, bool]] = {
@@ -116,6 +120,7 @@ _SUMMARY: Dict[str, Tuple[tuple, bool]] = {
     "env_restarts": (_INT, False),
     "health": (_STR, False),
     "dataflow": (_DICT, False),
+    "learning": (_DICT, False),  # run-level learning rollup (+ last window)
     "programs": (_DICT, False),
     "serve": (_DICT, False),
 }

@@ -9,6 +9,16 @@ config group) and driven by four hooks, each a no-op when the feature is off:
 - ``observe_train(units, losses)`` — after each train round; accumulates the
   gradient-step count that scales the in-loop MFU and keeps the latest host/device
   losses for the periodic loss-finiteness health guard.
+- ``observe_learn(stats)`` — after each train round, with the fused program's
+  device-side ``Learn/*`` scalar block (``utils/learn_stats.py``): grad norms
+  pre/post clip, clip fraction, update-to-param ratios, param/moment norms,
+  policy entropy, value stats, TD-error quantiles, dreamer KL balance. Only
+  REFERENCES are kept (a bounded stride-doubling reservoir per window); the
+  host fetches them in ONE ``jax.device_get`` at window cadence, so the
+  zero-steady-state-host-transfer contract survives.
+- ``observe_episodes(returns, lengths)`` — whenever episodes finish; feeds the
+  per-window episode-return distribution (count/mean/p10/p50/p90) the
+  reward-plateau detector and ``compare``'s learning-curve extraction read.
 - ``register_program(name, fn, args, units=...)`` — once (guard with
   ``wants_program``) with the live fused train program; lowers it from avals
   (no execution, donation-safe) to read XLA's own FLOPs/memory numbers.
@@ -84,6 +94,18 @@ _PHASE_TIMERS = {
 # window/health events the in-loop diagnosis keeps (bounded history)
 _HISTORY_CAP = 512
 
+# learn-stats reservoir: at most this many per-round device-stat dicts are held
+# per window; past it the reservoir drops every other entry and doubles its
+# sampling stride, so coverage stays spread over the whole window at O(1) memory
+_LEARN_RESERVOIR = 64
+
+# episode returns kept per window for the return distribution (count stays exact)
+_EPISODE_RESERVOIR = 4096
+
+# the Learn/* key grammar lives in utils/learn_stats.py (the producers' module);
+# importing it keeps the filter and the gauges on the one shared definition
+from sheeprl_tpu.utils.learn_stats import LEARN_PREFIX, learn_keys
+
 # live (built, not yet closed) RunTelemetry instances of this process. The loops
 # close their own instance on the normal path; an exception that unwinds past a
 # loop leaves its instance here, and cli.run_algorithm's finally flushes it with
@@ -126,6 +148,14 @@ class NullTelemetry:
         pass
 
     def observe_train(self, units: int, losses: Any = None) -> None:
+        pass
+
+    def observe_learn(self, stats: Any = None) -> None:
+        pass
+
+    def observe_episodes(
+        self, returns: Any = None, lengths: Any = None, count: Any = None
+    ) -> None:
         pass
 
     def observe_env_restart(self, count: int = 1) -> None:
@@ -260,6 +290,7 @@ class RunTelemetry:
         self.compile_warmup_steps = int(tcfg.get("compile_warmup_steps") or 0)
         self._program_analysis = bool(tcfg.get("program_analysis", True))
         self.diagnosis = bool(tcfg.get("diagnosis", True))
+        self.learning = bool(tcfg.get("learning", True))
 
         # stream identity: rank = the writing process's launch-topology position
         # (role streams override it), attempt = supervisor restart counter
@@ -320,6 +351,22 @@ class RunTelemetry:
         self._last_mfu: Optional[float] = None
         self._peak_hbm = 0
         self._last_step: Optional[int] = None
+        # learning-health state: per-window device-stat reservoir (references
+        # only — fetched in one device_get at window cadence), per-window
+        # episode-return sample, and run-level accumulators for the summary
+        self._learn_window: list = []
+        self._learn_stride = 1
+        self._learn_seen = 0
+        self._learn_rounds_total = 0
+        self._learn_run_sums: Dict[str, float] = {}
+        self._learn_run_counts: Dict[str, int] = {}
+        self._learn_run_max: Dict[str, float] = {}
+        self._last_learning: Optional[Dict[str, Any]] = None
+        self._ep_returns: list = []
+        self._ep_lengths: list = []
+        self._ep_count_window = 0
+        self._ep_count_total = 0
+        self._ep_return_total = 0.0
         self._dataflow: Any = None  # attach_dataflow provider (experience plane)
         self._last_dataflow: Optional[Dict[str, Any]] = None
         # opt-in Prometheus endpoint (metric.telemetry.http_port): serves the
@@ -468,6 +515,52 @@ class RunTelemetry:
         self._total_train_units += int(units)
         if losses is not None:
             self._last_losses = losses
+
+    def observe_learn(self, stats: Any = None) -> None:
+        """Keep this train round's ``Learn/*`` device-stat block (references
+        only — no sync here; see the module docstring). Accepts either a pure
+        learn dict or a mixed metrics mapping (the dreamer family's) and keeps
+        the ``Learn/``-prefixed subset, prefix stripped."""
+        if not self.enabled or not self.learning or not isinstance(stats, Mapping):
+            return
+        learn = {k[len(LEARN_PREFIX) :]: v for k, v in learn_keys(stats).items()}
+        if not learn:
+            return
+        self._learn_seen += 1
+        self._learn_rounds_total += 1
+        if (self._learn_seen - 1) % self._learn_stride:
+            return
+        self._learn_window.append(learn)
+        if len(self._learn_window) >= _LEARN_RESERVOIR:
+            # stride-doubling decimation: coverage stays spread across the
+            # whole window instead of biasing to its head or tail
+            self._learn_window = self._learn_window[::2]
+            self._learn_stride *= 2
+
+    def observe_episodes(
+        self, returns: Any = None, lengths: Any = None, count: Optional[int] = None
+    ) -> None:
+        """Account finished episodes: exact counts + return sums, plus a bounded
+        per-window return sample for the p10/p50/p90 distribution. ``count``
+        overrides the episode count when the caller aggregates on device and
+        only ships a batch mean (the Anakin loops: one sample, exact count)."""
+        if not self.enabled or not self.learning or returns is None:
+            return
+        r = np.asarray(returns, dtype=np.float64).reshape(-1)
+        if r.size == 0:
+            return
+        n = int(count) if count is not None else int(r.size)
+        self._ep_count_window += n
+        self._ep_count_total += n
+        self._ep_return_total += float(r.mean()) * n
+        room = _EPISODE_RESERVOIR - len(self._ep_returns)
+        if room > 0:
+            self._ep_returns.extend(float(x) for x in r[:room])
+        if lengths is not None:
+            ln = np.asarray(lengths, dtype=np.float64).reshape(-1)
+            room = _EPISODE_RESERVOIR - len(self._ep_lengths)
+            if room > 0:
+                self._ep_lengths.extend(float(x) for x in ln[:room])
 
     def observe_env_restart(self, count: int = 1) -> None:
         """Account ``RestartOnException`` env restarts (previously invisible):
@@ -618,6 +711,11 @@ class RunTelemetry:
                 # numbers bench.py attaches under conditions.dataflow; absent
                 # entirely on runs without an experience plane
                 dataflow=self._dataflow_snapshot() or None,
+                # run-level learning rollup: per-stat run means, grad-norm run
+                # maxes, episode totals + the last window's block — what
+                # bench.py attaches under conditions.learning and the fleet
+                # leaderboard rolls up
+                learning=self._learning_summary() or None,
                 programs={k: v for k, v in self._programs.items()},
             )
             self._sink.close()
@@ -750,6 +848,134 @@ class RunTelemetry:
                 gauges[gauge] = float(value)
         return gauges
 
+    def _learning_block(self) -> Optional[Dict[str, Any]]:
+        """Fetch the window's learn-stat reservoir (ONE ``jax.device_get`` of
+        scalar buffers — the only host transfer the learning plane ever pays)
+        and distill it plus the episode sample into the window event's
+        ``learning`` block. Resets the per-window state. None when the window
+        saw neither train stats nor episodes."""
+        if not self._learn_window and self._ep_count_window == 0:
+            return None
+        stats: Dict[str, Optional[float]] = {}
+        nonfinite: list = []
+        if self._learn_window:
+            try:
+                import jax
+
+                host = jax.device_get(self._learn_window)
+            except Exception:
+                host = []
+            if host:
+                keys = sorted({k for entry in host for k in entry})
+                series: Dict[str, np.ndarray] = {}
+                for k in keys:
+                    vals = np.asarray(
+                        [float(np.asarray(e[k])) for e in host if k in e], dtype=np.float64
+                    )
+                    series[k] = vals
+                    finite = vals[np.isfinite(vals)]
+                    if finite.size < vals.size:
+                        nonfinite.append(k)
+                    if finite.size == 0:
+                        stats[k] = None
+                    elif k.startswith("grad_norm_max/"):
+                        stats[k] = round(float(finite.max()), 6)
+                    else:
+                        stats[k] = round(float(finite.mean()), 6)
+                # single-step programs emit no per-round max: synthesize the
+                # window max from the per-round grad norms so the explosion
+                # detector always has a spike-sensitive series to read
+                for k, vals in series.items():
+                    if not k.startswith("grad_norm/"):
+                        continue
+                    group = k[len("grad_norm/") :]
+                    max_key = f"grad_norm_max/{group}"
+                    if max_key not in stats:
+                        finite = vals[np.isfinite(vals)]
+                        if finite.size:
+                            stats[max_key] = round(float(finite.max()), 6)
+        episodes: Optional[Dict[str, Any]] = None
+        if self._ep_count_window:
+            r = np.asarray(self._ep_returns, dtype=np.float64)
+            episodes = {
+                "count": int(self._ep_count_window),
+                "return_mean": round(float(r.mean()), 4),
+                "return_p10": round(float(np.quantile(r, 0.1)), 4),
+                "return_p50": round(float(np.quantile(r, 0.5)), 4),
+                "return_p90": round(float(np.quantile(r, 0.9)), 4),
+            }
+            if self._ep_lengths:
+                episodes["len_mean"] = round(float(np.mean(self._ep_lengths)), 2)
+        samples = len(self._learn_window)
+        for k, v in stats.items():
+            if v is None:
+                continue
+            if k.startswith("grad_norm_max/"):
+                self._learn_run_max[k] = max(self._learn_run_max.get(k, float("-inf")), v)
+            else:
+                self._learn_run_sums[k] = self._learn_run_sums.get(k, 0.0) + v * samples
+                self._learn_run_counts[k] = self._learn_run_counts.get(k, 0) + samples
+        block: Dict[str, Any] = {"rounds": int(self._learn_seen)}
+        if stats:
+            block["stats"] = stats
+        if episodes is not None:
+            block["episodes"] = episodes
+        if nonfinite:
+            block["nonfinite"] = nonfinite
+        self._last_learning = block
+        # reset the per-window state
+        self._learn_window = []
+        self._learn_stride = 1
+        self._learn_seen = 0
+        self._ep_returns = []
+        self._ep_lengths = []
+        self._ep_count_window = 0
+        return block
+
+    @staticmethod
+    def _learning_gauges(learning: Optional[Mapping[str, Any]]) -> Dict[str, float]:
+        """The ``Learn/*`` gauge projection of one learning block (finite stats
+        plus the episode-return mean/count — what the Prometheus endpoint and
+        the metric logger see)."""
+        if not learning:
+            return {}
+        gauges: Dict[str, float] = {}
+        for k, v in (learning.get("stats") or {}).items():
+            if isinstance(v, (int, float)) and np.isfinite(v):
+                gauges[f"{LEARN_PREFIX}{k}"] = float(v)
+        episodes = learning.get("episodes") or {}
+        if isinstance(episodes.get("return_mean"), (int, float)):
+            gauges[f"{LEARN_PREFIX}ep_return_mean"] = float(episodes["return_mean"])
+        if episodes.get("count"):
+            gauges[f"{LEARN_PREFIX}ep_count"] = float(episodes["count"])
+        return gauges
+
+    def _learning_summary(self) -> Optional[Dict[str, Any]]:
+        """Run-level learning rollup for the summary event: per-stat run means
+        (sample-weighted across windows), run-max grad norms, exact episode
+        totals, and the last window's block (the freshest state — what the
+        fleet leaderboard ranks on)."""
+        if self._learn_rounds_total == 0 and self._ep_count_total == 0:
+            return None
+        stats = {
+            k: round(s / max(self._learn_run_counts.get(k, 1), 1), 6)
+            for k, s in self._learn_run_sums.items()
+        }
+        stats.update({k: round(v, 6) for k, v in self._learn_run_max.items()})
+        out: Dict[str, Any] = {"rounds": int(self._learn_rounds_total)}
+        if stats:
+            out["stats"] = stats
+        if self._ep_count_total:
+            out["episodes"] = {
+                "count": int(self._ep_count_total),
+                "return_mean": round(self._ep_return_total / self._ep_count_total, 4),
+            }
+        if self._last_learning is not None:
+            out["last"] = {
+                k: v for k, v in self._last_learning.items() if k in ("stats", "episodes")
+            }
+        return out
+
     def _check_health(self, policy_step: int) -> Optional[Dict[str, Any]]:
         if self._window_idx % self.health_every != 0:
             return None
@@ -811,6 +1037,7 @@ class RunTelemetry:
 
         prefetch = self._prefetch_delta()
         dataflow = self._dataflow_snapshot()
+        learning = self._learning_block()
         health = self._check_health(policy_step)
 
         # phase attribution: replay/prefetch wait is carved OUT of the train span
@@ -860,6 +1087,7 @@ class RunTelemetry:
         if self._env_restarts > 0:
             gauges["Health/env_restarts"] = float(self._env_restarts)
         gauges.update(self._dataflow_gauges(dataflow))
+        gauges.update(self._learning_gauges(learning))
         if self._logger is not None:
             self._logger.log_metrics(gauges, policy_step)
         if self.metrics_endpoint is not None:
@@ -890,6 +1118,8 @@ class RunTelemetry:
         )
         if dataflow is not None:
             window_event["dataflow"] = dataflow
+        if learning is not None:
+            window_event["learning"] = learning
         self._append_history("window", window_event)
         if self._sink is not None:
             self._sink.emit("window", **window_event)

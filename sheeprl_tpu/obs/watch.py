@@ -15,7 +15,9 @@ reports it, host RSS otherwise), prefetch pipeline occupancy/staleness, the
 experience plane's dataflow line on ``buffer.backend=service`` runs (worst
 actor weight lag, learner row age p50/p99, ingest latency, queue depth — from
 the windows' ``dataflow`` blocks, whatever stream they ride), the latest
-health verdict and in-loop diagnosis findings, and the attempt/restart state
+health verdict and in-loop diagnosis findings, the training-health line
+(episode-return p50, policy entropy, worst gradient norm, KL — from the
+windows' ``learning`` blocks), and the attempt/restart state
 of supervised runs. Fleet watch adds per-member staleness to the member lines. Multi-process (gang) runs additionally get a per-rank
 liveness board: every stream's rank identity marks its writer alive, a
 ``health`` ``status=rank_dead`` event (heartbeat failure detection,
@@ -301,6 +303,31 @@ class WatchState:
                 if serve.get("queue_depth"):
                     bits.append(f"queue {float(serve['queue_depth']):.1f}")
                 lines.append("  serve: " + " · ".join(bits))
+            learning = w.get("learning")
+            if isinstance(learning, dict):
+                # the training-health line: is the run actually LEARNING?
+                stats = learning.get("stats") or {}
+                episodes = learning.get("episodes") or {}
+                bits = []
+                if isinstance(episodes.get("return_p50"), (int, float)):
+                    bits.append(
+                        f"ret p50 {episodes['return_p50']:g}"
+                        + (f" ({episodes.get('count')} eps)" if episodes.get("count") else "")
+                    )
+                if isinstance(stats.get("entropy"), (int, float)):
+                    bits.append(f"H {stats['entropy']:.3g}")
+                grad_norms = [
+                    v for k, v in stats.items()
+                    if k.startswith("grad_norm/") and isinstance(v, (int, float))
+                ]
+                if grad_norms:
+                    bits.append(f"|g| {max(grad_norms):.3g}")
+                if isinstance(stats.get("kl"), (int, float)):
+                    bits.append(f"kl {stats['kl']:.3g}")
+                if learning.get("nonfinite"):
+                    bits.append(f"NONFINITE {','.join(learning['nonfinite'][:3])}")
+                if bits:
+                    lines.append("  learning: " + " · ".join(bits))
             phases = w.get("phases")
             if isinstance(phases, dict):
                 wall = float(w.get("wall_seconds") or 0.0)

@@ -88,11 +88,16 @@ def init_sharded(
     return jax.jit(init_fn, out_shardings=shardings)(*args)
 
 
-def build_state_shardings(fabric: Any, *state_trees: Any) -> Optional[tuple]:
+def build_state_shardings(
+    fabric: Any, *state_trees: Any, extra_outputs: int = 1
+) -> Optional[tuple]:
     """out_shardings for a fused Dreamer-family train program on ``fabric``'s
     mesh: one rule-derived sharding tree per donated state tree (params,
-    opt_state, moments, ...) plus a trailing replicated prefix for the metrics
-    output; ``None`` on a single device, where the pin buys nothing.
+    opt_state, moments, ...) plus ``extra_outputs`` trailing replicated
+    prefixes for the non-state outputs (losses/metrics, and since the
+    learning-health plane the ``Learn/*`` stats block — sac-family programs
+    return both, so they pass ``extra_outputs=2``); ``None`` on a single
+    device, where the pin buys nothing.
 
     Pinning matters on ANY multi-device mesh: without out_shardings GSPMD may
     reshard small state leaves over the mesh on output — observed on the plain
@@ -100,7 +105,9 @@ def build_state_shardings(fabric: Any, *state_trees: Any) -> Optional[tuple]:
     donation aliasing the drivers rely on."""
     if getattr(fabric, "num_devices", 1) <= 1:
         return None
-    return tuple(fabric.param_shardings(t) for t in state_trees) + (fabric.replicated,)
+    return tuple(fabric.param_shardings(t) for t in state_trees) + (fabric.replicated,) * int(
+        extra_outputs
+    )
 
 
 def per_device_bytes(tree: Any) -> Dict[int, int]:

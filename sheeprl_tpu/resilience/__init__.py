@@ -42,7 +42,13 @@ from sheeprl_tpu.resilience.distributed import (
     checkpoint_manifest,
     supervise_gang,
 )
-from sheeprl_tpu.resilience.faults import FAULT_KINDS, InjectedFaultError, normalize_fault_cfg, reset_faults
+from sheeprl_tpu.resilience.faults import (
+    FAULT_KINDS,
+    InjectedFaultError,
+    apply_armed_learn_fault,
+    normalize_fault_cfg,
+    reset_faults,
+)
 from sheeprl_tpu.resilience.monitor import (
     NullResilience,
     PeerResilience,
@@ -76,6 +82,7 @@ __all__ = [
     "ResilienceMonitor",
     "WATCHDOG_EXIT_CODE",
     "WatchdogError",
+    "apply_armed_learn_fault",
     "build_coordinator",
     "build_resilience",
     "channel_options",

@@ -21,6 +21,14 @@ first-class requirement for the same reason):
                    (pickle: tmp written, not yet renamed; sharded: sidecar
                    committed, orbax directory not): recovery must skip the torn
                    artifacts and fall back to the previous valid checkpoint.
+- ``lr_spike``   — deterministic LEARNING pathology: before the next train
+                   round the loop scales every float parameter leaf by
+                   ``fault.factor`` (default 32), emulating one grossly
+                   mis-scaled update (a transient learning-rate spike). The
+                   run keeps running — nothing crashes — but the loss/gradient
+                   landscape explodes, which is exactly what the training-health
+                   detectors (``grad_explosion`` first) must catch end-to-end,
+                   the same way crash/sigterm/ckpt_kill smoke the recovery path.
 
 Rank-targeted faults (multi-process runs; ``resilience.fault.rank`` selects the
 target process index, default 0 — the driving rank, which keeps the original
@@ -56,10 +64,13 @@ FAULT_KINDS = (
     "sigterm",
     "env_step",
     "ckpt_kill",
+    "lr_spike",
     "kill_rank",
     "stale_heartbeat",
     "channel_drop",
 )
+
+DEFAULT_LR_SPIKE_FACTOR = 32.0
 
 
 class InjectedFaultError(RuntimeError):
@@ -71,6 +82,7 @@ _fired: Dict[tuple, int] = {}  # (kind, at_policy_step) -> policy step it fired 
 _env_fault_armed = threading.Event()
 _heartbeat_stale = threading.Event()
 _channel_drop_armed = threading.Event()
+_learn_fault_factor: list = [None]  # armed lr_spike scale, consumed by the next train round
 
 
 def normalize_fault_cfg(resilience_cfg: Any) -> Optional[Dict[str, Any]]:
@@ -91,6 +103,7 @@ def normalize_fault_cfg(resilience_cfg: Any) -> Optional[Dict[str, Any]]:
         "kind": kind,
         "at": int(fault.get("at_policy_step") or 0),
         "rank": None if rank is None else int(rank),
+        "factor": float(fault.get("factor") or DEFAULT_LR_SPIKE_FACTOR),
     }
 
 
@@ -106,6 +119,7 @@ def reset_faults() -> None:
     _env_fault_armed.clear()
     _heartbeat_stale.clear()
     _channel_drop_armed.clear()
+    _learn_fault_factor[0] = None
     from sheeprl_tpu.utils import checkpoint
 
     if checkpoint._fault_hook is _ckpt_kill_hook:
@@ -129,6 +143,35 @@ def _consume_channel_drop() -> bool:
         _channel_drop_armed.clear()
         return True
     return False
+
+
+def consume_learn_fault() -> Optional[float]:
+    """One-shot poll the loops run right before a train round: the armed
+    ``lr_spike`` factor, or None. Consuming disarms it — the spike is exactly
+    one mis-scaled 'update', not a persistent corruption."""
+    with _lock:
+        factor = _learn_fault_factor[0]
+        _learn_fault_factor[0] = None
+    return factor
+
+
+def apply_armed_learn_fault(tree: Any) -> Any:
+    """Apply a pending ``lr_spike`` to a parameter pytree: every float leaf is
+    scaled by the armed factor (identity when nothing is armed — the loops call
+    this unconditionally before each train round). Returns a NEW tree of fresh
+    arrays, so donation of the inputs stays sound."""
+    factor = consume_learn_fault()
+    if factor is None:
+        return tree
+    import jax
+    import jax.numpy as jnp
+
+    def scale(leaf: Any) -> Any:
+        if hasattr(leaf, "dtype") and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            return leaf * jnp.asarray(factor, dtype=jnp.asarray(leaf).dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(scale, tree)
 
 
 def consume_env_fault() -> bool:
@@ -155,10 +198,17 @@ class FaultPlan:
     """The armed fault a resilience facade drives from its per-iteration hook.
     ``maybe_fire`` is idempotent across restarts (process-global ledger)."""
 
-    def __init__(self, kind: str, at_policy_step: int, rank: Optional[int] = None) -> None:
+    def __init__(
+        self,
+        kind: str,
+        at_policy_step: int,
+        rank: Optional[int] = None,
+        factor: float = DEFAULT_LR_SPIKE_FACTOR,
+    ) -> None:
         self.kind = kind
         self.at = int(at_policy_step)
         self.rank = rank
+        self.factor = float(factor)
 
     def maybe_fire(self, policy_step: int, emit: Callable[..., None]) -> None:
         if policy_step < self.at:
@@ -168,7 +218,14 @@ class FaultPlan:
             if key in _fired:
                 return
             _fired[key] = int(policy_step)
-        emit("fault", step=policy_step, kind=self.kind, at_policy_step=self.at, rank=self.rank)
+        emit(
+            "fault",
+            step=policy_step,
+            kind=self.kind,
+            at_policy_step=self.at,
+            rank=self.rank,
+            **({"factor": self.factor} if self.kind == "lr_spike" else {}),
+        )
         if self.kind == "crash":
             raise InjectedFaultError(
                 f"resilience.fault=crash: injected hard crash at policy step {policy_step}"
@@ -181,6 +238,9 @@ class FaultPlan:
             from sheeprl_tpu.utils import checkpoint
 
             checkpoint._fault_hook = _ckpt_kill_hook
+        elif self.kind == "lr_spike":
+            with _lock:
+                _learn_fault_factor[0] = self.factor
         elif self.kind == "kill_rank":
             # a DEAD peer, not a crashing one: no exception path, no channel
             # sentinel, no exit handshake — SIGKILL bypasses every cleanup
@@ -209,4 +269,4 @@ def build_fault_plan(
     target = 0 if spec["rank"] is None else int(spec["rank"])
     if process_rank is not None and target != int(process_rank):
         return None
-    return FaultPlan(spec["kind"], spec["at"], rank=target)
+    return FaultPlan(spec["kind"], spec["at"], rank=target, factor=spec["factor"])

@@ -1,0 +1,206 @@
+"""Device-side training-health statistics for the fused train programs.
+
+Every fused train program (the 9 ``make_train_phase`` factories, the Anakin
+fused rollout+train step, the ppo/a2c/ppo_recurrent optimization phases) grows
+a ``Learn/*`` scalar block computed INSIDE the jitted program — gradient norms
+pre/post clip, clip fraction, update-to-param ratios, param/optimizer-moment
+norms, policy entropy, value statistics, TD-error quantiles, and the dreamer
+family's KL posterior/prior balance. The helpers here are pure ``jnp`` so the
+no-host-callback contract of every registered program survives unchanged
+(``sheeprl.py lint --aot`` asserts it): nothing in this module may sync,
+print, or touch the host.
+
+The stats ride the programs' outputs as fresh (never donated) scalar buffers;
+the loops hand the device dict to ``RunTelemetry.observe_learn`` which keeps a
+bounded reservoir of REFERENCES and fetches them in one ``jax.device_get`` at
+window cadence — the Podracer rule: learner-side statistics are computed on
+device, the host only pulls a handful of scalars per telemetry window.
+
+Key grammar (consumed by ``obs/telemetry.py``, ``obs/diagnose.py``,
+``obs/compare.py``): every key starts with ``Learn/``; per-module-group stats
+append ``/<group>`` (``Learn/grad_norm/actor``), run-level stats are bare
+(``Learn/entropy``). ``obs/telemetry.py`` strips the ``Learn/`` prefix when it
+builds the window event's ``learning.stats`` block.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "LEARN_PREFIX",
+    "enabled",
+    "global_norm",
+    "moment_norm",
+    "group_stats",
+    "value_stats",
+    "td_quantiles",
+    "entropy_stats",
+    "kl_stats",
+    "reduce_stacked",
+    "learn_keys",
+]
+
+LEARN_PREFIX = "Learn/"
+
+_EPS = 1e-12
+
+
+def enabled(cfg: Any) -> bool:
+    """Whether the train-phase factories should COMPILE the Learn/* stats into
+    the fused program. Gated on the telemetry config (``metric.telemetry.enabled``
+    + ``metric.telemetry.learning``): with telemetry off — the default — the
+    programs stay byte-identical to the pre-learning-plane lowering and pay
+    zero extra compute (the norms/quantiles are a measurable share of a SMALL
+    model's train step on CPU; at accelerator scale they are noise). The
+    factories return an empty stats dict on the off path, so callers never
+    branch on arity."""
+    try:
+        tcfg = cfg.metric.get("telemetry") or {}
+    except (AttributeError, TypeError):
+        return False
+    return bool(tcfg.get("enabled", False)) and bool(tcfg.get("learning", True))
+
+
+def _inexact_leaves(tree: Any) -> list:
+    """Float leaves only: optimizer states carry integer step counters whose
+    norm is meaningless (and whose dtype would upcast the reduction)."""
+    return [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact)
+    ]
+
+
+def maybe(on: bool, build) -> Dict[str, jnp.ndarray]:
+    """``build()`` when the learning plane is compiled in, else the empty stats
+    dict — the one-line guard every factory wraps its Learn/* block in (``on``
+    is a Python bool at trace time, so the off path traces nothing)."""
+    return build() if on else {}
+
+
+def global_norm(tree: Any) -> jnp.ndarray:
+    """L2 norm over every float leaf of a pytree (optax.global_norm without the
+    integer-leaf hazard)."""
+    leaves = _inexact_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves))
+
+
+def moment_norm(opt_state: Any) -> jnp.ndarray:
+    """Global norm of an optimizer state's float leaves (adam mu/nu moments;
+    chained transforms contribute whatever float state they carry). A coarse
+    but optimizer-agnostic divergence signal: the moments integrate gradient
+    history, so a drift here shows up before the params move."""
+    return global_norm(opt_state)
+
+
+def group_stats(
+    group: str,
+    *,
+    grads: Any = None,
+    updates: Any = None,
+    params: Any = None,
+    opt_state: Any = None,
+    clip: Optional[float] = None,
+) -> Dict[str, jnp.ndarray]:
+    """The per-module-group block: grad norm pre/post clip + clip fraction,
+    update-to-param ratio, param and optimizer-moment norms. Pass whatever the
+    call site has — absent inputs contribute no keys. ``clip`` is the static
+    clip_by_global_norm threshold from the config (the post-clip norm is then
+    ``min(pre, clip)`` analytically — no second pass over the gradients)."""
+    out: Dict[str, jnp.ndarray] = {}
+    if grads is not None:
+        g = global_norm(grads)
+        out[f"{LEARN_PREFIX}grad_norm/{group}"] = g
+        if clip is not None and clip > 0:
+            out[f"{LEARN_PREFIX}grad_norm_post/{group}"] = jnp.minimum(g, jnp.float32(clip))
+            out[f"{LEARN_PREFIX}clip_fraction/{group}"] = (g > clip).astype(jnp.float32)
+    if params is not None:
+        p = global_norm(params)
+        out[f"{LEARN_PREFIX}param_norm/{group}"] = p
+        if updates is not None:
+            out[f"{LEARN_PREFIX}update_ratio/{group}"] = global_norm(updates) / jnp.maximum(p, _EPS)
+    if opt_state is not None:
+        out[f"{LEARN_PREFIX}opt_moment_norm/{group}"] = moment_norm(opt_state)
+    return out
+
+
+def value_stats(values: jnp.ndarray, prefix: str = "value") -> Dict[str, jnp.ndarray]:
+    """Mean/std/min/max of a value (or Q) estimate batch."""
+    v = jnp.asarray(values).astype(jnp.float32)
+    return {
+        f"{LEARN_PREFIX}{prefix}_mean": v.mean(),
+        f"{LEARN_PREFIX}{prefix}_std": v.std(),
+        f"{LEARN_PREFIX}{prefix}_min": v.min(),
+        f"{LEARN_PREFIX}{prefix}_max": v.max(),
+    }
+
+
+def td_quantiles(td_error: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """p10/p50/p90 of a TD-error (or advantage) batch — the distribution shape
+    is the signal (a fat upper tail reads as optimistic bootstrapping, a drift
+    of the median as value bias)."""
+    td = jnp.asarray(td_error).astype(jnp.float32).reshape(-1)
+    q = jnp.quantile(td, jnp.asarray([0.1, 0.5, 0.9], jnp.float32))
+    return {
+        f"{LEARN_PREFIX}td_error_p10": q[0],
+        f"{LEARN_PREFIX}td_error_p50": q[1],
+        f"{LEARN_PREFIX}td_error_p90": q[2],
+    }
+
+
+def entropy_stats(entropy: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Mean policy entropy (continuous policies report differential entropy,
+    which is legitimately negative — the collapse detector works on deltas,
+    not signs)."""
+    return {f"{LEARN_PREFIX}entropy": jnp.asarray(entropy).astype(jnp.float32).mean()}
+
+
+def kl_stats(
+    kl: jnp.ndarray,
+    post_entropy: Optional[jnp.ndarray] = None,
+    prior_entropy: Optional[jnp.ndarray] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Dreamer-family latent-dynamics health: the (regularized) posterior/prior
+    KL plus the posterior/prior entropy balance — ``post / (post + prior)``
+    drifting toward 0 reads as posterior collapse (the representation stops
+    carrying information), toward 1 as a prior that never learned the
+    dynamics."""
+    out = {f"{LEARN_PREFIX}kl": jnp.asarray(kl).astype(jnp.float32).mean()}
+    if post_entropy is not None and prior_entropy is not None:
+        post = jnp.asarray(post_entropy).astype(jnp.float32).mean()
+        prior = jnp.asarray(prior_entropy).astype(jnp.float32).mean()
+        out[f"{LEARN_PREFIX}post_entropy"] = post
+        out[f"{LEARN_PREFIX}prior_entropy"] = prior
+        out[f"{LEARN_PREFIX}kl_balance"] = post / jnp.maximum(post + prior, _EPS)
+    return out
+
+
+def reduce_stacked(stats: Mapping[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Reduce a ``lax.scan``-stacked stats dict (leading axes = gradient steps)
+    to scalars: mean for every key, plus a ``grad_norm_max/<group>`` companion
+    for each pre-clip grad norm (a one-step spike inside a fused multi-step
+    round must not be averaged away — it is exactly what the grad-explosion
+    detector hunts)."""
+    out: Dict[str, jnp.ndarray] = {}
+    for key, value in stats.items():
+        v = jnp.asarray(value)
+        out[key] = v.mean()
+        if key.startswith(f"{LEARN_PREFIX}grad_norm/"):
+            group = key[len(f"{LEARN_PREFIX}grad_norm/") :]
+            out[f"{LEARN_PREFIX}grad_norm_max/{group}"] = v.max()
+    return out
+
+
+def learn_keys(stats: Mapping[str, Any]) -> Dict[str, Any]:
+    """The ``Learn/``-prefixed subset of a metrics mapping (the dreamer family
+    rides its learn stats on the existing metrics dict; everything else passes
+    a pure learn dict). Pure key filtering — never syncs device values."""
+    if not isinstance(stats, Mapping):
+        return {}
+    return {k: v for k, v in stats.items() if isinstance(k, str) and k.startswith(LEARN_PREFIX)}

@@ -330,6 +330,7 @@ _HOOKED_LOOP = """
         resilience = build_resilience(fabric, cfg, ".")
         for step in range(10):
             telemetry.observe_train(1, None)
+            telemetry.observe_learn(None)
             telemetry.step(step)
             resilience.step(step)
             if resilience.preempt_requested():

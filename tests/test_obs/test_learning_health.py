@@ -1,0 +1,529 @@
+"""The learning-health consumer tier, unit-tested on synthetic material:
+
+- ``RunTelemetry.observe_learn``/``observe_episodes`` → the window/summary
+  ``learning`` block (reservoir mechanics, one-device_get fetch, Learn/* gauges);
+- one unit test per training-health detector (positive + healthy negative)
+  on synthetic window streams;
+- ``compare``'s learning-curve extraction + ``learning_regression`` verdicts
+  (noise-banded, direction-pinned);
+- ``watch``'s learning line;
+- bench-diff direction pins for the learning units ("return"/"nats" are
+  higher-is-better, "loss" lower-is-better — entropy can never gate backwards).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.obs.diagnose import run_detectors
+
+_LEARN_DETECTORS = (
+    "grad_explosion",
+    "entropy_collapse",
+    "value_overestimation",
+    "update_ratio_anomaly",
+    "kl_balance_drift",
+    "reward_plateau",
+)
+
+
+def _findings(events, detector):
+    return [f for f in run_detectors(events, detectors=[detector]) if f["detector"] == detector]
+
+
+def _win(
+    i: int,
+    stats: Optional[Dict[str, Any]] = None,
+    episodes: Optional[Dict[str, Any]] = None,
+    nonfinite: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    learning: Dict[str, Any] = {"rounds": 4}
+    if stats is not None:
+        learning["stats"] = stats
+    if episodes is not None:
+        learning["episodes"] = episodes
+    if nonfinite:
+        learning["nonfinite"] = nonfinite
+    return {
+        "event": "window",
+        "window": i,
+        "step": (i + 1) * 100,
+        "wall_seconds": 1.0,
+        "sps": 100.0,
+        "train_units": 4,
+        "seq": i,
+        "learning": learning,
+    }
+
+
+def _stream(per_window: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [{"event": "start", "seq": -1}] + per_window
+
+
+# ---------------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------------
+def test_grad_explosion_flags_spike_vs_run_median():
+    values = [1.0, 1.1, 0.9, 1.0, 55.0]
+    events = _stream([_win(i, {"grad_norm_max/actor": v}) for i, v in enumerate(values)])
+    found = _findings(events, "grad_explosion")
+    assert len(found) == 1 and found[0]["severity"] == "warning"
+    assert found[0]["metrics"]["group"] == "actor"
+    # 1000x the median across one window escalates
+    events = _stream([_win(i, {"grad_norm_max/actor": v}) for i, v in enumerate([1.0, 1.0, 1.0, 1.0, 1000.0])])
+    assert _findings(events, "grad_explosion")[0]["severity"] == "critical"
+
+
+def test_grad_explosion_nonfinite_is_critical_from_one_window():
+    events = _stream(
+        [_win(0, {"grad_norm/critic": 1.0}), _win(1, {"grad_norm/critic": None}, nonfinite=["grad_norm/critic"])]
+    )
+    found = _findings(events, "grad_explosion")
+    assert found and found[0]["severity"] == "critical"
+
+
+def test_grad_explosion_quiet_on_flat_series():
+    events = _stream([_win(i, {"grad_norm_max/actor": 1.0 + 0.05 * i}) for i in range(6)])
+    assert _findings(events, "grad_explosion") == []
+
+
+def test_entropy_collapse_judges_deltas_not_signs():
+    # differential entropy: legitimately negative; the drop is the signal
+    values = [1.2, 1.1, 1.0, -0.4, -0.5, -0.5]
+    events = _stream([_win(i, {"entropy": v}) for i, v in enumerate(values)])
+    found = _findings(events, "entropy_collapse")
+    assert len(found) == 1 and found[0]["severity"] == "critical"
+    # a gentle decline stays quiet
+    events = _stream([_win(i, {"entropy": 1.2 - 0.05 * i}) for i in range(6)])
+    assert _findings(events, "entropy_collapse") == []
+
+
+def test_value_overestimation_needs_return_scale():
+    eps = {"count": 3, "return_mean": 4.0, "return_p50": 4.0}
+    grown = [1.0, 1.5, 2.0, 40.0, 55.0, 60.0]
+    events = _stream([_win(i, {"q_mean": v}, episodes=eps) for i, v in enumerate(grown)])
+    found = _findings(events, "value_overestimation")
+    assert len(found) == 1 and found[0]["severity"] == "warning"
+    # without episode returns there is no scale to judge against — no finding
+    events = _stream([_win(i, {"q_mean": v}) for i, v in enumerate(grown)])
+    assert _findings(events, "value_overestimation") == []
+    # values tracking the return scale are healthy
+    events = _stream([_win(i, {"q_mean": 3.5 + 0.1 * i}, episodes=eps) for i in range(6)])
+    assert _findings(events, "value_overestimation") == []
+
+
+def test_update_ratio_anomaly_vs_run_median():
+    values = [0.001, 0.0012, 0.0009, 0.001, 0.03]
+    events = _stream([_win(i, {"update_ratio/policy": v}) for i, v in enumerate(values)])
+    found = _findings(events, "update_ratio_anomaly")
+    assert len(found) == 1 and found[0]["metrics"]["group"] == "policy"
+    events = _stream([_win(i, {"update_ratio/policy": 0.001}) for i in range(5)])
+    assert _findings(events, "update_ratio_anomaly") == []
+
+
+def test_kl_balance_drift_collapse_explosion_and_balance():
+    collapse = [1.0, 1.0, 1.0, 0.05, 0.04, 0.05]
+    events = _stream([_win(i, {"kl": v}) for i, v in enumerate(collapse)])
+    found = _findings(events, "kl_balance_drift")
+    assert [f["metrics"]["mode"] for f in found] == ["collapse"]
+    explosion = [1.0, 1.0, 1.0, 15.0, 16.0, 14.0]
+    events = _stream([_win(i, {"kl": v}) for i, v in enumerate(explosion)])
+    assert [f["metrics"]["mode"] for f in _findings(events, "kl_balance_drift")] == ["explosion"]
+    balance = [0.5, 0.5, 0.5, 0.9, 0.9, 0.9]
+    events = _stream(
+        [_win(i, {"kl": 1.0, "kl_balance": v}) for i, v in enumerate(balance)]
+    )
+    assert [f["metrics"]["mode"] for f in _findings(events, "kl_balance_drift")] == ["balance"]
+    # stable latent dynamics stay quiet
+    events = _stream([_win(i, {"kl": 1.0, "kl_balance": 0.55}) for i in range(6)])
+    assert _findings(events, "kl_balance_drift") == []
+
+
+def test_reward_plateau_fires_on_converged_curve_only():
+    def eps(ret):
+        return {"count": 4, "return_mean": ret, "return_p50": ret}
+
+    flat_after_climb = [1, 2, 5, 9, 10, 10, 10, 10, 10, 10]
+    events = _stream([_win(i, {}, episodes=eps(v)) for i, v in enumerate(flat_after_climb)])
+    found = _findings(events, "reward_plateau")
+    assert len(found) == 1 and found[0]["severity"] == "info"
+    # a still-climbing curve never fires
+    climbing = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+    events = _stream([_win(i, {}, episodes=eps(v)) for i, v in enumerate(climbing)])
+    assert _findings(events, "reward_plateau") == []
+    # too few windows: no judgement
+    events = _stream([_win(i, {}, episodes=eps(v)) for i, v in enumerate([1, 5, 5, 5])])
+    assert _findings(events, "reward_plateau") == []
+
+
+def test_reward_plateau_ignores_flat_noise_and_decline():
+    def eps(ret):
+        return {"count": 4, "return_mean": ret, "return_p50": ret}
+
+    # noise around zero: the sample-max "climb" must not read as improvement
+    noise = [0.0, 0.1, -0.1, 0.05, 0.0, -0.05, 0.1, 0.0, 0.05, -0.1]
+    events = _stream([_win(i, {}, episodes=eps(v)) for i, v in enumerate(noise)])
+    assert _findings(events, "reward_plateau") == []
+    # a monotonically DECLINING run never "climbed then flattened"
+    decline = [10, 9, 8, 7, 6, 5, 4, 3, 3, 3]
+    events = _stream([_win(i, {}, episodes=eps(v)) for i, v in enumerate(decline)])
+    assert _findings(events, "reward_plateau") == []
+
+
+def test_learning_detectors_judge_one_stream_of_a_decoupled_run():
+    """Decoupled topologies mirror the learner's Learn block onto the player's
+    primary stream: the merged dir must not double-count windows (two real
+    spike windows would read as four and spuriously escalate to critical)."""
+    spikes = [1.0, 1.0, 1.0, 1.0, 30.0, 30.0]
+    per_stream = []
+    for stream in ("telemetry.jsonl", "telemetry.learner.jsonl"):
+        for i, v in enumerate(spikes):
+            w = _win(i, {"grad_norm_max/actor": v})
+            w["stream"] = stream
+            per_stream.append(w)
+    found = _findings(_stream(per_stream), "grad_explosion")
+    assert len(found) == 1
+    # 2 affected windows (not 4): stays a warning, never escalates via the dupe
+    assert found[0]["severity"] == "warning"
+    assert found[0]["metrics"]["windows"] == 2
+    # a learner-only stream (service topology: the player never trains) still judges
+    learner_only = [w for w in per_stream if w["stream"] == "telemetry.learner.jsonl"]
+    found = _findings(_stream(learner_only), "grad_explosion")
+    assert len(found) == 1 and found[0]["metrics"]["windows"] == 2
+
+
+def test_learning_detectors_are_noops_on_streams_without_learning_blocks():
+    windows = [
+        {"event": "window", "window": i, "step": i * 10, "wall_seconds": 1.0, "seq": i}
+        for i in range(6)
+    ]
+    for detector in _LEARN_DETECTORS:
+        assert _findings(_stream(windows), detector) == []
+
+
+# ---------------------------------------------------------------------------------
+# telemetry: observe_learn/observe_episodes -> learning block
+# ---------------------------------------------------------------------------------
+class _FakeFabric:
+    is_global_zero = True
+    global_rank = 0
+    world_size = 1
+    device = None
+    devices: list = []
+
+
+def _telemetry(tmp_path, **tcfg):
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.obs.telemetry import RunTelemetry
+
+    cfg = compose(["exp=sac", "env=dummy", "metric.telemetry.enabled=true"])
+    for k, v in tcfg.items():
+        cfg.metric.telemetry[k] = v
+    cfg.metric.telemetry.every = 10
+    return RunTelemetry(_FakeFabric(), cfg, str(tmp_path))
+
+
+def test_observe_learn_builds_window_and_summary_blocks(tmp_path):
+    import json
+
+    t = _telemetry(tmp_path)
+    t.step(0)
+    for i in range(5):
+        t.observe_train(1, None)
+        t.observe_learn(
+            {
+                "Learn/grad_norm/actor": jnp.asarray(float(i + 1)),
+                "Learn/entropy": jnp.asarray(0.5),
+                "Loss/never": jnp.asarray(9.9),  # not Learn/-prefixed: dropped
+            }
+        )
+    t.observe_episodes(np.asarray([1.0, 3.0]), np.asarray([10, 20]))
+    t.step(10)  # window boundary
+    t.close(20)
+    events = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    windows = [e for e in events if e["event"] == "window"]
+    learning = windows[0]["learning"]
+    assert learning["rounds"] == 5
+    stats = learning["stats"]
+    assert stats["grad_norm/actor"] == pytest.approx(3.0)  # mean of 1..5
+    assert stats["grad_norm_max/actor"] == pytest.approx(5.0)  # synthesized max
+    assert stats["entropy"] == pytest.approx(0.5)
+    assert "never" not in stats and "Loss/never" not in stats
+    episodes = learning["episodes"]
+    assert episodes["count"] == 2 and episodes["return_mean"] == pytest.approx(2.0)
+    assert episodes["return_p10"] <= episodes["return_p50"] <= episodes["return_p90"]
+    summary = [e for e in events if e["event"] == "summary"][-1]
+    assert summary["learning"]["rounds"] == 5
+    assert summary["learning"]["episodes"]["count"] == 2
+    assert summary["learning"]["stats"]["grad_norm_max/actor"] == pytest.approx(5.0)
+    # schema: the new blocks validate
+    from sheeprl_tpu.obs.schema import validate_events
+
+    assert validate_events(events) == []
+
+
+def test_observe_learn_reservoir_is_bounded_and_counts_all_rounds(tmp_path):
+    import json
+
+    t = _telemetry(tmp_path)
+    t.step(0)
+    for i in range(1000):
+        t.observe_learn({"Learn/entropy": jnp.asarray(1.0)})
+        assert len(t._learn_window) < 64  # stride-doubling keeps it bounded
+    t.step(10)
+    t.close(20)
+    events = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    learning = [e for e in events if e["event"] == "window"][0]["learning"]
+    assert learning["rounds"] == 1000  # the COUNT is exact; only the sample is bounded
+
+
+def test_observe_learn_nonfinite_surfaces_in_block(tmp_path):
+    import json
+
+    t = _telemetry(tmp_path)
+    t.step(0)
+    t.observe_learn({"Learn/grad_norm/critic": jnp.asarray(float("nan"))})
+    t.step(10)
+    t.close(20)
+    events = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    learning = [e for e in events if e["event"] == "window"][0]["learning"]
+    assert learning["nonfinite"] == ["grad_norm/critic"]
+    assert learning["stats"]["grad_norm/critic"] is None  # NaN never round-trips as JSON
+
+
+def test_observe_episodes_count_override(tmp_path):
+    import json
+
+    t = _telemetry(tmp_path)
+    t.step(0)
+    # the anakin feed: one device-aggregated mean, exact count
+    t.observe_episodes([5.0], [100.0], count=32)
+    t.step(10)
+    t.close(20)
+    events = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    episodes = [e for e in events if e["event"] == "window"][0]["learning"]["episodes"]
+    assert episodes["count"] == 32 and episodes["return_mean"] == pytest.approx(5.0)
+    summary = [e for e in events if e["event"] == "summary"][-1]
+    assert summary["learning"]["episodes"]["count"] == 32
+
+
+def test_learning_gauges_feed_endpoint_map(tmp_path):
+    t = _telemetry(tmp_path)
+    gauges = t._learning_gauges(
+        {
+            "stats": {"grad_norm/actor": 2.0, "entropy": None},
+            "episodes": {"count": 3, "return_mean": 7.5},
+        }
+    )
+    assert gauges == {
+        "Learn/grad_norm/actor": 2.0,
+        "Learn/ep_return_mean": 7.5,
+        "Learn/ep_count": 3.0,
+    }
+    from sheeprl_tpu.obs.metrics_http import prometheus_name
+
+    assert prometheus_name("Learn/grad_norm/actor") == "sheeprl_learn_grad_norm_actor"
+    t.close(0)
+
+
+def test_learning_off_knob_disables_the_plane(tmp_path):
+    import json
+
+    t = _telemetry(tmp_path, learning=False)
+    t.step(0)
+    t.observe_learn({"Learn/entropy": jnp.asarray(1.0)})
+    t.observe_episodes([1.0])
+    t.step(10)
+    t.close(20)
+    events = [json.loads(line) for line in open(tmp_path / "telemetry.jsonl")]
+    # no window carries a block; the summary's rollup field stays null
+    assert all(e.get("learning") is None for e in events)
+
+
+# ---------------------------------------------------------------------------------
+# compare: curves + learning_regression
+# ---------------------------------------------------------------------------------
+def _learning_events(returns, loss, entropy=1.0, jitter=0.0):
+    events = [{"event": "start", "seq": -1, "fingerprint": {"algo": "sac"}}]
+    for i, ret in enumerate(returns):
+        events.append(
+            _win(
+                i,
+                {"loss/critic": loss[i] + (jitter if i % 2 else -jitter), "entropy": entropy},
+                episodes={
+                    "count": 4,
+                    "return_mean": ret,
+                    "return_p50": ret,
+                    "return_p10": ret - 1,
+                    "return_p90": ret + 1,
+                },
+            )
+        )
+    return events
+
+
+def test_learning_curves_extraction():
+    from sheeprl_tpu.obs.compare import learning_curves
+
+    events = _learning_events([1.0, 2.0, 3.0], [5.0, 4.0, 3.0])
+    curve = learning_curves(events)
+    assert [p["step"] for p in curve] == [100, 200, 300]
+    assert [p["return_p50"] for p in curve] == [1.0, 2.0, 3.0]
+    assert all(p["return_p10"] < p["return_p50"] < p["return_p90"] for p in curve)
+    assert [p["loss"]["critic"] for p in curve] == [5.0, 4.0, 3.0]
+    # old streams without learning blocks extract nothing
+    assert learning_curves([{"event": "window", "step": 1, "wall_seconds": 1.0}]) == []
+
+
+def test_compare_flags_learning_regression_on_returns():
+    from sheeprl_tpu.obs.compare import compare_profiles, profile_run
+
+    healthy = profile_run(_learning_events([5, 7, 9, 10, 10, 10], [3] * 6))
+    worse = profile_run(_learning_events([1, 1.5, 2, 2, 2, 2], [3] * 6))
+    result = compare_profiles(healthy, worse)
+    regressions = [f for f in result["findings"] if f["detector"] == "learning_regression"]
+    assert regressions and regressions[0]["metrics"]["metric"] == "ep_return"
+    assert result["metrics"]["learning"]["ep_return"]["beyond_noise"]
+    # same-direction comparison is clean
+    again = compare_profiles(healthy, healthy)
+    assert [f for f in again["findings"] if f["detector"] == "learning_regression"] == []
+
+
+def test_compare_flags_learning_regression_on_loss_growth():
+    from sheeprl_tpu.obs.compare import compare_profiles, profile_run
+
+    a = profile_run(_learning_events([5] * 6, [2.0] * 6, jitter=0.05))
+    b = profile_run(_learning_events([5] * 6, [4.0] * 6, jitter=0.05))
+    result = compare_profiles(a, b)
+    losses = [
+        f
+        for f in result["findings"]
+        if f["detector"] == "learning_regression" and f["metrics"]["metric"] == "loss/critic"
+    ]
+    assert len(losses) == 1
+    # lower loss in B is NOT a regression
+    result = compare_profiles(b, a)
+    assert [
+        f
+        for f in result["findings"]
+        if f["detector"] == "learning_regression" and f["metrics"]["metric"] == "loss/critic"
+    ] == []
+
+
+def test_entropy_is_reported_but_never_gated():
+    from sheeprl_tpu.obs.compare import compare_profiles, profile_run
+
+    a = profile_run(_learning_events([5] * 6, [2.0] * 6, entropy=1.5))
+    b = profile_run(_learning_events([5] * 6, [2.0] * 6, entropy=0.1))
+    result = compare_profiles(a, b)
+    assert result["metrics"]["learning"]["entropy"] is not None
+    assert [f for f in result["findings"] if f["detector"] == "learning_regression"] == []
+
+
+def test_bench_diff_learning_metric_directions():
+    from sheeprl_tpu.obs.compare import _lower_is_better, bench_diff
+
+    # direction pins: entropy/return regress DOWN, loss regresses UP
+    assert _lower_is_better("nats (mean policy entropy, steady run)") is False
+    assert _lower_is_better("return (mean episode return, steady run)") is False
+    assert _lower_is_better("loss (mean training loss)") is True
+    old = {
+        "metric": "sac_steady_env_steps_per_sec",
+        "value": 100.0,
+        "unit": "env-steps/sec (steady-state)",
+        "extras": [
+            {"metric": "sac_steady_entropy", "value": 1.0, "unit": "nats (mean policy entropy)"},
+            {"metric": "sac_steady_ep_return", "value": 10.0, "unit": "return (mean episode return)"},
+        ],
+    }
+    new = {
+        "metric": "sac_steady_env_steps_per_sec",
+        "value": 100.0,
+        "unit": "env-steps/sec (steady-state)",
+        "extras": [
+            {"metric": "sac_steady_entropy", "value": 0.2, "unit": "nats (mean policy entropy)"},
+            {"metric": "sac_steady_ep_return", "value": 14.0, "unit": "return (mean episode return)"},
+        ],
+    }
+    diff = bench_diff(old, new)
+    assert "sac_steady_entropy" in diff["regressions"]  # entropy DROP regresses
+    assert "sac_steady_ep_return" in diff["improvements"]  # return RISE improves
+
+
+def test_bench_diff_direction_survives_negative_baselines():
+    """Continuous-policy entropy and many episode returns are NEGATIVE: the
+    relative change must be judged over |old|, or (new-old)/old flips the
+    direction and an entropy collapse gates as an 'improvement'."""
+    from sheeprl_tpu.obs.compare import bench_diff
+
+    def wl(ent, ret):
+        return {
+            "metric": "x_sps",
+            "value": 100.0,
+            "unit": "env-steps/sec",
+            "extras": [
+                {"metric": "x_entropy", "value": ent, "unit": "nats (mean policy entropy)"},
+                {"metric": "x_ep_return", "value": ret, "unit": "return (mean episode return)"},
+            ],
+        }
+
+    # entropy collapse -1 -> -2 AND return regression -100 -> -200: both regress
+    diff = bench_diff(wl(-1.0, -100.0), wl(-2.0, -200.0))
+    assert "x_entropy" in diff["regressions"]
+    assert "x_ep_return" in diff["regressions"]
+    # the recoveries gate as improvements
+    diff = bench_diff(wl(-2.0, -200.0), wl(-1.0, -100.0))
+    assert "x_entropy" in diff["improvements"]
+    assert "x_ep_return" in diff["improvements"]
+
+
+def test_compare_flags_loss_regression_with_negative_baseline():
+    """Policy/actor losses are routinely negative: growth must be judged over
+    |A's median|, not the signed relative change (which can never cross a
+    positive threshold when A is negative)."""
+    from sheeprl_tpu.obs.compare import compare_profiles, profile_run
+
+    a = profile_run(_learning_events([5] * 6, [-3.2] * 6, jitter=0.05))
+    b = profile_run(_learning_events([5] * 6, [-0.5] * 6, jitter=0.05))
+    result = compare_profiles(a, b)
+    losses = [
+        f
+        for f in result["findings"]
+        if f["detector"] == "learning_regression" and f["metrics"]["metric"] == "loss/critic"
+    ]
+    assert len(losses) == 1
+
+
+# ---------------------------------------------------------------------------------
+# watch: the learning line
+# ---------------------------------------------------------------------------------
+def test_watch_renders_learning_line():
+    from sheeprl_tpu.obs.watch import WatchState
+
+    state = WatchState()
+    state.consume(
+        [
+            {"event": "start", "seq": 0},
+            _win(
+                0,
+                {"entropy": 1.25, "grad_norm/actor": 3.0, "grad_norm/critic": 12.0, "kl": 0.8},
+                episodes={"count": 6, "return_p50": 42.5},
+            ),
+        ]
+    )
+    frame = state.render("run", 1.0, ["telemetry.jsonl"])
+    assert "learning:" in frame
+    assert "ret p50 42.5" in frame and "(6 eps)" in frame
+    assert "H 1.25" in frame and "|g| 12" in frame and "kl 0.8" in frame
+    # nonfinite stats shout
+    state.consume([_win(1, {"entropy": 1.0}, nonfinite=["grad_norm/actor"])])
+    assert "NONFINITE" in state.render("run", 1.0, ["telemetry.jsonl"])
+    # windows without a learning block render no learning line
+    fresh = WatchState()
+    fresh.consume([{"event": "window", "window": 0, "step": 1, "wall_seconds": 1.0, "sps": 1.0}])
+    assert "learning:" not in fresh.render("run", 1.0, [])

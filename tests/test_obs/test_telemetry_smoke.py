@@ -109,6 +109,39 @@ def _assert_stream_shape(events, expect_train: bool):
         assert progs and (progs[0].get("flops") or progs[0].get("error"))
     # mfu is honest: null on CPU (no chip peak), never a bogus number
     assert all(w["mfu"] is None for w in windows)
+    if expect_train:
+        # the learning-health plane: every window that trained carries a
+        # learning block with device-computed Learn/* stats, and the summary
+        # carries the run rollup
+        trained = [w for w in windows if (w.get("train_units") or 0) > 0]
+        assert trained
+        for w in trained:
+            learning = w.get("learning")
+            assert isinstance(learning, dict) and learning["rounds"] > 0
+            stats = learning.get("stats") or {}
+            assert any(k.startswith("grad_norm/") for k in stats)
+            assert all(v is None or v == v for v in stats.values())  # NaN never round-trips silently
+        assert isinstance(summary.get("learning"), dict)
+        assert summary["learning"]["rounds"] > 0
+        # a healthy tiny run must trip NO training-health detector at
+        # warning+ severity (the lr_spike fault smoke asserts the converse)
+        from sheeprl_tpu.obs.diagnose import run_detectors
+
+        learn_findings = [
+            f
+            for f in run_detectors(events)
+            if f["detector"]
+            in (
+                "grad_explosion",
+                "entropy_collapse",
+                "value_overestimation",
+                "update_ratio_anomaly",
+                "kl_balance_drift",
+                "reward_plateau",
+            )
+            and f["severity"] in ("warning", "critical")
+        ]
+        assert learn_findings == [], learn_findings
 
 
 @pytest.mark.timeout(240)

@@ -51,6 +51,8 @@ def test_ppo_train_phase_dp_parity():
         "buffer.share_data=True",
         "buffer.memmap=False",
         "metric.log_level=0",
+        # compile the Learn/* stats in: the parity asserts below cover them
+        "metric.telemetry.enabled=true",
     ]
     cfg1 = compose(base + ["algo.per_rank_batch_size=16", "fabric.devices=1"])
     cfg2 = compose(base + ["algo.per_rank_batch_size=8", "fabric.devices=2"])
@@ -82,7 +84,7 @@ def test_ppo_train_phase_dp_parity():
     clip_coef, ent_coef = 0.2, 0.01
 
     tp1 = make_train_phase(agent, cfg1, fabric1, tx, actions_dim, False, [], ["state"], E)
-    p1, _, losses1 = tp1(params, opt_state, data, next_values, key, clip_coef, ent_coef)
+    p1, _, losses1, learn1 = tp1(params, opt_state, data, next_values, key, clip_coef, ent_coef)
 
     sharded = fabric2.sharding(None, "data")
     data2 = jax.device_put(data, sharded)
@@ -90,10 +92,15 @@ def test_ppo_train_phase_dp_parity():
     params2 = fabric2.replicate_pytree(params)
     opt2 = fabric2.replicate_pytree(opt_state)
     tp2 = make_train_phase(agent, cfg2, fabric2, tx, actions_dim, False, [], ["state"], E)
-    p2, _, losses2 = tp2(params2, opt2, data2, nv2, key, clip_coef, ent_coef)
+    p2, _, losses2, learn2 = tp2(params2, opt2, data2, nv2, key, clip_coef, ent_coef)
 
     _tree_allclose(p1, p2)
     np.testing.assert_allclose(np.asarray(losses1), np.asarray(losses2), rtol=2e-4, atol=1e-5)
+    # the Learn/* block is part of the program contract too: dp must not skew it
+    for k in learn1:
+        np.testing.assert_allclose(
+            np.asarray(learn1[k]), np.asarray(learn2[k]), rtol=2e-3, atol=1e-4, err_msg=k
+        )
 
 
 @pytest.mark.timeout(280)

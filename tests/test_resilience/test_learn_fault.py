@@ -1,0 +1,177 @@
+"""The ``lr_spike`` learning-pathology fault: unit mechanics + the end-to-end
+smoke the training-health detectors are accepted on — a spiked sac run MUST
+trip ``grad_explosion`` under ``diagnose --fail-on warning`` while the same
+run without the fault trips no training-health detector (the healthy halves
+of the acceptance pair live in ``tests/test_obs/test_telemetry_smoke.py``).
+
+Scoped with the ``resilience`` marker; not ``slow``, so tier-1 includes it.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.obs.diagnose import run_detectors
+from sheeprl_tpu.resilience import reset_faults, reset_preemption
+from sheeprl_tpu.resilience.faults import (
+    FaultPlan,
+    apply_armed_learn_fault,
+    build_fault_plan,
+    consume_learn_fault,
+    normalize_fault_cfg,
+)
+
+pytestmark = pytest.mark.resilience
+
+_LEARN_DETECTORS = (
+    "grad_explosion",
+    "entropy_collapse",
+    "value_overestimation",
+    "update_ratio_anomaly",
+    "kl_balance_drift",
+    "reward_plateau",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    reset_preemption()
+    reset_faults()
+    yield
+    reset_preemption()
+    reset_faults()
+
+
+# ---------------------------------------------------------------------------------
+# unit mechanics
+# ---------------------------------------------------------------------------------
+def test_normalize_fault_cfg_accepts_lr_spike_with_factor():
+    spec = normalize_fault_cfg({"fault": {"kind": "lr_spike", "at_policy_step": 8, "factor": 5.0}})
+    assert spec == {"kind": "lr_spike", "at": 8, "rank": None, "factor": 5.0}
+    # default factor when unset
+    spec = normalize_fault_cfg({"fault": {"kind": "lr_spike", "at_policy_step": 8}})
+    assert spec["factor"] == 32.0
+
+
+def test_lr_spike_arms_once_and_scales_float_leaves_only():
+    events = []
+    plan = build_fault_plan({"fault": {"kind": "lr_spike", "at_policy_step": 4, "factor": 3.0}})
+    plan.maybe_fire(2, lambda *a, **k: events.append(k))
+    assert consume_learn_fault() is None  # not yet due
+    plan.maybe_fire(4, lambda *a, **k: events.append(k))
+    assert events and events[0]["kind"] == "lr_spike" and events[0]["factor"] == 3.0
+    params = {"w": jnp.ones((2, 2)), "step": jnp.asarray(7, jnp.int32)}
+    spiked = apply_armed_learn_fault(params)
+    np.testing.assert_allclose(np.asarray(spiked["w"]), 3.0 * np.ones((2, 2)))
+    assert int(spiked["step"]) == 7  # integer leaves untouched
+    # one-shot: the next round is identity (and the fault never re-fires)
+    again = apply_armed_learn_fault(spiked)
+    assert again is spiked or np.allclose(np.asarray(again["w"]), np.asarray(spiked["w"]))
+    plan.maybe_fire(9, lambda *a, **k: events.append(k))
+    assert len(events) == 1
+    assert consume_learn_fault() is None
+
+
+def test_lr_spike_targets_its_rank_only():
+    cfg = {"fault": {"kind": "lr_spike", "at_policy_step": 0, "rank": 1}}
+    assert build_fault_plan(cfg, process_rank=0) is None
+    assert isinstance(build_fault_plan(cfg, process_rank=1), FaultPlan)
+
+
+# ---------------------------------------------------------------------------------
+# end-to-end: the acceptance smoke
+# ---------------------------------------------------------------------------------
+_SAC_SPIKE = [
+    "exp=sac",
+    "env=dummy",
+    "env.id=continuous_dummy",
+    "dry_run=False",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "metric.log_level=0",
+    "buffer.memmap=False",
+    "buffer.size=512",
+    "env.num_envs=2",
+    "algo.learning_starts=4",
+    "algo.run_test=False",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.per_rank_batch_size=4",
+    "algo.hidden_size=16",
+    "algo.total_steps=192",
+    "checkpoint.every=0",
+    "checkpoint.save_last=False",
+    "metric.telemetry.enabled=true",
+    "metric.telemetry.every=16",
+    "metric.telemetry.compile_warmup_steps=0",
+    "buffer.prefetch.enabled=false",
+]
+
+
+@pytest.mark.timeout(280)
+def test_sac_lr_spike_trips_grad_explosion(tmp_path):
+    """An injected mid-run lr spike must surface as a ``fault`` event in the
+    stream AND as a ``grad_explosion`` finding — offline (``sheeprl.py
+    diagnose --fail-on warning`` exits 1) and from the same detector catalog
+    the in-loop diagnosis runs."""
+    run(
+        _SAC_SPIKE
+        + [
+            "resilience.fault.kind=lr_spike",
+            "resilience.fault.at_policy_step=112",
+            "resilience.fault.factor=64",
+            "root_dir=tlearnfault",
+            "run_name=sac-spike",
+        ]
+    )
+    paths = glob.glob("logs/runs/tlearnfault/sac-spike/version_*/telemetry.jsonl")
+    assert paths
+    events = [json.loads(line) for line in open(paths[0])]
+    faults = [e for e in events if e.get("event") == "fault"]
+    assert faults and faults[0]["kind"] == "lr_spike" and faults[0]["factor"] == 64.0
+    findings = run_detectors(events, detectors=["grad_explosion"])
+    assert findings, "the spiked run did not trip grad_explosion"
+    assert findings[0]["severity"] in ("warning", "critical")
+    # the run kept running (a learning pathology, not a crash): clean summary
+    summary = [e for e in events if e.get("event") == "summary"][-1]
+    assert summary["clean_exit"] is True
+    # the CLI gate: diagnose --fail-on warning must fail the spiked run
+    import os
+
+    import sheeprl_tpu
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(sheeprl_tpu.__file__)))
+    run_dir = paths[0].rsplit("/", 1)[0]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo_root, "sheeprl.py"), "diagnose", run_dir, "--quiet", "--fail-on", "warning"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.load(open(f"{run_dir}/diagnosis.json"))
+    assert "grad_explosion" in {f["detector"] for f in report["findings"]}
+
+
+@pytest.mark.timeout(280)
+def test_sac_healthy_twin_trips_no_learning_detector():
+    """The same run without the fault: every training-health detector stays
+    quiet (the false-positive half of the acceptance criterion)."""
+    run(_SAC_SPIKE + ["root_dir=tlearnfault", "run_name=sac-healthy"])
+    paths = glob.glob("logs/runs/tlearnfault/sac-healthy/version_*/telemetry.jsonl")
+    assert paths
+    events = [json.loads(line) for line in open(paths[0])]
+    findings = [
+        f
+        for f in run_detectors(events)
+        if f["detector"] in _LEARN_DETECTORS and f["severity"] in ("warning", "critical")
+    ]
+    assert findings == [], findings

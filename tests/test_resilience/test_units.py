@@ -155,9 +155,9 @@ def test_normalize_fault_cfg():
     assert normalize_fault_cfg({"fault": {"kind": None}}) is None
     assert normalize_fault_cfg({"fault": {"kind": "none"}}) is None
     spec = normalize_fault_cfg({"fault": {"kind": "crash", "at_policy_step": 7}})
-    assert spec == {"kind": "crash", "at": 7, "rank": None}
+    assert spec == {"kind": "crash", "at": 7, "rank": None, "factor": 32.0}
     spec = normalize_fault_cfg({"fault": {"kind": "kill_rank", "at_policy_step": 3, "rank": 1}})
-    assert spec == {"kind": "kill_rank", "at": 3, "rank": 1}
+    assert spec == {"kind": "kill_rank", "at": 3, "rank": 1, "factor": 32.0}
     with pytest.raises(ValueError, match="unknown resilience.fault.kind"):
         normalize_fault_cfg({"fault": {"kind": "explode"}})
 

@@ -1,0 +1,91 @@
+"""Unit tests for the device-side training-health helpers
+(``utils/learn_stats.py``): the scalar blocks every fused train program emits."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu.utils import learn_stats
+
+
+def _params():
+    return {"w": jnp.ones((4, 3)), "b": jnp.zeros((3,)), "count": jnp.asarray(7, jnp.int32)}
+
+
+def test_global_norm_skips_integer_leaves():
+    norm = float(learn_stats.global_norm(_params()))
+    assert norm == pytest.approx(float(np.sqrt(12.0)))
+
+
+def test_group_stats_full_block():
+    params = _params()
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, 2.0) if jnp.issubdtype(p.dtype, jnp.inexact) else p, params
+    )
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1))
+    opt_state = tx.init({k: v for k, v in params.items() if k != "count"})
+    updates, opt_state = tx.update(
+        {k: v for k, v in grads.items() if k != "count"},
+        opt_state,
+        {k: v for k, v in params.items() if k != "count"},
+    )
+    out = learn_stats.group_stats(
+        "actor", grads=grads, updates=updates, params=params, opt_state=opt_state, clip=1.0
+    )
+    g = float(out["Learn/grad_norm/actor"])
+    # 2.0 in every float slot (12 + 3 elements); the int `count` leaf is skipped
+    assert g == pytest.approx(float(np.sqrt(4.0 * 15)))
+    # post-clip norm is min(pre, clip); this gradient is clipped
+    assert float(out["Learn/grad_norm_post/actor"]) == pytest.approx(1.0)
+    assert float(out["Learn/clip_fraction/actor"]) == 1.0
+    assert float(out["Learn/param_norm/actor"]) == pytest.approx(float(np.sqrt(12.0)))
+    # clipped-to-1 gradient through sgd(0.1): update norm 0.1 -> ratio 0.1/|p|
+    assert float(out["Learn/update_ratio/actor"]) == pytest.approx(0.1 / np.sqrt(12.0), rel=1e-5)
+    assert float(out["Learn/opt_moment_norm/actor"]) >= 0.0
+
+
+def test_group_stats_no_clip_omits_clip_keys():
+    out = learn_stats.group_stats("critic", grads=_params())
+    assert "Learn/grad_norm/critic" in out
+    assert "Learn/grad_norm_post/critic" not in out
+    assert "Learn/clip_fraction/critic" not in out
+
+
+def test_value_stats_and_td_quantiles():
+    v = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    out = learn_stats.value_stats(v, prefix="q")
+    assert float(out["Learn/q_mean"]) == pytest.approx(2.5)
+    assert float(out["Learn/q_min"]) == 1.0 and float(out["Learn/q_max"]) == 4.0
+    td = learn_stats.td_quantiles(jnp.linspace(-1.0, 1.0, 101))
+    assert float(td["Learn/td_error_p50"]) == pytest.approx(0.0, abs=1e-6)
+    assert float(td["Learn/td_error_p10"]) == pytest.approx(-0.8, abs=1e-6)
+    assert float(td["Learn/td_error_p90"]) == pytest.approx(0.8, abs=1e-6)
+
+
+def test_kl_stats_balance():
+    out = learn_stats.kl_stats(jnp.asarray(2.0), jnp.asarray(3.0), jnp.asarray(1.0))
+    assert float(out["Learn/kl"]) == 2.0
+    assert float(out["Learn/kl_balance"]) == pytest.approx(0.75)
+
+
+def test_reduce_stacked_mean_plus_grad_max():
+    stacked = {
+        "Learn/grad_norm/actor": jnp.asarray([1.0, 2.0, 9.0]),
+        "Learn/entropy": jnp.asarray([0.5, 0.7, 0.9]),
+    }
+    out = learn_stats.reduce_stacked(stacked)
+    assert float(out["Learn/grad_norm/actor"]) == pytest.approx(4.0)
+    # the per-round spike survives reduction as the _max companion
+    assert float(out["Learn/grad_norm_max/actor"]) == pytest.approx(9.0)
+    assert float(out["Learn/entropy"]) == pytest.approx(0.7)
+    assert "Learn/entropy_max" not in out
+
+
+def test_learn_keys_filters_prefix_without_sync():
+    mixed = {"Loss/x": 1.0, "Learn/entropy": 2.0, "Grads/actor": 3.0, 4: "odd"}
+    assert learn_stats.learn_keys(mixed) == {"Learn/entropy": 2.0}
+    assert learn_stats.learn_keys(None) == {}
