@@ -728,10 +728,15 @@ def _bench_serve_load(
     never gated on completions, and reports sessions/sec with the p99 step
     latency riding as a nested extra workload — latency units gate
     LOWER-is-better under ``--against`` (obs/compare.py ``_lower_is_better``).
-    CPU-only by construction (the checkpoint is tiny); the numbers measure the
-    serving machinery — batching, slot table, donated step program — not the
-    model."""
+    The robustness plane is exercised too: a hot reload lands MID-LOAD (a new
+    checkpoint saved while sessions run; the reloader applies it — recorded
+    under ``conditions.reload``), and a second bounded-queue overload burst
+    measures ``serve_load_shed_rate`` (unit "fraction", lower-is-better: more
+    shedding at the same offered load = capacity regressed). CPU-only by
+    construction (the checkpoint is tiny); the numbers measure the serving
+    machinery — batching, slot table, donated step program — not the model."""
     import shutil
+    import threading
 
     from sheeprl_tpu.cli import run
 
@@ -806,12 +811,60 @@ def _bench_serve_load(
         )
         server.table.attach({0: int(cfg.seed)})
 
+        # hot reload, exercised mid-load: a newer checkpoint lands while the
+        # open-loop sessions run and the reload thread swaps it in (same avals,
+        # zero recompiles — the summary's compile count stays flat)
+        from sheeprl_tpu.serve.reload import CheckpointReloadSource, WeightReloader
+        from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+        ckpt_dir = os.path.dirname(cfg.checkpoint_path)
+        reloader = WeightReloader(
+            server,
+            CheckpointReloadSource(
+                ckpt_dir, fabric, cfg, current_path=str(cfg.checkpoint_path)
+            ),
+            telemetry=telemetry,
+            poll_s=0.1,
+        )
+
+        def _publish_newer_checkpoint() -> None:
+            time.sleep(0.4)  # let the load reach steady state first
+            save_checkpoint(os.path.join(ckpt_dir, "ckpt_999128_0.ckpt"), state)
+
+        publisher = threading.Thread(target=_publish_newer_checkpoint, daemon=True)
+
         with server:
+            reloader.start()
+            publisher.start()
             load = run_synthetic_load(
                 server,
                 sessions=sessions,
                 steps_per_session=steps_per_session,
                 seed=int(cfg.seed),
+            )
+            publisher.join(timeout=10)
+            reloader.stop()
+
+        # overload burst phase: the SAME policy behind a bounded admission
+        # queue, offered 6x its (slots + queue) capacity at once — the shed
+        # fraction is the gateable overload-protection number (a faster server
+        # turns sessions over during the burst and sheds less)
+        burst_sessions = 6 * (slots + slots)  # 6x (slots + max_queue) below
+        burst_steps = steps_per_session
+        shed_server = PolicyServer(
+            policy,
+            slots=slots,
+            max_batch_wait_ms=float(cfg.serve.max_batch_wait_ms),
+            base_seed=int(cfg.seed) + 1,
+            max_queue=slots,
+        )
+        with shed_server:
+            shed_load = run_synthetic_load(
+                shed_server,
+                sessions=burst_sessions,
+                steps_per_session=burst_steps,
+                arrival_interval_s=0.001,
+                seed=int(cfg.seed) + 1,
             )
 
         events = read_events(telemetry_path)
@@ -850,6 +903,12 @@ def _bench_serve_load(
                 ),
                 "sessions_per_sec": serve_summary.get("sessions_per_sec"),
             },
+            # the hot reload exercised mid-load (serve/reload.py): versions
+            # applied + failures from the summary's cumulative weights block
+            "reload": {
+                **(serve_summary.get("weights") or {}),
+                "applied_mid_load": reloader.applied,
+            },
             "telemetry": {
                 k: v for k, v in summary.items() if k not in ("event", "time", "seq")
             },
@@ -863,10 +922,11 @@ def _bench_serve_load(
             "vs_baseline": None,  # first serving tier — no reference number exists
             "conditions": conditions,
         }
+        extras = []
         if p99 is not None:
             # the latency companion gates independently; "ms" units are
             # lower-is-better in bench-diff (verified by test_compare)
-            result["extras"] = [
+            extras.append(
                 {
                     "metric": "serve_load_step_latency_p99_ms",
                     "value": p99,
@@ -879,7 +939,28 @@ def _bench_serve_load(
                         "fingerprint": fingerprint,
                     },
                 }
-            ]
+            )
+        # "fraction" units gate lower-is-better (obs/compare.py): shedding
+        # MORE of the same offered burst means serving capacity regressed
+        extras.append(
+            {
+                "metric": "serve_load_shed_rate",
+                "value": shed_load["shed_rate"],
+                "unit": "fraction (sessions shed / offered, 6x overload burst)",
+                "vs_baseline": None,
+                "conditions": {
+                    "slots": slots,
+                    "max_queue": slots,
+                    "sessions_offered": burst_sessions,
+                    "sessions_finished": shed_load["sessions_finished"],
+                    "sessions_shed": shed_load["sessions_shed"],
+                    "steps_per_session": burst_steps,
+                    "arrival_interval_s": 0.001,
+                    "fingerprint": fingerprint,
+                },
+            }
+        )
+        result["extras"] = extras
         return result
     finally:
         shutil.rmtree(workdir, ignore_errors=True)

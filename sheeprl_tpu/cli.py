@@ -635,7 +635,12 @@ def serve(args: Optional[Sequence[str]] = None) -> int:
     checkpoint set — resolved through the supervisor's manifest-validated
     discovery), compile ONE donated fixed-shape step program, and serve
     concurrent sessions via continuous batching over a device-resident slot
-    table. ``serve.prime=true`` compiles the serving programs into the
+    table. The robustness plane (howto/serving.md "Operating a server"): hot
+    weight reload (``serve.reload.enabled``, zero recompiles), overload
+    shedding (``serve.max_queue``) + per-request deadlines
+    (``serve.deadline_ms``), SIGTERM → graceful drain (exit 75), ``/healthz``
+    readiness on the metrics port, and ``serve.supervisor.*`` bounded-restart
+    supervision. ``serve.prime=true`` compiles the serving programs into the
     persistent XLA cache and exits (cold-start priming, the ``sheeprl-compile``
     story for serving)."""
     from sheeprl_tpu.serve.main import serve_main

@@ -773,6 +773,8 @@ def _lower_is_better(unit: str) -> bool:
     # regresses UP, while "return" (episode return) and "nats" (policy
     # entropy) are higher-is-better — the default — so an entropy workload can
     # never be gated backwards (direction-pinned in tests/test_obs/test_compare.py).
+    # "fraction" covers failure-share metrics (serve_load_shed_rate: sessions
+    # shed / offered) — more shedding at the same offered load regresses UP.
     unit = (unit or "").lower()
     return (
         unit.startswith("seconds")
@@ -784,6 +786,7 @@ def _lower_is_better(unit: str) -> bool:
         or unit.endswith("_ms")
         or "_ms " in unit
         or unit.startswith("loss")
+        or unit.startswith("fraction")
     )
 
 

@@ -31,6 +31,9 @@ unattributed_time   the phases breakdown leaves too much wall time unnamed
 occupancy_collapse  (serving) batch occupancy fell away with sessions attached
 latency_regression  (serving) window p99 step latency far above the run median
 slot_starvation     (serving) sessions queued while the slot table ran full
+shed_rate           (serving) admissions rejected by overload protection
+deadline_misses     (serving) requests dropped past their serve.deadline_ms
+reload_stall        (serving) hot reload rejecting candidates / falling behind
 weight_staleness    (service) actors acting with weights far behind the learner
 row_age_drift       (service) the learner trains on increasingly old rows
 ingest_backpressure (service) actors blocked on flow control / ingest backlog
@@ -86,6 +89,14 @@ LATENCY_REGRESSION_RATIO = 2.0  # window p99 vs run median p99
 LATENCY_REGRESSION_CRITICAL = 4.0
 SLOT_STARVATION_OCCUPANCY = 0.95  # "table full" occupancy floor
 SLOT_STARVATION_FRACTION = 0.5  # share of windows with a waiting queue
+# serving robustness plane (shed/deadline/reload state in the serve block)
+SHED_RATE_WARNING = 0.1  # window shed/offered fraction that flags overload
+SHED_RATE_CRITICAL = 0.5
+SHED_MIN_SESSIONS = 3  # total shed sessions before judging (burst noise floor)
+DEADLINE_MISS_WARNING = 0.05  # window missed/(missed+served) fraction
+DEADLINE_MISS_CRITICAL = 0.25
+DEADLINE_MIN_MISSES = 3
+RELOAD_STALL_WINDOWS = 2  # windows with available > serving version in a row
 # experience-plane (dataflow block) detectors — buffer.backend=service runs
 WEIGHT_STALENESS_LAG = 3  # versions behind the publisher that flag an actor
 WEIGHT_STALENESS_WINDOWS = 2  # sustained lagging windows before flagging
@@ -707,6 +718,146 @@ def detect_slot_starvation(events: Events) -> List[Finding]:
     ]
 
 
+def detect_shed_rate(events: Events) -> List[Finding]:
+    """Overload protection rejected admissions: demand exceeded `serve.slots` +
+    `serve.max_queue` capacity. Working as designed — but an operator must see
+    that traffic is being turned away (and how much) to size the server."""
+    windows = _serve_windows(events)
+    shed_windows = [
+        w for w in windows if _f((w["serve"].get("sessions") or {}).get("shed")) > 0
+    ]
+    if not shed_windows:
+        return []
+    total_shed = int(sum(_f((w["serve"].get("sessions") or {}).get("shed")) for w in shed_windows))
+    if total_shed < SHED_MIN_SESSIONS:
+        return []
+    worst = max(_f(w["serve"].get("shed_rate")) for w in shed_windows)
+    if worst < SHED_RATE_WARNING:
+        return []
+    severity = "critical" if worst >= SHED_RATE_CRITICAL else "warning"
+    return [
+        _finding(
+            "shed_rate",
+            severity,
+            f"{total_shed} session(s) shed by overload protection across "
+            f"{len(shed_windows)} window(s) (worst window shed rate {worst:.0%})",
+            shed_windows,
+            "capacity is below demand: raise serve.slots (one recompile, then O(1) "
+            "again), raise serve.max_queue if the bursts are short, or add servers",
+            sessions_shed=total_shed,
+            worst_shed_rate=round(worst, 4),
+            windows=len(shed_windows),
+        )
+    ]
+
+
+def detect_deadline_misses(events: Events) -> List[Finding]:
+    """Requests dropped before the tick because their `serve.deadline_ms`
+    expired: the server cannot turn batches around inside the latency budget
+    (slow ticks, saturation, or a too-tight deadline)."""
+    windows = _serve_windows(events)
+    missed_windows = [
+        w for w in windows if _f(w["serve"].get("deadline_missed")) > 0
+    ]
+    if not missed_windows:
+        return []
+    total_missed = int(sum(_f(w["serve"].get("deadline_missed")) for w in missed_windows))
+    if total_missed < DEADLINE_MIN_MISSES:
+        return []
+    fractions = [
+        _f(w["serve"].get("deadline_missed"))
+        / max(_f(w["serve"].get("deadline_missed")) + _f(w.get("steps")), 1.0)
+        for w in missed_windows
+    ]
+    worst = max(fractions)
+    if worst < DEADLINE_MISS_WARNING:
+        return []
+    severity = "critical" if worst >= DEADLINE_MISS_CRITICAL else "warning"
+    return [
+        _finding(
+            "deadline_misses",
+            severity,
+            f"{total_missed} request(s) exceeded serve.deadline_ms before their tick "
+            f"across {len(missed_windows)} window(s) (worst window {worst:.0%} of requests)",
+            missed_windows,
+            "check the same windows' latency p99 and compile counts (a slow/stalling "
+            "tick starves deadlines); widen serve.deadline_ms or shrink "
+            "serve.max_batch_wait_ms if the budget is real",
+            deadline_missed=total_missed,
+            worst_miss_fraction=round(worst, 4),
+            windows=len(missed_windows),
+        )
+    ]
+
+
+def detect_reload_stall(events: Events) -> List[Finding]:
+    """The hot-reload path is not keeping the server current: candidates are
+    being rejected (torn/invalid — the old params keep serving, by design, but
+    someone is producing bad checkpoints), or newer versions keep appearing
+    without ever being applied (a wedged reload thread / unreadable source)."""
+    # the weights block is CUMULATIVE state, conclusive from the last window
+    # alone — so the final window is evidence here, not startup noise
+    windows = [
+        w for w in _windows(events, steady=False) if isinstance(w.get("serve"), dict)
+    ]
+    weighted = [w for w in windows if isinstance(w["serve"].get("weights"), dict)]
+    if not weighted:
+        return []
+    findings: List[Finding] = []
+    last = weighted[-1]["serve"]["weights"]
+    failures = int(_f(last.get("failures")))
+    if failures > 0:
+        failed_windows = [
+            w for w in weighted if _f(w["serve"]["weights"].get("failures")) > 0
+        ]
+        findings.append(
+            _finding(
+                "reload_stall",
+                "warning",
+                f"hot reload rejected {failures} candidate(s) (torn/invalid) — the old "
+                f"version (v{int(_f(last.get('version')))}) kept serving",
+                failed_windows[-4:],
+                "inspect the producing run's checkpoints (sha256 sidecar mismatch = "
+                "torn write); the server is safe but will not pick up new weights "
+                "until a valid candidate lands",
+                failures=failures,
+                serving_version=int(_f(last.get("version"))),
+            )
+        )
+    stalled = [
+        w
+        for w in weighted
+        if _f(w["serve"]["weights"].get("available")) > _f(w["serve"]["weights"].get("version"))
+    ]
+    # judge only a stall that PERSISTS to the end of the run — a version that
+    # was behind mid-run and applied later is the normal reload cadence
+    tail = weighted[-RELOAD_STALL_WINDOWS:]
+    if (
+        len(tail) >= RELOAD_STALL_WINDOWS
+        and all(w in stalled for w in tail)
+        and failures == 0
+    ):
+        behind = int(
+            _f(last.get("available")) - _f(last.get("version"))
+        )
+        findings.append(
+            _finding(
+                "reload_stall",
+                "warning",
+                f"a newer weight version has been available for {len(tail)}+ window(s) "
+                f"without being applied (serving v{int(_f(last.get('version')))}, "
+                f"available v{int(_f(last.get('available')))})",
+                tail,
+                "the reload thread is stalled or the source is unreadable: check "
+                "serve.reload.poll_s and the reload events in the stream",
+                versions_behind=behind,
+                serving_version=int(_f(last.get("version"))),
+                available_version=int(_f(last.get("available"))),
+            )
+        )
+    return findings
+
+
 def _dataflow_windows(events: Events, role: str) -> List[Dict[str, Any]]:
     """Steady windows carrying a ``dataflow`` block of the given role
     (``buffer.backend=service`` runs only — everything else contributes none,
@@ -1294,6 +1445,9 @@ DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "occupancy_collapse": detect_occupancy_collapse,
     "latency_regression": detect_latency_regression,
     "slot_starvation": detect_slot_starvation,
+    "shed_rate": detect_shed_rate,
+    "deadline_misses": detect_deadline_misses,
+    "reload_stall": detect_reload_stall,
     "weight_staleness": detect_weight_staleness,
     "row_age_drift": detect_row_age_drift,
     "ingest_backpressure": detect_ingest_backpressure,

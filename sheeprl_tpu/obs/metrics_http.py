@@ -16,10 +16,20 @@ thread, no artifact. ``http_port: 0`` binds an ephemeral port (tests read it
 back from :attr:`MetricsEndpoint.port`). The listener binds
 ``metric.telemetry.http_host`` (default ``127.0.0.1`` — scraping across hosts
 is an explicit opt-in, not a default exposure).
+
+The same listener also answers ``GET /healthz`` — the readiness/liveness probe
+the serving tier's drain/overload lifecycle needs (howto/serving.md,
+"Operating a server"): the owner pushes a health dict via
+:meth:`MetricsEndpoint.set_health` (``{"ready": bool, "status": str, ...}``)
+and the probe returns it as JSON with 200 when ready, 503 when not (a draining
+or still-loading server is alive but must be pulled from rotation). With no
+health dict set the probe reports ``{"ready": true, "status": "ok"}`` — a
+process serving metrics is, at minimum, alive.
 """
 
 from __future__ import annotations
 
+import json
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -85,13 +95,27 @@ class MetricsEndpoint:
     ) -> None:
         self._lock = threading.Lock()
         self._gauges: Dict[str, float] = {}
+        self._health: Dict[str, Any] = {}
         self._labels = dict(labels or {})
         self._namespace = namespace
         endpoint = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 — http.server API
-                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                route = self.path.split("?", 1)[0]
+                if route == "/healthz":
+                    ready, payload = endpoint.health()
+                    body = (json.dumps(payload) + "\n").encode("utf-8")
+                    # readiness semantics: 503 pulls a draining/booting server
+                    # out of rotation while the process stays alive (liveness
+                    # is the connection itself)
+                    self.send_response(200 if ready else 503)
+                    self.send_header("Content-Type", "application/json; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if route not in ("/metrics", "/"):
                     self.send_error(404)
                     return
                 body = endpoint.render().encode("utf-8")
@@ -124,6 +148,21 @@ class MetricsEndpoint:
                 self._gauges = numeric
             else:
                 self._gauges.update(numeric)
+
+    def set_health(self, health: Mapping[str, Any]) -> None:
+        """Replace the ``/healthz`` payload. ``{"ready": bool, "status": str,
+        ...}`` — extras (weight version, active sessions) pass through as
+        JSON. The owner pushes state transitions (loading → ok → draining);
+        the probe only renders."""
+        with self._lock:
+            self._health = dict(health)
+
+    def health(self) -> tuple:
+        with self._lock:
+            payload = dict(self._health) if self._health else {"ready": True, "status": "ok"}
+        payload.setdefault("ready", True)
+        payload.setdefault("status", "ok" if payload["ready"] else "not_ready")
+        return bool(payload["ready"]), payload
 
     def render(self) -> str:
         with self._lock:

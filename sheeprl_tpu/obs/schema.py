@@ -174,6 +174,23 @@ _OPEN_EVENTS: Dict[str, Dict[str, Tuple[tuple, bool]]] = {
     "preempt": {},
     "preempt_exit": {},
     "fault": {"kind": (_STR, False)},
+    # serving robustness plane (sheeprl_tpu/serve): hot-reload lifecycle
+    # (applied/rejected with the version bookkeeping) and graceful-drain
+    # lifecycle (begin/end with shed/aborted accounting)
+    "reload": {
+        "status": (_STR, True),
+        "version": (_INT, False),
+        "available": (_INT, False),
+        "reloads": (_INT, False),
+        "reason": (_STR, False),
+        "source": (_STR, False),
+    },
+    "drain": {
+        "status": (_STR, True),
+        "shed": (_INT, False),
+        "aborted": (_INT, False),
+        "grace_s": (_NUM, False),
+    },
     "checkpoint": {},
     "restart": {"reason": (_STR, False)},
     "resume": {},

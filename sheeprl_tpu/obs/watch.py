@@ -88,6 +88,9 @@ class WatchState:
         self.health = "unknown"
         self.findings: List[Dict[str, Any]] = []
         self.preempted = False
+        # serving robustness plane: drain lifecycle + last reload event
+        self.draining = False
+        self.last_reload: Optional[Dict[str, Any]] = None
         self.summary: Optional[Dict[str, Any]] = None  # primary-stream summary
         self.gave_up = False
         self.events_seen = 0
@@ -130,6 +133,11 @@ class WatchState:
                 self._consume_health(event)
             elif kind == "preempt":
                 self.preempted = True
+            elif kind == "drain":
+                # a drain never un-begins: the server is winding down
+                self.draining = True
+            elif kind == "reload":
+                self.last_reload = event
             elif kind in ("restart", "resume"):
                 self.restarts += int(kind == "restart")
                 # only the restart carries the reason — the resume event that
@@ -292,16 +300,34 @@ class WatchState:
             serve = w.get("serve")
             if isinstance(serve, dict):
                 # a SERVING run's window (sheeprl_tpu/serve): sessions + latency
+                # + the robustness plane's state (weight version, shed/deadline
+                # pressure, degraded/draining flags)
                 lat = serve.get("latency_ms") or {}
                 sessions = serve.get("sessions") or {}
                 bits = [
                     f"sessions {sessions.get('active', 0)}",
                     f"occupancy {float(serve.get('occupancy') or 0.0):.0%}",
                 ]
+                weights = serve.get("weights") or {}
+                if weights.get("version") is not None:
+                    version_bit = f"weights v{int(weights['version'])}"
+                    if float(weights.get("available") or 0) > float(weights["version"]):
+                        version_bit += f" (v{int(weights['available'])} avail)"
+                    if weights.get("failures"):
+                        version_bit += f" · {int(weights['failures'])} reload failure(s)"
+                    bits.append(version_bit)
                 if lat.get("p50") is not None:
                     bits.append(f"latency p50 {lat['p50']:.1f}ms p99 {lat.get('p99', 0):.1f}ms")
                 if serve.get("queue_depth"):
                     bits.append(f"queue {float(serve['queue_depth']):.1f}")
+                if sessions.get("shed"):
+                    bits.append(f"SHED {int(sessions['shed'])}")
+                if serve.get("deadline_missed"):
+                    bits.append(f"deadline missed {int(serve['deadline_missed'])}")
+                if serve.get("degraded"):
+                    bits.append("DEGRADED")
+                if self.draining:
+                    bits.append("DRAINING")
                 lines.append("  serve: " + " · ".join(bits))
             learning = w.get("learning")
             if isinstance(learning, dict):
@@ -369,6 +395,10 @@ class WatchState:
             )
         if self.preempted:
             health_bits.append("preempt requested")
+        if self.draining:
+            health_bits.append("draining")
+        if self.last_reload is not None and self.last_reload.get("status") == "applied":
+            health_bits.append(f"reloaded v{self.last_reload.get('version')}")
         lines.append("  " + " · ".join(health_bits))
         # multi-process runs: per-rank liveness, so a gang teardown reads as
         # "rank 1 DEAD (heartbeat timeout)" instead of an unexplained crash

@@ -80,7 +80,11 @@ def is_valid_checkpoint(path: str) -> bool:
     """Would ``load_checkpoint(path)`` find a complete state at ``path``?
 
     - pickle file: committed atomically (tmp+``os.replace``), so a non-empty
-      ``.ckpt`` file is complete by construction;
+      ``.ckpt`` file is complete by construction; when a ``<path>.sha256``
+      integrity sidecar exists (utils/checkpoint.py writes one per save), the
+      digest must ALSO match — a corrupted/torn file is invalid and resolution
+      falls back to the previous valid checkpoint (what hot reload's
+      ``reload_torn`` fault and ``resume_from=latest`` both lean on);
     - orbax directory: needs its sidecar — at ``<path>.extras.pkl`` or, in the
       mid-displacement crash window, ``<path>.old.extras.pkl``;
     - missing path with a ``<path>.old`` directory: the in-place-overwrite crash
@@ -95,9 +99,15 @@ def is_valid_checkpoint(path: str) -> bool:
         return False
     if os.path.isfile(path):
         try:
-            return os.path.getsize(path) > 0
+            if os.path.getsize(path) <= 0:
+                return False
         except OSError:
             return False
+        from sheeprl_tpu.utils.checkpoint import verify_sha_sidecar
+
+        # advisory integrity sidecar: absent (None) keeps the size heuristic's
+        # verdict; present-but-mismatching vetoes — the file is corrupt
+        return verify_sha_sidecar(path) is not False
     if os.path.isdir(path):
         return os.path.isfile(path + ".extras.pkl") or os.path.isfile(path + ".old.extras.pkl")
     old = path + ".old"

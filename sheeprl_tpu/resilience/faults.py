@@ -46,6 +46,22 @@ single-process semantics):
                          receivers must exhaust their bounded timeout instead
                          of hanging forever.
 
+Serving faults (``sheeprl.py serve`` — the server's tick loop drives
+``maybe_fire`` with SERVED steps as the policy-step axis):
+
+- ``slow_tick``        — every tick after the trigger pays a ``fault.factor``
+                         millisecond stall (default 32ms): a degraded device /
+                         noisy neighbor; the ``latency_regression`` and
+                         ``deadline_misses`` detectors must see it;
+- ``session_flood``    — a burst of ``fault.factor`` synthetic sessions storms
+                         admission at once: overload shedding (``serve.max_queue``)
+                         must reject the excess and the ``shed_rate`` detector
+                         must flag the window;
+- ``reload_torn``      — the hot-reload path's next checkpoint candidate is
+                         torn (corrupted on disk before the read): integrity
+                         validation must reject it, the OLD params must keep
+                         serving, and ``reload_stall`` must surface it.
+
 Every fault fires at most once per process (the in-process supervisor restarts
 within the same process, so a resumed attempt replaying policy steps below
 ``at_policy_step`` must not re-trigger); the supervisors additionally strip the
@@ -68,6 +84,9 @@ FAULT_KINDS = (
     "kill_rank",
     "stale_heartbeat",
     "channel_drop",
+    "slow_tick",
+    "session_flood",
+    "reload_torn",
 )
 
 DEFAULT_LR_SPIKE_FACTOR = 32.0
@@ -83,6 +102,9 @@ _env_fault_armed = threading.Event()
 _heartbeat_stale = threading.Event()
 _channel_drop_armed = threading.Event()
 _learn_fault_factor: list = [None]  # armed lr_spike scale, consumed by the next train round
+_slow_tick_seconds: list = [0.0]  # permanent per-tick stall once slow_tick fired
+_session_flood: list = [None]  # one-shot burst size for the serving flood
+_reload_torn_armed = threading.Event()
 
 
 def normalize_fault_cfg(resilience_cfg: Any) -> Optional[Dict[str, Any]]:
@@ -120,6 +142,9 @@ def reset_faults() -> None:
     _heartbeat_stale.clear()
     _channel_drop_armed.clear()
     _learn_fault_factor[0] = None
+    _slow_tick_seconds[0] = 0.0
+    _session_flood[0] = None
+    _reload_torn_armed.clear()
     from sheeprl_tpu.utils import checkpoint
 
     if checkpoint._fault_hook is _ckpt_kill_hook:
@@ -174,6 +199,32 @@ def apply_armed_learn_fault(tree: Any) -> Any:
     return jax.tree_util.tree_map(scale, tree)
 
 
+def slow_tick_seconds() -> float:
+    """The armed per-tick stall (``slow_tick``), in seconds; 0 when off. NOT
+    one-shot — a degraded device stays degraded, which is what the sustained
+    latency/deadline detectors need to see."""
+    return _slow_tick_seconds[0]
+
+
+def consume_session_flood() -> Optional[int]:
+    """One-shot poll the serving tick loop runs after ``maybe_fire``: the armed
+    ``session_flood`` burst size, or None."""
+    with _lock:
+        count = _session_flood[0]
+        _session_flood[0] = None
+    return count
+
+
+def consume_reload_torn() -> bool:
+    """One-shot poll the hot-reload source runs before reading a checkpoint
+    candidate: True exactly once after ``reload_torn`` fired — the source then
+    tears the candidate on disk so the integrity path is exercised end-to-end."""
+    if _reload_torn_armed.is_set():
+        _reload_torn_armed.clear()
+        return True
+    return False
+
+
 def consume_env_fault() -> bool:
     """One-shot poll the env fault wrapper runs per ``step()`` call. Process-
     global, so it reaches in-process (sync) vector envs; subprocess (async)
@@ -224,7 +275,11 @@ class FaultPlan:
             kind=self.kind,
             at_policy_step=self.at,
             rank=self.rank,
-            **({"factor": self.factor} if self.kind == "lr_spike" else {}),
+            **(
+                {"factor": self.factor}
+                if self.kind in ("lr_spike", "slow_tick", "session_flood")
+                else {}
+            ),
         )
         if self.kind == "crash":
             raise InjectedFaultError(
@@ -248,6 +303,14 @@ class FaultPlan:
             import signal as _stdlib_signal
 
             os.kill(os.getpid(), _stdlib_signal.SIGKILL)
+        elif self.kind == "slow_tick":
+            # factor is MILLISECONDS of stall per tick (default 32ms)
+            _slow_tick_seconds[0] = max(self.factor, 0.0) / 1000.0
+        elif self.kind == "session_flood":
+            with _lock:
+                _session_flood[0] = max(int(self.factor), 1)
+        elif self.kind == "reload_torn":
+            _reload_torn_armed.set()
         elif self.kind == "stale_heartbeat":
             _heartbeat_stale.set()
         elif self.kind == "channel_drop":

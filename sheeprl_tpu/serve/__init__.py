@@ -10,7 +10,10 @@ Layout (shape parity with ``obs/`` and ``resilience/``):
 
 - ``policy.py``  — the :class:`ServePolicy` contract + per-family registry
 - ``slots.py``   — the device slot table and its donated step/attach programs
-- ``server.py``  — the continuous-batching server + in-process session API
+- ``server.py``  — the continuous-batching server + in-process session API,
+  with the robustness plane: overload shedding, deadlines, degraded mode,
+  graceful drain, atomic hot weight swap
+- ``reload.py``  — hot weight reload sources + the reload thread
 - ``drivers.py`` — env-session and open-loop load clients
 - ``telemetry.py`` — the serving telemetry stream (watch/diagnose-compatible)
 - ``main.py``    — the CLI verb implementation + compile-cache priming
@@ -21,18 +24,34 @@ See ``howto/serving.md``.
 from __future__ import annotations
 
 from sheeprl_tpu.serve.policy import ObsSpec, ServePolicy, resolve_serve_policy, space_obs_spec
-from sheeprl_tpu.serve.server import PolicyServer, ServeSession, ServerClosed
+from sheeprl_tpu.serve.reload import (
+    CheckpointReloadSource,
+    SubscriberReloadSource,
+    WeightReloader,
+)
+from sheeprl_tpu.serve.server import (
+    DeadlineExceeded,
+    PolicyServer,
+    ServeSession,
+    ServerClosed,
+    ServerOverloaded,
+)
 from sheeprl_tpu.serve.slots import SlotTable
 from sheeprl_tpu.serve.telemetry import ServingTelemetry
 
 __all__ = [
+    "CheckpointReloadSource",
+    "DeadlineExceeded",
     "ObsSpec",
     "PolicyServer",
     "ServePolicy",
     "ServeSession",
     "ServerClosed",
+    "ServerOverloaded",
     "ServingTelemetry",
     "SlotTable",
+    "SubscriberReloadSource",
+    "WeightReloader",
     "resolve_serve_policy",
     "space_obs_spec",
 ]

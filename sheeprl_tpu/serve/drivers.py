@@ -23,9 +23,32 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from sheeprl_tpu.serve.server import PolicyServer, ServerClosed
+from sheeprl_tpu.serve.server import (
+    DeadlineExceeded,
+    PolicyServer,
+    ServerClosed,
+    ServerOverloaded,
+)
 
 __all__ = ["run_env_sessions", "run_synthetic_load"]
+
+# env-driver client etiquette under overload: honor the shed's retry-after a
+# bounded number of times, retry a deadline-missed request once per step
+_ADMISSION_RETRIES = 8
+_DEADLINE_RETRIES = 2
+
+
+def _open_with_retry(server: PolicyServer, seed: int, record: Dict[str, Any]):
+    """A WELL-BEHAVED client of the overload-protection plane: a shed admission
+    waits the server's ``retry_after_s`` hint and retries (bounded) instead of
+    hammering; the retry count rides the session record."""
+    for _ in range(_ADMISSION_RETRIES):
+        try:
+            return server.open_session(seed=seed)
+        except ServerOverloaded as exc:
+            record["admission_retries"] = record.get("admission_retries", 0) + 1
+            time.sleep(min(exc.retry_after_s, 5.0))
+    return server.open_session(seed=seed)  # last try: let the rejection surface
 
 
 def run_env_sessions(
@@ -49,10 +72,19 @@ def run_env_sessions(
         session = None
         try:
             env = make_env(cfg, record["seed"], i, log_dir, "serve", vector_env_idx=i)()
-            session = server.open_session(seed=record["seed"])
+            session = _open_with_retry(server, record["seed"], record)
             obs = env.reset(seed=record["seed"])[0]
             for _ in range(max_session_steps):
-                action = session.step(obs)
+                for attempt in range(_DEADLINE_RETRIES + 1):
+                    try:
+                        action = session.step(obs)
+                        break
+                    except DeadlineExceeded:
+                        # the request never reached the device (carry intact):
+                        # retrying the SAME observation preserves the episode
+                        record["deadline_retries"] = record.get("deadline_retries", 0) + 1
+                        if attempt >= _DEADLINE_RETRIES:
+                            raise
                 record["actions"].append(np.asarray(action))
                 obs, reward, terminated, truncated, _ = env.step(
                     np.asarray(action).reshape(env.action_space.shape)
@@ -61,7 +93,7 @@ def run_env_sessions(
                 record["steps"] += 1
                 if bool(terminated) or bool(truncated):
                     break
-        except (ServerClosed, TimeoutError) as exc:
+        except (ServerClosed, ServerOverloaded, DeadlineExceeded, TimeoutError) as exc:
             record["error"] = repr(exc)
         finally:
             if session is not None:
@@ -92,7 +124,7 @@ def run_synthetic_load(
     rng = np.random.default_rng(seed)
     spec = server.policy.obs_spec
     done = threading.Event()
-    state = {"finished": 0, "steps": 0, "errors": 0}
+    state = {"finished": 0, "steps": 0, "errors": 0, "shed": 0, "deadline_missed": 0}
     lock = threading.Lock()
 
     def _client(i: int) -> None:
@@ -107,11 +139,22 @@ def run_synthetic_load(
             }
             steps = 0
             for _ in range(steps_per_session):
-                session.step(obs)
-                steps += 1
+                try:
+                    session.step(obs)
+                    steps += 1
+                except DeadlineExceeded:
+                    # open-loop semantics: a missed deadline is counted and the
+                    # session moves on — arrivals never slow down for the server
+                    with lock:
+                        state["deadline_missed"] += 1
             with lock:
                 state["finished"] += 1
                 state["steps"] += steps
+        except ServerOverloaded:
+            # shed at admission: open-loop clients do NOT retry — the point of
+            # the generator is to measure how the server holds under overload
+            with lock:
+                state["shed"] += 1
         except (ServerClosed, TimeoutError):
             with lock:
                 state["errors"] += 1
@@ -121,7 +164,7 @@ def run_synthetic_load(
             if session is not None:
                 session.close()
             with lock:
-                if state["finished"] + state["errors"] >= sessions:
+                if state["finished"] + state["errors"] + state["shed"] >= sessions:
                     done.set()
 
     t0 = time.perf_counter()
@@ -135,6 +178,9 @@ def run_synthetic_load(
         "sessions": sessions,
         "sessions_finished": state["finished"],
         "errors": state["errors"],
+        "sessions_shed": state["shed"],
+        "shed_rate": round(state["shed"] / sessions, 4) if sessions else 0.0,
+        "deadline_missed": state["deadline_missed"],
         "steps": state["steps"],
         "wall_seconds": round(wall, 3),
         "sessions_per_sec": round(state["finished"] / wall, 3) if wall > 0 else None,

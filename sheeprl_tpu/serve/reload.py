@@ -1,0 +1,332 @@
+"""Hot weight reload: a long-lived server picks up newer weights, in place.
+
+A production policy server outlives any single checkpoint — training keeps
+publishing newer ones (MindSpeed RL makes the continuous train→serve weight
+flow the unit of production RL). This module closes that loop for
+``sheeprl.py serve``: a reload thread polls a weight *source*, stages the new
+params device-side, validates them, and hands them to
+:meth:`~sheeprl_tpu.serve.server.PolicyServer.update_params` — the tick loop
+swaps them in atomically *between* ticks. Because the slot-table programs take
+params as an ordinary argument, same avals ⇒ the SAME compiled ``slot_step``
+program: a reload costs zero recompiles, and no session's device carry is
+touched (state and weights are independent inputs — the O(1) session-state
+design is what makes the in-place swap safe).
+
+Two sources:
+
+- :class:`CheckpointReloadSource` — watch a run/checkpoint directory through
+  the crash supervisor's discovery rules (``resolve_checkpoint_path``
+  semantics: manifest-validated, sha256-verified, torn sets can never
+  resolve). The ``serve.reload.source=checkpoint`` mode: point a server at the
+  run dir it was launched from and it follows training's checkpoint cadence.
+- :class:`SubscriberReloadSource` — ride the fleet experience plane's
+  versioned weight flow (``data/service.py`` ``WeightSubscriber``): the
+  learner publishes, servers refresh — the same plane the actors use.
+
+Safety: a candidate that fails integrity validation (torn file, sha mismatch,
+unpicklable payload) or whose params avals do not match the serving policy's
+is REJECTED — the old params keep serving, the rejection lands as a ``reload``
+event (``status=rejected``) and in the window's ``serve.weights.failures``
+counter, and the ``reload_stall`` detector surfaces a reload path that keeps
+failing while newer versions exist. The ``reload_torn`` fault
+(``resilience/faults.py``) tears the next candidate on disk to exercise
+exactly this path deterministically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "CheckpointReloadSource",
+    "ReloadRejected",
+    "SubscriberReloadSource",
+    "WeightReloader",
+    "params_aval_mismatch",
+]
+
+
+class ReloadRejected(RuntimeError):
+    """A reload candidate failed validation; the old params keep serving."""
+
+
+def params_aval_mismatch(current: Any, candidate: Any) -> Optional[str]:
+    """None when ``candidate`` has exactly the avals of ``current`` (same tree
+    structure, same leaf shapes and dtypes) — the precondition for a zero-
+    recompile swap; otherwise a human-readable description of the first
+    mismatch. An aval change is a DIFFERENT program (a resized model, a wrong
+    checkpoint) and must be rejected, not silently recompiled mid-serve."""
+    import jax
+    import numpy as np
+
+    cur_leaves, cur_def = jax.tree_util.tree_flatten(current)
+    cand_leaves, cand_def = jax.tree_util.tree_flatten(candidate)
+    if cur_def != cand_def:
+        return f"params tree structure changed: {cand_def} != {cur_def}"
+    for i, (a, b) in enumerate(zip(cur_leaves, cand_leaves)):
+        a_shape = tuple(np.shape(a))
+        b_shape = tuple(np.shape(b))
+        if a_shape != b_shape:
+            return f"leaf {i} shape changed: {b_shape} != {a_shape}"
+        a_dtype = np.asarray(a).dtype if not hasattr(a, "dtype") else a.dtype
+        b_dtype = np.asarray(b).dtype if not hasattr(b, "dtype") else b.dtype
+        if np.dtype(a_dtype) != np.dtype(b_dtype):
+            return f"leaf {i} dtype changed: {b_dtype} != {a_dtype}"
+    return None
+
+
+class CheckpointReloadSource:
+    """Follow the newest valid checkpoint under a directory (or an exact file's
+    parent): discovery-validated resolution, family extractor for the params.
+
+    Versions are this source's own monotonic counter (1 per successfully
+    loaded NEW path) — checkpoint steps order within a run, but the serving
+    version axis must survive restarts and resumes, so the counter is local.
+    """
+
+    name = "checkpoint"
+
+    def __init__(self, watch_dir: str, fabric: Any, cfg: Any, current_path: Optional[str] = None) -> None:
+        self.watch_dir = str(watch_dir)
+        self.fabric = fabric
+        self.cfg = cfg
+        # the checkpoint the server booted from never re-applies as version 1
+        self._last_path = os.path.abspath(current_path) if current_path else None
+        self._version = 0
+        # one-shot scan handoff: the reloader calls peek_available() then
+        # poll() back to back each poll — share a single directory resolution
+        # (each scan re-validates candidates) instead of scanning twice
+        self._scan: Optional[Tuple[Optional[str]]] = None
+
+    def peek_available(self) -> Optional[int]:
+        """Whether an unapplied candidate exists (versions-available probe for
+        the stall accounting): the source's NEXT version when a newer path is
+        resolvable, else the current one."""
+        from sheeprl_tpu.resilience.discovery import find_latest_checkpoint
+
+        self._scan = None
+        newest = find_latest_checkpoint(self.watch_dir)
+        self._scan = (newest,)
+        if newest is not None and os.path.abspath(newest) != self._last_path:
+            return self._version + 1
+        return self._version
+
+    def poll(self) -> Optional[Tuple[Any, int, Dict[str, Any]]]:
+        """(params, version, meta) when a NEW valid checkpoint resolved, None
+        when nothing newer exists. Raises :class:`ReloadRejected` when the
+        candidate is torn/unloadable — the caller keeps the old params."""
+        from sheeprl_tpu.resilience import faults
+        from sheeprl_tpu.resilience.discovery import (
+            checkpoint_step,
+            find_latest_checkpoint,
+            is_valid_checkpoint,
+        )
+
+        scan, self._scan = self._scan, None
+        newest = scan[0] if scan is not None else find_latest_checkpoint(self.watch_dir)
+        if newest is None or os.path.abspath(newest) == self._last_path:
+            return None
+        if faults.consume_reload_torn():
+            _tear_checkpoint(newest)
+            if not is_valid_checkpoint(newest):
+                raise ReloadRejected(
+                    f"torn checkpoint rejected by integrity validation: {newest}"
+                )
+        try:
+            params = self._extract_params(newest)
+        except ReloadRejected:
+            raise
+        except Exception as exc:
+            raise ReloadRejected(f"checkpoint {newest} failed to load: {exc!r}") from exc
+        self._last_path = os.path.abspath(newest)
+        self._version += 1
+        return params, self._version, {
+            "path": newest,
+            "checkpoint_step": checkpoint_step(newest),
+        }
+
+    def _extract_params(self, path: str) -> Any:
+        """Run the SAME family extractor the serve boot ran — the params of the
+        new checkpoint in serving form (the step functions are discarded; only
+        the params swap)."""
+        from sheeprl_tpu.serve.policy import resolve_serve_policy
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        state = load_checkpoint(path)
+        return resolve_serve_policy(self.fabric, self.cfg, state).params
+
+
+class SubscriberReloadSource:
+    """Ride the fleet weight plane: the versioned, immutable, GC'd payloads of
+    ``data/service.py``'s ``WeightPublisher``/``WeightSubscriber``. The plane's
+    own version numbers ARE the serving versions."""
+
+    name = "subscriber"
+
+    def __init__(self, subscriber: Any) -> None:
+        self.subscriber = subscriber
+
+    def peek_available(self) -> Optional[int]:
+        return int(self.subscriber.peek_latest())
+
+    def poll(self) -> Optional[Tuple[Any, int, Dict[str, Any]]]:
+        from sheeprl_tpu.resilience import faults
+
+        if faults.consume_reload_torn():
+            # the plane's payloads are immutable, so a torn read manifests as
+            # an undecodable tree — emulate with a poisoned payload
+            payload = self.subscriber.poll()
+            if payload is not None:
+                raise ReloadRejected(
+                    f"torn weight payload rejected (version {payload.get('version')})"
+                )
+            return None
+        payload = self.subscriber.poll()
+        if payload is None:
+            return None
+        return payload["tree"], int(payload["version"]), {"final": payload.get("final")}
+
+
+def _tear_checkpoint(path: str) -> None:
+    """Corrupt ``path`` on disk the way a mid-write kill would (``reload_torn``
+    fault): a pickle file is truncated to half, an orbax dir loses its sidecar's
+    integrity by truncating the extras pickle."""
+    target = path if os.path.isfile(path) else path + ".extras.pkl"
+    try:
+        size = os.path.getsize(target)
+        with open(target, "r+b") as fh:
+            fh.truncate(max(size // 2, 1))
+    except OSError:
+        pass
+
+
+class WeightReloader:
+    """The reload thread: poll the source at ``poll_s``, stage candidate params
+    on the serving device, validate avals, hand them to the server. All
+    telemetry rides :class:`~sheeprl_tpu.serve.telemetry.ServingTelemetry`
+    (``reload`` events + the windows' ``serve.weights`` block)."""
+
+    def __init__(
+        self,
+        server: Any,
+        source: Any,
+        *,
+        telemetry: Any = None,
+        poll_s: float = 2.0,
+        device: Any = None,
+    ) -> None:
+        self.server = server
+        self.source = source
+        self.telemetry = telemetry
+        self.poll_s = max(float(poll_s), 0.05)
+        self.device = device
+        self.applied = 0
+        self.failures = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------------
+
+    def start(self) -> "WeightReloader":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="sheeprl-serve-reload", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        last_reason: Optional[str] = None
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.step()
+                last_reason = None
+            except Exception as exc:
+                # the reload thread must never take the server down — but a
+                # broken source (unmounted watch_dir, malformed payload) must
+                # leave a failure trail for the reload_stall detector instead
+                # of serving stale weights with failures=0. A repeat of the
+                # same failure bumps the counter quietly (no event per poll).
+                reason = f"{type(exc).__name__}: {exc}"
+                self.failures += 1
+                if self.telemetry is not None:
+                    self.telemetry.observe_reload(
+                        failed=True,
+                        reason=reason,
+                        source=getattr(self.source, "name", None),
+                        quiet=(reason == last_reason),
+                    )
+                last_reason = reason
+
+    # -- one poll (directly drivable from tests) -----------------------------------
+
+    def step(self) -> Optional[int]:
+        """One reload poll: returns the staged version on success, None when
+        there was nothing new or the candidate was rejected."""
+        from sheeprl_tpu.serve.server import ServerClosed
+
+        available = None
+        try:
+            available = self.source.peek_available()
+        except Exception:
+            pass
+        if available and self.telemetry is not None:
+            self.telemetry.observe_reload(available=int(available))
+
+        try:
+            candidate = self.source.poll()
+        except ReloadRejected as exc:
+            self.failures += 1
+            if self.telemetry is not None:
+                self.telemetry.observe_reload(
+                    failed=True, reason=str(exc), source=getattr(self.source, "name", None)
+                )
+            return None
+        if candidate is None:
+            return None
+        params, version, _meta = candidate
+
+        mismatch = params_aval_mismatch(self.server.policy.params, params)
+        if mismatch is not None:
+            self.failures += 1
+            if self.telemetry is not None:
+                self.telemetry.observe_reload(
+                    failed=True,
+                    reason=f"aval mismatch: {mismatch}",
+                    source=getattr(self.source, "name", None),
+                )
+            return None
+
+        staged = self._stage(params)
+        try:
+            self.server.update_params(staged, version)
+        except ServerClosed:
+            return None
+        self.applied += 1
+        return int(version)
+
+    def _stage(self, params: Any) -> Any:
+        """Move the candidate tree onto the serving device BEFORE the swap is
+        staged, so the tick loop's rebind is instant (no host→device transfer
+        on the serving path). Placement stays UNCOMMITTED (``device_put`` with
+        no device) unless an explicit device was configured: the boot params
+        are uncommitted, and a committed swap would change the jit argument
+        signature — recompiling step/attach at the first post-swap call, which
+        breaks the zero-recompile contract."""
+        import jax
+
+        try:
+            if self.device is not None:
+                return jax.device_put(params, self.device)
+            return jax.device_put(params)
+        except Exception:
+            return params

@@ -19,6 +19,28 @@ recurrent policy inference:
 - **eviction**: closing a session frees its slot immediately; the stale carry
   is overwritten by the next admission.
 
+The robustness plane (howto/serving.md, "Operating a server"):
+
+- **overload shedding** — ``max_queue`` bounds the admission queue; a session
+  arriving past it is rejected with :class:`ServerOverloaded` (carrying a
+  ``retry_after_s`` hint from the observed session-completion rate) instead of
+  queueing unboundedly;
+- **deadlines** — ``deadline_ms`` bounds each request: an observation still
+  pending past its deadline is dropped *before* the tick (the carry stays
+  bit-exact — the request never reached the device) and the client gets
+  :class:`DeadlineExceeded`;
+- **degraded mode** — under sustained saturation (full table + waiting queue,
+  or shedding) the coalescing window widens by ``degraded_wait_factor`` to buy
+  occupancy back at a latency cost; it narrows again when saturation clears;
+- **hot weight reload** — :meth:`PolicyServer.update_params` stages a new
+  params pytree; the tick loop swaps it in atomically *between* steps. Same
+  avals ⇒ the SAME compiled step program (params are an ordinary argument) —
+  zero recompiles, and no session's carry is touched (the O(1) device-side
+  session-state argument: state and weights are independent inputs);
+- **graceful drain** — :meth:`begin_drain` stops admissions (queued sessions
+  are shed), lets in-flight sessions finish within a grace window, then closes
+  with a ``clean_exit`` summary. The SIGTERM path of ``sheeprl.py serve``.
+
 The server is transport-agnostic: :meth:`PolicyServer.open_session` returns an
 in-process handle (``session.step(obs) -> action``); the CLI's env driver and
 the bench's open-loop generator (``serve/drivers.py``) are both plain clients.
@@ -36,11 +58,42 @@ import numpy as np
 from sheeprl_tpu.serve.policy import ServePolicy
 from sheeprl_tpu.serve.slots import SlotTable
 
-__all__ = ["PolicyServer", "ServeSession", "ServerClosed"]
+__all__ = [
+    "DeadlineExceeded",
+    "PolicyServer",
+    "ServeSession",
+    "ServerClosed",
+    "ServerOverloaded",
+]
+
+# degraded-mode hysteresis: consecutive saturated ticks that enter the mode,
+# and consecutive healthy ticks that exit it (module constants so tests and
+# operators can reason about them)
+DEGRADED_ENTER_TICKS = 8
+DEGRADED_EXIT_TICKS = 8
+DEFAULT_DEGRADED_WAIT_FACTOR = 4.0
 
 
 class ServerClosed(RuntimeError):
-    """The server shut down (or crashed) while a session was waiting on it."""
+    """The server shut down (or crashed) while a session was waiting on it.
+    When the tick loop died, the root-cause exception rides as ``__cause__``
+    (and its repr in the message) — clients see WHY, not just that it ended."""
+
+
+class ServerOverloaded(RuntimeError):
+    """Admission was shed: the slot table is full and the bounded admission
+    queue (``max_queue``) is too. ``retry_after_s`` is the server's estimate of
+    when capacity frees up (from the observed session-completion rate)."""
+
+    def __init__(self, message: str, retry_after_s: float) -> None:
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's observation was still pending when its ``deadline_ms``
+    expired; it was dropped before the tick (the session carry is untouched —
+    the request never reached the device) and the client may retry."""
 
 
 class ServeSession:
@@ -56,6 +109,8 @@ class ServeSession:
         self._action: Optional[np.ndarray] = None
         self._submit_time = 0.0
         self._attached_time = 0.0
+        self._deadline: Optional[float] = None
+        self._deadline_missed = False
         self._event = threading.Event()
         self._closed = False
 
@@ -69,8 +124,15 @@ class ServeSession:
             raise TimeoutError(
                 f"serve session (slot {self.slot}) timed out waiting for an action"
             )
+        if self._deadline_missed:
+            raise DeadlineExceeded(
+                f"request exceeded its {self._server.deadline_ms:.0f}ms deadline before "
+                "the tick — dropped pre-batch, session state untouched; retry"
+            )
         if self._server._error is not None:
-            raise ServerClosed(f"policy server died: {self._server._error!r}")
+            raise ServerClosed(
+                f"policy server died: {self._server._error!r}"
+            ) from self._server._error
         if self._action is None:
             raise ServerClosed("policy server shut down mid-request")
         self.steps += 1
@@ -95,11 +157,19 @@ class PolicyServer:
         base_seed: int = 0,
         telemetry: Any = None,
         request_timeout: float = 120.0,
+        max_queue: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        degraded_wait_factor: float = DEFAULT_DEGRADED_WAIT_FACTOR,
+        fault_plan: Any = None,
     ) -> None:
         self.policy = policy
         self.table = SlotTable(policy, slots, base_seed=base_seed)
         self.max_batch_wait_ms = float(max_batch_wait_ms)
         self.request_timeout = float(request_timeout)
+        self.max_queue = None if max_queue is None else max(int(max_queue), 0)
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        self.degraded_wait_factor = max(float(degraded_wait_factor), 1.0)
+        self.fault_plan = fault_plan
         self.telemetry = telemetry
 
         self._cond = threading.Condition()
@@ -107,10 +177,24 @@ class PolicyServer:
         self._sessions: Dict[int, ServeSession] = {}  # slot -> session
         self._started_delta = 0
         self._finished_delta = 0
+        self._shed_delta = 0
+        self._deadline_delta = 0
         self._closing = False
         self._closed = False
+        self._draining = False
         self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
+        # hot-reload staging: the tick loop swaps `_pending_params` in between
+        # steps (never mid-tick) — clients and the reloader only ever stage
+        self._pending_params: Optional[tuple] = None
+        self.weight_version = 0
+        self.reloads = 0
+        # degraded-mode state (tick-loop-confined except the read-only flag)
+        self.degraded = False
+        self._saturated_ticks = 0
+        self._healthy_ticks = 0
+        # recent session completion times, for the retry-after estimate
+        self._finish_times: deque = deque(maxlen=64)
         # preallocated [S, ...] staging buffers, zeroed rows for masked slots
         self._obs_buf = {k: spec.zeros(self.table.num_slots) for k, spec in policy.obs_spec.items()}
 
@@ -144,10 +228,61 @@ class PolicyServer:
             # after the final batch tick), then finalize the stream
             with self._cond:
                 started, finished = self._started_delta, self._finished_delta
-                self._started_delta = self._finished_delta = 0
-            if started or finished:
-                self.telemetry.observe_sessions(started=started, finished=finished)
+                shed, deadline_missed = self._shed_delta, self._deadline_delta
+                self._started_delta = self._finished_delta = self._shed_delta = 0
+                self._deadline_delta = 0
+            if started or finished or shed or deadline_missed:
+                self.telemetry.observe_sessions(
+                    started=started,
+                    finished=finished,
+                    shed=shed,
+                    deadline_missed=deadline_missed,
+                )
             self.telemetry.close(clean_exit=clean_exit and self._error is None)
+
+    def begin_drain(self) -> None:
+        """Stop admissions (graceful shutdown, phase 1): new sessions are
+        rejected with :class:`ServerClosed`, QUEUED sessions are shed (they
+        never reached a slot — the grace window belongs to in-flight work),
+        attached sessions keep being served. Idempotent."""
+        with self._cond:
+            if self._draining or self._closing:
+                return
+            self._draining = True
+            queued = list(self._admission)
+            self._admission.clear()
+            for session in queued:
+                session._event.set()
+            self._cond.notify_all()
+        # the telemetry fold happens in observe_drain (NOT via _shed_delta —
+        # that would double-count when close() flushes the deltas)
+        if self.telemetry is not None:
+            self.telemetry.observe_drain(phase="begin", shed=len(queued))
+
+    def drain(self, grace_s: float = 10.0, clean_exit: bool = True) -> Dict[str, int]:
+        """Graceful shutdown: :meth:`begin_drain`, wait up to ``grace_s`` for
+        in-flight sessions to finish, then :meth:`close` (aborting whatever is
+        left — they get :class:`ServerClosed`). Returns the accounting the
+        caller reports: ``{completed, aborted}`` relative to drain begin."""
+        self.begin_drain()
+        deadline = time.monotonic() + max(float(grace_s), 0.0)
+        while time.monotonic() < deadline:
+            with self._cond:
+                if not self._sessions:
+                    break
+            time.sleep(0.02)
+        with self._cond:
+            aborted = len(self._sessions)
+        if self.telemetry is not None:
+            self.telemetry.observe_drain(
+                phase="end", aborted=aborted, grace_s=float(grace_s)
+            )
+        self.close(clean_exit=clean_exit)
+        return {"aborted": aborted}
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def __enter__(self) -> "PolicyServer":
         return self.start()
@@ -155,19 +290,76 @@ class PolicyServer:
     def __exit__(self, exc_type, exc, tb) -> None:
         self.close(clean_exit=exc_type is None)
 
+    # -- hot weight reload ---------------------------------------------------------
+
+    def update_params(self, params: Any, version: int) -> None:
+        """Stage a new params pytree; the tick loop swaps it in atomically
+        between steps. The caller (``serve/reload.py``) has already validated
+        the avals match the serving policy's — same avals ⇒ the same compiled
+        ``slot_step`` program, zero recompiles; no session carry is touched."""
+        with self._cond:
+            if self._closing:
+                raise ServerClosed("server is shutting down")
+            self._pending_params = (params, int(version))
+            self._cond.notify_all()
+
+    def _apply_pending_params_locked(self) -> Optional[int]:
+        """Swap staged params in (tick loop only, under the lock, between
+        ticks). Returns the new version when a swap happened."""
+        if self._pending_params is None:
+            return None
+        params, version = self._pending_params
+        self._pending_params = None
+        self.policy.params = params
+        self.weight_version = version
+        self.reloads += 1
+        return version
+
     # -- client API ----------------------------------------------------------------
 
     def open_session(self, seed: Optional[int] = None) -> ServeSession:
         """Create a session; it attaches to a slot as soon as one frees up (its
-        first ``step`` blocks through the admission wait)."""
+        first ``step`` blocks through the admission wait). Raises
+        :class:`ServerClosed` once closing/draining, :class:`ServerOverloaded`
+        when the bounded admission queue is full (load shedding)."""
         with self._cond:
-            if self._closing:
-                raise ServerClosed("server is shutting down")
+            if self._closing or self._error is not None:
+                raise ServerClosed("server is shutting down") from self._error
+            if self._draining:
+                raise ServerClosed("server is draining — not admitting new sessions")
+            # capacity check against the queue's CLAIM on free slots, not the
+            # instantaneous table state: slots are only claimed by the tick
+            # loop, so during a burst every free slot is already spoken for by
+            # a queued session the loop has not admitted yet — counting them
+            # is what keeps the queue actually bounded under a flood
+            if (
+                self.max_queue is not None
+                and len(self._admission) >= self.max_queue + self.table.free_slots
+            ):
+                self._shed_delta += 1
+                retry = self._retry_after_locked()
+                raise ServerOverloaded(
+                    f"admission queue is full ({len(self._admission)} waiting >= "
+                    f"max_queue {self.max_queue} beyond free capacity) — retry in "
+                    f"~{retry:.2f}s",
+                    retry_after_s=retry,
+                )
             session = ServeSession(self, seed if seed is not None else len(self._sessions))
             self._admission.append(session)
             self._started_delta += 1
             self._cond.notify_all()
             return session
+
+    def _retry_after_locked(self) -> float:
+        """Capacity estimate for the shed hint: the mean inter-finish interval
+        of recent sessions, scaled by the queue a retry would land behind."""
+        times = list(self._finish_times)
+        waiting = len(self._admission) + 1
+        if len(times) >= 2 and times[-1] > times[0]:
+            per_finish = (times[-1] - times[0]) / (len(times) - 1)
+            return min(max(per_finish * waiting, 0.01), 60.0)
+        # no completion history yet: fall back to a coalescing-window multiple
+        return min(max(self.max_batch_wait_ms / 1000.0, 0.01) * waiting, 60.0)
 
     @property
     def active_sessions(self) -> int:
@@ -183,11 +375,17 @@ class PolicyServer:
 
     def _submit(self, session: ServeSession, obs: Dict[str, np.ndarray]) -> None:
         with self._cond:
-            if self._closing:
-                raise ServerClosed("server is shutting down")
+            if self._closing or self._error is not None:
+                raise ServerClosed("server is shutting down") from self._error
             session._obs = obs
             session._action = None
+            session._deadline_missed = False
             session._submit_time = time.perf_counter()
+            session._deadline = (
+                session._submit_time + self.deadline_ms / 1000.0
+                if self.deadline_ms is not None
+                else None
+            )
             session._event.clear()
             self._cond.notify_all()
 
@@ -198,6 +396,7 @@ class PolicyServer:
                 self.table.evict(session.slot)
                 session.slot = None
                 self._finished_delta += 1
+                self._finish_times.append(time.monotonic())
             elif session in self._admission:
                 self._admission.remove(session)
                 self._finished_delta += 1
@@ -224,6 +423,45 @@ class PolicyServer:
     def _pending_locked(self) -> List[ServeSession]:
         return [s for s in self._sessions.values() if s._obs is not None]
 
+    def _expire_deadlines_locked(self, now: float) -> int:
+        """Drop pending observations whose deadline passed BEFORE the tick:
+        the request never reaches the device (the slot is masked out, carry
+        bit-exact), the client gets :class:`DeadlineExceeded`."""
+        if self.deadline_ms is None:
+            return 0
+        expired = 0
+        for session in self._sessions.values():
+            if (
+                session._obs is not None
+                and session._deadline is not None
+                and now > session._deadline
+            ):
+                session._obs = None
+                session._deadline_missed = True
+                session._event.set()
+                expired += 1
+        self._deadline_delta += expired
+        return expired
+
+    def _update_degraded_locked(self, saturated: bool) -> Optional[bool]:
+        """Degraded-mode hysteresis: sustained saturation (full table with a
+        waiting queue, or shedding) widens the coalescing window by
+        ``degraded_wait_factor``; sustained health narrows it back. Returns
+        the new mode on a transition, None otherwise."""
+        if saturated:
+            self._saturated_ticks += 1
+            self._healthy_ticks = 0
+            if not self.degraded and self._saturated_ticks >= DEGRADED_ENTER_TICKS:
+                self.degraded = True
+                return True
+        else:
+            self._healthy_ticks += 1
+            self._saturated_ticks = 0
+            if self.degraded and self._healthy_ticks >= DEGRADED_EXIT_TICKS:
+                self.degraded = False
+                return False
+        return None
+
     def _run(self) -> None:
         try:
             self._loop()
@@ -235,21 +473,72 @@ class PolicyServer:
                     session._event.set()
                 self._cond.notify_all()
 
+    def _emit_fault_event(self, *args: Any, **fields: Any) -> None:
+        if self.telemetry is not None:
+            self.telemetry.emit_event(*args, **fields)
+
+    def _maybe_fire_fault(self, steps: int) -> None:
+        """Serving fault injection: the armed plan fires once at the configured
+        served step, exactly like the training loops' per-iteration hook."""
+        if self.fault_plan is None:
+            return
+        self.fault_plan.maybe_fire(steps, self._emit_fault_event)
+        from sheeprl_tpu.resilience import faults as _faults
+
+        flood = _faults.consume_session_flood()
+        if flood:
+            self._spawn_flood(flood)
+
+    def _spawn_flood(self, count: int) -> None:
+        """``session_flood``: a burst of synthetic clients storming admission —
+        the deterministic stand-in for a traffic spike. Shed sessions count in
+        the telemetry; admitted ones run a few zero-obs steps and leave."""
+
+        def _client(i: int) -> None:
+            try:
+                session = self.open_session(seed=100_000 + i)
+                obs = {k: spec.zeros(1)[0] for k, spec in self.policy.obs_spec.items()}
+                for _ in range(4):
+                    session.step(obs)
+                session.close()
+            except (ServerClosed, ServerOverloaded, DeadlineExceeded, TimeoutError):
+                pass
+
+        for i in range(count):
+            threading.Thread(
+                target=_client, args=(i,), name=f"sheeprl-flood-{i}", daemon=True
+            ).start()
+
     def _loop(self) -> None:
-        wait_budget = self.max_batch_wait_ms / 1000.0
+        from sheeprl_tpu.resilience import faults as _faults
+
+        base_wait_budget = self.max_batch_wait_ms / 1000.0
+        total_steps = 0
         while True:
             wait_started = time.perf_counter()
             with self._cond:
                 if self._closing:
                     return
+                swapped = self._apply_pending_params_locked()
                 attached = self._admit_locked()
+            if swapped is not None and self.telemetry is not None:
+                self.telemetry.observe_reload(version=swapped)
             if attached:
                 self.table.attach(attached)
+
+            # degraded mode trades latency for occupancy: the widened window
+            # lets a saturated table coalesce fuller batches instead of
+            # burning ticks on partial ones
+            wait_budget = base_wait_budget * (
+                self.degraded_wait_factor if self.degraded else 1.0
+            )
 
             # coalescing wait: fire when every attached session is pending, or
             # max_batch_wait_ms after the FIRST pending request arrived
             with self._cond:
                 while not self._closing:
+                    now = time.perf_counter()
+                    self._expire_deadlines_locked(now)
                     pending = self._pending_locked()
                     if pending:
                         # remaining coalescing budget measured from the FIRST
@@ -257,14 +546,26 @@ class PolicyServer:
                         # the full budget (that would double the worst-case
                         # added latency)
                         oldest = min(s._submit_time for s in pending)
-                        remaining = wait_budget - (time.perf_counter() - oldest)
+                        remaining = wait_budget - (now - oldest)
                         if len(pending) == len(self._sessions) or remaining <= 0:
                             break
+                        # a deadline expiring mid-window must wake the loop in
+                        # time to drop the request before the tick fires
+                        deadlines = [
+                            s._deadline - now
+                            for s in pending
+                            if s._deadline is not None
+                        ]
+                        if deadlines:
+                            remaining = min(remaining, max(min(deadlines), 0.0))
                     if self._admission and self.table.free_slots:
                         break  # admit first, then come back for the batch
+                    if self._pending_params is not None:
+                        break  # idle reload: swap now, not at the next request
                     self._cond.wait(remaining if pending else 0.05)
                 if self._closing:
                     return
+                self._expire_deadlines_locked(time.perf_counter())
                 pending = self._pending_locked()
                 if not pending:
                     continue
@@ -273,9 +574,24 @@ class PolicyServer:
                 queue_depth = len(self._admission)
                 started = self._started_delta
                 finished = self._finished_delta
+                shed = self._shed_delta
+                deadline_missed = self._deadline_delta
                 self._started_delta = 0
                 self._finished_delta = 0
+                self._shed_delta = 0
+                self._deadline_delta = 0
+                saturated = shed > 0 or (queue_depth > 0 and not self.table.free_slots)
+                transition = self._update_degraded_locked(saturated)
             wait_seconds = time.perf_counter() - wait_started
+            if transition is not None and self.telemetry is not None:
+                self.telemetry.observe_degraded(transition)
+
+            total_steps += len(batch)
+            self._maybe_fire_fault(total_steps)
+            slow = _faults.slow_tick_seconds()
+            if slow > 0:
+                # injected device-degradation: every tick pays the armed stall
+                time.sleep(slow)
 
             # stage [S, ...] obs (zero rows for masked slots), run ONE step
             mask = np.zeros((self.table.num_slots,), np.bool_)
@@ -314,5 +630,9 @@ class PolicyServer:
                     latencies_ms=latencies,
                     started=started,
                     finished=finished,
+                    shed=shed,
+                    deadline_missed=deadline_missed,
                     state_bytes=self.table.state_bytes(),
+                    weight_version=self.weight_version,
+                    degraded=self.degraded,
                 )

@@ -14,9 +14,22 @@ renders), and each window carries a ``serve`` block:
 
 - ``latency_ms``: p50/p99/mean request latency (submit → action delivered),
 - ``occupancy``: mean fraction of slots doing useful work per tick,
-- ``sessions``: active / started / finished counters + sessions/sec,
+- ``sessions``: active / started / finished / **shed** counters + sessions/sec
+  and the window's ``shed_rate`` (shed / offered — the overload-protection
+  number the ``shed_rate`` detector judges),
 - ``queue_depth``: sessions waiting for a free slot (slot starvation signal),
+- ``deadline_missed``: requests dropped pre-tick past ``serve.deadline_ms``,
+- ``weights``: the hot-reload state — serving ``version``, cumulative
+  ``reloads``, ``failures`` (torn/invalid candidates rejected), and the newest
+  ``available`` version the reloader has seen (version > available never
+  happens; available > version sustained = a stalled reload),
+- ``degraded``: whether the widened coalescing window is active,
 - ``ticks`` and ``state_bytes`` (the O(S) device session-state footprint).
+
+Lifecycle events of the robustness plane (schema-registered in
+``obs/schema.py``): ``reload`` (status=applied/rejected/stale with the version
+bookkeeping), ``drain`` (status=begin/end with shed/aborted counts), and the
+``fault`` events the serving fault plan emits.
 
 Phase attribution reuses the training schema with two serving phases:
 ``serve_step`` (device program wall time) and ``serve_wait`` (idle, waiting for
@@ -77,6 +90,7 @@ class ServingTelemetry:
         diagnosis: bool = True,
         http_port: Optional[int] = None,
         http_host: str = "127.0.0.1",
+        attempt: int = 0,
     ) -> None:
         self.enabled = bool(enabled)
         self.every = max(int(every), 1)
@@ -102,10 +116,21 @@ class ServingTelemetry:
         self._ticks = 0
         self._sessions_started = 0
         self._sessions_finished = 0
+        self._sessions_shed = 0
+        self._sessions_drained = 0
+        self._deadline_missed = 0
         self._sessions_active = 0
         self._queue_depth = 0
         self._state_bytes: Optional[int] = None
         self._peak_hbm = 0
+        # robustness-plane state (hot reload / degraded mode / drain)
+        self._weight_version = 0
+        self._weight_available = 0
+        self._reloads = 0
+        self._reload_failures = 0
+        self._degraded = False
+        self._draining = False
+        self._drain_info: Optional[Dict[str, Any]] = None
 
         # window accumulators
         self._window_idx = 0
@@ -118,6 +143,9 @@ class ServingTelemetry:
         self._win_queue_sum = 0
         self._win_sessions_started = 0
         self._win_sessions_finished = 0
+        self._win_sessions_shed = 0
+        self._win_sessions_drained = 0
+        self._win_deadline_missed = 0
         self._all_latencies: deque = deque(maxlen=_LATENCY_RESERVOIR)
 
         self._start_time = time.perf_counter()
@@ -133,7 +161,7 @@ class ServingTelemetry:
         path = jsonl_path or (
             os.path.join(log_dir, "telemetry.jsonl") if log_dir else "telemetry.jsonl"
         )
-        self._sink = JsonlEventSink(path, rank=0, attempt=0)
+        self._sink = JsonlEventSink(path, rank=0, attempt=int(attempt))
         from sheeprl_tpu.obs.fingerprint import run_fingerprint
 
         try:
@@ -169,21 +197,33 @@ class ServingTelemetry:
         latencies_ms: Optional[List[float]] = None,
         started: int = 0,
         finished: int = 0,
+        shed: int = 0,
+        deadline_missed: int = 0,
         state_bytes: Optional[int] = None,
+        weight_version: Optional[int] = None,
+        degraded: Optional[bool] = None,
     ) -> None:
         """One server tick: ``batch`` sessions stepped out of ``slots`` total
         (``active`` attached), after ``wait_seconds`` of coalescing/idle wait
-        and ``step_seconds`` of device program wall time."""
+        and ``step_seconds`` of device program wall time. ``shed`` /
+        ``deadline_missed`` are the inter-tick overload-protection deltas;
+        ``weight_version``/``degraded`` snapshot the robustness-plane state."""
         if not self.enabled:
             return
         self._ticks += 1
         self._steps += int(batch)
         self._sessions_started += int(started)
         self._sessions_finished += int(finished)
+        self._sessions_shed += int(shed)
+        self._deadline_missed += int(deadline_missed)
         self._sessions_active = int(active)
         self._queue_depth = int(queue_depth)
         if state_bytes is not None:
             self._state_bytes = int(state_bytes)
+        if weight_version is not None:
+            self._weight_version = int(weight_version)
+        if degraded is not None:
+            self._degraded = bool(degraded)
 
         self._win_ticks += 1
         self._win_steps += int(batch)
@@ -193,6 +233,8 @@ class ServingTelemetry:
         self._win_queue_sum += int(queue_depth)
         self._win_sessions_started += int(started)
         self._win_sessions_finished += int(finished)
+        self._win_sessions_shed += int(shed)
+        self._win_deadline_missed += int(deadline_missed)
         if latencies_ms:
             self._win_latencies.extend(float(v) for v in latencies_ms)
             self._all_latencies.extend(float(v) for v in latencies_ms)
@@ -200,23 +242,140 @@ class ServingTelemetry:
         if self._win_steps >= self.every:
             self._emit_window()
 
-    def observe_sessions(self, started: int = 0, finished: int = 0) -> None:
+    def observe_sessions(
+        self,
+        started: int = 0,
+        finished: int = 0,
+        shed: int = 0,
+        deadline_missed: int = 0,
+    ) -> None:
         """Fold session lifecycle deltas that never rode a tick (sessions
         closing after the LAST batch tick — e.g. every session finishing its
-        fixed-length episode on the same final step) into the counters, so the
-        summary's ``sessions_finished`` is exact, not tick-sampled. The server
-        calls this once from ``close()``."""
+        fixed-length episode on the same final step, or requests expiring
+        between the final tick and shutdown) into the counters, so the
+        summary's ``sessions_finished``/``deadline_missed`` are exact, not
+        tick-sampled. The server calls this once from ``close()``."""
         if not self.enabled:
             return
         self._sessions_started += int(started)
         self._sessions_finished += int(finished)
+        self._sessions_shed += int(shed)
+        self._deadline_missed += int(deadline_missed)
         self._win_sessions_started += int(started)
         self._win_sessions_finished += int(finished)
+        self._win_sessions_shed += int(shed)
+        self._win_deadline_missed += int(deadline_missed)
+
+    # -- robustness-plane hooks ----------------------------------------------------
+
+    def emit_event(self, event: str, step: Optional[int] = None, **fields: Any) -> None:
+        """Raw schema-registered event passthrough (the serving fault plan's
+        ``fault`` events ride this, exactly like a training loop's)."""
+        if self.enabled and self._sink is not None:
+            self._sink.emit(event, step=step if step is not None else self._steps, **fields)
+
+    def observe_reload(
+        self,
+        *,
+        version: Optional[int] = None,
+        available: Optional[int] = None,
+        failed: bool = False,
+        reason: Optional[str] = None,
+        source: Optional[str] = None,
+        quiet: bool = False,
+    ) -> None:
+        """Hot-reload bookkeeping: an applied swap (``version``), a newer
+        candidate observed (``available``), or a rejected/torn candidate
+        (``failed`` + ``reason``). Applied/rejected land as ``reload`` events;
+        the rolling state rides every window's ``serve.weights`` block.
+        ``quiet`` counts a failure into the gauges without an event — the
+        reload thread's dedupe for a persistently failing source."""
+        if not self.enabled:
+            return
+        if available is not None:
+            self._weight_available = max(self._weight_available, int(available))
+        if failed:
+            self._reload_failures += 1
+            if quiet:
+                return
+            self.emit_event(
+                "reload",
+                status="rejected",
+                version=self._weight_version,
+                available=self._weight_available,
+                reason=str(reason or "invalid checkpoint"),
+                **({"source": source} if source else {}),
+            )
+            return
+        if version is not None:
+            self._weight_version = int(version)
+            self._weight_available = max(self._weight_available, int(version))
+            self._reloads += 1
+            self.emit_event(
+                "reload",
+                status="applied",
+                version=int(version),
+                reloads=self._reloads,
+                **({"source": source} if source else {}),
+            )
+
+    def observe_degraded(self, enabled: bool) -> None:
+        """Degraded-mode transition: the widened coalescing window engaged (or
+        cleared) — a health event so `watch` and operators see it live."""
+        if not self.enabled:
+            return
+        self._degraded = bool(enabled)
+        self.emit_event(
+            "health",
+            status="degraded" if enabled else "degraded_cleared",
+        )
+
+    def observe_drain(
+        self,
+        *,
+        phase: str,
+        shed: int = 0,
+        aborted: int = 0,
+        grace_s: Optional[float] = None,
+    ) -> None:
+        """Drain lifecycle: ``begin`` (admissions stopped, queued sessions
+        shed) and ``end`` (grace expired / table empty; ``aborted`` sessions
+        were still in flight). The summary's ``serve.drain`` block carries the
+        final accounting."""
+        if not self.enabled:
+            return
+        if shed:
+            # drain-shed sessions were already counted ``started`` at
+            # admission — fold them into their own counter, NOT the overload
+            # shed that feeds shed_rate's offered denominator (offered =
+            # started + shed would double-count them, and a clean wind-down
+            # is not the overload signal the shed_rate detector judges)
+            self._sessions_drained += int(shed)
+            self._win_sessions_drained += int(shed)
+        if phase == "begin":
+            self._draining = True
+            self._drain_info = {"shed": int(shed)}
+        else:
+            info = self._drain_info or {}
+            info.update({"aborted": int(aborted)})
+            if grace_s is not None:
+                info["grace_s"] = float(grace_s)
+            self._drain_info = info
+        self.emit_event(
+            "drain",
+            status=str(phase),
+            shed=int(shed),
+            aborted=int(aborted),
+            **({"grace_s": float(grace_s)} if grace_s is not None else {}),
+        )
 
     # -- window / summary ----------------------------------------------------------
 
     def _serve_block(self, wall: float) -> Dict[str, Any]:
         ticks = max(self._win_ticks, 1)
+        # shed_rate: shed / offered, where offered = sessions that ASKED for
+        # admission this window (started already excludes the shed ones)
+        offered = self._win_sessions_started + self._win_sessions_shed
         return {
             "latency_ms": _percentiles(self._win_latencies),
             "occupancy": round(self._win_occupancy_sum / ticks, 4),
@@ -224,9 +383,20 @@ class ServingTelemetry:
                 "active": self._sessions_active,
                 "started": self._win_sessions_started,
                 "finished": self._win_sessions_finished,
+                "shed": self._win_sessions_shed,
+                "drained": self._win_sessions_drained,
                 "per_sec": round(self._win_sessions_finished / wall, 3) if wall > 0 else None,
             },
+            "shed_rate": round(self._win_sessions_shed / offered, 4) if offered else 0.0,
+            "deadline_missed": self._win_deadline_missed,
             "queue_depth": round(self._win_queue_sum / ticks, 2),
+            "weights": {
+                "version": self._weight_version,
+                "available": self._weight_available,
+                "reloads": self._reloads,
+                "failures": self._reload_failures,
+            },
+            "degraded": self._degraded,
             "ticks": self._win_ticks,
             "state_bytes": self._state_bytes,
         }
@@ -295,8 +465,16 @@ class ServingTelemetry:
                     "Serve/occupancy": serve_block.get("occupancy"),
                     "Serve/sessions_active": sessions.get("active"),
                     "Serve/sessions_per_sec": sessions.get("per_sec"),
+                    "Serve/sessions_shed": sessions.get("shed"),
+                    "Serve/shed_rate": serve_block.get("shed_rate"),
+                    "Serve/deadline_missed": serve_block.get("deadline_missed"),
                     "Serve/queue_depth": serve_block.get("queue_depth"),
                     "Serve/state_bytes": serve_block.get("state_bytes"),
+                    "Serve/weight_version": (serve_block.get("weights") or {}).get("version"),
+                    "Serve/reloads": (serve_block.get("weights") or {}).get("reloads"),
+                    "Serve/reload_failures": (serve_block.get("weights") or {}).get("failures"),
+                    "Serve/degraded": 1.0 if serve_block.get("degraded") else 0.0,
+                    "Serve/draining": 1.0 if self._draining else 0.0,
                     "Compile/count": (window_event.get("compile") or {}).get("count"),
                 }
             )
@@ -313,6 +491,9 @@ class ServingTelemetry:
         self._win_queue_sum = 0
         self._win_sessions_started = 0
         self._win_sessions_finished = 0
+        self._win_sessions_shed = 0
+        self._win_sessions_drained = 0
+        self._win_deadline_missed = 0
         self._anchor_time = now
 
     def close(self, clean_exit: bool = True) -> None:
@@ -343,9 +524,28 @@ class ServingTelemetry:
                 "latency_ms": _percentiles(self._all_latencies),
                 "sessions_started": self._sessions_started,
                 "sessions_finished": self._sessions_finished,
+                "sessions_shed": self._sessions_shed,
+                "sessions_drained": self._sessions_drained,
+                "shed_rate": (
+                    round(
+                        self._sessions_shed
+                        / (self._sessions_started + self._sessions_shed),
+                        4,
+                    )
+                    if (self._sessions_started + self._sessions_shed)
+                    else 0.0
+                ),
+                "deadline_missed": self._deadline_missed,
                 "sessions_per_sec": round(self._sessions_finished / wall, 3)
                 if wall > 0
                 else None,
+                "weights": {
+                    "version": self._weight_version,
+                    "available": self._weight_available,
+                    "reloads": self._reloads,
+                    "failures": self._reload_failures,
+                },
+                **({"drain": self._drain_info} if self._drain_info else {}),
                 "ticks": self._ticks,
                 "state_bytes": self._state_bytes,
             },

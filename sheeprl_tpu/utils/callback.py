@@ -117,8 +117,17 @@ class CheckpointCallback:
                         os.remove(stale + ".extras.pkl")
                 else:
                     os.remove(stale)
+                    if os.path.exists(stale + ".sha256"):
+                        os.remove(stale + ".sha256")
             except OSError:
                 pass
+        # orphan integrity sidecars whose pickle checkpoint was swept above
+        for sidecar in glob.glob(os.path.join(ckpt_folder, "*.ckpt.sha256")):
+            if not os.path.exists(sidecar[: -len(".sha256")]):
+                try:
+                    os.remove(sidecar)
+                except OSError:
+                    pass
         # orphan sidecars from a crash between sidecar write and orbax commit
         for sidecar in glob.glob(os.path.join(ckpt_folder, "*.ckpt.extras.pkl")):
             if live is not None and os.path.abspath(sidecar) == live + ".extras.pkl":

@@ -10,12 +10,68 @@ implementation detail behind save/load.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
+
+# Integrity sidecar: pickle checkpoints get a `<path>.sha256` next to them so
+# discovery (resilience/discovery.py) can tell a torn/corrupted file from a
+# valid one BEFORE anything unpickles it — hot reload (serve/reload.py) and
+# `resume_from=latest` both lean on it. The sidecar is advisory: a checkpoint
+# without one validates by the original heuristics (old runs keep resolving).
+SHA_SIDECAR_SUFFIX = ".sha256"
+
+
+# digest cache keyed by (mtime_ns, size): the reload thread re-validates the
+# same candidate every poll — hashing a multi-GB checkpoint once is fine,
+# every 2 seconds is not. A rewrite changes mtime/size and invalidates.
+_sha_cache: Dict[str, tuple] = {}
+
+
+def sha256_file(path: str) -> str:
+    path = os.path.abspath(path)
+    st = os.stat(path)
+    key = (st.st_mtime_ns, st.st_size)
+    cached = _sha_cache.get(path)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    digest = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            digest.update(chunk)
+    value = digest.hexdigest()
+    _sha_cache[path] = (key, value)
+    return value
+
+
+def write_sha_sidecar(path: str) -> None:
+    """Write ``<path>.sha256`` (atomically) for an already-committed pickle
+    checkpoint. Ordering: any STALE sidecar is removed before the checkpoint
+    commit (see ``save_checkpoint``), so the crash windows degrade to
+    "no sidecar" — never to a mismatching one vetoing a good checkpoint."""
+    sidecar = path + SHA_SIDECAR_SUFFIX
+    tmp = sidecar + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(sha256_file(path) + "\n")
+    os.replace(tmp, sidecar)
+
+
+def verify_sha_sidecar(path: str) -> Optional[bool]:
+    """True/False when ``<path>.sha256`` exists and the digest matches/differs;
+    None when there is no sidecar to judge by (advisory contract)."""
+    sidecar = path + SHA_SIDECAR_SUFFIX
+    if not os.path.isfile(sidecar):
+        return None
+    try:
+        with open(sidecar) as fh:
+            expected = fh.read().strip().split()[0]
+        return sha256_file(path) == expected
+    except (OSError, IndexError):
+        return False
 
 # Fault-injection hook (resilience/faults.py): called at the exact points where a
 # process kill would leave the crash-window on-disk states the loaders/discovery
@@ -45,7 +101,19 @@ def save_checkpoint(path: str, state: Dict[str, Any]) -> None:
     with open(tmp, "wb") as f:
         pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
     _maybe_fault("pickle_commit", path)
+    # a stale sidecar (from the checkpoint being overwritten in place) must
+    # never outlive its file: drop it BEFORE the commit rename, so a crash in
+    # either window leaves "checkpoint without sidecar" (valid by heuristics),
+    # never "checkpoint with a mismatching sidecar" (vetoed)
+    try:
+        os.remove(path + SHA_SIDECAR_SUFFIX)
+    except OSError:
+        pass
     os.replace(tmp, path)
+    try:
+        write_sha_sidecar(path)
+    except OSError:
+        pass  # advisory: an unwritable sidecar must not fail the save
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:

@@ -393,12 +393,15 @@ def test_lower_is_better_unit_directions():
         "seconds/train-step",
         "seconds",
         "bytes/device (DV3 params, [2,4] data x model mesh)",
+        # failure-share metrics: shedding MORE of the same load regresses UP
+        "fraction (sessions shed / offered, 3x overload burst)",
     ):
         assert _lower_is_better(unit), unit
     for unit in (
         "env-steps/sec",
         "sessions/sec (open-loop synthetic load)",
         "env-steps/sec (steady-state)",
+        # contains "fraction" mid-string but is a higher-is-better efficiency
         "MFU (fraction of chip peak bf16)",
         "atoms/sec",  # contains the "ms/" byte sequence — must NOT match
         "items/sec",

@@ -1,0 +1,317 @@
+"""Serving fault smokes (ISSUE 15): the serve verb under the resilience fault
+matrix — SIGTERM → graceful drain (clean summary, exit 75), session_flood →
+overload shedding caught by the shed_rate detector under ``diagnose --fail-on
+warning``, and slow_tick → deadline misses caught by deadline_misses. Scoped
+``resilience`` (rides ``sheeprl.py fault-matrix``) + ``serve``; not slow, so
+tier-1 includes it."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import diagnose, run, serve
+from sheeprl_tpu.resilience import signals
+from sheeprl_tpu.resilience.faults import FaultPlan, reset_faults
+from sheeprl_tpu.resilience.signals import PREEMPTED_EXIT_CODE, reset_preemption
+
+pytestmark = [pytest.mark.resilience, pytest.mark.serve]
+
+_TRAIN = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "algo.rollout_steps=16",
+    "algo.total_steps=64",
+    "algo.update_epochs=1",
+    "algo.cnn_keys.encoder=[]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.run_test=False",
+    "metric.log_level=0",
+    "checkpoint.save_last=True",
+    "root_dir=servefault",
+    "run_name=ppo",
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    reset_preemption()
+    reset_faults()
+    yield
+    reset_preemption()
+    reset_faults()
+
+
+@pytest.fixture(scope="module")
+def ppo_run_dir(tmp_path_factory):
+    """One tiny trained PPO checkpoint shared by every smoke in this module.
+    Trained under a module tmp dir and returned as an ABSOLUTE path — the
+    per-test autouse chdir (tests/conftest.py) moves each test's cwd."""
+    reset_preemption()
+    reset_faults()
+    base = tmp_path_factory.mktemp("servefault-train")
+    old_cwd = os.getcwd()
+    os.chdir(base)
+    try:
+        run(_TRAIN)
+    finally:
+        os.chdir(old_cwd)
+    return str(base / "logs" / "runs" / "servefault" / "ppo")
+
+
+def _serve_in_thread(args):
+    rc = {}
+
+    def _target():
+        rc["rc"] = serve(args)
+
+    thread = threading.Thread(target=_target, daemon=True)
+    thread.start()
+    return thread, rc
+
+
+def _wait_for_stream(serve_dir: str, thread, rc, timeout: float = 240.0) -> str:
+    deadline = time.monotonic() + timeout
+    stream = os.path.join(serve_dir, "telemetry.jsonl")
+    while not glob.glob(stream) and time.monotonic() < deadline:
+        assert thread.is_alive() or "rc" in rc, f"serve died early (rc={rc.get('rc')})"
+        time.sleep(0.1)
+    assert glob.glob(stream), "serving telemetry stream never appeared"
+    return stream
+
+
+def _events(stream: str):
+    return [json.loads(line) for line in open(stream)]
+
+
+@pytest.mark.timeout(300)
+def test_sigterm_drains_clean_exit_75(ppo_run_dir, tmp_path):
+    """SIGTERM during serve: admissions stop, in-flight env sessions complete
+    their episodes inside the grace window, the summary lands with
+    clean_exit=true, and the verb exits 75 (EX_TEMPFAIL) — lifecycle parity
+    with a preempted training run."""
+    serve_dir = str(tmp_path / "drain-serve")
+    thread, rc = _serve_in_thread(
+        [
+            f"checkpoint_path={ppo_run_dir}",
+            "serve.sessions=2",
+            "serve.slots=2",
+            "serve.max_session_steps=500",
+            "serve.telemetry.every=8",
+            "serve.drain_grace_s=60",
+            f"serve.log_dir={serve_dir}",
+            # stretch the dummy episodes (default: 4 steps) so the sessions
+            # are demonstrably IN FLIGHT when the signal lands
+            "env.wrapper.n_steps=400",
+            "env.wrapper.step_latency_ms=5",
+        ]
+    )
+    stream = _wait_for_stream(serve_dir, thread, rc)
+    # let the sessions get in flight, then deliver the cooperative signal
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        windows = [e for e in _events(stream) if e.get("event") == "window"]
+        if windows:
+            break
+        time.sleep(0.1)
+    signals.request_preemption()
+    thread.join(timeout=180)
+    assert not thread.is_alive(), "serve did not wind down after SIGTERM"
+    assert rc.get("rc") == PREEMPTED_EXIT_CODE
+
+    events = _events(stream)
+    summary = events[-1]
+    assert summary["event"] == "summary"
+    assert summary["clean_exit"] is True
+    drain_events = [e for e in events if e.get("event") == "drain"]
+    assert [e["status"] for e in drain_events] == ["begin", "end"]
+    # in-flight sessions completed their episodes (the 128-step dummy episode
+    # fits far inside the grace): nothing was aborted mid-flight
+    assert summary["serve"]["drain"]["aborted"] == 0
+    assert summary["serve"]["sessions_finished"] >= 2
+    from sheeprl_tpu.obs.schema import validate_events
+
+    assert validate_events(events) == []
+
+
+@pytest.mark.timeout(300)
+def test_session_flood_trips_shed_rate_fail_on_warning(ppo_run_dir, tmp_path):
+    """A session_flood fault (burst of synthetic sessions) against a bounded
+    admission queue: the excess is shed, the window records it, and
+    ``diagnose --fail-on warning`` exits 1 on the shed_rate finding."""
+    serve_dir = str(tmp_path / "flood-serve")
+    rc = serve(
+        [
+            f"checkpoint_path={ppo_run_dir}",
+            "serve.sessions=2",
+            "serve.slots=2",
+            "serve.max_queue=0",
+            "serve.max_session_steps=300",
+            "serve.telemetry.every=8",
+            f"serve.log_dir={serve_dir}",
+            "env.wrapper.n_steps=200",
+            "env.wrapper.step_latency_ms=2",
+            "resilience.fault.kind=session_flood",
+            "resilience.fault.at_policy_step=16",
+            "resilience.fault.factor=24",
+        ]
+    )
+    assert rc == 0, "the driven env sessions themselves must complete"
+    events = _events(os.path.join(serve_dir, "telemetry.jsonl"))
+    fault_events = [e for e in events if e.get("event") == "fault"]
+    assert fault_events and fault_events[0]["kind"] == "session_flood"
+    summary = events[-1]
+    assert summary["serve"]["sessions_shed"] >= 3
+    assert summary["serve"]["shed_rate"] > 0
+    # the CI gate: warning findings fail the run
+    assert diagnose([serve_dir, "--quiet", "--fail-on", "warning"]) == 1
+    from sheeprl_tpu.obs.diagnose import diagnose_run
+
+    findings = diagnose_run(serve_dir)["findings"]
+    assert "shed_rate" in {f["detector"] for f in findings}
+    from sheeprl_tpu.obs.schema import validate_events
+
+    assert validate_events(events) == []
+
+
+@pytest.mark.timeout(300)
+def test_slow_tick_starves_deadlines():
+    """slow_tick (injected per-tick stall) + serve.deadline_ms: requests
+    submitted while a degraded tick is in flight expire before their own tick,
+    and the deadline_misses detector flags the stream."""
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.obs.diagnose import run_detectors
+    from sheeprl_tpu.serve.drivers import run_synthetic_load
+    from sheeprl_tpu.serve.policy import ObsSpec, ServePolicy
+    from sheeprl_tpu.serve.server import PolicyServer
+    from sheeprl_tpu.serve.telemetry import ServingTelemetry
+
+    params = {"gain": jnp.float32(1.0)}
+
+    def init_slot(params, key):
+        return {"key": key}
+
+    def step_slot(params, carry, obs):
+        key, _ = jax.random.split(carry["key"])
+        return obs["state"].sum() * params["gain"], {"key": key}
+
+    policy = ServePolicy(
+        algo="echo",
+        params=params,
+        init_slot=init_slot,
+        step_slot=step_slot,
+        obs_spec={"state": ObsSpec((2,), np.float32)},
+        action_shape=(),
+    )
+
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="sheeprl-slowtick-")
+
+    class _Fabric:
+        device = jax.devices("cpu")[0]
+
+    tel = ServingTelemetry(
+        _Fabric(), {"algo": {"name": "echo"}, "env": {}}, tmp, every=8, serve_info={"slots": 2}
+    )
+    server = PolicyServer(
+        policy,
+        slots=2,
+        max_batch_wait_ms=1.0,
+        deadline_ms=20.0,
+        telemetry=tel,
+        fault_plan=FaultPlan("slow_tick", at_policy_step=8, factor=60.0),
+    )
+    with server:
+        load = run_synthetic_load(server, sessions=4, steps_per_session=48, seed=0)
+    assert load["deadline_missed"] >= 3, load
+    events = _events(os.path.join(tmp, "telemetry.jsonl"))
+    fault_events = [e for e in events if e.get("event") == "fault"]
+    assert fault_events and fault_events[0]["kind"] == "slow_tick"
+    findings = [f for f in run_detectors(events) if f["detector"] == "deadline_misses"]
+    assert findings, [w.get("serve", {}).get("deadline_missed") for w in events if w.get("event") == "window"]
+    from sheeprl_tpu.obs.schema import validate_events
+
+    assert validate_events(events) == []
+
+
+@pytest.mark.timeout(300)
+def test_reload_torn_through_serve_verb(ppo_run_dir, tmp_path):
+    """reload_torn through the FULL serve verb: hot reload enabled, a newer
+    checkpoint lands but the armed fault tears it mid-reload — integrity
+    validation rejects it, the OLD version keeps serving (sessions complete),
+    and diagnose reports the reload_stall warning."""
+    from sheeprl_tpu.resilience.discovery import resolve_checkpoint_path
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    serve_dir = str(tmp_path / "torn-serve")
+    boot_ckpt = resolve_checkpoint_path(ppo_run_dir)
+    state = load_checkpoint(boot_ckpt)
+    newer = os.path.join(os.path.dirname(boot_ckpt), "ckpt_990000_0.ckpt")
+
+    thread, rc = _serve_in_thread(
+        [
+            f"checkpoint_path={ppo_run_dir}",
+            "serve.sessions=2",
+            "serve.slots=2",
+            "serve.max_session_steps=800",
+            "serve.telemetry.every=8",
+            "serve.reload.enabled=true",
+            "serve.reload.poll_s=0.2",
+            f"serve.log_dir={serve_dir}",
+            "env.wrapper.n_steps=700",
+            "env.wrapper.step_latency_ms=5",
+            "resilience.fault.kind=reload_torn",
+            "resilience.fault.at_policy_step=4",
+        ]
+    )
+    stream = _wait_for_stream(serve_dir, thread, rc)
+    # wait for the fault to arm (it fires from the tick loop), then publish
+    # the candidate the armed fault will tear
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if any(e.get("event") == "fault" for e in _events(stream)):
+            break
+        time.sleep(0.1)
+    save_checkpoint(newer, state)
+    try:
+        deadline = time.monotonic() + 60
+        rejected = []
+        while time.monotonic() < deadline and not rejected:
+            rejected = [
+                e
+                for e in _events(stream)
+                if e.get("event") == "reload" and e.get("status") == "rejected"
+            ]
+            time.sleep(0.1)
+        thread.join(timeout=180)
+        assert not thread.is_alive()
+        assert rc.get("rc") == 0, "sessions must complete on the OLD weights"
+        assert rejected, "the torn candidate was never rejected"
+        events = _events(stream)
+        summary = events[-1]
+        assert summary["clean_exit"] is True
+        weights = summary["serve"]["weights"]
+        assert weights["failures"] >= 1
+        assert weights["version"] == 0, "a torn candidate must never become the serving version"
+        from sheeprl_tpu.obs.diagnose import diagnose_run
+
+        findings = diagnose_run(serve_dir)["findings"]
+        stall = [f for f in findings if f["detector"] == "reload_stall"]
+        assert stall and stall[0]["severity"] == "warning"
+    finally:
+        for path in (newer, newer + ".sha256"):
+            if os.path.exists(path):
+                os.remove(path)

@@ -1,0 +1,400 @@
+"""Hot weight reload tests (ISSUE 15 tentpole): atomic swap under load (a
+session spanning a reload sees a pure function of the VERSION SCHEDULE, never
+a torn mix), checkpoint-source discovery mechanics, torn-candidate rejection
+through the `reload_torn` fault, aval-mismatch rejection, and the sha256
+integrity sidecar the checkpoint source leans on."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience import faults
+from sheeprl_tpu.resilience.discovery import find_latest_checkpoint, is_valid_checkpoint
+from sheeprl_tpu.serve.policy import ObsSpec, ServePolicy
+from sheeprl_tpu.serve.reload import (
+    CheckpointReloadSource,
+    ReloadRejected,
+    SubscriberReloadSource,
+    WeightReloader,
+    params_aval_mismatch,
+)
+from sheeprl_tpu.serve.server import PolicyServer
+from sheeprl_tpu.serve.telemetry import ServingTelemetry
+from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+pytestmark = pytest.mark.serve
+
+_OBS = {"state": np.zeros((2,), np.float32)}
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.reset_faults()
+    yield
+    faults.reset_faults()
+
+
+def _gain_policy(gain: float = 1.0) -> ServePolicy:
+    """action = count * gain: every action names the gain that produced it, so
+    a torn read (half-old, half-new params) would be visible immediately."""
+    params = {"gain": jnp.float32(gain)}
+
+    def init_slot(params, key):
+        return {"count": jnp.float32(0), "key": key}
+
+    def step_slot(params, carry, obs):
+        key, _ = jax.random.split(carry["key"])
+        return carry["count"] * params["gain"], {"count": carry["count"] + 1, "key": key}
+
+    return ServePolicy(
+        algo="gain",
+        params=params,
+        init_slot=init_slot,
+        step_slot=step_slot,
+        obs_spec={"state": ObsSpec((2,), np.float32)},
+        action_shape=(),
+    )
+
+
+class _Fabric:
+    device = jax.devices("cpu")[0]
+
+
+_CFG = {"algo": {"name": "gain"}, "env": {}}
+
+
+class _StatePathSource(CheckpointReloadSource):
+    """CheckpointReloadSource with the family extractor swapped for a direct
+    ``state["params"]`` read — the discovery/torn/version mechanics under test
+    do not need the serve registry."""
+
+    def _extract_params(self, path):
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        return load_checkpoint(path)["params"]
+
+
+# -- aval validation ------------------------------------------------------------------
+
+
+def test_params_aval_mismatch():
+    a = {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))}
+    assert params_aval_mismatch(a, {"w": jnp.full((3, 2), 7.0), "b": jnp.ones((2,))}) is None
+    assert "shape" in params_aval_mismatch(a, {"w": jnp.ones((3, 3)), "b": jnp.zeros((2,))})
+    assert "dtype" in params_aval_mismatch(
+        a, {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,), jnp.int32)}
+    )
+    assert "structure" in params_aval_mismatch(a, {"w": jnp.ones((3, 2))})
+
+
+# -- reload under load: version-schedule purity ---------------------------------------
+
+
+def test_sessions_spanning_swaps_see_pure_version_schedule(tmp_path):
+    """A session served ACROSS weight swaps: every action equals
+    count * gain_v for one of the published gains, the observed gain sequence
+    is monotone in the version schedule (never mixes back), and the carry
+    (count) is never perturbed by a swap — no torn reads, no lost steps."""
+    tel = ServingTelemetry(_Fabric(), _CFG, str(tmp_path), every=8, serve_info={"slots": 2})
+    gains = [1.0, 10.0, 100.0]
+    server = PolicyServer(
+        _gain_policy(gains[0]), slots=2, max_batch_wait_ms=0.5, telemetry=tel
+    ).start()
+    session = server.open_session(seed=0)
+    actions = []
+
+    def _client():
+        for _ in range(60):
+            actions.append(float(session.step(_OBS)))
+        session.close()
+
+    t = threading.Thread(target=_client)
+    t.start()
+    # stage each swap only after the client demonstrably served steps under
+    # the previous version (a pending stage is latest-wins: two stages between
+    # ticks would collapse into one applied version)
+    for version, (gain, floor) in enumerate(zip(gains[1:], (10, 30)), start=1):
+        deadline = time.monotonic() + 20
+        while len(actions) < floor and time.monotonic() < deadline:
+            time.sleep(0.005)
+        server.update_params({"gain": jnp.float32(gain)}, version=version)
+    t.join(20)
+    server.close()
+
+    assert len(actions) == 60
+    observed = []
+    for count, action in enumerate(actions):
+        if count == 0:
+            continue  # 0 * any gain == 0: carries no version information
+        matches = [g for g in gains if action == pytest.approx(count * g)]
+        assert matches, f"step {count}: action {action} is NO pure (count*gain) value — torn mix"
+        observed.append(matches[0])
+    # the gain sequence follows the version schedule: monotone non-decreasing,
+    # starts at v0's gain, ends at the last published one
+    assert observed[0] == gains[0]
+    assert observed[-1] == gains[-1]
+    assert all(a <= b for a, b in zip(observed, observed[1:]))
+    assert server.weight_version == 2 and server.reloads == 2
+
+    events = [json.loads(line) for line in (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    applied = [e for e in events if e["event"] == "reload" and e["status"] == "applied"]
+    assert [e["version"] for e in applied] == [1, 2]
+    # zero recompiles from the swaps: same avals => same compiled program
+    windows = [e for e in events if e["event"] == "window"]
+    assert windows
+    total_compiles = windows[-1]["compile"]["count"]
+    first_window_compiles = windows[0]["compile"]["count"]
+    assert total_compiles == first_window_compiles, "a reload recompiled the step program"
+
+
+# -- checkpoint source ----------------------------------------------------------------
+
+
+def _save_ckpt(dirpath: str, step: int, gain: float, mtime: float = None) -> str:
+    path = os.path.join(dirpath, f"ckpt_{step}_0.ckpt")
+    save_checkpoint(path, {"params": {"gain": jnp.float32(gain)}})
+    if mtime is not None:
+        os.utime(path, (mtime, mtime))
+    return path
+
+
+def test_checkpoint_source_follows_newest_valid(tmp_path):
+    boot = _save_ckpt(str(tmp_path), 100, 1.0, mtime=time.time() - 100)
+    source = _StatePathSource(str(tmp_path), None, None, current_path=boot)
+    assert source.poll() is None  # the boot checkpoint never re-applies
+    _save_ckpt(str(tmp_path), 200, 2.0)
+    assert source.peek_available() == 1
+    params, version, meta = source.poll()
+    assert version == 1 and meta["checkpoint_step"] == 200
+    assert float(params["gain"]) == 2.0
+    assert source.poll() is None  # nothing newer
+    _save_ckpt(str(tmp_path), 300, 3.0, mtime=time.time() + 5)
+    params, version, _ = source.poll()
+    assert version == 2 and float(params["gain"]) == 3.0
+
+
+def test_reload_torn_fault_rejects_and_keeps_old_params(tmp_path):
+    """The reload_torn fault tears the NEXT candidate on disk: integrity
+    validation (sha256 sidecar) rejects it, discovery falls back, the server
+    keeps serving the old version, and the rejection is a reload event the
+    reload_stall detector turns into a warning finding."""
+    from sheeprl_tpu.obs.diagnose import run_detectors
+    from sheeprl_tpu.resilience.faults import FaultPlan
+
+    tel = ServingTelemetry(
+        _Fabric(), _CFG, str(tmp_path / "serve"), every=4, serve_info={"slots": 1}
+    )
+    boot = _save_ckpt(str(tmp_path), 100, 1.0, mtime=time.time() - 100)
+    server = PolicyServer(_gain_policy(1.0), slots=1, max_batch_wait_ms=0.5, telemetry=tel).start()
+    source = _StatePathSource(str(tmp_path), None, None, current_path=boot)
+    reloader = WeightReloader(server, source, telemetry=tel, poll_s=60.0)
+
+    # arm the fault exactly as the serve verb would (FaultPlan -> one-shot arm)
+    plan = FaultPlan("reload_torn", at_policy_step=0)
+    plan.maybe_fire(0, tel.emit_event)
+
+    torn = _save_ckpt(str(tmp_path), 200, 2.0)
+    assert reloader.step() is None  # candidate torn on disk -> rejected
+    assert reloader.failures == 1
+    assert not is_valid_checkpoint(torn), "torn candidate still validates"
+    assert find_latest_checkpoint(str(tmp_path)) == boot  # discovery fell back
+    assert float(server.policy.params["gain"]) == 1.0  # old params keep serving
+    assert server.weight_version == 0
+
+    # the NEXT (valid) candidate still reloads — the path is not wedged
+    _save_ckpt(str(tmp_path), 300, 3.0, mtime=time.time() + 5)
+    assert reloader.step() == 1
+    session = server.open_session(seed=0)
+    session.step(_OBS)
+    time.sleep(0.05)
+    assert float(server.policy.params["gain"]) == 3.0
+    session.close()
+    server.close()
+
+    events = [
+        json.loads(line)
+        for line in (tmp_path / "serve" / "telemetry.jsonl").read_text().splitlines()
+    ]
+    kinds = [(e["event"], e.get("status")) for e in events]
+    assert ("fault", None) in [(k, None) for k, _ in kinds]  # the fault event landed
+    rejected = [e for e in events if e["event"] == "reload" and e["status"] == "rejected"]
+    assert rejected and "torn" in rejected[0]["reason"]
+    findings = [f for f in run_detectors(events) if f["detector"] == "reload_stall"]
+    assert findings and findings[0]["severity"] == "warning"
+    from sheeprl_tpu.obs.schema import validate_events
+
+    assert validate_events(events) == []
+
+
+def test_aval_mismatch_candidate_rejected(tmp_path):
+    boot = _save_ckpt(str(tmp_path), 100, 1.0, mtime=time.time() - 100)
+    server = PolicyServer(_gain_policy(1.0), slots=1, max_batch_wait_ms=0.5).start()
+    source = _StatePathSource(str(tmp_path), None, None, current_path=boot)
+    reloader = WeightReloader(server, source, poll_s=60.0)
+    path = os.path.join(str(tmp_path), "ckpt_200_0.ckpt")
+    save_checkpoint(path, {"params": {"gain": jnp.zeros((4,))}})  # wrong avals
+    assert reloader.step() is None
+    assert reloader.failures == 1
+    assert float(np.asarray(server.policy.params["gain"])) == 1.0
+    server.close()
+
+
+def test_subscriber_source_rides_weight_plane():
+    """The fleet weight plane (WeightPublisher/WeightSubscriber over LocalKV)
+    feeds the reloader: plane versions ARE the serving versions."""
+    from sheeprl_tpu.data.service import LocalKV, WeightPublisher, WeightSubscriber
+
+    kv = LocalKV()
+    publisher = WeightPublisher(kv, "t")
+    subscriber = WeightSubscriber(kv, "t")
+    source = SubscriberReloadSource(subscriber)
+    server = PolicyServer(_gain_policy(1.0), slots=1, max_batch_wait_ms=0.5).start()
+    reloader = WeightReloader(server, source, poll_s=60.0)
+    assert reloader.step() is None  # nothing published yet
+    publisher.publish({"gain": jnp.float32(5.0)})
+    publisher.publish({"gain": jnp.float32(7.0)})
+    assert reloader.step() == 2  # the subscriber jumps to latest
+    session = server.open_session(seed=0)
+    session.step(_OBS)
+    time.sleep(0.05)
+    assert float(np.asarray(server.policy.params["gain"])) == 7.0
+    assert server.weight_version == 2
+    session.close()
+    server.close()
+
+
+@pytest.mark.timeout(300)
+def test_e2e_serve_reload_two_versions_zero_recompiles(tmp_path):
+    """ISSUE 15 acceptance: a REAL trained PPO checkpoint served through the
+    full verb with hot reload following its run dir — two newer checkpoint
+    versions land while env sessions run, the server swaps to both, and the
+    compile monitor shows ZERO recompiles after warmup (same avals ⇒ the same
+    slot_step program across every swap)."""
+    from sheeprl_tpu.cli import run, serve
+    from sheeprl_tpu.resilience.discovery import resolve_checkpoint_path
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+    run(
+        [
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "env.num_envs=2",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "algo.rollout_steps=16",
+            "algo.total_steps=64",
+            "algo.update_epochs=1",
+            "algo.cnn_keys.encoder=[]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.run_test=False",
+            "metric.log_level=0",
+            "checkpoint.save_last=True",
+            "root_dir=reloadsmk",
+            "run_name=ppo",
+        ]
+    )
+    run_dir = "logs/runs/reloadsmk/ppo"
+    boot = resolve_checkpoint_path(run_dir)
+    state = load_checkpoint(boot)
+    ckpt_dir = os.path.dirname(boot)
+    serve_dir = str(tmp_path / "reload-serve")
+
+    rc = {}
+
+    def _serve():
+        rc["rc"] = serve(
+            [
+                f"checkpoint_path={run_dir}",
+                "serve.sessions=3",
+                "serve.slots=2",
+                "serve.max_session_steps=900",
+                "serve.telemetry.every=16",
+                "serve.reload.enabled=true",
+                "serve.reload.poll_s=0.1",
+                f"serve.log_dir={serve_dir}",
+                # long paced episodes: the sessions provably SPAN both swaps
+                "env.wrapper.n_steps=800",
+                "env.wrapper.step_latency_ms=3",
+            ]
+        )
+
+    thread = threading.Thread(target=_serve, daemon=True)
+    thread.start()
+    stream = os.path.join(serve_dir, "telemetry.jsonl")
+    deadline = time.monotonic() + 240
+    while not os.path.exists(stream) and time.monotonic() < deadline:
+        assert thread.is_alive() or rc.get("rc") == 0
+        time.sleep(0.1)
+    assert os.path.exists(stream)
+
+    def _applied_versions():
+        return [
+            e["version"]
+            for e in (json.loads(line) for line in open(stream))
+            if e.get("event") == "reload" and e.get("status") == "applied"
+        ]
+
+    # training keeps publishing: two newer checkpoints land while serving
+    for i, step in enumerate((990100, 990200), start=1):
+        save_checkpoint(os.path.join(ckpt_dir, f"ckpt_{step}_0.ckpt"), state)
+        deadline = time.monotonic() + 60
+        while len(_applied_versions()) < i and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(_applied_versions()) >= i, f"reload {i} never applied"
+
+    thread.join(timeout=200)
+    assert not thread.is_alive() and rc.get("rc") == 0
+
+    events = [json.loads(line) for line in open(stream)]
+    assert _applied_versions() == [1, 2]
+    summary = events[-1]
+    assert summary["clean_exit"] is True
+    assert summary["serve"]["weights"]["version"] == 2
+    assert summary["serve"]["weights"]["failures"] == 0
+    # zero recompiles after warmup, compile-monitor-asserted: every window
+    # past the first (which absorbs the step/attach compiles) is flat — the
+    # two swaps cost no compilation
+    windows = [e for e in events if e.get("event") == "window"]
+    assert len(windows) >= 2
+    for w in windows[1:]:
+        assert w["compile"]["window_count"] == 0, (
+            f"window {w['window']} recompiled under reload"
+        )
+    # the serving detectors stay green on the healthy reload run
+    from sheeprl_tpu.obs.diagnose import run_detectors
+
+    assert not [
+        f
+        for f in run_detectors(events)
+        if f["detector"] in ("reload_stall", "shed_rate", "deadline_misses")
+    ]
+    from sheeprl_tpu.obs.schema import validate_events
+
+    assert validate_events(events) == []
+
+
+def test_reload_stall_detector_on_unapplied_versions(tmp_path):
+    """A newer version visible but never applied for the tail windows is a
+    stalled reload — warning, with the version gap in the metrics."""
+    from sheeprl_tpu.obs.diagnose import run_detectors
+
+    tel = ServingTelemetry(_Fabric(), _CFG, str(tmp_path), every=2, serve_info={"slots": 1})
+    with PolicyServer(_gain_policy(1.0), slots=1, max_batch_wait_ms=0.5, telemetry=tel) as server:
+        tel.observe_reload(available=3)  # the reloader saw v3 but never applied
+        session = server.open_session(seed=0)
+        for _ in range(8):
+            session.step(_OBS)
+        session.close()
+    events = [json.loads(line) for line in (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    findings = [f for f in run_detectors(events) if f["detector"] == "reload_stall"]
+    assert findings and findings[0]["severity"] == "warning"
+    assert findings[0]["metrics"]["versions_behind"] == 3

@@ -57,6 +57,73 @@ def test_empty_dir_and_missing_path_raise(tmp_path):
         resolve_checkpoint_path(str(tmp_path / "nope"))
 
 
+def test_sha_sidecar_written_and_verified(tmp_path):
+    """save_checkpoint writes a sha256 sidecar; a matching digest validates, a
+    corrupted file is vetoed, and a checkpoint WITHOUT a sidecar keeps the
+    original size heuristic (old runs keep resolving)."""
+    import numpy as np
+
+    from sheeprl_tpu.resilience.discovery import is_valid_checkpoint
+    from sheeprl_tpu.utils.checkpoint import save_checkpoint, verify_sha_sidecar
+
+    path = str(tmp_path / "ckpt_100_0.ckpt")
+    save_checkpoint(path, {"x": np.arange(64)})
+    assert os.path.isfile(path + ".sha256")
+    assert verify_sha_sidecar(path) is True
+    assert is_valid_checkpoint(path)
+    # sidecar-less checkpoints stay valid by the original heuristics
+    bare = _ckpt(str(tmp_path), "ckpt_50_0.ckpt")
+    assert verify_sha_sidecar(bare) is None
+    assert is_valid_checkpoint(bare)
+    # torn write: truncate the pickle — the digest vetoes it
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    assert verify_sha_sidecar(path) is False
+    assert not is_valid_checkpoint(path)
+
+
+def test_discovery_falls_back_past_corrupt_checkpoint(tmp_path):
+    """The reload_torn / resume_from=latest contract: a corrupted newest
+    checkpoint never resolves — discovery falls back to the previous valid
+    one (and resolve_checkpoint_path follows)."""
+    import numpy as np
+
+    from sheeprl_tpu.resilience.discovery import find_latest_checkpoint
+    from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+    old = str(tmp_path / "ckpt_100_0.ckpt")
+    save_checkpoint(old, {"x": np.arange(8)})
+    os.utime(old, (time.time() - 100, time.time() - 100))
+    newest = str(tmp_path / "ckpt_200_0.ckpt")
+    save_checkpoint(newest, {"x": np.arange(8)})
+    assert find_latest_checkpoint(str(tmp_path)) == newest
+    with open(newest, "r+b") as fh:
+        fh.truncate(os.path.getsize(newest) // 2)
+    assert find_latest_checkpoint(str(tmp_path)) == old
+    assert resolve_checkpoint_path(str(tmp_path)) == old
+
+
+def test_checkpoint_sweep_removes_sha_sidecars(tmp_path):
+    """keep_last sweeping a pickle checkpoint removes its integrity sidecar
+    too (and orphan sidecars from older sweeps)."""
+    import numpy as np
+
+    from sheeprl_tpu.utils.callback import CheckpointCallback
+    from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+    cb = CheckpointCallback(keep_last=1)
+    paths = []
+    for step in (100, 200, 300):
+        path = str(tmp_path / f"ckpt_{step}_0.ckpt")
+        save_checkpoint(path, {"x": np.arange(4)})
+        os.utime(path, (time.time() - 1000 + step, time.time() - 1000 + step))
+        paths.append(path)
+    cb._delete_old_checkpoints(str(tmp_path), live=paths[-1])
+    assert not os.path.exists(paths[0]) and not os.path.exists(paths[0] + ".sha256")
+    assert not os.path.exists(paths[1]) and not os.path.exists(paths[1] + ".sha256")
+    assert os.path.exists(paths[-1]) and os.path.exists(paths[-1] + ".sha256")
+
+
 def test_eval_cli_accepts_run_dir(tmp_path, monkeypatch):
     """cli.evaluation resolves checkpoint_path through the same helper — a run
     dir with a config.yaml two levels above the checkpoint evaluates."""
