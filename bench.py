@@ -34,6 +34,15 @@ Select a single workload with BENCH_ALGO:
   stepping INSIDE the jitted program. Scale jump vs the host `ppo` workload is
   structural (~100x: no host<->device handoff per env step); the fingerprint's
   ``env_backend`` field keeps the regression gate from diffing across planes.
+- sac_anakin — the fully device-resident off-policy topology (envs/jax +
+  data/device_ring.py + algos/sac/anakin.py): rollout, replay-ring write,
+  uniform ring sample and G gradient steps fused into ONE donated jitted
+  program, Pendulum stepping inside it. Steady-state env-steps/sec, plus a
+  measured device-vs-local A/B (a short host `sac_benchmarks` window run in the
+  same process) under ``conditions.device_vs_local`` — the acceptance bar is a
+  >= 10x speedup over the host SAC loop. ``conditions.env_backend`` /
+  ``conditions.buffer_backend`` and the fingerprint's matching fields keep the
+  regression gate from ever diffing across replay planes.
 - dreamer_v3_mfu — flagship-size (S preset) DV3 train-program MFU on the
   accelerator: FLOPs from XLA's own cost model over achieved step time vs chip
   peak (sheeprl_tpu/utils/mfu.py). Run automatically as an extra when the
@@ -529,18 +538,15 @@ def _dv3_train_mfu(size: str | None = None, reps: int = 5) -> dict:
     return stats
 
 
-def _bench_sac_steady() -> dict:
-    """SAC steady-state env-steps/sec over a bounded post-compile window (the
-    BenchWindow in sac.py), with the prefetch on/off A/B recorded like the dreamer
-    steady bench. The whole-run `sac` wall-clock workload stays untouched."""
-    total_steps, ref_seconds = BASELINES["sac"]
-    baseline_sps = total_steps / ref_seconds
-
-    args = ["exp=sac_benchmarks"]
+def _sac_host_fallback_overrides() -> list:
+    """Host-SAC benchmark fallback when Box2D (LunarLanderContinuous's backend)
+    is not installed: the continuous dummy env at the same MLP shapes."""
     try:
         import Box2D  # noqa: F401  (gymnasium's LunarLanderContinuous backend)
+
+        return []
     except ImportError:
-        args += [
+        return [
             "env=dummy",
             "env.id=continuous_dummy",
             "env.capture_video=False",
@@ -549,6 +555,16 @@ def _bench_sac_steady() -> dict:
             "metric.log_level=0",
             "metric.disable_timer=True",
         ]
+
+
+def _bench_sac_steady() -> dict:
+    """SAC steady-state env-steps/sec over a bounded post-compile window (the
+    BenchWindow in sac.py), with the prefetch on/off A/B recorded like the dreamer
+    steady bench. The whole-run `sac` wall-clock workload stays untouched."""
+    total_steps, ref_seconds = BASELINES["sac"]
+    baseline_sps = total_steps / ref_seconds
+
+    args = ["exp=sac_benchmarks"] + _sac_host_fallback_overrides()
     total, steady_start = 6144, 2048  # warmup spans learning_starts (100) + compiles
     probe = _accelerator_probe_cached()
     if not probe["alive"] or probe["platform"] == "cpu":
@@ -626,6 +642,104 @@ def _bench_ppo_anakin() -> dict:
         "conditions": conditions,
     }
     extras = _learning_extras("ppo_anakin", steady, conditions.get("fingerprint"))
+    if extras:
+        result["extras"] = extras
+    return result
+
+
+def _bench_sac_anakin() -> dict:
+    """sac_anakin steady-state env-steps/sec: the fully device-resident
+    off-policy topology (exp=sac_anakin_benchmarks — Pendulum + the replay ring
+    + G gradient steps inside ONE donated jitted program, 512 envs x 64 rollout
+    steps per call). Reported over the post-compile BenchWindow like
+    ppo_anakin, and paired with a MEASURED device-vs-local A/B: a short host
+    ``sac_benchmarks`` steady window run in the same process, recorded under
+    ``conditions.device_vs_local`` with the speedup ratio (acceptance bar
+    >= 10x). The scale jump is structural — no host<->device handoff per env
+    step AND no host replay round-trip per gradient step — and
+    ``conditions.env_backend``/``conditions.buffer_backend`` plus the
+    fingerprint's matching fields keep the regression gate from ever diffing it
+    against a host-replay run."""
+    total_steps, ref_seconds = BASELINES["sac"]
+    baseline_sps = total_steps / ref_seconds  # the reference's host SAC, 4 CPUs
+
+    total = 2_097_152  # 64 fused iterations of 32768 env steps
+    steady_start = 65_536  # 2 iterations of warmup: compile + cache effects
+    args = [
+        "exp=sac_anakin_benchmarks",
+        f"algo.total_steps={total}",
+        # one telemetry window per fused iteration (see _bench_ppo_anakin)
+        "metric.telemetry.every=32768",
+    ]
+    probe = _accelerator_probe_cached()
+    on_cpu = not probe["alive"] or probe["platform"] == "cpu"
+    if on_cpu:
+        args += ["fabric.accelerator=cpu"]
+
+    steady = _steady_window_run(args, steady_start)
+    sps = steady["steps"] / steady["seconds"]
+
+    # the device-vs-local A/B control: the HOST loop (gymnasium env, host
+    # ReplayBuffer, per-G-step host<->device round trips) on a short window —
+    # sac_steady's exact conditions, bounded so the control costs seconds
+    local_total, local_start = 4096, 2048
+    local_args = (
+        ["exp=sac_benchmarks"]
+        + _sac_host_fallback_overrides()
+        + [f"algo.total_steps={local_total}"]
+    )
+    if on_cpu:
+        local_args += ["fabric.accelerator=cpu"]
+    local_sps = None
+    device_vs_local = {"device_sps": round(sps, 2)}
+    try:
+        local_steady = _steady_window_run(local_args, local_start)
+        local_sps = local_steady["steps"] / local_steady["seconds"]
+        device_vs_local.update(
+            {
+                "local_sps": round(local_sps, 2),
+                "speedup": round(sps / local_sps, 2) if local_sps > 0 else None,
+                "local_window": {
+                    "steps": local_steady["steps"],
+                    "seconds": round(local_steady["seconds"], 2),
+                    "total_steps": local_total,
+                },
+            }
+        )
+    except Exception as exc:  # the control must never lose the device number
+        device_vs_local["local_error"] = repr(exc)[:300]
+
+    conditions = {
+        "steady_window_steps": steady["steps"],
+        "steady_window_seconds": round(steady["seconds"], 2),
+        "total_steps": total,
+        "baseline_sps": round(baseline_sps, 2),
+        # the workload's two defining axes: which plane stepped the envs and
+        # which plane fed the gradient steps
+        "env_backend": "jax",
+        "buffer_backend": "device",
+        "device_vs_local": device_vs_local,
+        "accelerator": (
+            "cpu-fallback"
+            if not probe["alive"]
+            else "cpu"
+            if probe["platform"] == "cpu"
+            else f"tpu ({probe['device_kind']})"
+            if probe["platform"] in ("tpu", "axon")
+            else probe["platform"]
+        ),
+    }
+    for key in ("telemetry", "fingerprint", "diagnosis", "learning"):
+        if key in steady:
+            conditions[key] = steady[key]
+    result = {
+        "metric": "sac_anakin_env_steps_per_sec",
+        "value": round(sps, 2),
+        "unit": "env-steps/sec (steady-state)",
+        "vs_baseline": round(sps / baseline_sps, 3),
+        "conditions": conditions,
+    }
+    extras = _learning_extras("sac_anakin", steady, conditions.get("fingerprint"))
     if extras:
         result["extras"] = extras
     return result
@@ -1195,6 +1309,8 @@ def _bench(algo: str) -> dict:
         result = _bench_dv3_2d_mesh(os.environ.get("SHEEPRL_BENCH_DV3_2D_SIZE", "L"))
     elif algo == "ppo_anakin":
         result = _bench_ppo_anakin()
+    elif algo == "sac_anakin":
+        result = _bench_sac_anakin()
     elif algo == "sac_steady":
         result = _bench_sac_steady()
     elif algo == "serve_load":
@@ -1390,6 +1506,17 @@ def main() -> int:
             print(json.dumps({**result, "extras": extras}), flush=True)
         except Exception as exc:
             result["ppo_anakin_extra_error"] = repr(exc)[:500]
+            chip_busy = live and isinstance(exc, BenchTimeout)
+    # sac_anakin steady-state: the fully device-resident off-policy topology
+    # (on-device envs + replay ring + gradient steps in one donated program) —
+    # the off-policy counterpart of ppo_anakin, with the device-vs-local A/B
+    # (runs on CPU or chip alike; one compile + a short host control window)
+    if not chip_busy:
+        try:
+            extras.append(_bench_subprocess("sac_anakin", timeout=900))
+            print(json.dumps({**result, "extras": extras}), flush=True)
+        except Exception as exc:
+            result["sac_anakin_extra_error"] = repr(exc)[:500]
             chip_busy = live and isinstance(exc, BenchTimeout)
     # dv3_2d_mesh: per-device DV3-L parameter footprint on the [2,4] data x
     # model mesh vs the [8] replicated mesh — init-time-only on 8 VIRTUAL CPU

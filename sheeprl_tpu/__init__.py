@@ -26,6 +26,7 @@ _ALGO_MODULES = [
     "sheeprl_tpu.algos.ppo.ppo_decoupled",
     "sheeprl_tpu.algos.ppo.evaluate",
     "sheeprl_tpu.algos.sac.sac",
+    "sheeprl_tpu.algos.sac.sac_anakin",
     "sheeprl_tpu.algos.sac.sac_decoupled",
     "sheeprl_tpu.algos.sac.evaluate",
     "sheeprl_tpu.algos.droq.droq",

@@ -74,54 +74,13 @@ from sheeprl_tpu.utils.utils import (
     save_configs,
 )
 
+# the Feistel minibatch shuffle now lives in utils/prp.py (shared with the
+# device replay ring); re-exported here so existing import sites keep working
+from sheeprl_tpu.utils.prp import prp_permutation  # noqa: E402, F401
+
 # stats accumulator keys carried device-side across iterations (pulled + zeroed
 # at the logging cadence; ``losses`` is overwritten each call, not accumulated)
 _STATS_ACC = ("ep_return_sum", "ep_length_sum", "ep_count")
-
-
-def _mix32(x: jax.Array) -> jax.Array:
-    """32-bit integer finalizer (splitmix-style avalanche) — the Feistel round
-    function of :func:`prp_permutation`."""
-    x = x.astype(jnp.uint32)
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x7FEB352D)
-    x = x ^ (x >> 15)
-    x = x * jnp.uint32(0x846CA68B)
-    x = x ^ (x >> 16)
-    return x
-
-
-def prp_permutation(key: jax.Array, n: int, rounds: int = 8) -> jax.Array:
-    """Pseudorandom permutation of ``[0, n)`` for power-of-two ``n`` via an
-    unbalanced Feistel network: O(n) elementwise integer ops, no sort.
-
-    ``jax.random.permutation`` lowers to a full sort — ~460 ms for 2^19 rows on
-    XLA CPU, which made the epoch shuffle HALF of the fused Anakin program's
-    train phase. A Feistel cipher over the index bits is a bijection by
-    construction (each round swaps halves and XORs one through a keyed hash),
-    costs ~2 ms at the same size, and is statistically more than enough for
-    minibatch decorrelation (tested uncorrelated with identity; every round key
-    derives from ``key``, so the shuffle stays deterministic per seed).
-    """
-    if n & (n - 1) or n < 2:
-        raise ValueError(f"prp_permutation needs a power-of-two size >= 2, got {n}")
-    bits = int(n).bit_length() - 1
-    half_b = bits // 2
-    half_a = bits - half_b
-    idx = jnp.arange(n, dtype=jnp.uint32)
-    left = idx >> half_b
-    right = idx & jnp.uint32((1 << half_b) - 1)
-    width_l, width_r = half_a, half_b
-    round_keys = jax.random.randint(key, (rounds,), 0, np.iinfo(np.int32).max).astype(jnp.uint32)
-    for i in range(rounds):
-        f = _mix32(right ^ round_keys[i])
-        left, right, width_l, width_r = (
-            right,
-            left ^ (f & jnp.uint32((1 << width_l) - 1)),
-            width_r,
-            width_l,
-        )
-    return ((left << width_r) | right).astype(jnp.int32)
 
 
 def sparse_truncation_bootstrap(values_fn, traj, gamma, num_steps, num_envs, max_truncations):

@@ -1,0 +1,611 @@
+"""SAC Anakin topology: rollout + replay ring + N gradient steps in ONE program.
+
+PR 7's Anakin port (``algos/ppo/anakin.py``, Podracer arxiv 2104.06272) fused
+the on-policy loop; every off-policy loop still pays the host↔device boundary
+*twice* per iteration — a numpy replay add per env step and a sampled-batch
+upload per train round. This module closes that gap with the device-resident
+replay ring (``data/device_ring.py``): environments (``envs/jax`` plane), ring
+write, uniform ring sample (Feistel ``utils/prp.py``) and the full
+``lax.scan``-ed gradient phase (the UNJITTED :func:`~sheeprl_tpu.algos.sac.sac.
+make_train_body` — the same update every SAC topology runs) compile into ONE
+donated XLA program over the mesh. Steady-state host traffic is the Anakin
+contract: opaque device references carried in a Python loop, a handful of
+scalars pulled at telemetry cadence, zero callbacks/infeeds/outfeeds — proven
+off-chip by the ``sac.anakin_step`` entry in ``analysis/programs.py``
+(``sheeprl.py lint --aot``).
+
+Differences from the host loop (``algos/sac/sac.py``), documented in
+``howto/device_replay.md``:
+
+- ``buffer.backend=device`` is REQUIRED: the ring is the replay storage; a host
+  ``ReplayBuffer`` exists only as the checkpoint-durability twin
+  (``DeviceRingSampler.sync_to_host`` at checkpoint cadence, ``device_put``
+  back on resume — ring contents and counters round-trip exactly).
+- the replay-ratio governor is STATIC: ``G = round(algo.replay_ratio *
+  rollout_steps * num_envs / world_size)`` gradient steps are compiled into the
+  program (a host-side ``Ratio`` would need a per-iteration recompile).
+- ``algo.learning_starts`` is ignored: the first fused iteration already writes
+  ``rollout_steps * num_envs`` fresh transitions before its sample phase, and
+  the ring samples uniformly over the valid region from the first row.
+
+Distribution mirrors the PPO Anakin mesh: envs and ring sharded over ``data``
+(the ring's batch axis is the env axis), params/opt-state replicated, XLA
+inserting the gradient all-reduce; ``build_state_shardings``-derived
+``out_shardings`` pin the carried state so GSPMD propagation can never
+re-scatter a donated leaf between iterations.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.anakin import _measure_rollout_seconds
+from sheeprl_tpu.algos.sac.agent import build_agent, squash_and_logprob
+from sheeprl_tpu.algos.sac.sac import build_optimizers, init_opt_state, make_train_body
+from sheeprl_tpu.algos.sac.utils import test
+from sheeprl_tpu.analysis.programs import register_fused_program
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_ring import ring_capacity, ring_init, ring_sample, ring_write
+from sheeprl_tpu.data.prefetch import make_replay_sampler
+from sheeprl_tpu.envs.jax import make_jax_env
+from sheeprl_tpu.obs import build_telemetry
+from sheeprl_tpu.resilience import apply_armed_learn_fault, build_resilience
+from sheeprl_tpu.utils.checkpoint import wait_for_checkpoint
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import BenchWindow, packed_device_get, save_configs
+
+# stats accumulator keys carried device-side across iterations (pulled + zeroed
+# at the logging cadence; ``losses`` is overwritten each call, not accumulated)
+_STATS_ACC = ("ep_return_sum", "ep_length_sum", "ep_count")
+
+# the transition schema one rollout step appends to the ring; ``terminated``
+# AND ``truncated`` are both stored so the checkpoint snapshot satisfies the
+# host buffer's ``_ckpt_rb`` durability protocol unchanged
+RING_ROW_KEYS = (
+    "observations",
+    "next_observations",
+    "actions",
+    "rewards",
+    "terminated",
+    "truncated",
+)
+
+
+def ring_row_specs(obs_dim: int, act_dim: int):
+    """Per-env trailing (shape, dtype) of each ring row key — ONE schema shared
+    by the driver's ``ring_init`` and the AOT builder."""
+    return {
+        "observations": ((int(obs_dim),), np.float32),
+        "next_observations": ((int(obs_dim),), np.float32),
+        "actions": ((int(act_dim),), np.float32),
+        "rewards": ((1,), np.float32),
+        "terminated": ((1,), np.float32),
+        "truncated": ((1,), np.float32),
+    }
+
+
+def grad_steps_per_iteration(cfg, total_num_envs: int, world_size: int) -> int:
+    """The STATIC per-rank gradient-step count of one fused iteration: the
+    replay-ratio contract (``algo.replay_ratio`` gradient steps per policy
+    step, reference sac.py:301-309) applied to the iteration's
+    ``rollout_steps * num_envs`` policy steps and baked into the program."""
+    T = int(cfg.algo.rollout_steps)
+    return max(1, int(round(float(cfg.algo.replay_ratio) * T * total_num_envs / world_size)))
+
+
+def make_sac_anakin_program(actor, critic, env, cfg, fabric, txs, total_num_envs, params, opt_state):
+    """Build (sac_anakin_step, rollout_only, grad_steps_per_iter).
+
+    ``sac_anakin_step(params, opt_state, env_state, obs, ring, key, stats,
+    iter_num) -> (params, opt_state, env_state, obs, ring, key, stats, learn)``
+    is the fused per-iteration program — T env+act steps, ring write, ring
+    sample, G gradient steps — jitted with every carried tree donated (stats is
+    NOT donated: telemetry holds the losses reference across calls, exactly the
+    PPO Anakin convention). ``rollout_only`` is a jit of just the acting half
+    for the measured rollout/train phase split.
+
+    Module-level so the ``sac.anakin_step`` AOT registration lowers exactly the
+    program the driver runs. ``params``/``opt_state`` are consumed only to
+    derive the multi-device ``out_shardings`` pin.
+    """
+    world_size = fabric.world_size
+    T = int(cfg.algo.rollout_steps)
+    B = int(cfg.algo.per_rank_batch_size) * world_size
+    G = grad_steps_per_iteration(cfg, total_num_envs, world_size)
+    act_dim = int(np.prod(env.spec.action.shape))
+    action_scale = jnp.asarray(actor.action_scale, dtype=jnp.float32)
+    action_bias = jnp.asarray(actor.action_bias, dtype=jnp.float32)
+    target_entropy = -float(act_dim)
+
+    data_sharding = fabric.sharding("data") if world_size > 1 else None
+    # ring storage is [capacity, n_envs, ...]: the env axis (axis 1) carries the
+    # mesh's data split, matching the rollout's env sharding so the write is a
+    # purely local scatter on every device
+    ring_data_sharding = fabric.sharding(None, "data") if world_size > 1 else None
+    batch_sharding = fabric.sharding(None, "data") if world_size > 1 else None
+
+    # ONE update implementation for every SAC topology: the host loop jits this
+    # same body standalone (make_train_phase); here it fuses after the ring
+    train_body = make_train_body(
+        cfg, actor, critic, target_entropy, policy_steps_per_iter=T * total_num_envs, txs=txs
+    )
+
+    def rollout_phase(params, env_state, obs, key):
+        """T fused env+act steps; returns the new env carry, the [T, E, ...]
+        ring rows and the summed episode stats of episodes that ended."""
+
+        def body(carry, _):
+            env_state, obs, key = carry
+            key, step_key = jax.random.split(key)
+            fobs = obs.astype(jnp.float32)
+            mean, std = actor.apply({"params": params["actor"]}, fobs)
+            actions, _ = squash_and_logprob(mean, std, step_key, action_scale, action_bias)
+            env_state, next_obs, reward, done, info = env.step(env_state, actions)
+            done_f = done.astype(jnp.float32)
+            transition = {
+                "observations": fobs,
+                # the PRE-reset observation of this step — the true successor
+                # state (the host loop's real_next_obs assembly, sac.py:281-289)
+                "next_observations": info["terminal_observation"].astype(jnp.float32),
+                "actions": actions,
+                "rewards": reward[:, None].astype(jnp.float32),
+                "terminated": info["terminated"].astype(jnp.float32)[:, None],
+                "truncated": info["truncated"].astype(jnp.float32)[:, None],
+            }
+            step_stats = jnp.stack(
+                [
+                    jnp.sum(info["episode_return"] * done_f),
+                    jnp.sum(info["episode_length"].astype(jnp.float32) * done_f),
+                    jnp.sum(done_f),
+                ]
+            )
+            return (env_state, next_obs, key), (transition, step_stats)
+
+        (env_state, obs, key), (traj, step_stats) = jax.lax.scan(
+            body, (env_state, obs, key), None, length=T
+        )
+        return env_state, obs, key, traj, step_stats.sum(axis=0)
+
+    def sac_anakin_step(params, opt_state, env_state, obs, ring, key, stats, iter_num):
+        if data_sharding is not None:
+            env_state = jax.lax.with_sharding_constraint(env_state, data_sharding)
+            obs = jax.lax.with_sharding_constraint(obs, data_sharding)
+            ring = dict(
+                ring, data=jax.lax.with_sharding_constraint(ring["data"], ring_data_sharding)
+            )
+        env_state, obs, key, traj, ep_stats = rollout_phase(params, env_state, obs, key)
+        ring = ring_write(ring, traj)
+        key, sample_key, train_key = jax.random.split(key, 3)
+        batch = ring_sample(ring, sample_key, batch_size=B, n_samples=G)
+        if batch_sharding is not None:
+            batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
+        params, opt_state, losses, learn = train_body(
+            params, opt_state, batch, iter_num, train_key
+        )
+        new_stats = {
+            "ep_return_sum": stats["ep_return_sum"] + ep_stats[0],
+            "ep_length_sum": stats["ep_length_sum"] + ep_stats[1],
+            "ep_count": stats["ep_count"] + ep_stats[2],
+            "losses": losses,
+        }
+        return params, opt_state, env_state, obs, ring, key, new_stats, learn
+
+    jit_kwargs: Dict[str, Any] = {}
+    if fabric.num_devices > 1:
+        # pin the carried outputs (PR 8's build_state_shardings rationale): the
+        # train state replicated, the ring env-sharded, key/stats replicated;
+        # env_state/learn propagate from the internal constraints (env-state
+        # pytree and Learn/* block structures are only known at trace time —
+        # None leaves in out_shardings mean "GSPMD decides" for that subtree)
+        replicated = fabric.replicated
+        jit_kwargs["out_shardings"] = (
+            fabric.param_shardings(params),
+            fabric.param_shardings(opt_state),
+            None,  # env_state: data-sharded via the in-program constraint
+            data_sharding,
+            {"data": ring_data_sharding, "pos": replicated, "fill": replicated},
+            replicated,
+            {k: replicated for k in (*_STATS_ACC, "losses")},
+            None,  # Learn/* stats block
+        )
+    fused = jax.jit(sac_anakin_step, donate_argnums=(0, 1, 2, 3, 4, 5), **jit_kwargs)
+    rollout_only = jax.jit(rollout_phase)
+    return fused, rollout_only, G
+
+
+@register_fused_program(
+    "sac.anakin_step",
+    min_donated=8,
+    expect_collectives=("all-reduce",),
+    compile_on_cpu=True,
+    devices=8,
+    doc="fused SAC rollout + device replay ring + G gradient steps on the 8-device dp mesh",
+)
+def _aot_sac_anakin_program():
+    """The fused off-policy program on the 8-device CPU mesh: donation must
+    survive for every carried tree (params/opt-state/env-state/obs/RING/key),
+    the steady state must carry NO host callbacks/outfeeds — the replay path
+    included, which is the whole point of the device ring — and the dp gradient
+    psum must appear as an all-reduce in the optimized HLO."""
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.data.device_ring import ring_init
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    devices = 8
+    cfg = compose(
+        [
+            "exp=sac_anakin_benchmarks",
+            "fabric.accelerator=cpu",
+            f"fabric.devices={devices}",
+            "fabric.strategy=dp",
+            "env.num_envs=16",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=32",
+            "algo.replay_ratio=0.02",
+            "buffer.size=4096",
+            # lower the GROWN program (Learn/* stats compile in under telemetry)
+            "metric.telemetry.enabled=true",
+        ]
+    )
+    fabric = Fabric(devices=devices, accelerator="cpu", strategy="dp")
+    fabric._setup()
+    total_envs = 16 * devices
+    env = make_jax_env(cfg, total_envs)
+    spec = env.spec
+    obs_space = gym.spaces.Dict({"state": spec.to_gym_obs_space()})
+    actor, critic, params = build_agent(
+        fabric, cfg, obs_space, spec.action.to_gym_space(), jax.random.PRNGKey(0), None
+    )
+    txs = build_optimizers(cfg)
+    opt_state = init_opt_state(txs, params)
+    fused, _, _ = make_sac_anakin_program(
+        actor, critic, env, cfg, fabric, txs, total_envs, params, opt_state
+    )
+    params = fabric.replicate_pytree(params)
+    opt_state = fabric.replicate_pytree(opt_state)
+    env_state, obs = jax.jit(env.reset)(jax.random.PRNGKey(1))
+    env_state = fabric.shard_pytree(env_state)
+    obs = fabric.shard_pytree(obs)
+    obs_dim = int(np.prod(spec.obs_shape))
+    act_dim = int(np.prod(spec.action.shape))
+    ring = ring_init(
+        ring_capacity(int(cfg.buffer.size), total_envs),
+        total_envs,
+        ring_row_specs(obs_dim, act_dim),
+        sharding=fabric.sharding(None, "data"),
+    )
+    stats = {
+        "ep_return_sum": jnp.float32(0),
+        "ep_length_sum": jnp.float32(0),
+        "ep_count": jnp.float32(0),
+        "losses": jnp.zeros((3,), jnp.float32),
+    }
+    args = (params, opt_state, env_state, obs, ring, jax.random.PRNGKey(2), stats, jnp.asarray(1))
+    return fused, args
+
+
+@register_fused_program(
+    "replay.ring_write",
+    min_donated=1,
+    doc="device replay ring wraparound append (donated carry, standalone backend path)",
+)
+def _aot_ring_write_program():
+    """The standalone ring write ``DeviceRingSampler.add`` dispatches (the
+    fused topology inlines the same function): the ring carry must stay donated
+    and the program host-transfer-free."""
+    from sheeprl_tpu.data.device_ring import ring_init
+
+    ring = ring_init(16, 4, ring_row_specs(3, 1))
+    rows = {
+        k: np.zeros((2, 4, *shape), dtype) for k, (shape, dtype) in ring_row_specs(3, 1).items()
+    }
+    return jax.jit(ring_write, donate_argnums=(0,)), (ring, rows)
+
+
+@register_fused_program(
+    "replay.ring_sample",
+    donated=False,
+    doc="device replay ring uniform Feistel sample (pure read, standalone backend path)",
+)
+def _aot_ring_sample_program():
+    from sheeprl_tpu.data.device_ring import ring_init
+
+    ring = ring_init(16, 4, ring_row_specs(3, 1))
+    fn = jax.jit(ring_sample, static_argnames=("batch_size", "n_samples"))
+    return fn, (ring, jax.random.PRNGKey(0), 8, 2)
+
+
+def run_sac_anakin(fabric, cfg: Dict[str, Any]):
+    """The fused off-policy training loop (registered as ``sac_anakin``)."""
+    backend = str(cfg.env.get("backend", "host") or "host").lower()
+    if backend != "jax":
+        raise ValueError(
+            f"{cfg.algo.name} requires the on-device env plane: set env.backend=jax "
+            f"(got {backend!r}); host envs cannot live inside the fused program"
+        )
+    buffer_backend = str(cfg.buffer.get("backend", "local") or "local").lower()
+    if buffer_backend != "device":
+        raise ValueError(
+            f"{cfg.algo.name} requires the device-resident replay ring: set "
+            f"buffer.backend=device (got {buffer_backend!r}); a host replay buffer "
+            "cannot live inside the fused program"
+        )
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        raise ValueError("the anakin topology supports mlp observations only (cnn_keys must be empty)")
+    if len(cfg.algo.mlp_keys.encoder) != 1:
+        raise ValueError(
+            f"the anakin topology expects exactly one mlp key, got {cfg.algo.mlp_keys.encoder!r}"
+        )
+    if int(cfg.algo.learning_starts) > 0:
+        warnings.warn(
+            f"{cfg.algo.name} ignores algo.learning_starts={cfg.algo.learning_starts}: the first "
+            "fused iteration writes its whole rollout into the ring before sampling"
+        )
+
+    rank = fabric.global_rank
+    world_size = fabric.world_size
+
+    state = fabric.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name)
+    logger = get_logger(fabric, cfg, log_dir=log_dir)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict())
+    fabric.print(f"Log dir: {log_dir}")
+
+    total_num_envs = int(cfg.env.num_envs * world_size)
+    # scale the compile warmup to fused-iteration granularity (see run_anakin)
+    tcfg = cfg.metric.get("telemetry") or {}
+    if tcfg and int(tcfg.get("compile_warmup_steps") or 0) > 0:
+        cfg.metric.telemetry.compile_warmup_steps = max(
+            int(tcfg.get("compile_warmup_steps")),
+            8 * total_num_envs * int(cfg.algo.rollout_steps),
+        )
+    telemetry = build_telemetry(fabric, cfg, log_dir, logger=logger)
+    resilience = build_resilience(fabric, cfg, log_dir, telemetry=telemetry)
+    if world_size > 1 and total_num_envs % world_size != 0:
+        raise ValueError(f"num_envs*world_size ({total_num_envs}) must divide the mesh ({world_size})")
+    env = make_jax_env(cfg, total_num_envs)
+    spec = env.spec
+    if spec.action.kind != "continuous":
+        raise ValueError(
+            f"Only continuous action space is supported for the SAC agent (env {cfg.env.id!r} is "
+            f"{spec.action.kind})"
+        )
+    mlp_key = cfg.algo.mlp_keys.encoder[0]
+    observation_space = gym.spaces.Dict({mlp_key: spec.to_gym_obs_space()})
+    action_space = spec.action.to_gym_space()
+    obs_dim = int(np.prod(spec.obs_shape))
+    act_dim = int(np.prod(spec.action.shape))
+
+    key = fabric.seed_everything(cfg.seed + rank)
+    key, agent_key, env_key = jax.random.split(key, 3)
+    actor, critic, params = build_agent(
+        fabric, cfg, observation_space, action_space, agent_key, state["agent"] if state else None
+    )
+
+    txs = build_optimizers(cfg)
+    opt_state = init_opt_state(txs, params)
+    if state is not None:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    # the durability twin: capacity-row host buffer the ring snapshots into at
+    # checkpoint cadence. memmap is forced off — the snapshot REPLACES the
+    # backing arrays wholesale (ring_to_buffer), which a memmap cannot survive,
+    # and the hot path never touches host memory anyway.
+    capacity = ring_capacity(int(cfg.buffer.size) if not cfg.dry_run else total_num_envs, total_num_envs)
+    rb = ReplayBuffer(capacity, total_num_envs, memmap=False, obs_keys=("observations",))
+    if state is not None and "rb" in state:
+        rb = state["rb"]
+
+    ring_sharding = fabric.sharding(None, "data") if world_size > 1 else None
+    sampler = make_replay_sampler(
+        rb,
+        cfg.buffer.get("prefetch"),
+        backend="device",
+        sample_kwargs=dict(
+            batch_size=cfg.algo.per_rank_batch_size * world_size,
+            sample_next_obs=bool(cfg.buffer.sample_next_obs),
+        ),
+        sharding=ring_sharding,
+        seed=int(cfg.seed),
+        name="sac-device-ring",
+    )
+    telemetry.attach_sampler(sampler)
+    if sampler.ring is None:
+        sampler.ring = ring_init(
+            capacity, total_num_envs, ring_row_specs(obs_dim, act_dim), sharding=ring_sharding
+        )
+
+    policy_steps_per_iter = int(total_num_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    start_iter = (state["iter_num"] // world_size) + 1 if state is not None else 1
+    policy_step = state["iter_num"] * policy_steps_per_iter // world_size if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    anakin_step, rollout_only, grad_steps_per_iter = make_sac_anakin_program(
+        actor, critic, env, cfg, fabric, txs, total_num_envs, params, opt_state
+    )
+
+    if world_size > 1:
+        params = fabric.replicate_pytree(params)
+        opt_state = fabric.replicate_pytree(opt_state)
+
+    env_state, obs = jax.jit(env.reset)(env_key)
+    if world_size > 1:
+        env_state = fabric.shard_pytree(env_state)
+        obs = fabric.shard_pytree(obs)
+
+    ring = sampler.ring
+
+    stats = {
+        "ep_return_sum": jnp.float32(0.0),
+        "ep_length_sum": jnp.float32(0.0),
+        "ep_count": jnp.float32(0.0),
+        "losses": jnp.zeros((3,), jnp.float32),
+    }
+    _zero = jnp.float32(0.0)
+    last_ep_stats = {"ep_return_sum": 0.0, "ep_length_sum": 0.0, "ep_count": 0.0}
+
+    bench = BenchWindow()
+
+    rollout_seconds = None
+    if not timer.disabled:
+        rollout_seconds = _measure_rollout_seconds(rollout_only, (params, env_state, obs, key))
+
+    for iter_num in range(start_iter, total_iters + 1):
+        bench.maybe_start(policy_step, sync_tree=stats["losses"])
+        policy_step += policy_steps_per_iter
+
+        t0 = time.perf_counter()
+        # one-shot injected learning pathology (resilience.fault=lr_spike):
+        # identity unless the fault armed this iteration
+        params = apply_armed_learn_fault(params)
+        params, opt_state, env_state, obs, ring, key, stats, learn = anakin_step(
+            params, opt_state, env_state, obs, ring, key, stats, jnp.asarray(iter_num)
+        )
+        # keep the live ring reachable for the checkpoint snapshot path
+        sampler.ring = ring
+        # one scalar sync per ITERATION (T * num_envs env steps): keeps the host
+        # from racing the device queue and makes the wall-time split honest
+        jax.block_until_ready(stats["losses"])
+        elapsed = time.perf_counter() - t0
+
+        split_frac = (
+            min(rollout_seconds / elapsed, 1.0)
+            if (rollout_seconds and elapsed > 0)
+            else 1.0
+        )
+        timer("Time/rollout_time").add(elapsed * split_frac)
+        timer("Time/train_time").add(elapsed * (1.0 - split_frac))
+
+        telemetry.observe_train(grad_steps_per_iter, stats["losses"])
+        telemetry.observe_learn(learn)
+        if telemetry.enabled:
+            ep_count = float(stats["ep_count"]) - last_ep_stats["ep_count"]
+            if ep_count >= 1.0:
+                mean_ret = (float(stats["ep_return_sum"]) - last_ep_stats["ep_return_sum"]) / ep_count
+                mean_len = (float(stats["ep_length_sum"]) - last_ep_stats["ep_length_sum"]) / ep_count
+                telemetry.observe_episodes([mean_ret], [mean_len], count=int(ep_count))
+                last_ep_stats = {
+                    k: float(stats[k]) for k in _STATS_ACC
+                }
+        if telemetry.wants_program("sac_anakin_step"):
+            telemetry.register_program(
+                "sac_anakin_step",
+                anakin_step,
+                (params, opt_state, env_state, obs, ring, key, stats, jnp.asarray(iter_num)),
+                units=grad_steps_per_iter,
+            )
+        telemetry.step(policy_step)
+        resilience.step(policy_step)
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run
+        ):
+            with timer("Time/logging_time"):
+                # the ONLY steady-state device->host traffic: a handful of scalars
+                stats_np = {k: np.asarray(stats[k]) for k in _STATS_ACC}
+                losses_np = np.asarray(stats["losses"])
+                if aggregator and not aggregator.disabled:
+                    if stats_np["ep_count"] > 0:
+                        aggregator.update(
+                            "Rewards/rew_avg", float(stats_np["ep_return_sum"] / stats_np["ep_count"])
+                        )
+                        aggregator.update(
+                            "Game/ep_len_avg", float(stats_np["ep_length_sum"] / stats_np["ep_count"])
+                        )
+                    aggregator.update("Loss/value_loss", float(losses_np[0]))
+                    aggregator.update("Loss/policy_loss", float(losses_np[1]))
+                    aggregator.update("Loss/alpha_loss", float(losses_np[2]))
+                stats = dict(stats, ep_return_sum=_zero, ep_length_sum=_zero, ep_count=_zero)
+                last_ep_stats = {"ep_return_sum": 0.0, "ep_length_sum": 0.0, "ep_count": 0.0}
+                metrics_dict = aggregator.compute() if aggregator else {}
+                if logger is not None:
+                    logger.log_metrics(metrics_dict, policy_step)
+                    timers = timer.to_dict(reset=False)
+                    fused_seconds = timers.get("Time/rollout_time", 0.0) + timers.get(
+                        "Time/train_time", 0.0
+                    )
+                    if fused_seconds > 0:
+                        logger.log_metrics(
+                            {"Time/sps_env_interaction": (policy_step - last_log) / fused_seconds},
+                            policy_step,
+                        )
+                timer.to_dict(reset=True)
+                if aggregator:
+                    aggregator.reset()
+            last_log = policy_step
+
+        preempted = resilience.preempt_requested()
+        if (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+            or preempted
+        ):
+            last_checkpoint = policy_step
+            # snapshot to host numpy first: params/opt_state/ring are donated
+            # into the NEXT anakin_step call, and an async checkpoint backend
+            # must never hold references into donated device buffers
+            ckpt_state = {
+                "agent": packed_device_get(params),
+                "opt_state": packed_device_get(opt_state),
+                "iter_num": iter_num * world_size,
+                "batch_size": int(cfg.algo.per_rank_batch_size * world_size),
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt")
+            with timer("Time/checkpoint_time"):
+                if cfg.buffer.checkpoint:
+                    # ring -> host buffer (cursor + fill included): the snapshot
+                    # then rides the exact _ckpt_rb durability protocol
+                    sampler.sync_to_host()
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
+            resilience.observe_checkpoint(ckpt_path, policy_step, preempted=preempted)
+        if preempted:
+            break
+
+    bench.finish(policy_step, sync_tree=stats["losses"])
+    sampler.close()
+    wait_for_checkpoint()
+    if not resilience.finalize(policy_step) and fabric.is_global_zero and cfg.algo.run_test:
+        with timer("Time/test_time"):
+            test(actor.apply, params["actor"], fabric, cfg, log_dir)
+    telemetry.close(policy_step)
+    if logger is not None:
+        logger.finalize()

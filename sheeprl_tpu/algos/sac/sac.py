@@ -65,17 +65,14 @@ def init_opt_state(txs: Dict[str, Any], params) -> Dict[str, Any]:
     }
 
 
-def make_train_phase(cfg, actor, critic, target_entropy, policy_steps_per_iter, txs=None, jit_kwargs=None):
-    """Build the fused multi-gradient-step SAC train program: a ``lax.scan``
-    over the ``[G, B, ...]`` replay block running critic -> EMA -> actor ->
-    alpha per step (one device program per iteration; reference train(),
-    sac.py:32-81). Shared verbatim by the coupled loop, the decoupled
-    trainer/service learner and the AOT contract registry — the program that
-    lowers in the gate is the program that trains.
-
-    ``jit_kwargs`` carries the multi-device ``out_shardings`` pin (see the
-    donation note below); ``policy_steps_per_iter`` sets the target-EMA period
-    in iterations, exactly as before."""
+def make_train_body(cfg, actor, critic, target_entropy, policy_steps_per_iter, txs=None):
+    """The UNJITTED fused multi-gradient-step SAC update: a ``lax.scan`` over
+    the ``[G, B, ...]`` replay block running critic -> EMA -> actor -> alpha
+    per step (reference train(), sac.py:32-81). :func:`make_train_phase` wraps
+    it as the host loop's standalone donated program; the fully fused
+    ``sac_anakin`` topology (``algos/sac/anakin.py``) inlines this same body
+    after its on-device rollout+ring stages — ONE update implementation for
+    every SAC topology and the AOT contract registry."""
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     num_critics = int(cfg.algo.critic.n)
@@ -113,14 +110,6 @@ def make_train_phase(cfg, actor, critic, target_entropy, policy_steps_per_iter, 
     def alpha_loss_fn(log_alpha, logprobs):
         return entropy_loss(log_alpha, jax.lax.stop_gradient(logprobs), target_entropy)
 
-    # donate_argnums: XLA reuses the params/opt-state buffers in place instead of
-    # copying the whole train state every round (callers always rebind to the
-    # returned trees, so the invalidated inputs are never read again).
-    # out_shardings (via jit_kwargs) pins the state outputs on multi-device
-    # meshes (replicated on dp) — without the pin GSPMD propagation may
-    # re-scatter small state leaves on output, silently degrading the donation
-    # aliasing (the PR 8 residual; parallel/sharding.py build_state_shardings).
-    @partial(jax.jit, donate_argnums=(0, 1), **(jit_kwargs or {}))
     def train_phase(params, opt_state, data, iter_num, train_key):
         """scan over the [G, B, ...] gradient-step axis: critic -> EMA -> actor -> alpha
         (one fused device program per iteration; reference train(), sac.py:32-81)."""
@@ -193,6 +182,24 @@ def make_train_phase(cfg, actor, critic, target_entropy, policy_steps_per_iter, 
         return params, opt_state, losses.mean(axis=0), learn_stats.reduce_stacked(learn)
 
     return train_phase
+
+
+def make_train_phase(cfg, actor, critic, target_entropy, policy_steps_per_iter, txs=None, jit_kwargs=None):
+    """Jit :func:`make_train_body` as the host loop's standalone per-iteration
+    device program. Shared verbatim by the coupled loop, the decoupled
+    trainer/service learner and the AOT contract registry — the program that
+    lowers in the gate is the program that trains.
+
+    donate_argnums: XLA reuses the params/opt-state buffers in place instead of
+    copying the whole train state every round (callers always rebind to the
+    returned trees, so the invalidated inputs are never read again).
+    ``jit_kwargs`` carries the multi-device ``out_shardings`` pin — without it
+    GSPMD propagation may re-scatter small state leaves on output, silently
+    degrading the donation aliasing (the PR 8 residual; parallel/sharding.py
+    build_state_shardings). ``policy_steps_per_iter`` sets the target-EMA
+    period in iterations, exactly as before."""
+    body = make_train_body(cfg, actor, critic, target_entropy, policy_steps_per_iter, txs=txs)
+    return partial(jax.jit, donate_argnums=(0, 1), **(jit_kwargs or {}))(body)
 
 
 @register_fused_program(

@@ -1,0 +1,16 @@
+"""SAC, Anakin topology: on-device envs + device-resident replay ring, with
+rollout, ring write/sample and the gradient phase fused into one donated jitted
+program over the mesh (see ``algos/sac/anakin.py`` for the architecture;
+``algos/sac/sac.py`` is the host-env reference semantics)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.sac.anakin import run_sac_anakin
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    run_sac_anakin(fabric, cfg)

@@ -182,11 +182,18 @@ def check_configs(cfg: dotdict) -> None:
     # experience-backend sanity (sheeprl_tpu/data/service.py, howto/fleet.md):
     # fail before launch on a config that cannot form a service plane
     backend = str(cfg.buffer.get("backend", "local") if cfg.get("buffer") else "local")
-    if backend not in ("local", "service"):
+    if backend not in ("local", "service", "device"):
         raise ValueError(
             f"unknown buffer.backend {backend!r}; available: local (in-process replay, "
-            "the default) and service (standalone experience data plane for the "
-            "decoupled topologies — see howto/fleet.md)"
+            "the default), service (standalone experience data plane for the "
+            "decoupled topologies — see howto/fleet.md) and device (on-mesh replay "
+            "ring for the fused off-policy topology — see howto/device_replay.md)"
+        )
+    if backend == "device" and cfg.algo.name != "sac_anakin":
+        raise ValueError(
+            f"buffer.backend=device is wired for the fused off-policy topology "
+            f"(sac_anakin), not {cfg.algo.name!r} — host loops would round-trip the "
+            "ring every step, losing exactly what it buys (howto/device_replay.md)"
         )
     if backend == "service":
         if cfg.algo.name not in ("sac_decoupled", "dreamer_v3_decoupled"):

@@ -5,6 +5,15 @@ from sheeprl_tpu.data.buffers import (
     SequentialReplayBuffer,
     get_tensor,
 )
+from sheeprl_tpu.data.device_ring import (
+    DeviceRingSampler,
+    buffer_to_ring,
+    ring_capacity,
+    ring_init,
+    ring_sample,
+    ring_to_buffer,
+    ring_write,
+)
 from sheeprl_tpu.data.prefetch import (
     ReplaySamplePrefetcher,
     SyncReplaySampler,
@@ -20,6 +29,7 @@ from sheeprl_tpu.data.service import (
 )
 
 __all__ = [
+    "DeviceRingSampler",
     "EnvIndependentReplayBuffer",
     "EpisodeBuffer",
     "ExperienceService",
@@ -30,8 +40,14 @@ __all__ = [
     "SyncReplaySampler",
     "WeightPublisher",
     "WeightSubscriber",
+    "buffer_to_ring",
     "get_tensor",
     "make_replay_sampler",
+    "ring_capacity",
+    "ring_init",
+    "ring_sample",
+    "ring_to_buffer",
+    "ring_write",
     "service_layout",
     "service_options",
 ]

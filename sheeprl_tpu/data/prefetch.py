@@ -459,15 +459,29 @@ def make_replay_sampler(
     sharding: Any = None,
     lock: Optional[threading.Lock] = None,
     name: str = "replay-prefetch",
+    backend: str = "local",
+    seed: int = 0,
 ):
     """Build the hot-path replay sampler from the ``buffer.prefetch`` config group:
     a :class:`ReplaySamplePrefetcher` when ``enabled`` (the default), else the
     :class:`SyncReplaySampler` that restores the exact inline code path.
 
+    ``backend="device"`` routes to the device-resident replay ring instead
+    (:class:`~sheeprl_tpu.data.device_ring.DeviceRingSampler`, same surface):
+    storage lives ON the mesh, ``rb`` becomes the checkpoint-durability twin,
+    and the ``prefetch`` group is ignored (there is no host sample path to
+    pipeline). ``local`` keeps the host samplers byte-for-byte unchanged.
+
     ``uint8_keys`` is a shorthand for the loops' standard cast (those keys — and
     their ``next_`` twins — stay uint8, the rest goes float32); pass ``transform``
     instead for anything custom. Without either, samples pass through unchanged.
     """
+    if backend == "device":
+        from sheeprl_tpu.data.device_ring import DeviceRingSampler
+
+        if transform is not None or uint8_keys:
+            raise ValueError("buffer.backend=device does not support host-side sample transforms")
+        return DeviceRingSampler(rb, sample_kwargs, sharding=sharding, lock=lock, seed=seed)
     if transform is None and uint8_keys is not None:
         transform = _uint8_transform(uint8_keys)
     enabled = bool(prefetch_cfg.get("enabled", False)) if prefetch_cfg else False

@@ -76,6 +76,7 @@ COMPARE_KEYS = (
     "mesh_shape",
     "axis_names",
     "env_backend",
+    "buffer_backend",
     "key_shapes",
 )
 
@@ -183,6 +184,7 @@ def run_fingerprint(cfg: Mapping[str, Any], fabric: Any = None) -> Dict[str, Any
     thing that takes a run down."""
     algo_cfg = cfg.get("algo") or {}
     env_cfg = cfg.get("env") or {}
+    buffer_cfg = cfg.get("buffer") or {}
     fabric_cfg = cfg.get("fabric") or {}
     fp: Dict[str, Any] = {
         "algo": algo_cfg.get("name") if hasattr(algo_cfg, "get") else None,
@@ -198,6 +200,13 @@ def run_fingerprint(cfg: Mapping[str, Any], fabric: Any = None) -> Dict[str, Any
         # scales, so compare/bench-diff must refuse to silently diff them
         "env_backend": str(env_cfg.get("backend") or "host")
         if hasattr(env_cfg, "get")
+        else None,
+        # which replay plane fed training (host local/service buffer vs the
+        # on-mesh device ring): same refusal rationale as env_backend — a
+        # device-ring run's throughput must never silently diff against a
+        # host-replay one. None-tolerant for pre-ring recordings.
+        "buffer_backend": str(buffer_cfg.get("backend") or "local")
+        if hasattr(buffer_cfg, "get")
         else None,
         "key_shapes": _key_shapes(cfg),
     }

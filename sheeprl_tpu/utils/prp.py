@@ -1,0 +1,60 @@
+"""Feistel pseudorandom permutation over index ranges (in-jit, O(n), no sort).
+
+Hoisted out of ``algos/ppo/anakin.py`` (PR 7) so every fused program that needs
+a bijective in-program index shuffle shares ONE implementation: the PPO epoch
+shuffle and the device-resident replay ring's uniform sampler
+(``data/device_ring.py``) both ride :func:`prp_permutation`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["prp_permutation"]
+
+
+def _mix32(x: jax.Array) -> jax.Array:
+    """32-bit integer finalizer (splitmix-style avalanche) — the Feistel round
+    function of :func:`prp_permutation`."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def prp_permutation(key: jax.Array, n: int, rounds: int = 8) -> jax.Array:
+    """Pseudorandom permutation of ``[0, n)`` for power-of-two ``n`` via an
+    unbalanced Feistel network: O(n) elementwise integer ops, no sort.
+
+    ``jax.random.permutation`` lowers to a full sort — ~460 ms for 2^19 rows on
+    XLA CPU, which made the epoch shuffle HALF of the fused Anakin program's
+    train phase. A Feistel cipher over the index bits is a bijection by
+    construction (each round swaps halves and XORs one through a keyed hash),
+    costs ~2 ms at the same size, and is statistically more than enough for
+    minibatch decorrelation (tested uncorrelated with identity; every round key
+    derives from ``key``, so the shuffle stays deterministic per seed).
+    """
+    if n & (n - 1) or n < 2:
+        raise ValueError(f"prp_permutation needs a power-of-two size >= 2, got {n}")
+    bits = int(n).bit_length() - 1
+    half_b = bits // 2
+    half_a = bits - half_b
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    left = idx >> half_b
+    right = idx & jnp.uint32((1 << half_b) - 1)
+    width_l, width_r = half_a, half_b
+    round_keys = jax.random.randint(key, (rounds,), 0, np.iinfo(np.int32).max).astype(jnp.uint32)
+    for i in range(rounds):
+        f = _mix32(right ^ round_keys[i])
+        left, right, width_l, width_r = (
+            right,
+            left ^ (f & jnp.uint32((1 << width_l) - 1)),
+            width_r,
+            width_l,
+        )
+    return ((left << width_r) | right).astype(jnp.int32)

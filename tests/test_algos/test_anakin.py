@@ -7,8 +7,9 @@
   the fused program on the 8-device CPU mesh, asserting donation survives
   lowering and the steady-state program contains NO host callbacks/outfeeds —
   the transfer-free claim, checked by compile-test inspection.
-- Unit coverage for the two fused-program kernels: the Feistel minibatch
-  permutation and the sparse truncation bootstrap (vs a dense reference).
+- Unit coverage for the sparse truncation bootstrap kernel (vs a dense
+  reference); the Feistel permutation tests moved to tests/test_utils/test_prp.py
+  with the hoist into ``utils/prp.py``.
 """
 
 from __future__ import annotations
@@ -23,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from sheeprl_tpu.algos.ppo.anakin import prp_permutation, sparse_truncation_bootstrap
+from sheeprl_tpu.algos.ppo.anakin import sparse_truncation_bootstrap
 from sheeprl_tpu.cli import run
 
 _SMOKE_BASE = [
@@ -228,22 +229,6 @@ def test_anakin_two_device_mesh_executes():
     out = fused(*out[:6], np.float32(0.2), np.float32(0.0))
     losses = np.asarray(out[5]["losses"])
     assert np.isfinite(losses).all()
-
-
-def test_prp_permutation_is_uniformish_bijection():
-    for n in (2, 64, 4096):
-        perm = np.asarray(jax.jit(lambda k, n=n: prp_permutation(k, n))(jax.random.PRNGKey(0)))
-        assert sorted(perm.tolist()) == list(range(n))
-    a = np.asarray(prp_permutation(jax.random.PRNGKey(1), 4096))
-    b = np.asarray(prp_permutation(jax.random.PRNGKey(2), 4096))
-    assert not np.array_equal(a, b)
-    # deterministic per key
-    c = np.asarray(prp_permutation(jax.random.PRNGKey(1), 4096))
-    np.testing.assert_array_equal(a, c)
-    # mixes: essentially uncorrelated with the identity order
-    assert abs(np.corrcoef(a, np.arange(4096))[0, 1]) < 0.1
-    with pytest.raises(ValueError, match="power-of-two"):
-        prp_permutation(jax.random.PRNGKey(0), 100)
 
 
 def test_sparse_truncation_bootstrap_matches_dense_reference():

@@ -1,0 +1,245 @@
+"""sac_anakin topology tests: the fused off-policy rollout+ring+train program.
+
+- CPU smoke: 4 real fused update rounds through the CLI emitting a valid
+  telemetry.jsonl (start fingerprint with ``env_backend=jax`` AND
+  ``buffer_backend=device``, ``rollout`` phase attribution, clean-exit summary).
+- Checkpoint durability: the ring snapshots into the host buffer with
+  ``rb._pos``/contents intact, and ``resume_from`` completes to ``total_steps``
+  with the restored ring.
+- AOT (PR 7 style): direct ``jit(...).lower(...)`` of the 1-device fused
+  program asserting donation survives and the steady state carries NO host
+  callbacks/infeeds/outfeeds — the replay path included, which is the device
+  ring's whole point — plus a pin of the ``sac.anakin_step`` registry entry so
+  the ``lint --aot`` sweep can never quietly lose the program.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+
+_SMOKE_BASE = [
+    "dry_run=False",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "checkpoint.save_last=False",
+    "env.num_envs=4",
+    "algo.rollout_steps=16",
+    "algo.run_test=False",
+    "algo.per_rank_batch_size=32",
+    "algo.replay_ratio=0.05",
+    "buffer.size=1024",
+    "metric.telemetry.enabled=true",
+    "metric.telemetry.every=64",
+    "metric.telemetry.compile_warmup_steps=0",
+]
+
+
+def _read_events(path):
+    events = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+@pytest.mark.telemetry
+@pytest.mark.timeout(240)
+def test_sac_anakin_smoke_two_rounds(tmp_path):
+    """4 envs x 16 rollout steps x 4 iterations = 4 real fused update rounds,
+    each with G = round(0.05 * 64) = 3 gradient steps from the device ring."""
+    jsonl = tmp_path / "telemetry.jsonl"
+    run(
+        ["exp=sac_anakin"]
+        + _SMOKE_BASE
+        + [
+            "algo.total_steps=256",
+            f"metric.telemetry.jsonl_path={jsonl}",
+            f"root_dir={tmp_path}/root",
+            "run_name=smoke",
+        ]
+    )
+    events = _read_events(jsonl)
+    kinds = [e["event"] for e in events]
+    assert "start" in kinds and "summary" in kinds and "program" in kinds
+
+    start = next(e for e in events if e["event"] == "start")
+    assert start["fingerprint"]["algo"] == "sac_anakin"
+    assert start["fingerprint"]["env_backend"] == "jax"
+    assert start["fingerprint"]["buffer_backend"] == "device"
+    assert start["fingerprint"]["key_shapes"]["num_envs"] == 4
+
+    summary = next(e for e in events if e["event"] == "summary")
+    assert summary["clean_exit"] is True
+    # telemetry anchors at the first post-iteration step() (host-loop
+    # semantics), so the counted window excludes the first fused iteration
+    assert summary["total_steps"] == 192
+    # >= 2 real update rounds: 3 gradient steps x 3 counted iterations
+    assert summary["train_units"] >= 6
+    phases = summary["phases"]
+    # the fused program's wall time lands in rollout+train, not env/other
+    assert phases["rollout"] > 0
+    assert phases["env"] == 0
+    assert summary["attributed_fraction"] is not None and summary["attributed_fraction"] > 0.7
+
+    windows = [e for e in events if e["event"] == "window"]
+    assert windows, "telemetry windows must be emitted at the configured cadence"
+    assert all("rollout" in w["phases"] for w in windows)
+
+
+@pytest.mark.timeout(240)
+def test_sac_anakin_checkpoint_ring_durability_and_resume(tmp_path):
+    """The checkpoint carries the ring as a host ReplayBuffer snapshot with
+    cursor/contents intact, and resume_from completes to total_steps."""
+    run(
+        ["exp=sac_anakin"]
+        + _SMOKE_BASE
+        + [
+            "metric.telemetry.enabled=false",
+            "algo.total_steps=128",
+            "checkpoint.save_last=True",
+            f"root_dir={tmp_path}/root",
+            "run_name=first",
+        ]
+    )
+    ckpts = []
+    for root, _dirs, files in os.walk(tmp_path):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    assert ckpts, "save_last must leave a checkpoint"
+
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric._setup()
+    state = fabric.load(ckpts[0])
+    rb = state["rb"]
+    # 2 iterations x 16 rollout steps written into a 256-row ring: cursor at 32,
+    # not yet wrapped, contents live
+    assert rb.buffer_size == 256 and rb.n_envs == 4
+    assert rb._pos == 32 and not rb.full
+    assert set(rb.buffer) >= {
+        "observations",
+        "next_observations",
+        "actions",
+        "rewards",
+        "terminated",
+        "truncated",
+    }
+    assert np.abs(rb["observations"][:32]).sum() > 0
+    # the _ckpt_rb durability protocol marks the resume boundary as an episode
+    # end on BOTH done flags
+    assert float(rb["terminated"][31].max()) == 1.0
+    assert float(rb["truncated"][31].max()) == 1.0
+
+    run(
+        ["exp=sac_anakin"]
+        + _SMOKE_BASE
+        + [
+            "metric.telemetry.enabled=false",
+            "algo.total_steps=256",
+            f"checkpoint.resume_from={ckpts[0]}",
+            f"root_dir={tmp_path}/root",
+            "run_name=resumed",
+        ]
+    )
+
+
+def _build_tiny_fused_program():
+    from sheeprl_tpu.algos.sac.anakin import (
+        make_sac_anakin_program,
+        ring_row_specs,
+    )
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.algos.sac.sac import build_optimizers, init_opt_state
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.data.device_ring import ring_capacity, ring_init
+    from sheeprl_tpu.envs.jax import make_jax_env
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    import gymnasium as gym
+
+    cfg = compose(
+        [
+            "exp=sac_anakin",
+            "fabric.accelerator=cpu",
+            "fabric.devices=1",
+            "env.num_envs=4",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=16",
+            "algo.replay_ratio=0.05",
+            "buffer.size=256",
+        ]
+    )
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric._setup()
+    env = make_jax_env(cfg, 4)
+    spec = env.spec
+    obs_space = gym.spaces.Dict({"state": spec.to_gym_obs_space()})
+    actor, critic, params = build_agent(
+        fabric, cfg, obs_space, spec.action.to_gym_space(), jax.random.PRNGKey(0), None
+    )
+    txs = build_optimizers(cfg)
+    opt_state = init_opt_state(txs, params)
+    fused, _, _ = make_sac_anakin_program(actor, critic, env, cfg, fabric, txs, 4, params, opt_state)
+    env_state, obs = jax.jit(env.reset)(jax.random.PRNGKey(1))
+    obs_dim = int(np.prod(spec.obs_shape))
+    act_dim = int(np.prod(spec.action.shape))
+    ring = ring_init(ring_capacity(256, 4), 4, ring_row_specs(obs_dim, act_dim))
+    stats = {
+        "ep_return_sum": jnp.float32(0),
+        "ep_length_sum": jnp.float32(0),
+        "ep_count": jnp.float32(0),
+        "losses": jnp.zeros((3,), jnp.float32),
+    }
+    return fused, (params, opt_state, env_state, obs, ring, jax.random.PRNGKey(2), stats, jnp.asarray(1))
+
+
+@pytest.mark.timeout(300)
+def test_sac_anakin_steady_state_is_transfer_free():
+    """AOT lowering of the fused program: donation aliasing survives for the
+    carried trees (ring included) and the module contains no host
+    callback/infeed/outfeed — zero steady-state host<->device traffic."""
+    fused, args = _build_tiny_fused_program()
+    text = fused.lower(*args).as_text()
+    assert ("jax.buffer_donor" in text) or ("tf.aliasing_output" in text)
+    for marker in ("callback", "infeed", "outfeed"):
+        assert marker not in text
+
+    # the program actually executes and chains across iterations
+    out = fused(*args)
+    out2 = fused(*out[:6], out[6], jnp.asarray(2))
+    losses = np.asarray(out2[6]["losses"])
+    assert np.isfinite(losses).all()
+    assert int(out2[4]["fill"]) == 16  # two 8-step rollouts in the ring
+
+
+def test_sac_anakin_aot_contract_is_registered():
+    """Pin the registry entries so the fused-program sweep (tests/test_analysis/
+    test_aot_contracts.py, ``sheeprl.py lint --aot``) can never quietly lose the
+    off-policy program or the ring subprograms."""
+    from sheeprl_tpu.analysis.programs import FUSED_PROGRAMS, ensure_registry
+
+    ensure_registry()
+    spec = FUSED_PROGRAMS["sac.anakin_step"]
+    assert spec.devices == 8
+    assert spec.contract.donated and spec.contract.min_donated >= 8
+    assert "all-reduce" in spec.contract.expect_collectives
+    assert spec.contract.compile_on_cpu
+    for marker in ("callback", "outfeed", "infeed"):
+        assert marker in spec.contract.forbidden
+
+    write_spec = FUSED_PROGRAMS["replay.ring_write"]
+    assert write_spec.contract.donated and write_spec.contract.min_donated >= 1
+    sample_spec = FUSED_PROGRAMS["replay.ring_sample"]
+    assert not sample_spec.contract.donated

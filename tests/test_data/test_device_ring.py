@@ -1,0 +1,241 @@
+"""Device-resident replay ring unit suite (``data/device_ring.py``):
+
+- pure write path: wraparound overwrite at the carried cursor, fill-count ramp,
+  oversize-block truncation — the host ``ReplayBuffer.add`` semantics, in-jit;
+- pure sample path: uniform coverage over the valid region, exact
+  without-replacement bijectivity on a full ring (the Feistel permutation
+  contract), power-of-two slot-count enforcement;
+- sharded write/sample parity on a 2-device dp mesh (the ring's env axis
+  carries the mesh's data split);
+- donation survives lowering for the write program (the carry aliasing the
+  fused topology and the standalone sampler both rely on);
+- the ``DeviceRingSampler`` behind ``make_replay_sampler(backend="device")``:
+  sampler-surface parity and the snapshot/restore durability bridge
+  (``rb._pos``/``rb._full``/contents round-trip, pickle included).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_ring import (
+    DeviceRingSampler,
+    buffer_to_ring,
+    ring_capacity,
+    ring_init,
+    ring_sample,
+    ring_to_buffer,
+    ring_write,
+)
+from sheeprl_tpu.data.prefetch import make_replay_sampler
+
+_SPECS = {"observations": ((3,), np.float32), "rewards": ((1,), np.float32)}
+
+
+def _rows(start: int, steps: int, n_envs: int):
+    """Rows whose observation values uniquely encode (step, env)."""
+    base = np.arange(start, start + steps, dtype=np.float32)[:, None, None]
+    env = np.arange(n_envs, dtype=np.float32)[None, :, None] / 100.0
+    obs = np.broadcast_to(base + env, (steps, n_envs, 3)).copy()
+    return {
+        "observations": jnp.asarray(obs),
+        "rewards": jnp.asarray(base + env).reshape(steps, n_envs, 1),
+    }
+
+
+def test_ring_capacity_rounds_to_power_of_two_slots():
+    assert ring_capacity(100, 4) * 4 == 128
+    assert ring_capacity(128, 4) == 32
+    assert ring_capacity(1, 8) == 1
+    with pytest.raises(ValueError, match="power-of-two"):
+        ring_capacity(100, 3)
+
+
+def test_ring_init_rejects_non_power_of_two_slots():
+    with pytest.raises(ValueError, match="power-of-two"):
+        ring_init(3, 4, _SPECS)
+
+
+def test_ring_write_wraparound_overwrites_oldest():
+    ring = ring_init(8, 2, _SPECS)
+    ring = ring_write(ring, _rows(0, 5, 2))
+    assert int(ring["pos"]) == 5 and int(ring["fill"]) == 5
+    ring = ring_write(ring, _rows(100, 5, 2))
+    # rows 0-1 overwritten by 103-104; 2-4 still the first block's tail
+    assert int(ring["pos"]) == 2 and int(ring["fill"]) == 8
+    obs = np.asarray(ring["data"]["observations"])[:, 0, 0]
+    np.testing.assert_allclose(obs, [103, 104, 2, 3, 4, 100, 101, 102])
+
+
+def test_ring_write_fill_count_ramps_then_saturates():
+    ring = ring_init(8, 2, _SPECS)
+    fills = []
+    for i in range(5):
+        ring = ring_write(ring, _rows(10 * i, 3, 2))
+        fills.append(int(ring["fill"]))
+    assert fills == [3, 6, 8, 8, 8]
+    assert int(ring["pos"]) == 15 % 8
+
+
+def test_ring_write_oversize_block_keeps_trailing_rows():
+    ring = ring_init(4, 2, _SPECS)
+    ring = ring_write(ring, _rows(0, 7, 2))
+    assert int(ring["fill"]) == 4
+    obs = sorted(np.asarray(ring["data"]["observations"])[:, 0, 0].tolist())
+    assert obs == [3, 4, 5, 6]
+
+
+def test_ring_sample_full_ring_is_a_bijection():
+    """A full-ring draw of exactly `slots` samples hits EVERY stored transition
+    exactly once — uniform without replacement, the Feistel guarantee."""
+    capacity, n_envs = 16, 4
+    ring = ring_init(capacity, n_envs, _SPECS)
+    ring = ring_write(ring, _rows(0, capacity, n_envs))
+    slots = capacity * n_envs
+    out = ring_sample(ring, jax.random.PRNGKey(0), batch_size=slots, n_samples=1)
+    assert out["observations"].shape == (1, slots, 3)
+    sampled = sorted(np.asarray(out["rewards"]).reshape(-1).tolist())
+    stored = sorted(np.asarray(ring["data"]["rewards"]).reshape(-1).tolist())
+    np.testing.assert_allclose(sampled, stored)
+
+
+def test_ring_sample_ramp_draws_only_valid_rows_near_uniformly():
+    capacity, n_envs = 16, 4
+    ring = ring_init(capacity, n_envs, _SPECS)
+    ring = ring_write(ring, _rows(0, 6, n_envs))
+    out = ring_sample(ring, jax.random.PRNGKey(1), batch_size=capacity * n_envs, n_samples=1)
+    vals = np.asarray(out["rewards"]).reshape(-1)
+    stored = np.asarray(ring["data"]["rewards"])[:6].reshape(-1)
+    assert set(np.round(vals, 4).tolist()) <= set(np.round(stored, 4).tolist())
+    # the permutation folds slots onto the valid region with multiplicity
+    # within +-1 of uniform during the ramp
+    _, counts = np.unique(vals, return_counts=True)
+    assert counts.max() - counts.min() <= 1
+
+
+def test_ring_sample_block_shape_and_determinism():
+    ring = ring_init(8, 2, _SPECS)
+    ring = ring_write(ring, _rows(0, 8, 2))
+    a = ring_sample(ring, jax.random.PRNGKey(7), batch_size=4, n_samples=3)
+    b = ring_sample(ring, jax.random.PRNGKey(7), batch_size=4, n_samples=3)
+    assert a["observations"].shape == (3, 4, 3)
+    np.testing.assert_array_equal(np.asarray(a["rewards"]), np.asarray(b["rewards"]))
+    c = ring_sample(ring, jax.random.PRNGKey(8), batch_size=4, n_samples=3)
+    assert not np.array_equal(np.asarray(a["rewards"]), np.asarray(c["rewards"]))
+
+
+def test_ring_sharded_write_sample_parity_two_device_mesh():
+    """Ring ops on a 2-device dp mesh (env axis sharded) produce exactly the
+    single-device results."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+    ring_sharding = NamedSharding(mesh, P(None, "data"))
+    capacity, n_envs = 8, 4
+    rows = _rows(0, 8, n_envs)
+
+    plain = ring_write(ring_init(capacity, n_envs, _SPECS), rows)
+    sharded = ring_write(ring_init(capacity, n_envs, _SPECS, sharding=ring_sharding), rows)
+    for k in _SPECS:
+        np.testing.assert_array_equal(
+            np.asarray(plain["data"][k]), np.asarray(sharded["data"][k])
+        )
+    assert int(sharded["pos"]) == int(plain["pos"]) == 0
+    assert int(sharded["fill"]) == capacity
+
+    a = ring_sample(plain, jax.random.PRNGKey(3), batch_size=8, n_samples=2)
+    b = ring_sample(sharded, jax.random.PRNGKey(3), batch_size=8, n_samples=2)
+    np.testing.assert_allclose(np.asarray(a["observations"]), np.asarray(b["observations"]))
+
+
+def test_ring_write_donation_survives_lowering():
+    """The donated ring carry must survive to the lowered program — the fused
+    topology chains it across iterations and a dropped alias would double the
+    replay plane's memory (the programs.py contract markers)."""
+    ring = ring_init(8, 2, _SPECS)
+    rows = _rows(0, 2, 2)
+    lowered = jax.jit(ring_write, donate_argnums=(0,)).lower(ring, rows)
+    text = lowered.as_text()
+    assert ("jax.buffer_donor" in text) or ("tf.aliasing_output" in text)
+
+
+def test_make_replay_sampler_routes_device_backend():
+    rb = ReplayBuffer(8, 2, obs_keys=("observations",), memmap=False)
+    sampler = make_replay_sampler(
+        rb, {"enabled": True, "depth": 2}, backend="device", sample_kwargs={"batch_size": 4}
+    )
+    assert isinstance(sampler, DeviceRingSampler)
+    assert sampler.is_async is False and sampler.buffer is rb
+    with pytest.raises(RuntimeError, match="add"):
+        sampler.sample(1)
+    sampler.add({k: np.asarray(v) for k, v in _rows(0, 8, 2).items()})
+    out = sampler.sample(2)
+    assert out["observations"].shape == (2, 4, 3)
+    snap = sampler.telemetry_snapshot()
+    assert snap["is_async"] is False and snap["sample_calls"] == 1 and snap["units"] == 2
+    sampler.close()
+
+
+def test_device_sampler_rejects_sample_next_obs_and_transforms():
+    rb = ReplayBuffer(8, 2, obs_keys=("observations",), memmap=False)
+    with pytest.raises(ValueError, match="sample_next_obs"):
+        make_replay_sampler(
+            rb, None, backend="device", sample_kwargs={"batch_size": 4, "sample_next_obs": True}
+        )
+    with pytest.raises(ValueError, match="transform"):
+        make_replay_sampler(
+            rb, None, backend="device", sample_kwargs={"batch_size": 4}, uint8_keys=("rgb",)
+        )
+
+
+def test_snapshot_restore_roundtrip_preserves_pos_and_contents():
+    """ring -> host buffer -> (pickle) -> ring: cursor, fill state and contents
+    all intact — the checkpoint-durability contract."""
+    capacity, n_envs = 8, 2
+    ring = ring_init(capacity, n_envs, _SPECS)
+    ring = ring_write(ring, _rows(0, 5, n_envs))  # partial fill, pos=5
+
+    rb = ring_to_buffer(ring)
+    assert rb._pos == 5 and not rb.full and rb.buffer_size == capacity
+    # the pickle path exercises ReplayBuffer's prefix-truncation protocol
+    rb2 = pickle.loads(pickle.dumps(rb))
+    assert rb2._pos == 5 and not rb2.full
+    restored = buffer_to_ring(rb2)
+    assert int(restored["pos"]) == 5 and int(restored["fill"]) == 5
+    np.testing.assert_array_equal(
+        np.asarray(restored["data"]["observations"])[:5],
+        np.asarray(ring["data"]["observations"])[:5],
+    )
+
+    # wrapped ring: full flag and cursor survive too
+    ring = ring_write(ring, _rows(100, 6, n_envs))  # pos wraps to 3, full
+    rb3 = pickle.loads(pickle.dumps(ring_to_buffer(ring)))
+    assert rb3._pos == 3 and rb3.full
+    restored = buffer_to_ring(rb3)
+    assert int(restored["pos"]) == 3 and int(restored["fill"]) == capacity
+    np.testing.assert_array_equal(
+        np.asarray(restored["data"]["rewards"]), np.asarray(ring["data"]["rewards"])
+    )
+
+
+def test_device_sampler_sync_and_restore_bridge():
+    rb = ReplayBuffer(8, 2, obs_keys=("observations",), memmap=False)
+    sampler = DeviceRingSampler(rb, {"batch_size": 4})
+    sampler.add({k: np.asarray(v) for k, v in _rows(0, 3, 2).items()})
+    out = sampler.sync_to_host()
+    assert out is rb and rb._pos == 3 and not rb.full
+
+    # a fresh sampler over the synced buffer re-lands the ring on device
+    resumed = DeviceRingSampler(rb, {"batch_size": 4})
+    assert resumed.ring is not None
+    assert int(resumed.ring["pos"]) == 3 and int(resumed.ring["fill"]) == 3
+    np.testing.assert_array_equal(
+        np.asarray(resumed.ring["data"]["observations"])[:3],
+        np.asarray(sampler.ring["data"]["observations"])[:3],
+    )

@@ -1,0 +1,48 @@
+"""Fingerprint handling of the replay plane (``buffer.backend``): a device-ring
+run must refuse to bench-diff against a host-replay run in BOTH directions —
+their throughput lives on different scales by construction — while recordings
+from before the field existed stay comparable under the None-tolerant rule
+(mirrors the ``env_backend`` treatment)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.obs.fingerprint import COMPARE_KEYS, fingerprint_compatible, run_fingerprint
+
+
+def _fp(buffer_backend=None):
+    fp = {"algo": "sac_anakin", "env_backend": "jax"}
+    if buffer_backend is not None:
+        fp["buffer_backend"] = buffer_backend
+    return fp
+
+
+def test_buffer_backend_is_a_compare_key():
+    assert "buffer_backend" in COMPARE_KEYS
+
+
+def test_device_vs_local_vetoes_both_directions():
+    a, b = _fp("device"), _fp("local")
+    ok_ab, mis_ab = fingerprint_compatible(a, b)
+    ok_ba, mis_ba = fingerprint_compatible(b, a)
+    assert not ok_ab and "buffer_backend" in mis_ab
+    assert not ok_ba and "buffer_backend" in mis_ba
+
+
+def test_pre_ring_recordings_stay_comparable():
+    # a recording from before the field existed carries no buffer_backend:
+    # never vetoed, in either direction
+    new, old = _fp("device"), _fp()
+    ok, mismatches = fingerprint_compatible(new, old)
+    assert ok and not mismatches
+    ok, mismatches = fingerprint_compatible(old, new)
+    assert ok and not mismatches
+
+
+def test_run_fingerprint_stamps_buffer_backend():
+    fp = run_fingerprint(
+        {"algo": {"name": "sac_anakin"}, "env": {"backend": "jax"}, "buffer": {"backend": "device"}}
+    )
+    assert fp["buffer_backend"] == "device"
+    # absent/None backend resolves to the host default, like env_backend -> host
+    fp = run_fingerprint({"algo": {"name": "sac"}, "env": {}, "buffer": {}})
+    assert fp["buffer_backend"] == "local"

@@ -1,0 +1,36 @@
+"""Unit coverage for the shared Feistel permutation (``utils/prp.py``).
+
+Moved from tests/test_algos/test_anakin.py when ``prp_permutation`` was hoisted
+out of the PPO anakin module so the device replay ring could share it.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.prp import prp_permutation
+
+
+def test_prp_permutation_is_uniformish_bijection():
+    for n in (2, 64, 4096):
+        perm = np.asarray(jax.jit(lambda k, n=n: prp_permutation(k, n))(jax.random.PRNGKey(0)))
+        assert sorted(perm.tolist()) == list(range(n))
+    a = np.asarray(prp_permutation(jax.random.PRNGKey(1), 4096))
+    b = np.asarray(prp_permutation(jax.random.PRNGKey(2), 4096))
+    assert not np.array_equal(a, b)
+    # deterministic per key
+    c = np.asarray(prp_permutation(jax.random.PRNGKey(1), 4096))
+    np.testing.assert_array_equal(a, c)
+    # mixes: essentially uncorrelated with the identity order
+    assert abs(np.corrcoef(a, np.arange(4096))[0, 1]) < 0.1
+    with pytest.raises(ValueError, match="power-of-two"):
+        prp_permutation(jax.random.PRNGKey(0), 100)
+
+
+def test_prp_permutation_reexported_from_anakin():
+    """The historical import site keeps working after the hoist."""
+    from sheeprl_tpu.algos.ppo import anakin
+
+    assert anakin.prp_permutation is prp_permutation
