@@ -84,6 +84,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import sys
 import tempfile
 import time
@@ -252,13 +253,28 @@ def _steady_window_run(args: list, steady_start: int) -> dict:
     """One training run with the BenchWindow active; returns its {steps, seconds}
     plus the run's final telemetry summary event under "telemetry" (the loops
     stream sps/compile/prefetch/memory gauges to a JSONL sink — see
-    howto/observability.md — so the bench reads them back without re-measuring)."""
+    howto/observability.md — so the bench reads them back without re-measuring).
+
+    SHEEPRL_BENCH_PROFILE=1 additionally opens a jax.profiler window over the
+    steady region and attaches its op-category attribution (obs/xprof.py
+    ``profile_analysis``) under "profile" — the per-workload answer to WHERE the
+    steady device time goes (comm/mxu/copy/idle shares, per-program roofline)."""
     from sheeprl_tpu.cli import run
 
     with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
         steady_file = f.name
     with tempfile.NamedTemporaryFile(suffix=".telemetry.jsonl", delete=False) as f:
         telemetry_file = f.name
+    profile_dir = None
+    profile_args = []
+    if os.environ.get("SHEEPRL_BENCH_PROFILE") not in (None, "", "0"):
+        profile_dir = tempfile.mkdtemp(suffix=".bench-profile")
+        profile_args = [
+            "metric.profiler.mode=window",
+            f"metric.profiler.start_step={steady_start}",
+            "metric.profiler.num_steps=0",  # one loop iteration past the warmup
+            f"metric.profiler.dir={profile_dir}",
+        ]
     os.environ["SHEEPRL_BENCH_STEADY_FILE"] = steady_file
     os.environ["SHEEPRL_BENCH_STEADY_START"] = str(steady_start)
     try:
@@ -268,6 +284,7 @@ def _steady_window_run(args: list, steady_start: int) -> dict:
                 "metric.telemetry.enabled=true",
                 f"metric.telemetry.jsonl_path={telemetry_file}",
             ]
+            + profile_args
         )
         with open(steady_file) as f:
             steady = json.load(f)
@@ -292,6 +309,15 @@ def _steady_window_run(args: list, steady_start: int) -> dict:
             starts = [e for e in events if e.get("event") == "start"]
             if starts and starts[-1].get("fingerprint"):
                 steady["fingerprint"] = starts[-1]["fingerprint"]
+            # the in-loop capture attribution (SHEEPRL_BENCH_PROFILE=1): the
+            # fractions are already unit-tiled device-time shares, ready for
+            # fraction-unit bench-diff gating
+            profiles = [e for e in events if e.get("event") == "profile_analysis"]
+            if profiles:
+                steady["profile"] = {
+                    k: profiles[-1].get(k)
+                    for k in ("device_seconds", "categories", "programs")
+                }
             # run the diagnosis detectors over the run's stream so BENCH JSONs
             # are regression-gateable on CAUSES (recompile storm, starved
             # pipeline, checkpoint-heavy windows), not just on env-steps/sec
@@ -314,6 +340,8 @@ def _steady_window_run(args: list, steady_start: int) -> dict:
                 os.unlink(p)
             except OSError:
                 pass
+        if profile_dir is not None:
+            shutil.rmtree(profile_dir, ignore_errors=True)
 
 
 def _prefetch_ab_enabled(algo: str) -> bool:
@@ -363,6 +391,9 @@ def _steady_ab_result(
         # returns — obs/telemetry.py learning summary): BENCH JSONs gate on
         # whether the run LEARNS, not just how fast it steps
         conditions["learning"] = steady["learning"]
+    if "profile" in steady:
+        # the steady window's op-category attribution (SHEEPRL_BENCH_PROFILE=1)
+        conditions["profile"] = steady["profile"]
     result = {
         "metric": metric,
         "value": round(sps, 2),
@@ -631,7 +662,7 @@ def _bench_ppo_anakin() -> dict:
             else probe["platform"]
         ),
     }
-    for key in ("telemetry", "fingerprint", "diagnosis", "learning"):
+    for key in ("telemetry", "fingerprint", "diagnosis", "learning", "profile"):
         if key in steady:
             conditions[key] = steady[key]
     result = {
@@ -729,7 +760,7 @@ def _bench_sac_anakin() -> dict:
             else probe["platform"]
         ),
     }
-    for key in ("telemetry", "fingerprint", "diagnosis", "learning"):
+    for key in ("telemetry", "fingerprint", "diagnosis", "learning", "profile"):
         if key in steady:
             conditions[key] = steady[key]
     result = {

@@ -5,6 +5,10 @@ Also hosts the offline/observability tooling (howto/observability.md):
 
 - ``python sheeprl.py diagnose <run_dir>`` — merge a run's telemetry.jsonl
   stream(s) and print a rule-based bottleneck report;
+- ``python sheeprl.py profile <run_dir>`` — op-level attribution of the run's
+  ``jax.profiler`` window capture(s): comm/mxu/copy/idle shares of device
+  time, achieved FLOP/s + roofline position per registered fused program
+  (``profile.json``, ``--fail-on`` gate);
 - ``python sheeprl.py watch <run_dir>`` — live terminal monitor that follows
   the stream(s) of a running (or about-to-start) run and exits with its status;
 - ``python sheeprl.py compare <run_a> <run_b>`` — fingerprint-aware cross-run
@@ -83,6 +87,7 @@ from sheeprl_tpu.cli import (  # noqa: E402
     fault_matrix,
     fleet,
     lint,
+    profile,
     run,
     serve,
     trace,
@@ -91,6 +96,7 @@ from sheeprl_tpu.cli import (  # noqa: E402
 
 _SUBCOMMANDS = {
     "diagnose": diagnose,
+    "profile": profile,
     "watch": watch,
     "compare": compare,
     "bench-diff": bench_diff,

@@ -492,8 +492,11 @@ def run_sac_anakin(fabric, cfg: Dict[str, Any]):
         params, opt_state, env_state, obs, ring, key, stats, learn = anakin_step(
             params, opt_state, env_state, obs, ring, key, stats, jnp.asarray(iter_num)
         )
-        # keep the live ring reachable for the checkpoint snapshot path
+        # keep the live ring reachable for the checkpoint snapshot path, and
+        # account the fused program's in-program writes (this topology bypasses
+        # sampler.add, so the Buffer/ring_* overwrite gauge is fed here)
         sampler.ring = ring
+        sampler.note_writes(int(cfg.algo.rollout_steps))
         # one scalar sync per ITERATION (T * num_envs env steps): keeps the host
         # from racing the device queue and makes the wall-time split honest
         jax.block_until_ready(stats["losses"])

@@ -535,6 +535,19 @@ def diagnose(args: Optional[Sequence[str]] = None) -> int:
     return diagnose_main(list(args if args is not None else sys.argv[1:]))
 
 
+def profile(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py profile <run_dir>`` — parse the run's
+    ``jax.profiler`` window capture(s) (``metric.profiler.mode=window``) into
+    op-category attribution (comm/mxu/elementwise/copy/loop/host/idle shares of
+    device time), achieved FLOP/s + roofline position per registered fused
+    program, writing machine-readable ``profile.json`` next to the streams.
+    ``--fail-on warning|critical`` gates on the comm_bound/copy_bound/host_gap
+    detectors. See ``howto/observability.md`` ("Profiling a fused program")."""
+    from sheeprl_tpu.obs.xprof import main as profile_main
+
+    return profile_main(list(args if args is not None else sys.argv[1:]))
+
+
 def fault_matrix(args: Optional[Sequence[str]] = None) -> int:
     """``python sheeprl.py fault-matrix`` — run the resilience fault matrix on
     the CPU mesh: every ``resilience``-marked smoke (single-process preempt /

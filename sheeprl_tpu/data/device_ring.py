@@ -241,6 +241,7 @@ class DeviceRingSampler:
         self._tele_wait_seconds = 0.0
         self._tele_sample_calls = 0
         self._tele_units = 0
+        self._tele_rows_written = 0
         if not rb.empty:
             # a restored (resume_from) buffer re-lands on the mesh immediately
             self.ring = buffer_to_ring(rb, sharding=sharding)
@@ -258,7 +259,16 @@ class DeviceRingSampler:
             n_envs = int(first.shape[1])
             specs = {k: (tuple(v.shape[2:]), v.dtype) for k, v in rows.items()}
             self.ring = ring_init(self._rb.buffer_size, n_envs, specs, sharding=self._sharding)
+        self.note_writes(int(next(iter(rows.values())).shape[0]))
         self.ring = self._write(self.ring, rows)
+
+    def note_writes(self, steps: int) -> None:
+        """Account ``steps`` ring rows written. ``add`` self-accounts; the fused
+        topologies that bypass it (``sac_anakin`` carries the ring through its
+        own donated program and rebinds :attr:`ring`) call this once per
+        iteration so the overwrite gauge stays honest — pure host bookkeeping,
+        no device sync."""
+        self._tele_rows_written += max(int(steps), 0)
 
     def sample(self, n_samples: int) -> Dict[str, Any]:
         import jax
@@ -278,8 +288,13 @@ class DeviceRingSampler:
 
     def telemetry_snapshot(self) -> Dict[str, Any]:
         """Same schema as the host samplers' — the sync-path semantics apply
-        (the consumer blocks for the full sample dispatch)."""
-        return {
+        (the consumer blocks for the full sample dispatch) — plus the ring
+        storage gauges: ``ring_fill``/``ring_capacity`` (occupancy in rows) and
+        the cumulative ``ring_overwritten`` slot count (rows written past
+        capacity × envs — experience lost to wraparound). Reading ``fill``
+        costs one device sync; this runs at telemetry-window cadence, not on
+        the hot path."""
+        snap = {
             "is_async": False,
             "wait_seconds": self._tele_wait_seconds,
             "sample_calls": self._tele_sample_calls,
@@ -289,7 +304,17 @@ class DeviceRingSampler:
             "empty_waits": 0,
             "pipeline_len": 0,
             "depth": 0,
+            "ring_fill": 0,
+            "ring_capacity": 0,
+            "ring_overwritten": 0,
         }
+        if self.ring is not None:
+            ref = next(iter(self.ring["data"].values()))
+            capacity, n_envs = int(ref.shape[0]), int(ref.shape[1])
+            snap["ring_fill"] = int(self.ring["fill"])
+            snap["ring_capacity"] = capacity
+            snap["ring_overwritten"] = max(self._tele_rows_written - capacity, 0) * n_envs
+        return snap
 
     def close(self) -> None:
         pass

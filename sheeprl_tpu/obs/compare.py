@@ -39,6 +39,8 @@ _SEVERITY_RANK = {"critical": 0, "warning": 1, "info": 2}
 REL_FLOOR = 0.02  # ignore sub-2% relative deltas even when beyond noise
 CRITICAL_DROP = 0.25  # a ≥25% throughput/MFU drop escalates to critical
 PHASE_SHIFT_ABS = 0.05  # a phase must grow ≥5 points of wall share to flag
+XLA_SHIFT_ABS = 0.05  # an op category must grow ≥5 points of device time to flag
+XLA_SHIFT_CRITICAL = 0.20  # ...and ≥20 points escalates to critical
 MEMORY_GROWTH = 0.10  # ≥10% peak-memory growth flags
 COMPILE_STORM_DELTA = 3  # ≥3 extra compiles escalates to critical
 DEFAULT_BENCH_THRESHOLD = 0.05  # bench-diff per-metric relative threshold
@@ -270,6 +272,25 @@ def profile_run(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
             "queue_depth": _dist([_f(d.get("queue_depth")) for d in learner if d.get("queue_depth") is not None]),
         }
 
+    # execution-profile attribution (profile_analysis events — obs/xprof.py):
+    # per-category device-time-share distributions across the run's window
+    # captures, so a comm/copy/idle regression between commits gates like an
+    # sps regression. None on runs that never captured a window.
+    prof_events = [
+        e
+        for e in events
+        if e.get("event") == "profile_analysis" and isinstance(e.get("categories"), dict)
+    ]
+    xla = None
+    if prof_events:
+        keys = sorted({k for e in prof_events for k in e["categories"]})
+        xla = {
+            "captures": len(prof_events),
+            "categories": {
+                k: _dist([_f(e["categories"].get(k)) for e in prof_events]) for k in keys
+            },
+        }
+
     # env restarts: the counter is a per-ATTEMPT running total (each restart
     # attempt's telemetry starts back at 0), so take the max within each attempt
     # and sum across attempts — max over the whole stream would under-report
@@ -298,6 +319,7 @@ def profile_run(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         "rss_peak_bytes": int(rss_peak) or None,
         "env_restarts": env_restarts,
         "dataflow": dataflow,
+        "xla": xla,
         # training-health curves (windows carrying a `learning` block): the
         # sample-efficiency half of the comparison — None on old/serving runs
         "learning": _profile_learning(events),
@@ -412,6 +434,35 @@ def compare_profiles(
                     **{k: dm[k] for k in ("delta", "noise")},
                 )
             )
+
+    # execution-profile category shifts (profile_analysis events): a cost
+    # category (comm/copy/idle/host/loop) whose device-time share grew
+    # materially beyond the captures' own spread gates like an sps regression;
+    # the compute categories (mxu/elementwise) growing is work, not waste
+    xla_a = (profile_a.get("xla") or {}).get("categories") or {}
+    xla_b = (profile_b.get("xla") or {}).get("categories") or {}
+    if xla_a and xla_b:
+        metrics["xla"] = {}
+        for category in sorted(set(xla_a) | set(xla_b)):
+            dm = _delta_metric(xla_a.get(category), xla_b.get(category))
+            metrics["xla"][category] = dm
+            if dm is None or category in ("mxu", "elementwise"):
+                continue
+            if dm["beyond_noise"] and dm["delta"] >= XLA_SHIFT_ABS:
+                findings.append(
+                    _finding(
+                        "xla_category_shift",
+                        "critical" if dm["delta"] >= XLA_SHIFT_CRITICAL else "warning",
+                        f"the `{category}` share of captured device time grew from "
+                        f"{dm['a']['median']:.1%} to {dm['b']['median']:.1%} — "
+                        "beyond both runs' capture spread",
+                        "`sheeprl.py profile` run B for the per-program attribution "
+                        "(the comm_bound/copy_bound/host_gap detectors name the worst "
+                        "program and the knob)",
+                        category=category,
+                        **{k: dm[k] for k in ("delta", "noise")},
+                    )
+                )
 
     # compile totals: any extra steady compiles are shape churn, not noise
     ca, cb = profile_a.get("compile") or {}, profile_b.get("compile") or {}

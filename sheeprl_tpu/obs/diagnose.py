@@ -45,13 +45,21 @@ kl_balance_drift    (learning, dreamer) KL collapsed/exploded or the posterior/
                     prior entropy balance drifted (posterior collapse signal)
 reward_plateau      (learning) episode returns rose, then flattened for the
                     rest of the run (advisory — sample-efficiency signal)
+comm_bound          (profile) collectives dominate the window capture's device
+                    time (``profile_analysis`` events — obs/xprof.py)
+copy_bound          (profile) copy/layout ops dominate the capture's device time
+host_gap            (profile) the device sat idle / fed by host transfers for a
+                    large share of the capture (fused calls gapped by the host)
 ==================  ============================================================
 
 The three serving detectors read the ``serve`` block of a serving run's
 windows (``sheeprl_tpu/serve/telemetry.py``); the three experience-plane
 detectors read the ``dataflow`` block (``data/service.py`` lineage,
 ``buffer.backend=service`` runs). Training streams without those blocks carry
-none of either, so all six are free no-ops there.
+none of either, so all six are free no-ops there. The three profile detectors
+read ``profile_analysis`` events (emitted when a ``metric.profiler.mode=window``
+capture completes, or synthesized by ``sheeprl.py profile``) — runs that never
+captured a window carry none, so they too are structural no-ops.
 """
 
 from __future__ import annotations
@@ -121,6 +129,14 @@ KL_EXPLOSION_RATIO = 10.0  # late-half KL vs early-half (dynamics divergence)
 REWARD_PLATEAU_MIN_WINDOWS = 8  # windows with episode stats before judging
 REWARD_PLATEAU_EPS = 0.05  # late improvement below this fraction of the climb
 REWARD_PLATEAU_MIN_CLIMB = 0.2  # climb must exceed this fraction of max(|peak|, 1)
+# execution-profile (profile_analysis events — obs/xprof.py) detectors
+PROFILE_MIN_DEVICE_SECONDS = 1e-4  # ignore empty/degenerate captures
+PROFILE_COMM_WARNING = 0.25  # comm share of the capture's device time
+PROFILE_COMM_CRITICAL = 0.50
+PROFILE_COPY_WARNING = 0.30  # copy/layout share of device time
+PROFILE_COPY_CRITICAL = 0.60
+PROFILE_HOST_GAP_WARNING = 0.40  # idle + host-transfer share of device time
+PROFILE_HOST_GAP_CRITICAL = 0.70
 
 
 def _ref(event: Dict[str, Any]) -> Dict[str, Any]:
@@ -1432,6 +1448,109 @@ def detect_reward_plateau(events: Events) -> List[Finding]:
     ]
 
 
+def _profile_events(events: Events) -> List[Dict[str, Any]]:
+    """``profile_analysis`` events carrying a usable fractions dict (emitted
+    in-loop when a window capture completes, or synthesized by the ``profile``
+    verb from on-disk captures). Runs that never captured carry none — the
+    three profile detectors below are structural no-ops there."""
+    return [
+        e
+        for e in events
+        if e.get("event") == "profile_analysis"
+        and isinstance(e.get("categories"), dict)
+        and _f(e.get("device_seconds")) >= PROFILE_MIN_DEVICE_SECONDS
+    ]
+
+
+def _worst_profile(events: Events, fraction_of: Callable[[Dict[str, Any]], float]):
+    profiles = _profile_events(events)
+    if not profiles:
+        return None, 0.0
+    worst = max(profiles, key=fraction_of)
+    return worst, fraction_of(worst)
+
+
+def _top_comm_program(profile: Dict[str, Any]) -> str:
+    programs = profile.get("programs") or {}
+    ranked = sorted(
+        ((name, _f(p.get("comm_fraction"))) for name, p in programs.items()),
+        key=lambda kv: -kv[1],
+    )
+    if ranked and ranked[0][1] > 0:
+        return f" (worst program: {ranked[0][0]} at {ranked[0][1]:.0%} comm)"
+    return ""
+
+
+def detect_comm_bound(events: Events) -> List[Finding]:
+    """Collectives dominate a window capture's device time: the program is
+    scaling-bound, not chip-bound — more chips would make it *worse*."""
+    worst, frac = _worst_profile(events, lambda e: _f(e["categories"].get("comm")))
+    if worst is None or frac < PROFILE_COMM_WARNING:
+        return []
+    severity = "critical" if frac >= PROFILE_COMM_CRITICAL else "warning"
+    return [
+        _finding(
+            "comm_bound",
+            severity,
+            f"collective communication is {frac:.0%} of the capture's device time"
+            + _top_comm_program(worst),
+            [worst],
+            "shrink the synced surface (donate + keep state device-resident), "
+            "overlap collectives with compute, or rebalance the mesh axes; "
+            "`sheeprl.py profile` lists the per-program comm shares",
+            comm_fraction=round(frac, 4),
+            capture=worst.get("capture"),
+        )
+    ]
+
+
+def detect_copy_bound(events: Events) -> List[Finding]:
+    """Copy/layout ops dominate the capture: the program moves data instead of
+    computing — usually a layout mismatch or host-visible staging."""
+    worst, frac = _worst_profile(events, lambda e: _f(e["categories"].get("copy")))
+    if worst is None or frac < PROFILE_COPY_WARNING:
+        return []
+    severity = "critical" if frac >= PROFILE_COPY_CRITICAL else "warning"
+    return [
+        _finding(
+            "copy_bound",
+            severity,
+            f"copy/layout ops are {frac:.0%} of the capture's device time",
+            [worst],
+            "look for layout changes at program boundaries (transposes feeding "
+            "donated carries), host-staged batches, or gather/scatter-heavy "
+            "indexing that a reshape of the storage would remove",
+            copy_fraction=round(frac, 4),
+            capture=worst.get("capture"),
+        )
+    ]
+
+
+def detect_host_gap(events: Events) -> List[Finding]:
+    """The device sat idle (or fed by infeed/outfeed) for a large share of the
+    capture: the fused calls are gapped by host work between dispatches."""
+    worst, frac = _worst_profile(
+        events,
+        lambda e: _f(e["categories"].get("idle")) + _f(e["categories"].get("host")),
+    )
+    if worst is None or frac < PROFILE_HOST_GAP_WARNING:
+        return []
+    severity = "critical" if frac >= PROFILE_HOST_GAP_CRITICAL else "warning"
+    return [
+        _finding(
+            "host_gap",
+            severity,
+            f"the device was idle or host-fed for {frac:.0%} of the capture",
+            [worst],
+            "move the loop's host round trips onto the device (fused rollout, "
+            "buffer.backend=device), raise the per-dispatch work "
+            "(algo.rollout_steps / scan length), or prefetch the host inputs",
+            gap_fraction=round(frac, 4),
+            capture=worst.get("capture"),
+        )
+    ]
+
+
 DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "recompile_storm": detect_recompile_storm,
     "prefetch_starvation": detect_prefetch_starvation,
@@ -1457,6 +1576,9 @@ DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "update_ratio_anomaly": detect_update_ratio_anomaly,
     "kl_balance_drift": detect_kl_balance_drift,
     "reward_plateau": detect_reward_plateau,
+    "comm_bound": detect_comm_bound,
+    "copy_bound": detect_copy_bound,
+    "host_gap": detect_host_gap,
 }
 
 

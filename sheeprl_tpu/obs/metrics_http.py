@@ -9,7 +9,13 @@ member board — at ``GET /metrics`` in Prometheus text-exposition format
 place with a stock Prometheus/Grafana stack. There is deliberately NO second
 bookkeeping path: the telemetry window emit pushes the same numbers it writes
 to ``telemetry.jsonl`` into the endpoint's gauge map, and the endpoint only
-renders that map on scrape.
+renders that map on scrape. That single push point is how new gauge families
+arrive for free — e.g. the device-ring storage gauges
+(``Buffer/ring_fill``/``ring_occupancy``/``ring_overwritten``,
+howto/device_replay.md) and the window-capture attribution gauges
+(``Perf/xla_comm_fraction``/``xla_mxu_fraction``/``xla_idle_fraction``,
+howto/observability.md "Profiling a fused program") are scrapeable on any run
+that produces them, with no endpoint change.
 
 Off (the default ``http_port: null``) constructs nothing: no socket, no
 thread, no artifact. ``http_port: 0`` binds an ephemeral port (tests read it

@@ -167,9 +167,22 @@ _SERVICE: Dict[str, Tuple[tuple, bool]] = {
 
 # resilience / fleet lifecycle events: payloads are fault/topology specific by
 # design; only their discriminators are pinned
+# op-level attribution of one completed profiler window capture (obs/xprof.py):
+# category fractions (comm/mxu/elementwise/copy/loop/host/idle, tiling to 1.0)
+# plus per-registered-program roofline verdicts
+_PROFILE_ANALYSIS: Dict[str, Tuple[tuple, bool]] = {
+    "step": (_INT, False),
+    "capture": (_STR, False),
+    "device_seconds": (_NUM, True),
+    "busy_seconds": (_NUM, False),
+    "categories": (_DICT, True),
+    "programs": (_DICT, False),
+}
+
 _OPEN_EVENTS: Dict[str, Dict[str, Tuple[tuple, bool]]] = {
     "health": _HEALTH,
     "program": _PROGRAM,
+    "profile_analysis": _PROFILE_ANALYSIS,
     "service": _SERVICE,
     "preempt": {},
     "preempt_exit": {},

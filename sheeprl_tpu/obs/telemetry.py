@@ -278,11 +278,24 @@ class RunTelemetry:
         self._logger = logger
         self._log_dir = log_dir
 
+        # stream identity: rank = the writing process's launch-topology position
+        # (role streams override it), attempt = supervisor restart counter
+        self._rank = int(rank if rank is not None else getattr(fabric, "global_rank", 0) or 0)
+        self._attempt = int(tcfg.get("attempt") or 0)
+
         pcfg = dict(profiler_cfg or resolve_profiler_config(metric_cfg))
-        dump_dir = pcfg.get("dir") or (os.path.join(log_dir, "profiler") if log_dir else "profiler")
+        base_dump = pcfg.get("dir") or (os.path.join(log_dir, "profiler") if log_dir else "profiler")
+        # attempt-scoped capture dir: a supervised restart must never collide
+        # with (or overwrite) a prior attempt's capture. The resolved path is
+        # written back into pcfg so the start event records where the captures
+        # actually land, and the profiler stop event repeats it — `profile`
+        # enumerates captures from the stream alone.
+        dump_dir = os.path.join(base_dump, f"attempt_{self._attempt}")
+        pcfg["dir"] = dump_dir
         self.profiler = ProfilerWindow(
             pcfg.get("mode", "off"), pcfg.get("start_step", 0), pcfg.get("num_steps", 0), dump_dir
         )
+        self._last_profile: Optional[Dict[str, Any]] = None
 
         self.every = int(tcfg.get("every") or metric_cfg.get("log_every") or 5000)
         self.health_every = max(1, int(tcfg.get("health_every") or 1))
@@ -291,11 +304,6 @@ class RunTelemetry:
         self._program_analysis = bool(tcfg.get("program_analysis", True))
         self.diagnosis = bool(tcfg.get("diagnosis", True))
         self.learning = bool(tcfg.get("learning", True))
-
-        # stream identity: rank = the writing process's launch-topology position
-        # (role streams override it), attempt = supervisor restart counter
-        self._rank = int(rank if rank is not None else getattr(fabric, "global_rank", 0) or 0)
-        self._attempt = int(tcfg.get("attempt") or 0)
 
         self._sink: Optional[JsonlEventSink] = None
         if self.enabled and bool(tcfg.get("jsonl", True)):
@@ -602,8 +610,10 @@ class RunTelemetry:
                     "profiler",
                     step=policy_step,
                     action="stop",
+                    dir=self.profiler.dump_dir,
                     covered_steps=self.profiler.stopped_at - self.profiler.started_at,
                 )
+                self._emit_profile_analysis(policy_step)
         if not self.enabled:
             return
         if self._anchor_step is None:
@@ -644,10 +654,12 @@ class RunTelemetry:
                 "profiler",
                 step=policy_step,
                 action="stop",
+                dir=self.profiler.dump_dir,
                 covered_steps=(self.profiler.stopped_at or self.profiler.started_at)
                 - self.profiler.started_at,
                 truncated=True,
             )
+            self._emit_profile_analysis(policy_step)
         if not self.enabled:
             return
         if (
@@ -781,6 +793,31 @@ class RunTelemetry:
             )
         self._last_diagnosis_key = key
 
+    def _emit_profile_analysis(self, policy_step: Optional[int]) -> None:
+        """Parse the window capture the profiler just finalized and emit the
+        schema-registered ``profile_analysis`` event (obs/xprof.py). The
+        fractions are cached so the next window's ``Perf/xla_*`` gauges carry
+        them to TB + the Prometheus endpoint. Parsing a capture must never take
+        the run down — any failure leaves the raw capture for the offline
+        ``sheeprl.py profile`` verb."""
+        if self._sink is None:
+            return
+        try:
+            from sheeprl_tpu.obs.xprof import analyze_capture, profile_event_payload
+
+            analysis = analyze_capture(
+                self.profiler.dump_dir,
+                self._programs,
+                peak_flops=self._peak_flops,
+                device_kind=getattr(self._device, "device_kind", None),
+            )
+        except Exception:
+            return
+        if analysis is None:
+            return
+        self._last_profile = analysis
+        self._sink.emit("profile_analysis", step=policy_step, **profile_event_payload(analysis))
+
     def _prefetch_delta(self) -> Optional[Dict[str, Any]]:
         if self._sampler is None:
             return None
@@ -795,7 +832,7 @@ class RunTelemetry:
             self._prefetch_total[k] = self._prefetch_total.get(k, 0.0) + v
         calls = max(delta["sample_calls"], 1.0)
         units = max(delta["units"], 1.0)
-        return {
+        out = {
             "wait_seconds": delta["wait_seconds"],
             "sample_calls": int(delta["sample_calls"]),
             "units": int(delta["units"]),
@@ -806,6 +843,17 @@ class RunTelemetry:
             "depth": int(snap.get("depth", 0)),
             "is_async": bool(snap.get("is_async", False)),
         }
+        # device-ring storage gauges (DeviceRingSampler.telemetry_snapshot):
+        # occupancy = fill/capacity, overwritten = slots lost to wraparound
+        if snap.get("ring_capacity"):
+            capacity = float(snap["ring_capacity"])
+            out["ring"] = {
+                "fill": int(snap.get("ring_fill", 0)),
+                "capacity": int(capacity),
+                "occupancy": float(snap.get("ring_fill", 0)) / max(capacity, 1.0),
+                "overwritten": int(snap.get("ring_overwritten", 0)),
+            }
+        return out
 
     def _dataflow_snapshot(self) -> Optional[Dict[str, Any]]:
         if self._dataflow is None:
@@ -1084,6 +1132,18 @@ class RunTelemetry:
             gauges["Time/prefetch_wait"] = float(prefetch["wait_seconds"])
             gauges["Buffer/pipeline_occupancy"] = float(prefetch["occupancy"])
             gauges["Buffer/pipeline_staleness"] = float(prefetch["staleness"])
+            ring = prefetch.get("ring")
+            if ring is not None:
+                gauges["Buffer/ring_fill"] = float(ring["fill"])
+                gauges["Buffer/ring_occupancy"] = float(ring["occupancy"])
+                gauges["Buffer/ring_overwritten"] = float(ring["overwritten"])
+        if self._last_profile is not None:
+            # the latest window capture's attribution (obs/xprof.py): fractions
+            # of device time, so TB/Prometheus trend them across captures
+            fractions = self._last_profile.get("fractions") or {}
+            gauges["Perf/xla_comm_fraction"] = float(fractions.get("comm", 0.0))
+            gauges["Perf/xla_mxu_fraction"] = float(fractions.get("mxu", 0.0))
+            gauges["Perf/xla_idle_fraction"] = float(fractions.get("idle", 0.0))
         if self._env_restarts > 0:
             gauges["Health/env_restarts"] = float(self._env_restarts)
         gauges.update(self._dataflow_gauges(dataflow))

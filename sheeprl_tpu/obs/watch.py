@@ -92,6 +92,8 @@ class WatchState:
         self.draining = False
         self.last_reload: Optional[Dict[str, Any]] = None
         self.summary: Optional[Dict[str, Any]] = None  # primary-stream summary
+        # latest window-capture attribution (obs/xprof.py profile_analysis)
+        self.profile: Optional[Dict[str, Any]] = None
         self.gave_up = False
         self.events_seen = 0
         # experience-plane dataflow state by role (buffer.backend=service runs):
@@ -165,6 +167,8 @@ class WatchState:
                         continue
                     if not str(self.ranks.get(rank, "")).startswith("DEAD"):
                         self.ranks[rank] = "exited 0" if code == 0 else f"EXITED {code}"
+            elif kind == "profile_analysis":
+                self.profile = event
             elif kind == "giveup":
                 self.gave_up = True
             elif kind == "summary" and _is_primary(event):
@@ -290,6 +294,16 @@ class WatchState:
                 if prefetch.get("is_async")
                 else ""
             )
+            ring = prefetch.get("ring") or {}
+            if ring.get("capacity"):
+                # device-ring storage (buffer.backend=device): fill/capacity
+                # plus slots already lost to wraparound
+                pipe += (
+                    f"   ring {float(ring.get('occupancy') or 0.0):.0%}"
+                    f" of {int(ring['capacity'])} rows"
+                )
+                if ring.get("overwritten"):
+                    pipe += f" ({int(ring['overwritten'])} overwritten)"
             compile_ = w.get("compile") or {}
             lines.append(
                 f"  step {w.get('step')}   {w.get('sps', 0.0):.1f} sps   "
@@ -297,6 +311,17 @@ class WatchState:
                 + f"{mem}   compiles {compile_.get('count', 0)}"
                 + pipe
             )
+            if self.profile is not None:
+                # the window capture's op-category attribution, once a
+                # profile_analysis event has landed (metric.profiler.mode=window)
+                cats = self.profile.get("categories") or {}
+                shares = "  ".join(
+                    f"{c} {float(f):.0%}"
+                    for c, f in cats.items()
+                    if isinstance(f, (int, float)) and f >= 0.005
+                )
+                if shares:
+                    lines.append(f"  xla   {shares}")
             serve = w.get("serve")
             if isinstance(serve, dict):
                 # a SERVING run's window (sheeprl_tpu/serve): sessions + latency

@@ -1,0 +1,546 @@
+"""Op-level attribution over ``jax.profiler`` window captures: the consumer
+that turns "this run is slow" into "this program spends 31% of device time in
+all-gathers".
+
+``metric.profiler.mode=window`` (PR 2) makes every run able to dump a bounded
+steady-state ``jax.profiler`` capture — but until now nothing in the repo ever
+*parsed* one; reading it meant manual Perfetto spelunking. This module parses
+the trace-event JSON the capture contains (both the CPU and TPU backends write
+``<dump_dir>/plugins/profile/<ts>/<host>.trace.json.gz``) into per-device op
+timelines and attributes the time three ways:
+
+- **categories** — every device op (trace events carrying ``args.hlo_op``) is
+  classified by its HLO opcode into ``comm`` (collectives), ``mxu``
+  (dot/convolution — the MXU class), ``elementwise`` (fusions, reductions,
+  math), ``copy`` (layout/data movement), ``loop`` (while/call/tuple plumbing)
+  or ``host`` (infeed/outfeed), plus the computed ``idle`` gaps between ops on
+  each device track. Categories + idle tile the capture's device time exactly
+  (the acceptance invariant), so the fractions are comparable across runs.
+- **programs** — ops carry ``args.hlo_module`` = ``jit_<fn name>``, and the
+  PR 13 program registry names its fused programs after the jitted python
+  function (``anakin_step``, ``sac_anakin_step``, ``train_step``), so module
+  time joins against the registry's cost-model analysis (``program`` events:
+  flops / bytes_accessed per call) to give achieved FLOP/s and arithmetic
+  intensity per registered program.
+- **roofline** — achieved intensity against the chip ridge point
+  (``peak_flops / hbm_bytes_per_s``, both from public spec sheets keyed by
+  ``device_kind`` like :mod:`sheeprl_tpu.utils.mfu`) labels each program
+  compute-bound or memory-bound; a dominant comm share labels it comm-bound
+  regardless (scaling, not the chip, is the wall). Off-TPU there is no honest
+  ridge, so the label falls back to the category mix and the achieved numbers
+  stand alone.
+
+Consumers: ``python sheeprl.py profile <run_dir>`` (this module's ``main``)
+writes ``profile.json`` + a human report and gates with ``--fail-on`` exactly
+like ``diagnose``; ``RunTelemetry`` calls :func:`analyze_capture` in-loop when
+a window capture completes and emits the schema-registered
+``profile_analysis`` event (fractions feed the ``Perf/xla_*`` gauges, the
+``comm_bound`` / ``copy_bound`` / ``host_gap`` detectors, ``compare``'s
+profile-category deltas and ``bench.py``'s ``SHEEPRL_BENCH_PROFILE=1``
+attachments). See ``howto/observability.md`` ("Profiling a fused program").
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+__all__ = [
+    "CATEGORIES",
+    "analyze_capture",
+    "analyze_run",
+    "classify_op",
+    "find_captures",
+    "format_report",
+    "hbm_bytes_per_s",
+    "load_trace_events",
+    "main",
+    "profile_event_payload",
+]
+
+# op-time categories; "idle" (computed per device track, not classified) rides
+# along in every fractions dict so the shares tile to 1.0 by construction
+CATEGORIES = ("comm", "mxu", "elementwise", "copy", "loop", "host")
+IDLE = "idle"
+
+# HBM bandwidth (bytes/s per chip, public spec sheets), keyed by lowercase
+# substrings of Device.device_kind — the memory roofline to mfu._TPU_PEAK_BF16's
+# compute roofline. Ridge intensity = peak_flops / hbm_bytes_per_s.
+_TPU_HBM_BYTES_PER_S: Dict[str, float] = {
+    "v2": 700e9,
+    "v3": 900e9,
+    "v4": 1228e9,
+    "v5 lite": 819e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v6 lite": 1640e9,
+    "v6e": 1640e9,
+}
+
+# a registered program whose own comm share reaches this is comm-bound before
+# any roofline question even applies (mirrors diagnose.PROFILE_COMM_WARNING)
+PROGRAM_COMM_BOUND = 0.25
+
+_TRAILING_ID = re.compile(r"\.\d+$")
+
+_COMM_PREFIXES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective",
+    "send",
+    "recv",
+    "partition-id",
+    "replica-id",
+)
+_MXU_PREFIXES = ("dot", "conv", "cholesky", "triangular-solve")
+_COPY_PREFIXES = (
+    "copy",
+    "transpose",
+    "bitcast",
+    "reshape",
+    "broadcast",
+    "concatenate",
+    "slice",
+    "dynamic-slice",
+    "dynamic-update-slice",
+    "pad",
+    "gather",
+    "scatter",
+    "reverse",
+)
+_LOOP_PREFIXES = (
+    "while",
+    "condition",
+    "body",
+    "call",
+    "conditional",
+    "tuple",
+    "get-tuple-element",
+    "parameter",
+    "constant",
+)
+_HOST_PREFIXES = ("infeed", "outfeed", "host")
+
+
+def classify_op(name: str) -> str:
+    """HLO opcode → category. Names come in as HLO instruction names
+    (``all-reduce.3``, ``dot.6``, ``loop_fusion.12``): the trailing ``.<id>``
+    is stripped and the base matched by opcode prefix, comm first (a
+    ``reduce-scatter`` must not fall into the generic-reduce bucket).
+    Everything unmatched — fusions, reductions, pointwise math — is the
+    ``elementwise`` default."""
+    base = _TRAILING_ID.sub("", str(name).strip().lower())
+    if base.startswith(_COMM_PREFIXES):
+        return "comm"
+    if base.startswith(_MXU_PREFIXES) or "gemm" in base or "conv" in base:
+        return "mxu"
+    if base.startswith(_COPY_PREFIXES):
+        return "copy"
+    if base.startswith(_LOOP_PREFIXES):
+        return "loop"
+    if base.startswith(_HOST_PREFIXES):
+        return "host"
+    return "elementwise"
+
+
+def hbm_bytes_per_s(device_kind: Optional[str]) -> Optional[float]:
+    """HBM bandwidth for a device kind, or None when unknown (host CPU)."""
+    kind = (device_kind or "").lower()
+    for tag, bw in sorted(_TPU_HBM_BYTES_PER_S.items(), key=lambda kv: -len(kv[0])):
+        if tag in kind:
+            return bw
+    return None
+
+
+# ---------------------------------------------------------------------------------
+# capture discovery + trace parsing
+# ---------------------------------------------------------------------------------
+def _trace_files(capture_dir: str) -> List[str]:
+    files: List[str] = []
+    for pattern in ("*.trace.json.gz", "*.trace.json"):
+        files.extend(glob.glob(os.path.join(capture_dir, pattern)))
+    return sorted(files)
+
+
+def find_captures(root: str) -> List[str]:
+    """Every capture (one ``plugins/profile/<timestamp>`` dir holding trace
+    files) under ``root``. ``root`` may be a run dir, a profiler dump dir, or a
+    timestamp dir itself."""
+    root = str(root)
+    if not os.path.isdir(root):
+        return []
+    if _trace_files(root):
+        return [root]
+    candidates = glob.glob(os.path.join(root, "plugins", "profile", "*")) + glob.glob(
+        os.path.join(root, "**", "plugins", "profile", "*"), recursive=True
+    )
+    seen: Dict[str, None] = {}
+    for cand in sorted(candidates):
+        real = os.path.realpath(cand)
+        if real not in seen and os.path.isdir(cand) and _trace_files(cand):
+            seen[real] = None
+    return list(seen)
+
+
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    """Parse one ``*.trace.json(.gz)`` file into its raw trace-event list."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:  # type: ignore[operator]
+        payload = json.load(fh)
+    events = payload.get("traceEvents") if isinstance(payload, Mapping) else None
+    return [e for e in (events or []) if isinstance(e, dict)]
+
+
+def _union_seconds(intervals: List[Tuple[float, float]]) -> float:
+    """Total covered length of a set of (start, end) intervals."""
+    total = 0.0
+    end = -float("inf")
+    for lo, hi in sorted(intervals):
+        if hi <= end:
+            continue
+        total += hi - max(lo, end)
+        end = hi
+    return total
+
+
+# ---------------------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------------------
+def analyze_capture(
+    capture: str,
+    programs: Optional[Mapping[str, Mapping[str, Any]]] = None,
+    *,
+    peak_flops: Optional[float] = None,
+    device_kind: Optional[str] = None,
+) -> Optional[Dict[str, Any]]:
+    """Attribute one capture's device time. ``programs`` is the registry join
+    input (``{name: {flops, bytes_accessed, units, ...}}`` — the ``program``
+    telemetry events). Returns None when the capture holds no device op events
+    (an empty or foreign trace) — the callers treat that as "no capture"."""
+    captures = find_captures(capture)
+    if not captures:
+        return None
+    capture_dir = captures[-1]  # latest timestamp dir when given an ancestor
+    op_events: List[Dict[str, Any]] = []
+    trace_files = _trace_files(capture_dir)
+    for path in trace_files:
+        try:
+            raw = load_trace_events(path)
+        except (OSError, ValueError):
+            continue
+        for ev in raw:
+            args = ev.get("args")
+            if (
+                ev.get("ph") == "X"
+                and isinstance(args, Mapping)
+                and args.get("hlo_op")
+                and ev.get("dur") is not None
+            ):
+                op_events.append(ev)
+    if not op_events:
+        return None
+
+    categories = {c: 0.0 for c in CATEGORIES}
+    tracks: Dict[Any, List[Tuple[float, float]]] = {}
+    modules: Dict[str, Dict[str, Any]] = {}
+    for ev in op_events:
+        dur = max(float(ev.get("dur") or 0.0), 0.0) / 1e6  # trace events are µs
+        ts = float(ev.get("ts") or 0.0) / 1e6
+        op = str(ev["args"]["hlo_op"])
+        category = classify_op(op)
+        categories[category] += dur
+        tracks.setdefault(ev.get("pid"), []).append((ts, ts + dur))
+        module = str(ev["args"].get("hlo_module") or "")
+        mod = modules.setdefault(
+            module,
+            {"seconds": 0.0, "categories": {c: 0.0 for c in CATEGORIES}, "op_counts": {}},
+        )
+        mod["seconds"] += dur
+        mod["categories"][category] += dur
+        mod["op_counts"][op] = mod["op_counts"].get(op, 0) + 1
+
+    # idle = per-device-track span minus the union of its op intervals: the gaps
+    # between fused calls where the device sat waiting on the host. busy + idle
+    # is the capture's total device time, so categories + idle tile it exactly.
+    idle = 0.0
+    for intervals in tracks.values():
+        span = max(hi for _, hi in intervals) - min(lo for lo, _ in intervals)
+        idle += max(span - _union_seconds(intervals), 0.0)
+    busy = sum(categories.values())
+    total = busy + idle
+    if total <= 0:
+        return None
+    fractions = {c: categories[c] / total for c in CATEGORIES}
+    fractions[IDLE] = idle / total
+
+    bandwidth = hbm_bytes_per_s(device_kind)
+    ridge = (peak_flops / bandwidth) if (peak_flops and bandwidth) else None
+    programs = programs or {}
+    prog_out: Dict[str, Dict[str, Any]] = {}
+    for module, mod in sorted(modules.items(), key=lambda kv: -kv[1]["seconds"]):
+        if mod["seconds"] <= 0:
+            continue
+        name = module[len("jit_") :] if module.startswith("jit_") else module
+        # every call executes each HLO instruction once, so the per-module call
+        # count is the max multiplicity of any single op in the module
+        calls = max(mod["op_counts"].values())
+        comm_fraction = mod["categories"]["comm"] / mod["seconds"]
+        entry: Dict[str, Any] = {
+            "module": module,
+            "device_seconds": round(mod["seconds"], 6),
+            "fraction": round(mod["seconds"] / total, 4),
+            "calls": int(calls),
+            "comm_fraction": round(comm_fraction, 4),
+            "categories": {
+                c: round(s, 6) for c, s in mod["categories"].items() if s > 0
+            },
+        }
+        info = programs.get(name) or {}
+        flops = info.get("flops")
+        bytes_accessed = info.get("bytes_accessed")
+        intensity = None
+        if flops:
+            entry["flops_per_call"] = float(flops)
+            entry["achieved_flops_per_s"] = float(flops) * calls / mod["seconds"]
+            if peak_flops:
+                entry["achieved_peak_fraction"] = round(
+                    entry["achieved_flops_per_s"] / peak_flops, 4
+                )
+            if bytes_accessed:
+                intensity = float(flops) / float(bytes_accessed)
+                entry["arithmetic_intensity"] = round(intensity, 3)
+        if comm_fraction >= PROGRAM_COMM_BOUND:
+            entry["bound"] = "comm"
+        elif intensity is not None and ridge is not None:
+            entry["bound"] = "compute" if intensity >= ridge else "memory"
+        else:
+            # no honest ridge (CPU, or no cost model): fall back to the mix
+            copy = mod["categories"]["copy"]
+            compute = mod["categories"]["mxu"] + mod["categories"]["elementwise"]
+            entry["bound"] = "memory" if copy > compute else ("compute" if compute > 0 else None)
+        prog_out[name] = entry
+
+    return {
+        "capture": capture_dir,
+        "trace_files": [os.path.basename(p) for p in trace_files],
+        "devices": len(tracks),
+        "op_count": len(op_events),
+        "device_seconds": round(total, 6),
+        "busy_seconds": round(busy, 6),
+        "idle_seconds": round(idle, 6),
+        "categories": {c: round(s, 6) for c, s in categories.items()},
+        "fractions": {c: round(f, 4) for c, f in fractions.items()},
+        "programs": prog_out,
+        "peak_flops": peak_flops,
+        "hbm_bytes_per_s": bandwidth,
+        "ridge_intensity": round(ridge, 3) if ridge else None,
+    }
+
+
+def profile_event_payload(analysis: Mapping[str, Any]) -> Dict[str, Any]:
+    """The ``profile_analysis`` telemetry-event projection of one capture
+    analysis: the fractions and the per-program verdicts, without the raw
+    per-category second tables (the stream stays compact; ``profile.json``
+    keeps the full analysis)."""
+    programs = {
+        name: {
+            k: p.get(k)
+            for k in (
+                "fraction",
+                "calls",
+                "comm_fraction",
+                "achieved_flops_per_s",
+                "arithmetic_intensity",
+                "bound",
+            )
+            if p.get(k) is not None
+        }
+        for name, p in (analysis.get("programs") or {}).items()
+    }
+    return {
+        "capture": analysis.get("capture"),
+        "device_seconds": analysis.get("device_seconds"),
+        "busy_seconds": analysis.get("busy_seconds"),
+        "categories": dict(analysis.get("fractions") or {}),
+        "programs": programs,
+    }
+
+
+# ---------------------------------------------------------------------------------
+# run-level analysis (the `profile` verb)
+# ---------------------------------------------------------------------------------
+def _stream_context(run_dir: str) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """(merged events, capture dirs recorded in the profiler events). A run dir
+    without any telemetry stream still profiles — captures are then discovered
+    by globbing — so both halves tolerate absence."""
+    try:
+        from sheeprl_tpu.obs.streams import merged_events
+
+        events = merged_events(run_dir)
+    except (FileNotFoundError, OSError):
+        events = []
+    dirs: List[str] = []
+    for ev in events:
+        if ev.get("event") == "profiler" and ev.get("dir"):
+            path = str(ev["dir"])
+            if path not in dirs:
+                dirs.append(path)
+    return events, dirs
+
+
+def analyze_run(run_dir: str, json_path: Optional[str] = None) -> Dict[str, Any]:
+    """Profile every capture of a run: enumerate captures from the telemetry
+    stream's ``profiler`` events (satellite: the events record their capture
+    dir) with a recursive glob fallback, join against the stream's ``program``
+    registry + ``start`` device facts, and write ``profile.json``. Raises
+    FileNotFoundError when the run holds no parseable capture."""
+    events, recorded_dirs = _stream_context(run_dir)
+    base = run_dir if os.path.isdir(run_dir) else os.path.dirname(run_dir)
+
+    captures: Dict[str, None] = {}
+    for recorded in recorded_dirs:
+        for cap in find_captures(recorded):
+            captures.setdefault(os.path.realpath(cap))
+    for cap in find_captures(base or "."):
+        captures.setdefault(os.path.realpath(cap))
+
+    programs = {
+        str(e["name"]): e
+        for e in events
+        if e.get("event") == "program" and e.get("name") and not e.get("error")
+    }
+    start = next((e for e in events if e.get("event") == "start"), {})
+    peak = start.get("peak_flops")
+    device_kind = start.get("device_kind")
+
+    analyses = [
+        a
+        for cap in captures
+        if (a := analyze_capture(cap, programs, peak_flops=peak, device_kind=device_kind))
+    ]
+    if not analyses:
+        raise FileNotFoundError(
+            f"no parseable profiler capture found under {run_dir!r} — run with "
+            "metric.profiler.mode=window to produce one"
+        )
+
+    # aggregate: capture-duration-weighted category fractions across captures
+    total = sum(a["device_seconds"] for a in analyses)
+    agg = {
+        c: round(
+            sum(a["categories"].get(c, 0.0) for a in analyses) / total if total else 0.0, 4
+        )
+        for c in CATEGORIES
+    }
+    agg[IDLE] = round(sum(a["idle_seconds"] for a in analyses) / total if total else 0.0, 4)
+
+    # findings come from the SAME detectors diagnose runs in-loop, over the
+    # event payloads these captures would have emitted — one threshold catalog
+    from sheeprl_tpu.obs.diagnose import run_detectors
+
+    pseudo = [
+        {"event": "profile_analysis", "seq": i, **profile_event_payload(a)}
+        for i, a in enumerate(analyses)
+    ]
+    findings = run_detectors(pseudo, detectors=("comm_bound", "copy_bound", "host_gap"))
+
+    result: Dict[str, Any] = {
+        "run_dir": str(run_dir),
+        "captures": analyses,
+        "device_seconds": round(total, 6),
+        "categories": agg,
+        "findings": findings,
+    }
+    out = json_path or os.path.join(base or ".", "profile.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    result["json_path"] = out
+    return result
+
+
+def format_report(result: Mapping[str, Any]) -> str:
+    """Human report: category shares, per-program roofline verdicts, findings."""
+    lines = [f"XLA execution profile — {result.get('run_dir', '<capture>')}"]
+    analyses = result.get("captures") or []
+    lines.append(
+        f"  captures: {len(analyses)}, "
+        f"{result.get('device_seconds', 0.0):.4f}s device time"
+    )
+    shares = ", ".join(
+        f"{c} {f:.1%}" for c, f in (result.get("categories") or {}).items() if f > 0
+    )
+    lines.append(f"  op time : {shares}")
+    for analysis in analyses:
+        lines.append(f"  [{analysis['capture']}]")
+        for name, prog in (analysis.get("programs") or {}).items():
+            bits = [f"{prog['fraction']:.1%} of device time", f"{prog['calls']} call(s)"]
+            if prog.get("achieved_flops_per_s"):
+                bits.append(f"{prog['achieved_flops_per_s'] / 1e9:.2f} GFLOP/s")
+            if prog.get("arithmetic_intensity") is not None:
+                bits.append(f"intensity {prog['arithmetic_intensity']:.1f} FLOP/B")
+            if prog.get("bound"):
+                bits.append(f"{prog['bound']}-bound")
+            lines.append(f"    {name}: " + ", ".join(bits))
+    findings = result.get("findings") or []
+    if not findings:
+        lines.append("  verdict : no findings — the capture looks healthy")
+        return "\n".join(lines)
+    lines.append(f"  verdict : {len(findings)} finding(s)")
+    for f in findings:
+        lines.append("")
+        lines.append(f"[{f['severity'].upper()}] {f['detector']}")
+        lines.append(f"  {f['summary']}")
+        lines.append(f"  try: {f['suggestion']}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py profile <run_dir>`` entry: print the report, write
+    ``profile.json``, exit 0 (or 1 with ``--fail-on`` when findings reach the
+    given severity, or 2 when the run holds no capture)."""
+    import argparse
+
+    from sheeprl_tpu.obs.diagnose import _SEVERITY_RANK
+
+    parser = argparse.ArgumentParser(
+        prog="sheeprl.py profile",
+        description="Attribute a run's jax.profiler window capture(s): op-category "
+        "shares, achieved FLOP/s + roofline position per registered program.",
+    )
+    parser.add_argument(
+        "run_dir", help="run directory (searched recursively) or a profiler capture dir"
+    )
+    parser.add_argument("--json", dest="json_path", default=None, help="where to write profile.json")
+    parser.add_argument("--quiet", action="store_true", help="suppress the human report")
+    parser.add_argument(
+        "--fail-on",
+        choices=("warning", "critical"),
+        default=None,
+        help="exit 1 when any finding is at least this severe",
+    )
+    args = parser.parse_args(list(argv) if argv is not None else sys.argv[1:])
+    try:
+        result = analyze_run(args.run_dir, json_path=args.json_path)
+    except FileNotFoundError as exc:
+        print(f"profile: {exc}", file=sys.stderr)
+        return 2
+    if not args.quiet:
+        print(format_report(result))
+        print(f"\nwrote {result['json_path']}")
+    if args.fail_on:
+        gate = _SEVERITY_RANK[args.fail_on]
+        if any(_SEVERITY_RANK.get(f["severity"], 3) <= gate for f in result["findings"]):
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -224,6 +224,45 @@ def test_snapshot_restore_roundtrip_preserves_pos_and_contents():
     )
 
 
+def test_device_sampler_snapshot_reports_ring_storage_gauges():
+    """``telemetry_snapshot`` carries ring fill/capacity and the cumulative
+    overwritten-slot count (rows written past capacity × envs) — the
+    ``Buffer/ring_*`` gauges and the watch pipeline line feed off these."""
+    rb = ReplayBuffer(8, 2, obs_keys=("observations",), memmap=False)
+    sampler = DeviceRingSampler(rb, {"batch_size": 4})
+    snap = sampler.telemetry_snapshot()
+    # no ring yet: zeros, not crashes
+    assert snap["ring_fill"] == 0 and snap["ring_capacity"] == 0
+    assert snap["ring_overwritten"] == 0
+
+    sampler.add({k: np.asarray(v) for k, v in _rows(0, 5, 2).items()})
+    snap = sampler.telemetry_snapshot()
+    assert snap["ring_fill"] == 5 and snap["ring_capacity"] == 8
+    assert snap["ring_overwritten"] == 0
+
+    # write past capacity: 5 + 6 = 11 rows into 8 -> 3 rows x 2 envs lost
+    sampler.add({k: np.asarray(v) for k, v in _rows(100, 6, 2).items()})
+    snap = sampler.telemetry_snapshot()
+    assert snap["ring_fill"] == 8 and snap["ring_capacity"] == 8
+    assert snap["ring_overwritten"] == 6
+
+
+def test_device_sampler_note_writes_accounts_fused_bypass_path():
+    """The fused sac_anakin loop bypasses ``add`` (it carries the ring through
+    its own donated program and rebinds ``sampler.ring``); ``note_writes``
+    keeps the overwrite gauge honest on that path."""
+    rb = ReplayBuffer(4, 2, obs_keys=("observations",), memmap=False)
+    sampler = DeviceRingSampler(rb, {"batch_size": 4})
+    sampler.ring = ring_write(ring_init(4, 2, _SPECS), _rows(0, 4, 2))
+    for _ in range(3):
+        sampler.note_writes(4)
+    snap = sampler.telemetry_snapshot()
+    assert snap["ring_fill"] == 4 and snap["ring_capacity"] == 4
+    assert snap["ring_overwritten"] == (12 - 4) * 2
+    sampler.note_writes(-5)  # defensive: never decrements
+    assert sampler.telemetry_snapshot()["ring_overwritten"] == 16
+
+
 def test_device_sampler_sync_and_restore_bridge():
     rb = ReplayBuffer(8, 2, obs_keys=("observations",), memmap=False)
     sampler = DeviceRingSampler(rb, {"batch_size": 4})

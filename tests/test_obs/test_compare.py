@@ -395,6 +395,9 @@ def test_lower_is_better_unit_directions():
         "bytes/device (DV3 params, [2,4] data x model mesh)",
         # failure-share metrics: shedding MORE of the same load regresses UP
         "fraction (sessions shed / offered, 3x overload burst)",
+        # xla attribution shares (obs/xprof.py): more comm/idle is always worse
+        "fraction of device time (xla comm)",
+        "fraction of device time (xla idle)",
     ):
         assert _lower_is_better(unit), unit
     for unit in (
@@ -502,3 +505,72 @@ def test_profile_and_compare_dataflow_regression():
     assert "dataflow_regression" not in _names(result["findings"])
     # runs without an experience plane profile dataflow=None and stay quiet
     assert profile_run(merged_events(_RUN_A))["dataflow"] is None
+
+
+# ---------------------------------------------------------------------------------
+# execution-profile (xla) category shifts
+# ---------------------------------------------------------------------------------
+def _xla_stream(comm, idle=0.05, captures=3, jitter=0.002):
+    """A stream whose window captures attribute `comm` of device time to
+    collectives (profile_analysis events — obs/xprof.py payloads)."""
+    events = []
+    for i in range(captures):
+        c = comm + jitter * (i - captures // 2)
+        events.append(
+            {
+                "event": "profile_analysis",
+                "seq": i,
+                "step": 64 * (i + 1),
+                "device_seconds": 0.5,
+                "categories": {
+                    "comm": c,
+                    "mxu": 0.75 - c - idle,
+                    "elementwise": 0.1,
+                    "copy": 0.1,
+                    "loop": 0.0,
+                    "host": 0.0,
+                    "idle": idle,
+                },
+            }
+        )
+    return events
+
+
+def test_profile_run_distills_xla_capture_distributions():
+    profile = profile_run(_xla_stream(0.10, captures=4))
+    assert profile["xla"]["captures"] == 4
+    comm = profile["xla"]["categories"]["comm"]
+    assert comm["n"] == 4 and comm["median"] == pytest.approx(0.10, abs=0.01)
+    # runs that never captured a window profile xla=None and stay quiet
+    assert profile_run(merged_events(_RUN_A))["xla"] is None
+    result = compare_profiles(profile_run(merged_events(_RUN_A)), profile)
+    assert "xla_category_shift" not in _names(result["findings"])
+
+
+def test_compare_flags_xla_category_shift_like_an_sps_regression():
+    fresh = profile_run(_xla_stream(0.05))
+    # same attribution: quiet
+    result = compare_profiles(fresh, profile_run(_xla_stream(0.05)))
+    assert "xla_category_shift" not in _names(result["findings"])
+    # comm grew 5 -> 15 points beyond the captures' spread: warning
+    result = compare_profiles(fresh, profile_run(_xla_stream(0.15)))
+    (f,) = _by(result["findings"], "xla_category_shift")
+    assert f["severity"] == "warning" and f["metrics"]["category"] == "comm"
+    # comm grew 5 -> 30 points (>= 20-point critical threshold): critical
+    result = compare_profiles(fresh, profile_run(_xla_stream(0.30)))
+    (f,) = _by(result["findings"], "xla_category_shift")
+    assert f["severity"] == "critical"
+    # the reverse direction (B leaner than A) never flags
+    result = compare_profiles(profile_run(_xla_stream(0.30)), fresh)
+    assert "xla_category_shift" not in _names(result["findings"])
+
+
+def test_xla_compute_category_growth_is_not_flagged():
+    """mxu/elementwise growing is WORK, not waste — only the cost categories
+    (comm/copy/idle/host/loop) gate."""
+    fresh = profile_run(_xla_stream(0.30))  # mxu = 0.40
+    lean = profile_run(_xla_stream(0.05))  # mxu = 0.65: +25 points of mxu
+    result = compare_profiles(fresh, lean)
+    assert "xla_category_shift" not in _names(result["findings"])
+    # the per-category deltas are still reported for both directions
+    assert result["metrics"]["xla"]["mxu"]["delta"] == pytest.approx(0.25, abs=0.01)

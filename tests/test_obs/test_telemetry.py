@@ -605,3 +605,73 @@ def test_telemetry_collects_local_mesh_devices():
         assert len(t._devices) == 4
     finally:
         t.close(0)
+
+
+def test_window_ring_gauges_ride_the_prefetch_block(tmp_path):
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+    from sheeprl_tpu.data.device_ring import DeviceRingSampler
+
+    logger = FakeLogger()
+    cfg = _cfg(telemetry={"enabled": True}, log_every=10)
+    t = build_telemetry(FakeFabric(), cfg, str(tmp_path), logger=logger)
+    rb = ReplayBuffer(8, 2, obs_keys=("observations",), memmap=False)
+    sampler = DeviceRingSampler(rb, {"batch_size": 2})
+    rows = {
+        "observations": np.ones((12, 2, 3), dtype=np.float32),
+        "rewards": np.ones((12, 2, 1), dtype=np.float32),
+    }
+    t.attach_sampler(sampler)
+    t.step(0)
+    sampler.add(rows)  # 12 rows into 8: 4 x 2 envs overwritten
+    t.step(10)
+    t.close(10)
+    window = [e for e in read_events(str(tmp_path / "telemetry.jsonl")) if e["event"] == "window"][0]
+    ring = window["prefetch"]["ring"]
+    assert ring["fill"] == 8 and ring["capacity"] == 8
+    assert ring["occupancy"] == pytest.approx(1.0)
+    assert ring["overwritten"] == 8
+    gauges = dict(logger.metrics[-1][1])
+    assert gauges["Buffer/ring_fill"] == 8.0
+    assert gauges["Buffer/ring_occupancy"] == pytest.approx(1.0)
+    assert gauges["Buffer/ring_overwritten"] == 8.0
+
+
+def test_profiler_capture_dir_is_attempt_scoped(tmp_path):
+    """Satellite: a supervised restart's capture must never collide with a
+    prior attempt's — the dump dir is attempt-suffixed and the profiler events
+    record the resolved path."""
+    cfg = _cfg(
+        telemetry={"enabled": True, "attempt": 2},
+        profiler={"mode": "window", "start_step": 0, "num_steps": 4, "dir": str(tmp_path / "prof")},
+        log_every=100,
+    )
+    t = build_telemetry(FakeFabric(), cfg, str(tmp_path))
+    assert t.profiler.dump_dir == str(tmp_path / "prof" / "attempt_2")
+    t.step(0)
+    t.step(4)
+    t.close(4)
+    events = read_events(str(tmp_path / "telemetry.jsonl"))
+    start = next(e for e in events if e["event"] == "start")
+    assert start["profiler"]["dir"].endswith("attempt_2")
+    prof = [e for e in events if e["event"] == "profiler"]
+    assert prof and all(e["dir"].endswith("attempt_2") for e in prof)
+    from sheeprl_tpu.obs.schema import validate_events
+
+    assert validate_events(events) == []
+
+
+def test_window_xla_gauges_after_a_profile_analysis(tmp_path):
+    logger = FakeLogger()
+    cfg = _cfg(telemetry={"enabled": True}, log_every=10)
+    t = build_telemetry(FakeFabric(), cfg, str(tmp_path), logger=logger)
+    t.step(0)
+    # no capture yet: the xla gauges stay absent (no bogus zeros on TB)
+    t.step(10)
+    assert "Perf/xla_comm_fraction" not in dict(logger.metrics[-1][1])
+    t._last_profile = {"fractions": {"comm": 0.31, "mxu": 0.5, "idle": 0.05}}
+    t.step(20)
+    gauges = dict(logger.metrics[-1][1])
+    assert gauges["Perf/xla_comm_fraction"] == pytest.approx(0.31)
+    assert gauges["Perf/xla_mxu_fraction"] == pytest.approx(0.5)
+    assert gauges["Perf/xla_idle_fraction"] == pytest.approx(0.05)
+    t.close(20)

@@ -343,3 +343,49 @@ def test_fleet_watch_shows_per_member_staleness():
     b_line = next(l for l in frame.splitlines() if l.strip().startswith("[b]"))
     assert "lag 4" in a_line and "row age 3.0s" in a_line
     assert "lag" not in b_line  # plain members contribute no staleness bits
+
+
+def test_ring_storage_renders_on_the_pipeline_line():
+    state = WatchState()
+    w = _window(100)
+    w["prefetch"] = {
+        "occupancy": 1.8,
+        "staleness": 1.1,
+        "is_async": False,
+        "ring": {"fill": 384, "capacity": 512, "occupancy": 0.75, "overwritten": 0},
+    }
+    state.consume([_event("start", 1.0), w])
+    frame = state.render("run", 12.0, ["telemetry.jsonl"])
+    assert "ring 75% of 512 rows" in frame
+    assert "overwritten" not in frame  # nothing lost yet
+    w2 = _window(200)
+    w2["prefetch"] = {
+        "is_async": False,
+        "ring": {"fill": 512, "capacity": 512, "occupancy": 1.0, "overwritten": 2048},
+    }
+    state.consume([w2])
+    frame = state.render("run", 13.0, ["telemetry.jsonl"])
+    assert "ring 100% of 512 rows (2048 overwritten)" in frame
+
+
+def test_xla_attribution_line_renders_after_a_window_capture():
+    state = WatchState()
+    state.consume([_event("start", 1.0), _window(100)])
+    assert "xla" not in state.render("run", 12.0, ["telemetry.jsonl"])
+    state.consume(
+        [
+            _event(
+                "profile_analysis",
+                2.0,
+                step=100,
+                device_seconds=0.5,
+                categories={"comm": 0.31, "mxu": 0.5, "elementwise": 0.14,
+                            "copy": 0.001, "loop": 0.0, "host": 0.0, "idle": 0.05},
+            ),
+            _window(200),
+        ]
+    )
+    frame = state.render("run", 13.0, ["telemetry.jsonl"])
+    assert "xla" in frame and "comm 31%" in frame and "mxu 50%" in frame
+    # sub-0.5% shares stay off the line
+    assert "copy" not in frame and "loop" not in frame

@@ -1,0 +1,310 @@
+"""XLA execution-profile plane (``obs/xprof.py`` + ``sheeprl.py profile``):
+
+- opcode classifier + HBM-bandwidth/ridge units;
+- attribution on a REAL recorded ``jax.profiler`` capture
+  (``tests/data/recorded_capture``: 4 calls of a jitted matmul+tanh step on the
+  CPU backend) — categories + idle tile the device time, the program join
+  recovers the call count and achieved FLOP/s;
+- the synthetic comm-heavy capture (``tests/data/comm_heavy_capture``) trips
+  ``comm_bound`` and gates ``profile --fail-on warning`` with exit 1;
+- the profile detectors (``comm_bound``/``copy_bound``/``host_gap``) are
+  structural no-ops without captures;
+- CPU e2e smoke (``profile`` marker): a real ppo_anakin run with
+  ``metric.profiler.mode=window`` → ``sheeprl.py profile`` exits 0 and the
+  written ``profile.json`` attributes ≈100% of device time with achieved
+  FLOP/s for the registered fused program.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.obs.xprof import (
+    CATEGORIES,
+    analyze_capture,
+    analyze_run,
+    classify_op,
+    find_captures,
+    hbm_bytes_per_s,
+    main,
+    profile_event_payload,
+)
+
+pytestmark = pytest.mark.profile
+
+_DATA = os.path.join(os.path.dirname(__file__), "..", "data")
+_RECORDED = os.path.join(_DATA, "recorded_capture")
+_COMM_HEAVY = os.path.join(_DATA, "comm_heavy_capture")
+
+# the recorded fixture's jitted step: y = tanh(x @ w); (y @ w.T).sum() with
+# x, w of shape (256, 256) — two matmuls per call, traced for 4 calls
+_TRAIN_STEP_FLOPS = 2 * (2 * 256**3)
+_TRAIN_STEP_PROGRAMS = {
+    "train_step": {"flops": _TRAIN_STEP_FLOPS, "bytes_accessed": 3 * 256 * 256 * 4}
+}
+
+
+# ---------------------------------------------------------------------------------
+# classifier + roofline units
+# ---------------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "op, category",
+    [
+        ("all-reduce.3", "comm"),
+        ("all-gather.12", "comm"),
+        ("reduce-scatter.1", "comm"),
+        ("collective-permute.7", "comm"),
+        ("dot.6", "mxu"),
+        ("dot_general.2", "mxu"),
+        ("convolution.4", "mxu"),
+        ("cublas-gemm.1", "mxu"),
+        ("copy.9", "copy"),
+        ("transpose.2", "copy"),
+        ("dynamic-update-slice.5", "copy"),
+        ("while.1", "loop"),
+        ("get-tuple-element.44", "loop"),
+        ("parameter.0", "loop"),
+        ("infeed.1", "host"),
+        ("outfeed.2", "host"),
+        ("loop_fusion.12", "elementwise"),
+        ("fusion.3", "elementwise"),
+        ("reduce.8", "elementwise"),
+        ("tanh.1", "elementwise"),
+    ],
+)
+def test_classify_op(op, category):
+    assert classify_op(op) == category
+
+
+def test_classify_op_comm_wins_over_generic_reduce():
+    # "reduce-scatter" must not fall into the elementwise reduce bucket
+    assert classify_op("reduce-scatter.2") == "comm"
+    assert classify_op("reduce.2") == "elementwise"
+
+
+def test_hbm_bandwidth_longest_tag_wins_and_cpu_is_none():
+    assert hbm_bytes_per_s("TPU v4") == 1228e9
+    # "v5 lite" must match its own entry, not the bare "v5p"/"v5e" tags
+    assert hbm_bytes_per_s("TPU v5 lite") == 819e9
+    assert hbm_bytes_per_s("TPU v5p") == 2765e9
+    assert hbm_bytes_per_s("cpu") is None
+    assert hbm_bytes_per_s(None) is None
+
+
+# ---------------------------------------------------------------------------------
+# capture discovery
+# ---------------------------------------------------------------------------------
+def test_find_captures_direct_and_nested(tmp_path):
+    assert find_captures(str(tmp_path / "missing")) == []
+    assert find_captures(str(tmp_path)) == []
+    # a timestamp dir holding trace files is itself the capture
+    assert find_captures(_RECORDED) == [_RECORDED]
+    # nested run-dir layout: <run>/profiler/attempt_0/plugins/profile/<ts>/
+    ts_dir = tmp_path / "profiler" / "attempt_0" / "plugins" / "profile" / "2026_01_01"
+    ts_dir.mkdir(parents=True)
+    src = glob.glob(os.path.join(_RECORDED, "*.trace.json.gz"))[0]
+    (ts_dir / "host.trace.json.gz").write_bytes(open(src, "rb").read())
+    assert find_captures(str(tmp_path)) == [str(ts_dir)]
+
+
+# ---------------------------------------------------------------------------------
+# attribution on the recorded capture
+# ---------------------------------------------------------------------------------
+def test_recorded_capture_fractions_tile_device_time():
+    a = analyze_capture(_RECORDED)
+    assert a is not None and a["op_count"] > 0 and a["devices"] >= 1
+    # the acceptance invariant: categories + idle tile the capture exactly
+    assert abs(sum(a["fractions"].values()) - 1.0) < 5e-3
+    assert abs((a["busy_seconds"] + a["idle_seconds"]) - a["device_seconds"]) < 1e-6
+    assert abs(sum(a["categories"].values()) - a["busy_seconds"]) < 1e-6
+    assert set(a["fractions"]) == set(CATEGORIES) | {"idle"}
+    # a matmul-dominated step: mxu is the top classified category, no comm
+    assert a["fractions"]["mxu"] > a["fractions"]["elementwise"]
+    assert a["fractions"]["comm"] == 0.0
+
+
+def test_recorded_capture_program_join_and_roofline():
+    a = analyze_capture(
+        _RECORDED, _TRAIN_STEP_PROGRAMS, peak_flops=1e12, device_kind="TPU v4"
+    )
+    prog = a["programs"]["train_step"]
+    assert prog["module"] == "jit_train_step"
+    # the capture traced exactly 4 dispatches of the jitted step
+    assert prog["calls"] == 4
+    assert prog["device_seconds"] > 0 and 0 < prog["fraction"] <= 1
+    # device_seconds is rounded for the report; the rate uses the raw sum
+    expected = _TRAIN_STEP_FLOPS * 4 / prog["device_seconds"]
+    assert prog["achieved_flops_per_s"] == pytest.approx(expected, rel=1e-3)
+    assert prog["achieved_peak_fraction"] == pytest.approx(expected / 1e12, abs=1e-3)
+    # intensity 85.3 FLOP/B vs a v4 ridge of 1e12/1228e9 ≈ 0.81 → compute-bound
+    assert a["ridge_intensity"] == pytest.approx(1e12 / 1228e9, abs=1e-2)
+    assert prog["arithmetic_intensity"] > a["ridge_intensity"]
+    assert prog["bound"] == "compute"
+
+
+def test_recorded_capture_without_cost_model_falls_back_to_mix():
+    a = analyze_capture(_RECORDED)
+    prog = a["programs"]["train_step"]
+    assert "achieved_flops_per_s" not in prog
+    # no ridge, no flops: the category mix (mxu+elementwise > copy) decides
+    assert prog["bound"] == "compute"
+
+
+def test_analyze_capture_returns_none_without_ops(tmp_path):
+    assert analyze_capture(str(tmp_path)) is None
+    (tmp_path / "empty.trace.json").write_text('{"traceEvents": []}')
+    assert analyze_capture(str(tmp_path)) is None
+
+
+def test_profile_event_payload_validates_against_schema():
+    from sheeprl_tpu.obs.schema import validate_events
+
+    a = analyze_capture(_RECORDED, _TRAIN_STEP_PROGRAMS)
+    event = {"event": "profile_analysis", "seq": 0, "step": 64, **profile_event_payload(a)}
+    assert validate_events([event]) == []
+    assert abs(sum(event["categories"].values()) - 1.0) < 5e-3
+    assert event["programs"]["train_step"]["calls"] == 4
+
+
+# ---------------------------------------------------------------------------------
+# the comm-heavy capture: detectors + the --fail-on gate
+# ---------------------------------------------------------------------------------
+def test_comm_heavy_capture_attribution():
+    a = analyze_capture(_COMM_HEAVY)
+    # hand-built timeline: 1200µs comm / 400 mxu / 200 elementwise / 100 copy
+    # over a 2000µs span (100µs idle) — see the fixture
+    assert a["fractions"]["comm"] == pytest.approx(0.60, abs=1e-3)
+    assert a["fractions"]["idle"] == pytest.approx(0.05, abs=1e-3)
+    prog = a["programs"]["anakin_step"]
+    assert prog["calls"] == 2
+    assert prog["comm_fraction"] == pytest.approx(1200 / 1900, abs=1e-3)
+    assert prog["bound"] == "comm"
+    # the runtime envelope event (no hlo args) must not be attributed
+    assert a["op_count"] == 8
+
+
+def test_comm_heavy_capture_trips_comm_bound_gate(tmp_path, capsys):
+    out = tmp_path / "profile.json"
+    rc = main([_COMM_HEAVY, "--json", str(out), "--fail-on", "warning"])
+    assert rc == 1
+    result = json.loads(out.read_text())
+    detectors = {f["detector"]: f for f in result["findings"]}
+    assert detectors["comm_bound"]["severity"] == "critical"
+    assert detectors["comm_bound"]["metrics"]["comm_fraction"] == pytest.approx(0.6, abs=1e-3)
+    report = capsys.readouterr().out
+    assert "comm_bound" in report and "anakin_step" in report
+    # without the gate the same findings are advisory: exit 0
+    assert main([_COMM_HEAVY, "--json", str(out), "--quiet"]) == 0
+
+
+def test_profile_verb_exits_2_without_capture(tmp_path, capsys):
+    assert main([str(tmp_path)]) == 2
+    assert "no parseable profiler capture" in capsys.readouterr().err
+
+
+def test_profile_detectors_are_structural_noops_without_captures():
+    from sheeprl_tpu.obs.diagnose import run_detectors
+
+    ordinary = [{"event": "window", "seq": 0, "sps": 100.0}]
+    findings = run_detectors(ordinary, detectors=("comm_bound", "copy_bound", "host_gap"))
+    assert findings == []
+    # a capture below the minimum device time is ignored too
+    tiny = [
+        {
+            "event": "profile_analysis",
+            "seq": 1,
+            "device_seconds": 1e-6,
+            "categories": {"comm": 1.0},
+        }
+    ]
+    assert run_detectors(tiny, detectors=("comm_bound", "copy_bound", "host_gap")) == []
+
+
+def test_copy_bound_and_host_gap_detectors_fire_on_profile_events():
+    from sheeprl_tpu.obs.diagnose import run_detectors
+
+    events = [
+        {
+            "event": "profile_analysis",
+            "seq": 0,
+            "device_seconds": 0.5,
+            "categories": {"copy": 0.35, "idle": 0.3, "host": 0.15, "mxu": 0.2},
+        }
+    ]
+    findings = {f["detector"]: f for f in run_detectors(events)}
+    assert findings["copy_bound"]["severity"] == "warning"
+    # idle + host = 0.45 ≥ the 0.40 host-gap warning threshold
+    assert findings["host_gap"]["severity"] == "warning"
+    assert findings["host_gap"]["metrics"]["gap_fraction"] == pytest.approx(0.45)
+    assert "comm_bound" not in findings
+
+
+# ---------------------------------------------------------------------------------
+# CPU e2e smoke: real run + window capture -> profile verb
+# ---------------------------------------------------------------------------------
+@pytest.mark.timeout(240)
+def test_ppo_anakin_window_capture_profiles_end_to_end(capsys):
+    from sheeprl_tpu.cli import run
+
+    run(
+        [
+            "exp=ppo_anakin",
+            "dry_run=False",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "fabric.devices=1",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "env.num_envs=4",
+            "algo.rollout_steps=16",
+            "algo.total_steps=256",
+            "algo.per_rank_batch_size=32",
+            "algo.update_epochs=2",
+            "algo.run_test=False",
+            "metric.telemetry.enabled=true",
+            "metric.telemetry.every=64",
+            "metric.telemetry.compile_warmup_steps=0",
+            "metric.profiler.mode=window",
+            "metric.profiler.start_step=64",
+            "metric.profiler.num_steps=128",
+            "root_dir=txprof",
+            "run_name=anakin",
+        ]
+    )
+    streams = glob.glob("logs/runs/txprof/anakin/version_*/telemetry.jsonl")
+    assert streams, "telemetry.jsonl missing"
+    run_dir = os.path.dirname(streams[-1])
+    events = [json.loads(line) for line in open(streams[-1])]
+
+    # satellite: the profiler events record their attempt-scoped capture dir
+    prof_events = [e for e in events if e["event"] == "profiler"]
+    assert prof_events and all(e.get("dir") for e in prof_events)
+    assert all(os.path.basename(e["dir"]) == "attempt_0" for e in prof_events)
+
+    # the in-loop emission: a schema-valid profile_analysis event with tiling
+    # fractions landed in the stream when the window closed
+    from sheeprl_tpu.obs.schema import validate_events
+
+    assert validate_events(events) == []
+    analyses = [e for e in events if e["event"] == "profile_analysis"]
+    assert analyses, "profile_analysis must be emitted when the window capture completes"
+    assert abs(sum(analyses[-1]["categories"].values()) - 1.0) < 5e-3
+
+    rc = main([run_dir])
+    assert rc == 0, "profile verb must exit 0 on a healthy capture"
+    report = capsys.readouterr().out
+    assert "XLA execution profile" in report
+
+    result = json.loads(open(os.path.join(run_dir, "profile.json")).read())
+    assert result["captures"] and result["device_seconds"] > 0
+    assert abs(sum(result["categories"].values()) - 1.0) < 5e-3
+    # the registered fused program joined against the capture with FLOP/s
+    progs = result["captures"][-1]["programs"]
+    assert "anakin_step" in progs
+    prog = progs["anakin_step"]
+    assert prog["calls"] >= 1 and prog["fraction"] > 0
+    assert prog.get("achieved_flops_per_s", 0) > 0
