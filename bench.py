@@ -63,6 +63,14 @@ Select a single workload with BENCH_ALGO:
   data plane rather than CPU contention. Value = 2-actor ingest rows/sec,
   vs_baseline = the 2/1-actor scaling ratio (acceptance bar >= 1.5); learner
   sps, gradient-step rates and service queue depth ride in conditions.
+- live_loop — the closed-loop flywheel (sheeprl_tpu/live, howto/live.md):
+  trains a tiny SAC checkpoint, then runs one ``sheeprl.py live`` gang end to
+  end — serving slots doubling as experience-service actors, an in-process
+  learner training on the captured sessions, published weights hot-reloading
+  into serving mid-traffic. Value = sessions/sec through the closed loop;
+  ingested rows/sec and learner gradient-steps/sec ride as nested extras,
+  reload count + dataflow in conditions. CPU-only; measures the loop's
+  machinery, not the model.
 
 The dreamer_v3 extra also records the MFU of the benchmark-size train program in
 its ``conditions.train_mfu`` block (and mirrors ``mfu`` top-level).
@@ -1111,6 +1119,198 @@ def _bench_serve_load(
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _bench_live_loop(
+    sessions: int = 2, session_rounds: int = 12, max_session_steps: int = 20
+) -> dict:
+    """``live_loop``: the closed-loop serve→experience→learn→reload flywheel
+    (sheeprl_tpu/live, howto/live.md). Trains a tiny SAC checkpoint, then runs
+    ONE ``sheeprl.py live`` gang to completion on the dummy env: ``sessions``
+    concurrent sessions per wave for ``session_rounds`` paced waves, serving
+    slots doubling as experience-service actors, the in-process service
+    learner training on the captured trajectories and publishing, every
+    published version hot-reloading into serving mid-traffic. Reports
+    sessions/sec through the CLOSED loop (wave pacing included — it is part of
+    the loop's design, recorded in conditions), with ingested rows/sec and the
+    learner's gradient-step rate as nested extras and the reload count +
+    dataflow view in ``conditions``. CPU-only by construction."""
+    import shutil
+
+    from sheeprl_tpu.cli import run
+    from sheeprl_tpu.live.runner import live_main
+    from sheeprl_tpu.obs.jsonl import read_events
+
+    workdir = tempfile.mkdtemp(prefix="sheeprl-live-loop-")
+    try:
+        run(
+            [
+                "exp=sac",
+                "env=dummy",
+                "env.id=continuous_dummy",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "fabric.accelerator=cpu",
+                "metric.log_level=0",
+                "buffer.memmap=False",
+                "buffer.size=256",
+                "env.num_envs=1",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.learning_starts=8",
+                "algo.total_steps=16",
+                "algo.run_test=False",
+                "algo.per_rank_batch_size=4",
+                "checkpoint.save_last=True",
+                "checkpoint.every=8",
+                f"hydra.run.dir={workdir}/train",
+            ]
+        )
+
+        live_dir = os.path.join(workdir, "live")
+        spec_path = os.path.join(workdir, "live_bench.yaml")
+        wave_pause_s = 0.3
+        spec = {
+            "name": "live_bench",
+            "checkpoint_path": os.path.join(workdir, "train"),
+            "servers": 1,
+            "sessions": sessions,
+            "session_rounds": session_rounds,
+            "wave_pause_s": wave_pause_s,
+            "max_session_steps": max_session_steps,
+            "log_dir": live_dir,
+            "serve": {
+                "slots": max(sessions, 2),
+                "max_batch_wait_ms": 1.0,
+                "telemetry": {"every": 8},
+                "explore": {"fraction": 0.5, "noise": 0.2},
+            },
+            # the tuned flywheel cadence (howto/live.md): publishes land
+            # mid-traffic, actor weight lag stays under the staleness threshold
+            "learner": [
+                "buffer.memmap=false",
+                "buffer.size=512",
+                "algo.learning_starts=8",
+                "buffer.service.publish_every=2",
+                "algo.replay_ratio=0.0625",
+                "metric.telemetry.every=8",
+                "checkpoint.every=64",
+            ],
+            "reload_poll_s": 0.1,
+        }
+        import yaml
+
+        with open(spec_path, "w") as fh:
+            yaml.safe_dump(spec, fh)
+
+        start = time.perf_counter()
+        rc = live_main([spec_path])
+        wall = time.perf_counter() - start
+        if rc != 0:
+            raise RuntimeError(f"live_loop gang exited {rc}")
+
+        serve_events = read_events(os.path.join(live_dir, "telemetry.jsonl"))
+        summary = next(
+            (e for e in reversed(serve_events) if e.get("event") == "summary"), {}
+        )
+        start_event = next((e for e in serve_events if e.get("event") == "start"), {})
+        serve_summary = summary.get("serve") or {}
+        weights = serve_summary.get("weights") or {}
+        traj = serve_summary.get("trajectories") or {}
+
+        learner_events = read_events(os.path.join(live_dir, "telemetry.learner.jsonl"))
+        service = next(
+            (
+                e
+                for e in reversed(learner_events)
+                if e.get("event") == "service" and e.get("role") == "learner"
+            ),
+            {},
+        )
+        learner_dataflow = next(
+            (
+                (e.get("dataflow") or {})
+                for e in reversed(learner_events)
+                if e.get("event") == "window" and (e.get("dataflow") or {}).get("role") == "learner"
+            ),
+            {},
+        )
+
+        sessions_finished = int(serve_summary.get("sessions_finished") or 0)
+        rows = int(traj.get("rows") or 0)
+        gradient_steps = int(service.get("gradient_steps") or 0)
+        fingerprint = start_event.get("fingerprint")
+        conditions = {
+            "servers": 1,
+            "sessions": sessions,
+            "session_rounds": session_rounds,
+            "wave_pause_s": wave_pause_s,
+            "max_session_steps": max_session_steps,
+            "wall_seconds": round(wall, 3),
+            "sessions_finished": sessions_finished,
+            "reloads": int(weights.get("reloads") or 0),
+            "weight_version": int(weights.get("version") or 0),
+            "reload_failures": int(weights.get("failures") or 0),
+            "trajectories": dict(traj),
+            # the loop's dataflow view: what the learner saw of its actors
+            "dataflow": {
+                "rows": service.get("rows"),
+                "rows_per_actor": service.get("rows_per_actor"),
+                "queue_depth_mean": service.get("queue_depth"),
+                "weight_lag": learner_dataflow.get("weight_lag"),
+                "row_age": learner_dataflow.get("row_age"),
+            },
+            "latency_ms": serve_summary.get("latency_ms"),
+            "fingerprint": fingerprint,
+        }
+        result = {
+            "metric": "live_loop_sessions_per_sec",
+            "value": round(sessions_finished / wall, 3) if wall > 0 else None,
+            "unit": "sessions/sec (closed serve→learn→reload loop, paced waves)",
+            "vs_baseline": None,  # first closed-loop tier — no reference number exists
+            "conditions": conditions,
+        }
+        extras = [
+            {
+                "metric": "live_loop_ingest_rows_per_sec",
+                "value": round(rows / wall, 2) if wall > 0 else None,
+                "unit": "rows/sec (session trajectories into the experience plane)",
+                "vs_baseline": None,
+                "conditions": {
+                    "rows": rows,
+                    "trajectories_ingested": traj.get("ingested"),
+                    "trajectories_dropped": traj.get("dropped"),
+                    "fingerprint": fingerprint,
+                },
+            },
+            {
+                "metric": "live_loop_gradient_steps_per_sec",
+                "value": round(gradient_steps / wall, 2) if wall > 0 else None,
+                "unit": "gradient-steps/sec (co-located service learner)",
+                "vs_baseline": None,
+                "conditions": {
+                    "gradient_steps": gradient_steps,
+                    "weight_version": service.get("weight_version"),
+                    "fingerprint": fingerprint,
+                },
+            },
+            {
+                # a count unit gates higher-is-better; fewer hot reloads for
+                # the same traffic means the loop stopped closing
+                "metric": "live_loop_reloads",
+                "value": int(weights.get("reloads") or 0),
+                "unit": "count (hot reloads applied mid-traffic)",
+                "vs_baseline": None,
+                "conditions": {
+                    "weight_version": int(weights.get("version") or 0),
+                    "reload_failures": int(weights.get("failures") or 0),
+                    "fingerprint": fingerprint,
+                },
+            },
+        ]
+        result["extras"] = extras
+        return result
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _bench_fleet_ingest(
     total_steps: int = 768, step_latency_ms: float = 20.0, num_envs: int = 4
 ) -> dict:
@@ -1348,6 +1548,8 @@ def _bench(algo: str) -> dict:
         result = _bench_serve_load()
     elif algo == "fleet_ingest":
         result = _bench_fleet_ingest()
+    elif algo == "live_loop":
+        result = _bench_live_loop()
     elif algo.startswith("dreamer_v"):
         result = _bench_dreamer_steady(algo)
     else:
@@ -1573,6 +1775,14 @@ def main() -> int:
         print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:
         result["fleet_ingest_extra_error"] = repr(exc)[:500]
+    # live_loop: the closed serve→experience→learn→reload flywheel (sessions/sec
+    # through the loop, ingest + gradient rates, hot-reload count) — tiny
+    # CPU-only gang, never touches the chip
+    try:
+        extras.append(_bench_subprocess("live_loop", timeout=900))
+        print(json.dumps({**result, "extras": extras}), flush=True)
+    except Exception as exc:
+        result["live_loop_extra_error"] = repr(exc)[:500]
     if chip_busy:
         # The abandoned child is still compiling/claiming on the single-tenant
         # chip; further live-chip extras would only queue behind it and time out

@@ -87,6 +87,7 @@ from sheeprl_tpu.cli import (  # noqa: E402
     fault_matrix,
     fleet,
     lint,
+    live,
     profile,
     run,
     serve,
@@ -103,6 +104,7 @@ _SUBCOMMANDS = {
     "fault-matrix": fault_matrix,
     "serve": serve,
     "fleet": fleet,
+    "live": live,
     "trace": trace,
     "lint": lint,
 }

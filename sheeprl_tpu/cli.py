@@ -668,6 +668,22 @@ def serve(args: Optional[Sequence[str]] = None) -> int:
     return serve_main(list(args if args is not None else sys.argv[1:]))
 
 
+def live(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py live <spec.yaml> [key=value ...]`` — the closed-loop
+    flywheel (howto/live.md): serving slots double as actors. One supervised
+    in-process gang runs N :class:`PolicyServer` roles (booted from the spec's
+    ``checkpoint_path``, explore slots injecting session-seeded noise), every
+    finished session's trajectory rides the experience service into ONE
+    ``buffer.backend=service`` learner, and each published weight version
+    hot-reloads into every server between ticks — zero recompiles. SIGTERM
+    drains the whole gang (exit 75); ``watch``/``diagnose``/``trace`` stitch
+    the session→ingest→train→publish→reload flow across the live dir's
+    per-role telemetry streams."""
+    from sheeprl_tpu.live.runner import live_main
+
+    return live_main(list(args if args is not None else sys.argv[1:]))
+
+
 def check_configs_evaluation(cfg: dotdict) -> None:
     if cfg.float32_matmul_precision not in ("default", "high", "highest"):
         raise ValueError(

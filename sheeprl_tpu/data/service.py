@@ -82,7 +82,9 @@ __all__ = [
     "ServiceTimeout",
     "WeightPublisher",
     "WeightSubscriber",
+    "clear_local_service_plane",
     "coordination_kv",
+    "install_local_service_plane",
     "service_layout",
     "service_namespace",
     "service_options",
@@ -196,10 +198,46 @@ class LocalKV:
                 del self._data[k]
 
 
+# in-process service-plane override (`sheeprl_tpu/live`): the live flywheel
+# runs serve and learner ROLES as threads of one process, so they must share a
+# single KV instance and a single namespace. `coordination_kv()` and
+# `service_namespace()` consult these before their multi-process defaults —
+# which is enough, because `_service_learner` imports both lazily at call time.
+_kv_override: Optional[Any] = None
+_namespace_override: Optional[str] = None
+
+
+def install_local_service_plane(
+    kv: Optional[Any] = None, namespace: Optional[str] = None
+) -> Tuple[Any, str]:
+    """Pin every subsequent ``coordination_kv()`` / ``service_namespace()``
+    call of this process to one shared in-process plane (a :class:`LocalKV` by
+    default, with one freshly-derived namespace). Returns ``(kv, namespace)``;
+    undo with :func:`clear_local_service_plane`."""
+    global _kv_override, _namespace_override
+    _kv_override = kv if kv is not None else LocalKV()
+    if namespace is None:
+        # derive ONE namespace through the normal nonce path, then pin it so
+        # every role of the gang resolves the same keyspace
+        _namespace_override = None
+        namespace = service_namespace()
+    _namespace_override = str(namespace)
+    return _kv_override, _namespace_override
+
+
+def clear_local_service_plane() -> None:
+    global _kv_override, _namespace_override
+    _kv_override = None
+    _namespace_override = None
+
+
 def coordination_kv() -> Optional[CoordinationKV]:
     """The process's coordination-service KV plane, or None outside a
     jax.distributed session (callers fail with an actionable message — the
-    service backend is a multi-process construct by design)."""
+    service backend is a multi-process construct by design). An installed
+    in-process plane (:func:`install_local_service_plane`) wins."""
+    if _kv_override is not None:
+        return _kv_override
     from sheeprl_tpu.parallel.distributed import _kv_client
 
     client = _kv_client()
@@ -217,6 +255,8 @@ _service_builds = 0
 def service_namespace() -> str:
     import os
 
+    if _namespace_override is not None:
+        return _namespace_override
     global _service_builds
     nonce = _service_builds
     _service_builds += 1

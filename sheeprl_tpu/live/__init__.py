@@ -1,0 +1,12 @@
+"""Closed-loop live RL: the serve→experience→learn→reload flywheel.
+
+``python sheeprl.py live <spec>`` runs one supervised in-process gang where
+serving slots double as actors: finished sessions feed an experience-service
+learner whose published weights hot-reload into every server between ticks.
+See :mod:`sheeprl_tpu.live.runner` for the gang anatomy and howto/live.md for
+operation.
+"""
+
+from sheeprl_tpu.live.spec import LIVE_MARKER, load_live_spec, read_marker, write_marker
+
+__all__ = ["LIVE_MARKER", "load_live_spec", "read_marker", "write_marker"]

@@ -1,0 +1,197 @@
+"""Live spec: the file ``python sheeprl.py live <spec>`` consumes.
+
+YAML (or JSON — YAML is a superset) with this shape::
+
+    name: cartpole_live             # live-run name (fs-safe)
+    checkpoint_path: logs/runs/...  # boot policy (file / run dir / rank set)
+    servers: 1                      # serving roles (each one actor rank)
+    sessions: 2                     # concurrent env sessions PER server
+    session_rounds: 1               # session waves each server drives
+    wave_pause_s: 0.0               # pause between waves (paces traffic so the
+                                    # learner's publishes land MID-traffic)
+    max_session_steps: 200          # per-session episode cap
+    log_dir: null                   # default: logs/live/<name>_<timestamp>
+    serve:                          # serve.* knobs (slots, explore, deadline_ms...)
+      slots: 4
+      explore: {fraction: 0.5, noise: 0.3}
+    overrides: []                   # raw dotted overrides onto the serve config
+    learner:                        # dotted overrides onto the learner config
+      - algo.learning_starts=64
+      - buffer.service.publish_every=1
+    supervisor:                     # gang restart policy (run_restart_policy)
+      enabled: false
+      max_restarts: 3
+      backoff: 1.0
+      backoff_cap: 60.0
+    drain_grace_s: 10.0             # SIGTERM: in-flight session grace
+    ingest:
+      max_queue: 64                 # bounded trajectory queue (overflow = shed)
+    reload_poll_s: 0.5              # serve-side weight-plane poll cadence
+
+CLI overrides (``key=value`` after the spec path) are dotted paths into this
+mapping — ``servers=2`` or ``serve.explore.fraction=0.25`` — applied before
+normalization, so a spec file can be a template the operator parameterizes.
+
+The spec describes ONE closed-loop gang: ``servers`` serving roles whose
+finished sessions feed a single in-process experience-service learner
+(``buffer.backend=service``), whose published weight versions hot-reload into
+every server between ticks. ``live.json`` (the marker ``write_marker`` drops in
+the live dir) makes the directory self-describing for ``watch``/``diagnose``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+LIVE_MARKER = "live.json"
+
+_NAME_RE = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def _fs_name(raw: str) -> str:
+    return _NAME_RE.sub("-", str(raw)).strip("-") or "live"
+
+
+def _set_dotted(spec: Dict[str, Any], key: str, value: Any) -> None:
+    parts = [p for p in str(key).split(".") if p]
+    node: Any = spec
+    for i, part in enumerate(parts):
+        last = i == len(parts) - 1
+        if isinstance(node, list):
+            # numeric segments index list-valued spec fields (``learner.2=...``
+            # edits the third learner override; index == len appends)
+            if not part.isdigit() or int(part) > len(node):
+                raise ValueError(
+                    f"live override segment {part!r} of {key!r} indexes a list "
+                    f"of {len(node)} item(s) — use 0..{len(node)}"
+                )
+            idx = int(part)
+            if last:
+                if idx == len(node):
+                    node.append(value)
+                else:
+                    node[idx] = value
+                return
+            node = node[idx]
+            continue
+        if last:
+            node[part] = value
+            return
+        child = node.get(part)
+        if not isinstance(child, (dict, list)):
+            child = {}
+            node[part] = child
+        node = child
+
+
+def load_live_spec(path: str, overrides: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Load + validate a live spec file, apply dotted CLI ``overrides``, and
+    return the normalized spec mapping."""
+    import yaml
+
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"live spec {path!r}: no such file")
+    with open(path) as fh:
+        raw = yaml.safe_load(fh)
+    if not isinstance(raw, dict):
+        raise ValueError(f"live spec {path!r} must be a mapping, got {type(raw).__name__}")
+    spec = dict(raw)
+    for item in overrides or []:
+        if "=" not in item:
+            raise ValueError(f"live override {item!r} must be key=value")
+        key, raw_value = item.split("=", 1)
+        try:
+            value = yaml.safe_load(raw_value)
+        except yaml.YAMLError:
+            value = raw_value
+        _set_dotted(spec, key, value)
+
+    spec["name"] = _fs_name(spec.get("name") or os.path.splitext(os.path.basename(path))[0])
+    if not spec.get("checkpoint_path"):
+        raise ValueError(
+            "live spec needs checkpoint_path: the boot policy every server loads "
+            "(a checkpoint file, a run dir, or a multi-rank checkpoint dir)"
+        )
+    spec["checkpoint_path"] = str(spec["checkpoint_path"])
+    spec["servers"] = max(int(spec.get("servers") or 1), 0)
+    spec["sessions"] = max(int(spec.get("sessions") or 2), 0)
+    spec["session_rounds"] = max(int(spec.get("session_rounds") or 1), 1)
+    spec["wave_pause_s"] = max(float(spec.get("wave_pause_s") or 0.0), 0.0)
+    spec["max_session_steps"] = max(int(spec.get("max_session_steps") or 200), 1)
+    spec["log_dir"] = str(spec["log_dir"]) if spec.get("log_dir") else None
+    serve = spec.get("serve") or {}
+    if not isinstance(serve, dict):
+        raise ValueError("live spec 'serve' must be a mapping of serve.* knobs")
+    spec["serve"] = serve
+    spec["overrides"] = [str(o) for o in spec.get("overrides") or []]
+    spec["learner"] = [str(o) for o in spec.get("learner") or []]
+    sup = dict(spec.get("supervisor") or {})
+    sup.setdefault("enabled", False)
+    sup.setdefault("max_restarts", 3)
+    sup.setdefault("backoff", 1.0)
+    sup.setdefault("backoff_cap", 60.0)
+    spec["supervisor"] = sup
+    spec["drain_grace_s"] = float(spec.get("drain_grace_s") or 10.0)
+    ingest = dict(spec.get("ingest") or {})
+    ingest["max_queue"] = max(int(ingest.get("max_queue") or 64), 1)
+    spec["ingest"] = ingest
+    spec["reload_poll_s"] = float(spec.get("reload_poll_s") or 0.5)
+    return spec
+
+
+def _flatten(prefix: str, node: Any, out: List[str]) -> None:
+    if isinstance(node, dict):
+        for key, value in node.items():
+            _flatten(f"{prefix}.{key}" if prefix else str(key), value, out)
+    else:
+        # values round-trip through yaml.safe_load in build_serve_cfg: JSON is
+        # a YAML subset, so dumps keeps strings quoted and None spelled null
+        out.append(f"{prefix}={json.dumps(node)}")
+
+
+def serve_overrides(spec: Dict[str, Any]) -> List[str]:
+    """The dotted override list :func:`~sheeprl_tpu.serve.main.build_serve_cfg`
+    composes the serving config from: the spec's ``serve`` block flattened to
+    ``serve.*`` assignments, then the raw ``overrides`` (which therefore win)."""
+    out: List[str] = [f"checkpoint_path={spec['checkpoint_path']}"]
+    _flatten("serve", spec["serve"], out)
+    out.extend(spec["overrides"])
+    return out
+
+
+def write_marker(live_dir: str, spec: Dict[str, Any], streams: Dict[str, str]) -> str:
+    """The ``live.json`` marker that makes a live dir self-describing: the gang
+    topology and the per-role telemetry stream files."""
+    payload = {
+        "schema": 1,
+        "kind": "live",
+        "name": spec["name"],
+        "checkpoint_path": spec["checkpoint_path"],
+        "servers": spec["servers"],
+        "sessions": spec["sessions"],
+        "streams": dict(streams),
+    }
+    path = os.path.join(live_dir, LIVE_MARKER)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def read_marker(path: str) -> Optional[Dict[str, Any]]:
+    """The live marker of ``path`` (a live dir), or None when ``path`` is not a
+    live dir / the marker is unreadable."""
+    marker = os.path.join(str(path), LIVE_MARKER)
+    if not os.path.isfile(marker):
+        return None
+    try:
+        with open(marker) as fh:
+            payload = json.load(fh)
+        return payload if isinstance(payload, dict) else None
+    except (OSError, ValueError):
+        return None

@@ -204,6 +204,29 @@ _OPEN_EVENTS: Dict[str, Dict[str, Tuple[tuple, bool]]] = {
         "aborted": (_INT, False),
         "grace_s": (_NUM, False),
     },
+    # the live flywheel (sheeprl_tpu/live): gang lifecycle on the supervisor
+    # stream (start/shutdown with the role topology and ingest totals) and the
+    # serve roles' trajectory-ingest accounting (captured/ingested/dropped —
+    # dropped is the bounded queue's explicit shed-don't-stall overflow policy)
+    "live": {
+        "status": (_STR, True),
+        "servers": (_INT, False),
+        "sessions": (_INT, False),
+        "reloads": (_INT, False),
+        "error": (_STR, False),
+    },
+    "ingest": {
+        "role": (_STR, False),
+        "rank": (_INT, False),
+        "trajectories_captured": (_INT, False),
+        "trajectories_ingested": (_INT, False),
+        "trajectories_dropped": (_INT, False),
+        "trajectory_rows": (_INT, False),
+        "queue_depth": (_INT, False),
+        "rows": (_INT, False),
+        "messages": (_INT, False),
+        "weight_version": (_INT, False),
+    },
     "checkpoint": {},
     "restart": {"reason": (_STR, False)},
     "resume": {},

@@ -320,6 +320,43 @@ def _emit_instants(tb: _TraceBuilder, pid: int, tid: int, event: Mapping[str, An
             for k in ("rows", "gradient_steps", "weight_version", "queue_depth_mean")
             if event.get(k) is not None
         }
+    elif kind == "reload":
+        # the flywheel's visible heartbeat: each applied hot swap marks the
+        # serving track at the moment a published version went live
+        name = f"reload:{event.get('status')}"
+        args = {
+            k: event.get(k)
+            for k in ("version", "available", "reloads", "reason", "source")
+            if event.get(k) is not None
+        }
+    elif kind == "drain":
+        name = f"drain:{event.get('status')}"
+        args = {
+            k: event.get(k)
+            for k in ("shed", "aborted", "grace_s")
+            if event.get(k) is not None
+        }
+    elif kind == "live":
+        name = f"live:{event.get('status')}"
+        args = {
+            k: event.get(k)
+            for k in ("servers", "sessions", "reloads", "error")
+            if event.get(k) is not None
+        }
+    elif kind == "ingest":
+        name = "ingest"
+        args = {
+            k: event.get(k)
+            for k in (
+                "rank",
+                "trajectories_captured",
+                "trajectories_ingested",
+                "trajectories_dropped",
+                "trajectory_rows",
+                "weight_version",
+            )
+            if event.get(k) is not None
+        }
     if name is None:
         return
     tb.events.append(

@@ -349,6 +349,18 @@ class WatchState:
                     bits.append(f"SHED {int(sessions['shed'])}")
                 if serve.get("deadline_missed"):
                     bits.append(f"deadline missed {int(serve['deadline_missed'])}")
+                traj = serve.get("trajectories") or {}
+                if traj.get("ingested") or traj.get("dropped"):
+                    # the live flywheel's serve-side ingest: trajectories this
+                    # window shipped to the learner, and the ones the bounded
+                    # queue shed (the explicit overflow policy — data lost,
+                    # latency protected)
+                    traj_bit = f"traj {int(traj.get('ingested') or 0)}"
+                    if traj.get("rows"):
+                        traj_bit += f" ({int(traj['rows'])} rows)"
+                    if traj.get("dropped"):
+                        traj_bit += f" · SHED {int(traj['dropped'])}"
+                    bits.append(traj_bit)
                 if serve.get("degraded"):
                     bits.append("DEGRADED")
                 if self.draining:

@@ -70,6 +70,10 @@ def run_env_sessions(
         results[i] = record
         env = None
         session = None
+        # env feedback for the last served action that has not yet been fed
+        # back: (reward, next_obs, terminated) — the trajectory-capture
+        # plane completes that transition on the NEXT step (or at close)
+        feedback = None
         try:
             env = make_env(cfg, record["seed"], i, log_dir, "serve", vector_env_idx=i)()
             session = _open_with_retry(server, record["seed"], record)
@@ -77,7 +81,10 @@ def run_env_sessions(
             for _ in range(max_session_steps):
                 for attempt in range(_DEADLINE_RETRIES + 1):
                     try:
-                        action = session.step(obs)
+                        action = session.step(
+                            obs, reward=feedback[0] if feedback is not None else None
+                        )
+                        feedback = None
                         break
                     except DeadlineExceeded:
                         # the request never reached the device (carry intact):
@@ -89,6 +96,7 @@ def run_env_sessions(
                 obs, reward, terminated, truncated, _ = env.step(
                     np.asarray(action).reshape(env.action_space.shape)
                 )
+                feedback = (reward, obs, bool(terminated))
                 record["reward"] += float(np.asarray(reward))
                 record["steps"] += 1
                 if bool(terminated) or bool(truncated):
@@ -97,7 +105,14 @@ def run_env_sessions(
             record["error"] = repr(exc)
         finally:
             if session is not None:
-                session.close()
+                if feedback is not None:
+                    session.close(
+                        reward=feedback[0],
+                        next_obs=feedback[1],
+                        terminated=feedback[2],
+                    )
+                else:
+                    session.close()
             if env is not None:
                 env.close()
 

@@ -69,6 +69,10 @@ SERVE_DEFAULTS: Dict[str, Any] = {
     # robustness plane (howto/serving.md, "Operating a server")
     "max_queue": None,  # null = unbounded admission (no shedding)
     "deadline_ms": None,  # null = no per-request deadline
+    # per-slot exploration split (the live flywheel, howto/live.md): the lowest
+    # round(fraction*slots) slot indices get session-seeded Gaussian action
+    # noise; all other slots serve greedy, byte-identical actions
+    "explore": {"fraction": 0.0, "noise": 0.3},
     "degraded_wait_factor": 4.0,
     "drain_grace_s": 10.0,
     "reload": {"enabled": False, "poll_s": 2.0, "watch_dir": None},
@@ -219,6 +223,8 @@ class _ServeAttempt:
             deadline_ms=serve_cfg.get("deadline_ms"),
             degraded_wait_factor=float(serve_cfg.get("degraded_wait_factor") or 4.0),
             fault_plan=build_fault_plan(cfg.get("resilience")),
+            explore_fraction=float((serve_cfg.get("explore") or {}).get("fraction") or 0.0),
+            explore_noise=float((serve_cfg.get("explore") or {}).get("noise") or 0.3),
         )
         self.reloader = None
         reload_cfg = serve_cfg.get("reload") or {}

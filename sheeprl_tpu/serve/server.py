@@ -113,10 +113,25 @@ class ServeSession:
         self._deadline_missed = False
         self._event = threading.Event()
         self._closed = False
+        # trajectory capture (serve/trajectory.py) — created lazily on the
+        # first successful step when the server has an ingest plane; explore
+        # noise rng is seeded from the SESSION seed at slot attach (purity:
+        # the stream depends only on the session, never on co-batching)
+        self._recorder: Optional[Any] = None
+        self._noise_rng: Optional[np.random.Generator] = None
 
-    def step(self, obs: Dict[str, np.ndarray], timeout: Optional[float] = None) -> np.ndarray:
+    def step(
+        self,
+        obs: Dict[str, np.ndarray],
+        timeout: Optional[float] = None,
+        *,
+        reward: Any = None,
+    ) -> np.ndarray:
         """Submit one observation, block until the batched step returns this
-        session's action."""
+        session's action. ``reward`` is the env feedback for the PREVIOUS
+        action (with ``obs`` as its next observation) — it completes that
+        pending transition in the session's trajectory recorder; the capture
+        plane rides the client thread, never the tick loop."""
         if self._closed:
             raise ServerClosed("session is closed")
         self._server._submit(self, obs)
@@ -136,11 +151,34 @@ class ServeSession:
         if self._action is None:
             raise ServerClosed("policy server shut down mid-request")
         self.steps += 1
+        ingest = self._server.trajectories
+        if ingest is not None:
+            if self._recorder is None:
+                from sheeprl_tpu.serve.trajectory import SessionRecorder
+
+                self._recorder = SessionRecorder(ingest, self.seed, self.slot)
+            if reward is not None:
+                self._recorder.complete(reward, next_obs=obs)
+            self._recorder.begin(obs, self._action)
         return self._action
 
-    def close(self) -> None:
+    def close(
+        self,
+        *,
+        reward: Any = None,
+        next_obs: Optional[Dict[str, np.ndarray]] = None,
+        terminated: bool = False,
+    ) -> None:
+        """End the session. With ``reward`` (and optionally ``next_obs`` /
+        ``terminated``) the final pending transition completes as the episode
+        tail; without it the recorder drops the torn tail and truncates —
+        evicted/shed/drained sessions never emit torn trajectories."""
         if not self._closed:
             self._closed = True
+            if self._recorder is not None:
+                self._recorder.finish(
+                    reward=reward, next_obs=next_obs, terminated=terminated
+                )
             self._server._release(self)
 
 
@@ -161,6 +199,9 @@ class PolicyServer:
         deadline_ms: Optional[float] = None,
         degraded_wait_factor: float = DEFAULT_DEGRADED_WAIT_FACTOR,
         fault_plan: Any = None,
+        trajectories: Any = None,
+        explore_fraction: float = 0.0,
+        explore_noise: float = 0.3,
     ) -> None:
         self.policy = policy
         self.table = SlotTable(policy, slots, base_seed=base_seed)
@@ -171,6 +212,14 @@ class PolicyServer:
         self.degraded_wait_factor = max(float(degraded_wait_factor), 1.0)
         self.fault_plan = fault_plan
         self.telemetry = telemetry
+        # the live flywheel's actor half: an optional TrajectoryIngest plane
+        # (serve/trajectory.py) sessions record into, plus the per-slot
+        # exploration split — the LOWEST round(fraction*slots) slot indices
+        # are explore slots whose delivered actions get session-seeded host
+        # noise; all other ("real traffic") slots stay greedy and byte-exact
+        self.trajectories = trajectories
+        self.explore_slots = int(round(max(min(float(explore_fraction), 1.0), 0.0) * int(slots)))
+        self.explore_noise = float(explore_noise)
 
         self._cond = threading.Condition()
         self._admission: deque = deque()  # sessions waiting for a slot
@@ -418,6 +467,15 @@ class PolicyServer:
             session._attached_time = time.perf_counter()
             self._sessions[slot] = session
             attached[slot] = session.seed
+            # explore-slot designation is a property of the SLOT; the noise
+            # stream is a property of the SESSION (seeded by its seed, advanced
+            # once per delivered action) — deterministic per session, invisible
+            # to every co-batched greedy session
+            session._noise_rng = (
+                np.random.default_rng(session.seed)
+                if slot < self.explore_slots
+                else None
+            )
         return attached
 
     def _pending_locked(self) -> List[ServeSession]:
@@ -609,7 +667,18 @@ class PolicyServer:
             latencies = []
             for slot, session in batch:
                 session._obs = None
-                session._action = np.array(actions[slot])
+                action = np.array(actions[slot])
+                if session._noise_rng is not None:
+                    # additive Gaussian exploration noise, applied HOST-side
+                    # after the batched device step: the compiled program (and
+                    # therefore the greedy slots' actions) is byte-identical
+                    # with or without explore slots co-batched. Unclipped by
+                    # design — action bounds are the env adapter's contract.
+                    action = (
+                        action
+                        + session._noise_rng.normal(0.0, self.explore_noise, action.shape)
+                    ).astype(action.dtype)
+                session._action = action
                 # STEP latency: a queued session's first request starts its
                 # clock at slot attach — time spent waiting for a slot is the
                 # admission queue's number (queue_depth / slot_starvation),

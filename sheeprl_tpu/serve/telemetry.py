@@ -40,6 +40,7 @@ mostly-idle server too.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -91,6 +92,7 @@ class ServingTelemetry:
         http_port: Optional[int] = None,
         http_host: str = "127.0.0.1",
         attempt: int = 0,
+        rank: int = 0,
     ) -> None:
         self.enabled = bool(enabled)
         self.every = max(int(every), 1)
@@ -131,6 +133,18 @@ class ServingTelemetry:
         self._degraded = False
         self._draining = False
         self._drain_info: Optional[Dict[str, Any]] = None
+        # trajectory-capture counters (the live flywheel's serve-side ingest:
+        # captured = finished sessions that produced transitions, dropped =
+        # shed by the bounded ingest queue — the explicit overflow policy)
+        self._traj_captured = 0
+        self._traj_ingested = 0
+        self._traj_dropped = 0
+        self._traj_rows = 0
+        self._traj_lock = threading.Lock()
+        # optional dataflow-lineage provider (ActorDataflow): snapshotted per
+        # window so serve windows carry the same role="actor" dataflow block a
+        # service-gang actor's do — diagnose/trace consume them unchanged
+        self._dataflow: Any = None
 
         # window accumulators
         self._window_idx = 0
@@ -146,6 +160,10 @@ class ServingTelemetry:
         self._win_sessions_shed = 0
         self._win_sessions_drained = 0
         self._win_deadline_missed = 0
+        self._win_traj_captured = 0
+        self._win_traj_ingested = 0
+        self._win_traj_dropped = 0
+        self._win_traj_rows = 0
         self._all_latencies: deque = deque(maxlen=_LATENCY_RESERVOIR)
 
         self._start_time = time.perf_counter()
@@ -161,7 +179,7 @@ class ServingTelemetry:
         path = jsonl_path or (
             os.path.join(log_dir, "telemetry.jsonl") if log_dir else "telemetry.jsonl"
         )
-        self._sink = JsonlEventSink(path, rank=0, attempt=int(attempt))
+        self._sink = JsonlEventSink(path, rank=int(rank), attempt=int(attempt))
         from sheeprl_tpu.obs.fingerprint import run_fingerprint
 
         try:
@@ -265,6 +283,45 @@ class ServingTelemetry:
         self._win_sessions_finished += int(finished)
         self._win_sessions_shed += int(shed)
         self._win_deadline_missed += int(deadline_missed)
+
+    def observe_trajectories(
+        self,
+        *,
+        captured: int = 0,
+        ingested: int = 0,
+        dropped: int = 0,
+        rows: int = 0,
+    ) -> None:
+        """Trajectory-capture deltas from the ingest plane (client/worker
+        threads — hence the lock): ``captured`` finished sessions offered,
+        ``ingested`` shipped into the experience writer, ``dropped`` shed by
+        the bounded queue, ``rows`` transitions shipped."""
+        if not self.enabled:
+            return
+        with self._traj_lock:
+            self._traj_captured += int(captured)
+            self._traj_ingested += int(ingested)
+            self._traj_dropped += int(dropped)
+            self._traj_rows += int(rows)
+            self._win_traj_captured += int(captured)
+            self._win_traj_ingested += int(ingested)
+            self._win_traj_dropped += int(dropped)
+            self._win_traj_rows += int(rows)
+
+    def attach_dataflow(self, provider: Any) -> None:
+        """Attach a dataflow-lineage provider (``ActorDataflow``): every window
+        carries its ``dataflow_snapshot()`` — the block diagnose's
+        weight_staleness detector and trace's ingest→sample / publish→refresh
+        flows consume, identical to a service-gang actor stream's."""
+        self._dataflow = provider
+
+    def _dataflow_block(self) -> Optional[Dict[str, Any]]:
+        if self._dataflow is None:
+            return None
+        try:
+            return self._dataflow.dataflow_snapshot()
+        except Exception:
+            return None
 
     # -- robustness-plane hooks ----------------------------------------------------
 
@@ -397,6 +454,12 @@ class ServingTelemetry:
                 "failures": self._reload_failures,
             },
             "degraded": self._degraded,
+            "trajectories": {
+                "captured": self._win_traj_captured,
+                "ingested": self._win_traj_ingested,
+                "dropped": self._win_traj_dropped,
+                "rows": self._win_traj_rows,
+            },
             "ticks": self._win_ticks,
             "state_bytes": self._state_bytes,
         }
@@ -450,6 +513,9 @@ class ServingTelemetry:
                 "window_seconds": round(window_compile_seconds, 3),
             },
         )
+        dataflow = self._dataflow_block()
+        if dataflow is not None:
+            window_event["dataflow"] = dataflow
         self._append_history("window", window_event)
         if self._sink is not None:
             self._sink.emit("window", **window_event)
@@ -474,6 +540,12 @@ class ServingTelemetry:
                     "Serve/reloads": (serve_block.get("weights") or {}).get("reloads"),
                     "Serve/reload_failures": (serve_block.get("weights") or {}).get("failures"),
                     "Serve/degraded": 1.0 if serve_block.get("degraded") else 0.0,
+                    "Serve/trajectories_captured": (serve_block.get("trajectories") or {}).get(
+                        "captured"
+                    ),
+                    "Serve/trajectories_dropped": (serve_block.get("trajectories") or {}).get(
+                        "dropped"
+                    ),
                     "Serve/draining": 1.0 if self._draining else 0.0,
                     "Compile/count": (window_event.get("compile") or {}).get("count"),
                 }
@@ -494,6 +566,11 @@ class ServingTelemetry:
         self._win_sessions_shed = 0
         self._win_sessions_drained = 0
         self._win_deadline_missed = 0
+        with self._traj_lock:
+            self._win_traj_captured = 0
+            self._win_traj_ingested = 0
+            self._win_traj_dropped = 0
+            self._win_traj_rows = 0
         self._anchor_time = now
 
     def close(self, clean_exit: bool = True) -> None:
@@ -512,9 +589,11 @@ class ServingTelemetry:
         snap = compile_snapshot()
         hbm = device_memory(self._device) if self._device is not None else None
         peak_hbm = max(self._peak_hbm, (hbm or {}).get("peak_bytes", 0)) or None
+        dataflow = self._dataflow_block()
         self._sink.emit(
             "summary",
             step=self._steps,
+            **({"dataflow": dataflow} if dataflow is not None else {}),
             clean_exit=bool(clean_exit),
             windows=self._window_idx,
             total_steps=self._steps,
@@ -546,6 +625,12 @@ class ServingTelemetry:
                     "failures": self._reload_failures,
                 },
                 **({"drain": self._drain_info} if self._drain_info else {}),
+                "trajectories": {
+                    "captured": self._traj_captured,
+                    "ingested": self._traj_ingested,
+                    "dropped": self._traj_dropped,
+                    "rows": self._traj_rows,
+                },
                 "ticks": self._ticks,
                 "state_bytes": self._state_bytes,
             },

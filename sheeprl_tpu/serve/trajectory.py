@@ -1,0 +1,278 @@
+"""Session-trajectory capture: the serving tier's actor half of the flywheel.
+
+``sheeprl.py live`` (howto/live.md) closes the production loop — serving slots
+double as actors. This module is the capture plane that makes that possible
+without touching the tick loop's latency budget:
+
+- :class:`SessionRecorder` — per-session transition assembly, driven entirely
+  by the CLIENT thread (``ServeSession.step``/``close``). A transition is
+  ``(obs, action)`` begun when an action is delivered and COMPLETED by the next
+  request's ``reward`` (with that request's observation as ``next_obs``); the
+  final transition completes at ``close(reward=..., terminated=...)``. A
+  session that vanishes mid-request (evicted, shed, drained, crashed client)
+  leaves its last transition pending — the recorder drops it and marks the
+  preceding completed transition ``truncated``, so an emitted trajectory is
+  never torn: it is a contiguous run of complete transitions ending in a
+  ``terminated`` or ``truncated`` flag.
+- :class:`TrajectoryIngest` — the bounded hand-off between finished sessions
+  and the experience plane. ``offer()`` is O(1) and never blocks: a full queue
+  sheds the trajectory and counts it (``Serve/trajectories_dropped``, the
+  explicit overflow policy of the live subsystem — a slow learner must cost
+  training data, never serving latency). A worker thread drains the queue,
+  stacks each trajectory into the ``_service_actor`` row format
+  (``[T, 1, ...]`` float32 blocks keyed ``observations`` / ``actions`` /
+  ``rewards`` / ``terminated`` / ``truncated`` and, for learners that store
+  them, ``next_observations``) and ships it through an
+  :class:`~sheeprl_tpu.data.service.ExperienceWriter`.
+
+The capture path is exploration-faithful: the recorded action is the action
+the CLIENT received (noise included for explore slots), because that is the
+action the environment actually saw.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["SessionRecorder", "TrajectoryIngest"]
+
+
+class SessionRecorder:
+    """One session's transition log, thread-confined to its client thread
+    (exactly like :class:`~sheeprl_tpu.serve.server.ServeSession` itself)."""
+
+    def __init__(self, ingest: "TrajectoryIngest", seed: int, slot: Optional[int]) -> None:
+        self._ingest = ingest
+        self.seed = int(seed)
+        self.slot = slot
+        self._pending: Optional[tuple] = None  # (obs, action) awaiting its reward
+        self._transitions: List[Dict[str, Any]] = []
+        self._emitted = False
+
+    def begin(self, obs: Any, action: Any) -> None:
+        """An action was delivered for ``obs``: open the transition that the
+        NEXT request's reward (or ``finish``) will complete."""
+        self._pending = (
+            {k: np.array(v) for k, v in obs.items()},
+            np.array(action),
+        )
+
+    def complete(
+        self,
+        reward: Any,
+        *,
+        next_obs: Any,
+        terminated: bool = False,
+        truncated: bool = False,
+    ) -> None:
+        """Close the pending transition with its environment feedback."""
+        if self._pending is None:
+            return
+        obs, action = self._pending
+        self._pending = None
+        self._transitions.append(
+            {
+                "obs": obs,
+                "action": action,
+                "reward": float(np.asarray(reward).reshape(-1)[0]),
+                "next_obs": {k: np.array(v) for k, v in next_obs.items()},
+                "terminated": bool(terminated),
+                "truncated": bool(truncated),
+            }
+        )
+
+    def finish(
+        self,
+        *,
+        reward: Any = None,
+        next_obs: Any = None,
+        terminated: bool = False,
+    ) -> None:
+        """Session over. With a final ``reward`` the pending transition
+        completes as the episode tail (``terminated`` from the env, else
+        ``truncated`` — a step-capped or wound-down episode). Without one the
+        pending request never got its feedback (evicted / shed / drained /
+        client error): it is DROPPED and the previous transition is marked
+        ``truncated``, keeping the emitted trajectory whole. Idempotent."""
+        if self._emitted:
+            return
+        self._emitted = True
+        if self._pending is not None:
+            if reward is not None:
+                obs, _ = self._pending
+                self.complete(
+                    reward,
+                    next_obs=next_obs if next_obs is not None else obs,
+                    terminated=bool(terminated),
+                    truncated=not bool(terminated),
+                )
+            else:
+                self._pending = None
+                if self._transitions:
+                    self._transitions[-1]["truncated"] = True
+                    self._transitions[-1]["terminated"] = False
+        elif self._transitions and not (
+            self._transitions[-1]["terminated"] or self._transitions[-1]["truncated"]
+        ):
+            # feedback for the last action arrived via step() but the episode
+            # never signalled an end: close it as truncated
+            self._transitions[-1]["truncated"] = True
+        transitions, self._transitions = self._transitions, []
+        if transitions:
+            self._ingest.offer(transitions, seed=self.seed)
+
+
+class TrajectoryIngest:
+    """Bounded trajectory queue + assembly worker in front of an
+    :class:`~sheeprl_tpu.data.service.ExperienceWriter`.
+
+    ``offer()`` (client threads) sheds on overflow — counted, never blocking;
+    the worker thread owns the writer (``ExperienceWriter`` is single-threaded
+    by design) and performs all stacking/flattening OFF both the tick loop and
+    the client threads' latency paths."""
+
+    def __init__(
+        self,
+        writer: Any,
+        *,
+        mlp_keys: Sequence[str],
+        max_queue: int = 64,
+        sample_next_obs: bool = False,
+        telemetry: Any = None,
+        weight_version_of: Any = None,
+    ) -> None:
+        self.writer = writer
+        self.mlp_keys = [str(k) for k in mlp_keys]
+        self.max_queue = max(int(max_queue), 1)
+        self.sample_next_obs = bool(sample_next_obs)
+        self.telemetry = telemetry
+        # lineage: stamp each shipped block with the policy version that
+        # produced it (the server's live weight version) so the learner's
+        # weight-lag accounting sees serving traffic like any other actor
+        self.weight_version_of = weight_version_of
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._error: Optional[BaseException] = None
+        # cumulative counters (lock-protected by _cond)
+        self.captured = 0
+        self.ingested = 0
+        self.dropped = 0
+        self.rows = 0
+        self._thread = threading.Thread(
+            target=self._run, name="sheeprl-traj-ingest", daemon=True
+        )
+        self._thread.start()
+
+    # -- client-thread side --------------------------------------------------------
+
+    def offer(self, transitions: List[Dict[str, Any]], *, seed: int = 0) -> bool:
+        """Hand a finished session's transitions to the worker. O(1), never
+        blocks: a full queue drops the trajectory and counts it (the live
+        subsystem's explicit shed-don't-stall overflow policy)."""
+        dropped = False
+        with self._cond:
+            self.captured += 1
+            if self._closed or len(self._queue) >= self.max_queue:
+                self.dropped += 1
+                dropped = True
+            else:
+                self._queue.append((transitions, int(seed)))
+                self._cond.notify_all()
+        if self.telemetry is not None:
+            self.telemetry.observe_trajectories(
+                captured=1, dropped=1 if dropped else 0
+            )
+        return not dropped
+
+    # -- worker side ---------------------------------------------------------------
+
+    def _flat_obs(self, obs: Dict[str, Any]) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(obs[k]).reshape(-1) for k in self.mlp_keys]
+        ).astype(np.float32)
+
+    def _assemble(self, transitions: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        """Stack one trajectory into the experience-service row format: the
+        exact ``[T, 1, ...]`` blocks ``_service_actor`` ships (one env column —
+        a serving role is one env worth of traffic per session)."""
+        rows: Dict[str, np.ndarray] = {
+            "observations": np.stack(
+                [self._flat_obs(t["obs"]) for t in transitions]
+            )[:, np.newaxis, :],
+            "actions": np.stack(
+                [np.asarray(t["action"], dtype=np.float32).reshape(-1) for t in transitions]
+            )[:, np.newaxis, :],
+            "rewards": np.asarray(
+                [[t["reward"]] for t in transitions], dtype=np.float32
+            )[:, np.newaxis, :],
+            "terminated": np.asarray(
+                [[float(t["terminated"])] for t in transitions], dtype=np.float32
+            )[:, np.newaxis, :],
+            "truncated": np.asarray(
+                [[float(t["truncated"])] for t in transitions], dtype=np.float32
+            )[:, np.newaxis, :],
+        }
+        if not self.sample_next_obs:
+            rows["next_observations"] = np.stack(
+                [self._flat_obs(t["next_obs"]) for t in transitions]
+            )[:, np.newaxis, :]
+        return rows
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait(0.1)
+                if not self._queue and self._closed:
+                    return
+                transitions, seed = self._queue.popleft()
+            try:
+                rows = self._assemble(transitions)
+                if self.weight_version_of is not None:
+                    self.writer.weight_version = int(self.weight_version_of())
+                self.writer.add(rows, steps=None)
+                self.writer.flush()
+            except BaseException as exc:
+                with self._cond:
+                    if self._error is None:
+                        self._error = exc
+                    self.dropped += 1
+                if self.telemetry is not None:
+                    self.telemetry.observe_trajectories(dropped=1)
+                continue
+            with self._cond:
+                self.ingested += 1
+                self.rows += len(transitions)
+            if self.telemetry is not None:
+                self.telemetry.observe_trajectories(
+                    ingested=1, rows=len(transitions)
+                )
+
+    # -- lifecycle -----------------------------------------------------------------
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        """Stop accepting trajectories, drain what is queued, join the worker.
+        Does NOT close the writer — its owner (the live runner) does, so the
+        EOS marker can ride the role's ordinary shutdown sequence."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=max(float(timeout_s), 0.0))
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                "trajectories_captured": self.captured,
+                "trajectories_ingested": self.ingested,
+                "trajectories_dropped": self.dropped,
+                "trajectory_rows": self.rows,
+                "queue_depth": len(self._queue),
+            }

@@ -1,0 +1,270 @@
+"""End-to-end CPU live-flywheel smokes (the ISSUE 18 acceptance path): train a
+real SAC checkpoint, then run ``sheeprl.py live`` semantics in-process —
+serving slots double as actors, finished sessions ride the experience service
+into a co-located learner, and published weight versions hot-reload into the
+server MID-traffic. Gates: schema-clean streams, stitched trace flows across
+role tracks, ``diagnose --fail-on critical`` green (and ``weight_staleness``
+silent) on the healthy loop, the staleness detector firing ONLY under the
+``poll_weights=false`` injection, and SIGTERM draining the whole gang to the
+preemption exit code."""
+
+from __future__ import annotations
+
+import glob
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+import yaml
+
+import sheeprl_tpu
+from sheeprl_tpu.cli import diagnose, live, run, trace
+from sheeprl_tpu.obs.schema import validate_stream
+from sheeprl_tpu.obs.watch import watch_run
+from sheeprl_tpu.resilience.signals import PREEMPTED_EXIT_CODE
+
+pytestmark = pytest.mark.live
+
+_SAC_TRAIN = [
+    "exp=sac",
+    "env=dummy",
+    "env.id=continuous_dummy",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.accelerator=cpu",
+    "metric.log_level=0",
+    "buffer.memmap=False",
+    "buffer.size=256",
+    "env.num_envs=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.learning_starts=8",
+    "algo.total_steps=16",
+    "algo.run_test=False",
+    "algo.per_rank_batch_size=4",
+    "checkpoint.save_last=True",
+    "checkpoint.every=8",
+    "root_dir=livesmk",
+    "run_name=sac",
+]
+
+# the tuned flywheel cadence: wave pauses overlap the learner's train→publish
+# latency so trained versions land MID-traffic, and the publish/replay-ratio
+# pair keeps actor weight lag under the staleness threshold on a healthy run
+_LEARNER = [
+    "buffer.memmap=false",
+    "buffer.size=512",
+    "algo.learning_starts=8",
+    "buffer.service.publish_every=2",
+    "algo.replay_ratio=0.0625",
+    "metric.telemetry.every=8",
+    "checkpoint.every=64",
+]
+
+
+@pytest.fixture(scope="module")
+def sac_checkpoint(tmp_path_factory):
+    # one checkpoint for the whole module; the autouse chdir_tmp fixture gives
+    # every TEST its own cwd, so train in a module tmpdir and hand back an
+    # absolute path
+    root = tmp_path_factory.mktemp("livesmk")
+    old = os.getcwd()
+    os.chdir(root)
+    try:
+        run(_SAC_TRAIN)
+    finally:
+        os.chdir(old)
+    return str(root / "logs" / "runs" / "livesmk" / "sac")
+
+
+def _write_spec(path, checkpoint, live_dir, **over):
+    spec = {
+        "name": "smoke",
+        "checkpoint_path": checkpoint,
+        "servers": 1,
+        "sessions": 2,
+        "session_rounds": 14,
+        "wave_pause_s": 0.4,
+        "max_session_steps": 20,
+        "log_dir": live_dir,
+        "serve": {
+            "slots": 2,
+            "max_batch_wait_ms": 1.0,
+            "telemetry": {"every": 8},
+            "explore": {"fraction": 0.5, "noise": 0.2},
+        },
+        "learner": list(_LEARNER),
+        "reload_poll_s": 0.1,
+    }
+    spec.update(over)
+    with open(path, "w") as fh:
+        yaml.safe_dump(spec, fh)
+    return str(path)
+
+
+def _events(live_dir, name):
+    path = os.path.join(live_dir, name)
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+@pytest.mark.timeout(600)
+def test_live_flywheel_closes_the_loop(sac_checkpoint, tmp_path):
+    """The full loop: ≥2 concurrent sessions per wave, trajectories ingested
+    with zero shed, ≥2 hot reloads (so at least one TRAINED version went live
+    mid-traffic), zero reload-attributable recompiles, stitched trace flows,
+    and a critical-green diagnosis with weight_staleness silent."""
+    live_dir = str(tmp_path / "flywheel")
+    spec = _write_spec(tmp_path / "live.yaml", sac_checkpoint, live_dir)
+    assert live([spec]) == 0
+
+    with open(os.path.join(live_dir, "live.json")) as fh:
+        marker = json.load(fh)
+    assert marker["kind"] == "live" and marker["servers"] == 1
+    assert set(marker["streams"].values()) == {
+        "telemetry.jsonl",
+        "telemetry.learner.jsonl",
+        "telemetry.live.jsonl",
+    }
+
+    for name in marker["streams"].values():
+        assert validate_stream(os.path.join(live_dir, name)) == []
+
+    serve_events = _events(live_dir, "telemetry.jsonl")
+    reloads = [
+        e for e in serve_events if e.get("event") == "reload" and e.get("status") == "applied"
+    ]
+    assert len(reloads) >= 2, "no trained-weight hot reload landed mid-traffic"
+    summary = serve_events[-1]
+    assert summary["event"] == "summary" and summary["clean_exit"] is True
+    weights = summary["serve"]["weights"]
+    assert weights["version"] >= 2 and weights["failures"] == 0
+    assert summary["serve"]["sessions_finished"] == 28  # 2 concurrent x 14 waves
+    traj = summary["serve"]["trajectories"]
+    assert traj["ingested"] >= 20 and traj["dropped"] == 0
+
+    # zero recompiles attributable to hot reloads: the compile counter is
+    # process-global (the co-located learner's train-step compiles land in it
+    # too), so the gate is growth-after-warmup far below the reload count
+    windows = [e for e in serve_events if e.get("event") == "window"]
+    growth = windows[-1]["compile"]["count"] - windows[0]["compile"]["count"]
+    assert growth <= 4 and growth < len(reloads)
+
+    learner_events = _events(live_dir, "telemetry.learner.jsonl")
+    services = [
+        e for e in learner_events if e.get("event") == "service" and e.get("role") == "learner"
+    ]
+    assert services and services[-1]["gradient_steps"] > 0
+    assert services[-1]["weight_version"] >= 2
+    assert services[-1]["rows_per_actor"]["0"] > 0
+
+    live_events = _events(live_dir, "telemetry.live.jsonl")
+    shutdown = live_events[-1]
+    assert shutdown["event"] == "live" and shutdown["status"] == "shutdown"
+    assert shutdown["preempted"] is False and shutdown["error"] is None
+    assert shutdown["reloads"] >= 2 and shutdown["sessions_lost"] == 0
+
+    # the trace stitches the flywheel across role tracks: experience flows
+    # (ingest→sample) and weights flows (publish→refresh), plus lifecycle
+    # instants on the learner/serve/live thread tracks
+    assert trace([live_dir]) == 0
+    with open(os.path.join(live_dir, "trace.json")) as fh:
+        tr = json.load(fh)["traceEvents"]
+    cats = {(e.get("cat"), e.get("ph")) for e in tr}
+    assert {("experience", "s"), ("experience", "f")} <= cats
+    assert {("weights", "s"), ("weights", "f")} <= cats
+    instants = {e["name"] for e in tr if e.get("ph") == "i"}
+    assert {"reload:applied", "live:start", "live:shutdown", "ingest"} <= instants
+    tracks = {
+        e["args"]["name"]
+        for e in tr
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert {"learner", "rank0", "live"} <= tracks
+
+    assert diagnose([live_dir, "--quiet", "--fail-on", "critical"]) == 0
+    with open(os.path.join(live_dir, "diagnosis.json")) as fh:
+        report = json.load(fh)
+    stale = [f for f in report["findings"] if f["detector"] == "weight_staleness"]
+    assert not stale, f"healthy loop flagged stale: {stale}"
+
+    # watch consumes the finished live dir and renders the ingest counters
+    out = io.StringIO()
+    assert watch_run(live_dir, interval=0.1, grace=0.2, timeout=60, plain=True, out=out) == 0
+    assert "traj" in out.getvalue()
+
+
+@pytest.mark.timeout(600)
+def test_live_stale_actor_injection_fires_weight_staleness(sac_checkpoint, tmp_path):
+    """``buffer.service.poll_weights=false`` freezes the serving weights while
+    the learner keeps publishing; diagnose must flag the frozen actor critical
+    — and ONLY under the injection (the healthy run above asserts silence)."""
+    live_dir = str(tmp_path / "stale")
+    learner = [o for o in _LEARNER if "replay_ratio" not in o and "publish_every" not in o]
+    learner += ["buffer.service.publish_every=1", "buffer.service.poll_weights=false"]
+    spec = _write_spec(
+        tmp_path / "stale.yaml",
+        sac_checkpoint,
+        live_dir,
+        # spread the waves out: the learner keeps publishing between them, so
+        # its LATER dataflow windows record the frozen actor's lag spanning the
+        # whole published history (one fast burst can end before version 3)
+        session_rounds=6,
+        wave_pause_s=0.25,
+        learner=learner,
+    )
+    assert live([spec]) == 0
+    serve_events = _events(live_dir, "telemetry.jsonl")
+    assert not [e for e in serve_events if e.get("event") == "reload"]
+    summary = serve_events[-1]
+    assert (summary["serve"].get("weights") or {}).get("version", 0) == 0
+    assert diagnose([live_dir, "--quiet", "--fail-on", "warning"]) == 1
+    with open(os.path.join(live_dir, "diagnosis.json")) as fh:
+        report = json.load(fh)
+    stale = [f for f in report["findings"] if f["detector"] == "weight_staleness"]
+    assert stale and stale[0]["severity"] == "critical"
+
+
+@pytest.mark.timeout(600)
+def test_live_sigterm_drains_whole_gang_exit_75(sac_checkpoint, tmp_path):
+    """SIGTERM mid-traffic: in-flight sessions drain, the learner takes its
+    emergency checkpoint, every stream flushes its summary, and the process
+    exits with the preemption code for the external supervisor."""
+    live_dir = str(tmp_path / "drain")
+    spec = _write_spec(
+        tmp_path / "drain.yaml",
+        sac_checkpoint,
+        live_dir,
+        session_rounds=500,
+        wave_pause_s=0.2,
+        max_session_steps=50,
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(sheeprl_tpu.__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "sheeprl.py"), "live", spec], env=env
+    )
+    try:
+        stream = os.path.join(live_dir, "telemetry.jsonl")
+        deadline = time.monotonic() + 240
+        while not os.path.exists(stream) and time.monotonic() < deadline:
+            assert proc.poll() is None, f"live exited early rc={proc.returncode}"
+            time.sleep(0.2)
+        assert os.path.exists(stream), "serve stream never appeared"
+        time.sleep(2.0)  # let sessions be mid-flight when the reclaim lands
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=180) == PREEMPTED_EXIT_CODE
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    live_events = _events(live_dir, "telemetry.live.jsonl")
+    shutdown = live_events[-1]
+    assert shutdown["event"] == "live" and shutdown["status"] == "shutdown"
+    assert shutdown["preempted"] is True and shutdown["error"] is None
+    serve_events = _events(live_dir, "telemetry.jsonl")
+    summary = [e for e in serve_events if e.get("event") == "summary"][-1]
+    assert summary["clean_exit"] is True

@@ -196,3 +196,47 @@ def test_crashed_tick_loop_still_flushes_summary(tmp_path):
     summary = events[-1]
     assert summary["event"] == "summary"
     assert summary["clean_exit"] is False
+
+
+def _drive_ordered(server, n_sessions, n_steps):
+    """Like _drive, but sessions are ADMITTED in order (slot assignment is
+    deterministic) and then stepped concurrently so traffic co-batches."""
+    sessions = [server.open_session(seed=i) for i in range(n_sessions)]
+    out, slots = {}, {}
+
+    def client(i, session):
+        acts = []
+        for _ in range(n_steps):
+            acts.append(float(session.step({"state": np.full((2,), i, np.float32)})))
+        slots[i] = session.slot  # recorded before close() clears it
+        session.close()
+        out[i] = acts
+
+    threads = [
+        threading.Thread(target=client, args=(i, s)) for i, s in enumerate(sessions)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return out, slots
+
+
+def test_explore_slots_never_perturb_greedy_sessions():
+    """serve.explore purity regression: a greedy slot's action stream must be
+    BIT-identical with and without an explore session co-batched (the noise is
+    host-side, post-delivery, so it cannot leak through the batched step), and
+    the explore slot's own stream must actually differ."""
+    policy = _echo_policy()
+    with PolicyServer(policy, slots=2, max_batch_wait_ms=1.0) as server:
+        base, _ = _drive_ordered(server, 2, 8)
+    with PolicyServer(
+        policy, slots=2, max_batch_wait_ms=1.0, explore_fraction=0.5, explore_noise=0.5
+    ) as server:
+        assert server.explore_slots == 1  # the LOWEST slot explores
+        mixed, slots = _drive_ordered(server, 2, 8)
+    greedy = [i for i, slot in slots.items() if slot >= 1]
+    explore = [i for i, slot in slots.items() if slot < 1]
+    assert len(greedy) == 1 and len(explore) == 1
+    assert mixed[greedy[0]] == base[greedy[0]]  # bit-identical, not approx
+    assert mixed[explore[0]] != base[explore[0]]  # noise actually injected

@@ -1,0 +1,245 @@
+"""Session-trajectory capture units (the live flywheel's actor half):
+recorder episode assembly across ticks, torn-trajectory rules for
+evicted/shed/drained sessions, the bounded ingest queue's shed-don't-stall
+overflow policy, weight-version lineage, and capture through a live
+:class:`~sheeprl_tpu.serve.server.PolicyServer`."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.trajectory import SessionRecorder, TrajectoryIngest
+
+pytestmark = pytest.mark.serve
+
+
+class _Writer:
+    """ExperienceWriter stand-in: records shipped [T, 1, ·] blocks and the
+    weight-version lineage stamped on each."""
+
+    def __init__(self):
+        self.blocks = []
+        self.weight_version = 0
+
+    def add(self, rows, steps=None):
+        self.blocks.append((rows, int(self.weight_version)))
+
+    def flush(self):
+        pass
+
+
+def _obs(v):
+    return {"state": np.full((2,), float(v), np.float32)}
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert pred(), "condition never became true"
+
+
+def test_full_episode_assembles_service_rows():
+    """A completed episode ships as ONE [T, 1, ·] float32 block in the
+    experience-service row format, stamped with the serving weight version."""
+    writer = _Writer()
+    ingest = TrajectoryIngest(writer, mlp_keys=["state"], weight_version_of=lambda: 7)
+    rec = SessionRecorder(ingest, seed=3, slot=0)
+    # tick 1 delivers a0 for obs0; the next request carries its reward
+    rec.begin(_obs(0), np.float32(10.0))
+    rec.complete(0.5, next_obs=_obs(1))
+    rec.begin(_obs(1), np.float32(11.0))
+    rec.finish(reward=1.5, next_obs=_obs(2), terminated=True)
+    ingest.close()
+    assert len(writer.blocks) == 1
+    rows, lineage = writer.blocks[0]
+    assert lineage == 7
+    assert rows["observations"].shape == (2, 1, 2)
+    assert rows["actions"].shape == (2, 1, 1)
+    assert rows["observations"].dtype == np.float32
+    np.testing.assert_allclose(rows["rewards"][:, 0, 0], [0.5, 1.5])
+    np.testing.assert_allclose(rows["terminated"][:, 0, 0], [0.0, 1.0])
+    np.testing.assert_allclose(rows["truncated"][:, 0, 0], [0.0, 0.0])
+    np.testing.assert_allclose(rows["next_observations"][0, 0], _obs(1)["state"])
+    snap = ingest.telemetry_snapshot()
+    assert snap["trajectories_ingested"] == 1 and snap["trajectory_rows"] == 2
+
+
+def test_step_capped_episode_closes_truncated():
+    """A final reward WITHOUT terminated (step cap / wind-down) closes the
+    tail as truncated, never as terminated."""
+    writer = _Writer()
+    ingest = TrajectoryIngest(writer, mlp_keys=["state"])
+    rec = SessionRecorder(ingest, seed=0, slot=0)
+    rec.begin(_obs(0), 1.0)
+    rec.finish(reward=0.0, next_obs=_obs(1), terminated=False)
+    ingest.close()
+    ((rows, _),) = writer.blocks
+    assert rows["truncated"][-1, 0, 0] == 1.0
+    assert rows["terminated"][-1, 0, 0] == 0.0
+
+
+def test_vanished_session_drops_torn_tail_and_truncates():
+    """Evicted/shed/drained: the pending (obs, action) that never got its
+    feedback is DROPPED and the previous completed transition becomes the
+    truncated tail — an emitted trajectory is never torn."""
+    writer = _Writer()
+    ingest = TrajectoryIngest(writer, mlp_keys=["state"])
+    rec = SessionRecorder(ingest, seed=0, slot=0)
+    rec.begin(_obs(0), 1.0)
+    rec.complete(0.25, next_obs=_obs(1))
+    rec.begin(_obs(1), 2.0)  # this action's reward never arrives
+    rec.finish()
+    ingest.close()
+    ((rows, _),) = writer.blocks
+    assert rows["rewards"].shape[0] == 1  # the torn transition never shipped
+    assert rows["truncated"][0, 0, 0] == 1.0
+    assert rows["terminated"][0, 0, 0] == 0.0
+
+
+def test_lone_pending_transition_emits_nothing():
+    """A session that vanished after ONE unanswered action has no complete
+    transition: nothing is offered to the experience plane."""
+    writer = _Writer()
+    ingest = TrajectoryIngest(writer, mlp_keys=["state"])
+    rec = SessionRecorder(ingest, seed=0, slot=0)
+    rec.begin(_obs(0), 1.0)
+    rec.finish()
+    ingest.close()
+    assert writer.blocks == []
+    assert ingest.telemetry_snapshot()["trajectories_captured"] == 0
+
+
+def test_finish_is_idempotent():
+    writer = _Writer()
+    ingest = TrajectoryIngest(writer, mlp_keys=["state"])
+    rec = SessionRecorder(ingest, seed=0, slot=0)
+    rec.begin(_obs(0), 1.0)
+    rec.finish(reward=1.0, terminated=True)
+    rec.finish(reward=9.0, terminated=True)
+    ingest.close()
+    assert len(writer.blocks) == 1
+
+
+def test_interleaved_sessions_keep_episode_boundaries():
+    """Two sessions' transitions interleave across ticks; each emitted
+    trajectory is whole and carries only its own session's steps."""
+    writer = _Writer()
+    ingest = TrajectoryIngest(writer, mlp_keys=["state"])
+    a = SessionRecorder(ingest, seed=0, slot=0)
+    b = SessionRecorder(ingest, seed=1, slot=1)
+    a.begin(_obs(0), 0.0)
+    b.begin(_obs(10), 10.0)
+    a.complete(0.1, next_obs=_obs(1))
+    a.begin(_obs(1), 1.0)
+    b.complete(10.1, next_obs=_obs(11))
+    b.begin(_obs(11), 11.0)
+    b.finish(reward=10.2, terminated=True)
+    a.finish(reward=0.2, terminated=True)
+    ingest.close()
+    assert len(writer.blocks) == 2
+    first, second = writer.blocks[0][0], writer.blocks[1][0]
+    np.testing.assert_allclose(first["rewards"][:, 0, 0], [10.1, 10.2])
+    np.testing.assert_allclose(first["actions"][:, 0, 0], [10.0, 11.0])
+    np.testing.assert_allclose(second["rewards"][:, 0, 0], [0.1, 0.2])
+
+
+def test_overflow_sheds_and_never_blocks():
+    """A full queue drops the trajectory in O(1) — a slow learner costs
+    training data, never serving latency — and the shed is counted."""
+    entered, release = threading.Event(), threading.Event()
+
+    class _StuckWriter(_Writer):
+        def add(self, rows, steps=None):
+            entered.set()
+            release.wait(30)
+            super().add(rows, steps)
+
+    writer = _StuckWriter()
+    ingest = TrajectoryIngest(writer, mlp_keys=["state"], max_queue=1)
+    traj = [
+        {
+            "obs": _obs(0),
+            "action": np.float32(1.0),
+            "reward": 0.0,
+            "next_obs": _obs(1),
+            "terminated": True,
+            "truncated": False,
+        }
+    ]
+    assert ingest.offer(list(traj), seed=0)  # worker dequeues it, wedges in add()
+    _wait(entered.is_set)
+    assert ingest.offer(list(traj), seed=1)  # fills the 1-deep queue
+    t0 = time.monotonic()
+    assert not ingest.offer(list(traj), seed=2)  # full: shed, not blocked
+    assert time.monotonic() - t0 < 1.0
+    snap = ingest.telemetry_snapshot()
+    assert snap["trajectories_dropped"] == 1
+    assert snap["trajectories_captured"] == 3
+    release.set()
+    ingest.close()
+    assert ingest.telemetry_snapshot()["trajectories_ingested"] == 2
+
+
+def _echo_policy():
+    """action = seed-keyed noise + running count (same shape as
+    test_server's): distinguishes sessions AND steps."""
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.serve.policy import ObsSpec, ServePolicy
+
+    params = {"gain": jnp.float32(100.0)}
+
+    def init_slot(params, key):
+        return {"count": jnp.float32(0), "key": key}
+
+    def step_slot(params, carry, obs):
+        count = carry["count"] + 1
+        key, k = jax.random.split(carry["key"])
+        action = carry["count"] * params["gain"] + obs["state"].sum() + jax.random.uniform(k, ())
+        return action, {"count": count, "key": key}
+
+    return ServePolicy(
+        algo="echo",
+        params=params,
+        init_slot=init_slot,
+        step_slot=step_slot,
+        obs_spec={"state": ObsSpec((2,), np.float32)},
+        action_shape=(),
+    )
+
+
+def test_server_sessions_capture_trajectories():
+    """End-to-end capture through a live server: the recorded actions are the
+    actions the CLIENT received, feedback threads through step(reward=)/close,
+    and a session closed without feedback ships a truncated (never torn)
+    trajectory."""
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    writer = _Writer()
+    ingest = TrajectoryIngest(writer, mlp_keys=["state"])
+    with PolicyServer(
+        _echo_policy(), slots=2, max_batch_wait_ms=1.0, trajectories=ingest
+    ) as server:
+        s = server.open_session(seed=0)
+        a0 = float(s.step(_obs(0)))
+        a1 = float(s.step(_obs(1), reward=0.5))
+        s.close(reward=1.0, next_obs=_obs(2), terminated=True)
+        v = server.open_session(seed=1)
+        v.step(_obs(5))
+        v.step(_obs(6), reward=0.25)
+        v.close()  # evicted/shed/drained path: no final feedback
+    ingest.close()
+    assert len(writer.blocks) == 2
+    full = writer.blocks[0][0]
+    np.testing.assert_allclose(full["actions"][:, 0, 0], [a0, a1])
+    np.testing.assert_allclose(full["rewards"][:, 0, 0], [0.5, 1.0])
+    assert full["terminated"][-1, 0, 0] == 1.0
+    torn = writer.blocks[1][0]
+    assert torn["rewards"].shape[0] == 1
+    assert torn["truncated"][0, 0, 0] == 1.0
