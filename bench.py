@@ -337,6 +337,25 @@ def _steady_window_run(args: list, steady_start: int) -> dict:
                 ],
                 "attribution": (diag["attribution"] or {}).get("named_fraction"),
             }
+            # SLO replay (obs/slo.py): training floors default to disabled, so
+            # this is usually empty — but a bench run under an operator's
+            # objectives overlay carries its error-budget view in conditions.slo
+            try:
+                from sheeprl_tpu.obs.slo import slo_events
+
+                slo_eval = slo_events(events)
+                slo_block = slo_eval.get("slo") or {}
+                if slo_block:
+                    steady["slo"] = {
+                        "worst": slo_block.get("worst"),
+                        "budget_remaining": {
+                            name: obj.get("budget_remaining")
+                            for name, obj in (slo_block.get("objectives") or {}).items()
+                        },
+                        "firing": slo_eval.get("alerts", {}).get("firing", []),
+                    }
+            except Exception:
+                pass
         except Exception:
             pass
         return steady
@@ -402,6 +421,10 @@ def _steady_ab_result(
     if "profile" in steady:
         # the steady window's op-category attribution (SHEEPRL_BENCH_PROFILE=1)
         conditions["profile"] = steady["profile"]
+    if "slo" in steady:
+        # error-budget view of the same run (obs/slo.py replay; only present
+        # when an objective with a non-null target saw its signal)
+        conditions["slo"] = steady["slo"]
     result = {
         "metric": metric,
         "value": round(sps, 2),
@@ -670,7 +693,7 @@ def _bench_ppo_anakin() -> dict:
             else probe["platform"]
         ),
     }
-    for key in ("telemetry", "fingerprint", "diagnosis", "learning", "profile"):
+    for key in ("telemetry", "fingerprint", "diagnosis", "learning", "profile", "slo"):
         if key in steady:
             conditions[key] = steady[key]
     result = {
@@ -768,7 +791,7 @@ def _bench_sac_anakin() -> dict:
             else probe["platform"]
         ),
     }
-    for key in ("telemetry", "fingerprint", "diagnosis", "learning", "profile"):
+    for key in ("telemetry", "fingerprint", "diagnosis", "learning", "profile", "slo"):
         if key in steady:
             conditions[key] = steady[key]
     result = {
@@ -1038,6 +1061,28 @@ def _bench_serve_load(
         ]
         fingerprint = start.get("fingerprint")
 
+        # SLO replay (obs/slo.py): run the recorded stream back through the
+        # exact in-loop evaluator/alert machinery so the bench row carries the
+        # error-budget view of the same load it just measured
+        slo_summary = None
+        try:
+            from sheeprl_tpu.obs.slo import slo_events
+
+            slo_eval = slo_events(events, run_dir=workdir)
+            slo_block = slo_eval.get("slo") or {}
+            slo_summary = {
+                "worst": slo_block.get("worst"),
+                "budget_remaining": {
+                    name: obj.get("budget_remaining")
+                    for name, obj in (slo_block.get("objectives") or {}).items()
+                },
+                "firing": slo_eval.get("alerts", {}).get("firing", []),
+                "worst_firing_severity": slo_eval.get("worst_firing_severity"),
+                "windows": slo_eval.get("windows"),
+            }
+        except Exception:
+            slo_summary = None
+
         conditions = {
             "slots": slots,
             "max_batch_wait_ms": float(cfg.serve.max_batch_wait_ms),
@@ -1065,6 +1110,7 @@ def _bench_serve_load(
             "telemetry": {
                 k: v for k, v in summary.items() if k not in ("event", "time", "seq")
             },
+            "slo": slo_summary,
             "fingerprint": fingerprint,
         }
         p99 = latency.get("p99")
@@ -1113,6 +1159,27 @@ def _bench_serve_load(
                 },
             }
         )
+        # the SLO companion gates the OTHER direction: "fraction" units default
+        # to lower-is-better in bench-diff, so this workload pins
+        # direction=higher explicitly (error budget REMAINING — burning it down
+        # is the regression)
+        worst = (slo_summary or {}).get("worst") or {}
+        if worst.get("budget_remaining") is not None:
+            extras.append(
+                {
+                    "metric": "serve_load_budget_remaining",
+                    "value": worst["budget_remaining"],
+                    "unit": "fraction (worst-objective error budget remaining)",
+                    "direction": "higher",
+                    "vs_baseline": None,
+                    "conditions": {
+                        "objective": worst.get("objective"),
+                        "firing": (slo_summary or {}).get("firing"),
+                        "windows": (slo_summary or {}).get("windows"),
+                        "fingerprint": fingerprint,
+                    },
+                }
+            )
         result["extras"] = extras
         return result
     finally:
@@ -1566,8 +1633,25 @@ def _bench(algo: str) -> dict:
 
 
 class BenchTimeout(RuntimeError):
-    """A workload child outlived its budget and was ABANDONED (never killed) —
-    on a live chip it still holds the single-tenant claim."""
+    """A workload child outlived its budget. ``killed`` says what happened to
+    it: True when the child was terminated (no live chip — nothing to wedge),
+    False when it was ABANDONED because on a live chip it still holds the
+    single-tenant claim."""
+
+    def __init__(self, message: str, *, algo: str = "?", killed: bool = False) -> None:
+        super().__init__(message)
+        self.algo = algo
+        self.killed = killed
+
+
+def _note_timeout(result: dict, exc: Exception) -> None:
+    """Record a workload timeout's disposition under ``conditions.timeout_killed``
+    so the BENCH_*.json trajectory shows whether the child was killed (CPU) or
+    abandoned holding the chip (live) — the `*_error` strings alone don't gate."""
+    if isinstance(exc, BenchTimeout):
+        result.setdefault("conditions", {}).setdefault("timeout_killed", []).append(
+            {"workload": exc.algo, "killed": exc.killed}
+        )
 
 
 def _bench_subprocess(algo: str, timeout: int = 1200) -> dict:
@@ -1575,11 +1659,14 @@ def _bench_subprocess(algo: str, timeout: int = 1200) -> dict:
     conditions) locks jax_platforms for the whole process, which would silently
     demote a later accelerator workload.
 
-    The child is NEVER killed on timeout — killing a client mid-TPU-claim is what
-    wedges the single-tenant tunnel (see _accelerator_probe). On timeout only the
-    WAIT is abandoned: the child keeps running, finishes (or fails) on its own,
-    and releases the chip cleanly. Its output goes to temp FILES, not pipes, so
-    an abandoned child can never block on a full pipe."""
+    Timeout policy splits on the cached accelerator probe. On a LIVE chip the
+    child is never killed — killing a client mid-TPU-claim is what wedges the
+    single-tenant tunnel (see _accelerator_probe) — so only the WAIT is
+    abandoned: the child keeps running, finishes (or fails) on its own, and
+    releases the chip cleanly. With no live chip there is nothing to wedge, and
+    an abandoned CPU child would keep burning cores under every later workload
+    (skewing their numbers), so it IS terminated. Output goes to temp FILES,
+    not pipes, so a still-running child can never block on a full pipe."""
     import subprocess
 
     with tempfile.NamedTemporaryFile("w", suffix=f".bench-{algo}.out", delete=False) as f:
@@ -1608,12 +1695,35 @@ def _bench_subprocess(algo: str, timeout: int = 1200) -> dict:
     except OSError:
         stdout = stderr = ""
     if rc is None:
+        probe = _accelerator_probe_cached()
+        live = bool(probe.get("alive")) and probe.get("platform") != "cpu"
+        if not live:
+            # no chip claim to protect: kill the child so it cannot keep
+            # burning CPU under (and skewing) every later workload
+            child.terminate()
+            try:
+                child.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                try:
+                    child.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+            raise BenchTimeout(
+                f"bench {algo} timed out after {timeout}s (no live chip — child "
+                f"pid {child.pid} killed; its partial output is in "
+                f"{out_path} / {err_path}): {stdout[-500:]}\n{stderr[-1000:]}",
+                algo=algo,
+                killed=True,
+            )
         # keep the temp files: the abandoned child is still writing its
         # post-mortem to them, and the paths in the message are how to find it
         raise BenchTimeout(
             f"bench {algo} timed out after {timeout}s (child pid {child.pid} left "
             f"running to release the chip cleanly; its output keeps landing in "
-            f"{out_path} / {err_path}): {stdout[-500:]}\n{stderr[-1000:]}"
+            f"{out_path} / {err_path}): {stdout[-500:]}\n{stderr[-1000:]}",
+            algo=algo,
+            killed=False,
         )
     for p in (out_path, err_path):
         try:
@@ -1720,6 +1830,7 @@ def main() -> int:
         print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:  # the already-printed headline must survive a failing extra
         result["extras_error"] = repr(exc)[:500]
+        _note_timeout(result, exc)
         chip_busy = live and isinstance(exc, BenchTimeout)
     # SAC steady-state with the same prefetch A/B — cheap (MLP program), runs on CPU
     # or chip alike, and makes the prefetch acceptance numbers visible for both loops
@@ -1729,6 +1840,7 @@ def main() -> int:
             print(json.dumps({**result, "extras": extras}), flush=True)
         except Exception as exc:
             result["sac_steady_extra_error"] = repr(exc)[:500]
+            _note_timeout(result, exc)
             chip_busy = live and isinstance(exc, BenchTimeout)
     # ppo_anakin steady-state: the on-device env plane + fused rollout/train
     # topology — the act-path counterpart of the ppo headline (runs on CPU or
@@ -1739,6 +1851,7 @@ def main() -> int:
             print(json.dumps({**result, "extras": extras}), flush=True)
         except Exception as exc:
             result["ppo_anakin_extra_error"] = repr(exc)[:500]
+            _note_timeout(result, exc)
             chip_busy = live and isinstance(exc, BenchTimeout)
     # sac_anakin steady-state: the fully device-resident off-policy topology
     # (on-device envs + replay ring + gradient steps in one donated program) —
@@ -1750,6 +1863,7 @@ def main() -> int:
             print(json.dumps({**result, "extras": extras}), flush=True)
         except Exception as exc:
             result["sac_anakin_extra_error"] = repr(exc)[:500]
+            _note_timeout(result, exc)
             chip_busy = live and isinstance(exc, BenchTimeout)
     # dv3_2d_mesh: per-device DV3-L parameter footprint on the [2,4] data x
     # model mesh vs the [8] replicated mesh — init-time-only on 8 VIRTUAL CPU
@@ -1759,6 +1873,7 @@ def main() -> int:
         print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:
         result["dv3_2d_mesh_extra_error"] = repr(exc)[:500]
+        _note_timeout(result, exc)
     # serve_load: the policy serving tier under synthetic open-loop load
     # (sessions/sec + p99 step latency + occupancy) — tiny CPU-only checkpoint,
     # never touches the chip, so it runs regardless of chip_busy
@@ -1767,6 +1882,7 @@ def main() -> int:
         print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:
         result["serve_load_extra_error"] = repr(exc)[:500]
+        _note_timeout(result, exc)
     # fleet_ingest: the experience data-plane A/B (1-actor vs 2-actor service
     # ingestion with an emulator-paced env, learner gradient rate vs the local
     # backend) — CPU-mesh gangs only, never touches the chip
@@ -1775,6 +1891,7 @@ def main() -> int:
         print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:
         result["fleet_ingest_extra_error"] = repr(exc)[:500]
+        _note_timeout(result, exc)
     # live_loop: the closed serve→experience→learn→reload flywheel (sessions/sec
     # through the loop, ingest + gradient rates, hot-reload count) — tiny
     # CPU-only gang, never touches the chip
@@ -1783,6 +1900,7 @@ def main() -> int:
         print(json.dumps({**result, "extras": extras}), flush=True)
     except Exception as exc:
         result["live_loop_extra_error"] = repr(exc)[:500]
+        _note_timeout(result, exc)
     if chip_busy:
         # The abandoned child is still compiling/claiming on the single-tenant
         # chip; further live-chip extras would only queue behind it and time out
@@ -1801,6 +1919,7 @@ def main() -> int:
                 print(json.dumps({**result, "extras": extras}), flush=True)
             except Exception as exc:
                 result[f"{extra_algo}_extra_error"] = repr(exc)[:500]
+                _note_timeout(result, exc)
                 if isinstance(exc, BenchTimeout):
                     result["extras_skipped"] = (
                         "remaining live-chip extras skipped: timed-out workload still holds the chip"

@@ -18,6 +18,10 @@ Also hosts the offline/observability tooling (howto/observability.md):
   member/rank/role, phase spans, cross-process dataflow flow events);
 - ``python sheeprl.py bench-diff <old.json> <new.json>`` — the BENCH_*.json
   regression gate (``--fail-on regression`` for CI);
+- ``python sheeprl.py slo <run_dir|fleet_dir|live_dir>`` — replay the run's
+  windows through its declared SLOs: per-objective burn rates and error-budget
+  remaining, recorded/recomputed alert states (``slo.json``, ``--fail-on
+  warning|critical``);
 - ``python sheeprl.py fault-matrix`` — the resilience fault matrix on the CPU
   mesh (single-process + rank-targeted distributed fault smokes; see
   ``howto/fault_tolerance.md``);
@@ -91,6 +95,7 @@ from sheeprl_tpu.cli import (  # noqa: E402
     profile,
     run,
     serve,
+    slo,
     trace,
     watch,
 )
@@ -103,6 +108,7 @@ _SUBCOMMANDS = {
     "bench-diff": bench_diff,
     "fault-matrix": fault_matrix,
     "serve": serve,
+    "slo": slo,
     "fleet": fleet,
     "live": live,
     "trace": trace,

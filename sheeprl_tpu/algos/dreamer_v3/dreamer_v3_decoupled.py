@@ -37,6 +37,7 @@ from sheeprl_tpu.parallel.distributed import (
     BroadcastChannel,
     ChannelError,
     coordination_barrier,
+    publish_channel_error,
     replicated_to_host,
 )
 from sheeprl_tpu.utils.env import make_env
@@ -161,6 +162,10 @@ def _trainer_loop(
             resilience.step(last_step)
     except BaseException as e:  # surface learner crashes to the player
         error["exc"] = e
+        # out-of-band marker FIRST: on a non-src learner rank the channel put
+        # below is a sequence-counter no-op (BroadcastChannel writes only on
+        # src), so the marker is the only signal the blocked peers ever get
+        publish_channel_error(f"learner train loop failed: {e!r:.300}")
         # a crash inside a channel collective leaves the plane desynced: further
         # lockstep puts could hang and bury the traceback
         if not isinstance(e, ChannelError):
@@ -321,10 +326,12 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
                 opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
                 if state.get("moments") is not None:
                     moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
-            except Exception:
+            except Exception as exc:
                 # a load failure must not strand the player: pass the warmup barrier
                 # it is waiting at, then surface the crash on the weight plane so its
-                # first round raises 'learner crashed mid-run'
+                # first round raises 'learner crashed mid-run'. The put is a real
+                # write only on the params src rank; the KV marker covers the rest.
+                publish_channel_error(f"checkpoint resume load failed: {exc!r:.300}")
                 try:
                     coordination_barrier("dv3_decoupled_warmup")
                     params_q.put(None)

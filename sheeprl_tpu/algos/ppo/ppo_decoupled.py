@@ -248,6 +248,10 @@ def _trainer_loop(
             resilience.step(rounds * policy_steps_per_iter)
     except BaseException as e:  # surface learner crashes to the player
         error["exc"] = e
+        # out-of-band marker FIRST: on a non-src learner rank the channel put
+        # below is a sequence-counter no-op (BroadcastChannel writes only on
+        # src), so the marker is the only signal the blocked peers ever get
+        _publish_channel_error(f"learner train loop failed: {e!r:.300}")
         # If the crash came from a channel collective the broadcast plane is
         # desynced — another lockstep put can block forever and bury the real
         # traceback. Only unblock the player while the channel is healthy.
@@ -260,6 +264,7 @@ def _trainer_loop(
 
 from sheeprl_tpu.parallel.distributed import BroadcastChannel as _BcastChannel
 from sheeprl_tpu.parallel.distributed import ChannelError as _ChannelError
+from sheeprl_tpu.parallel.distributed import publish_channel_error as _publish_channel_error
 from sheeprl_tpu.parallel.distributed import replicated_to_host
 
 
@@ -308,11 +313,13 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
 
         try:
             resume_state = load_checkpoint(cfg.checkpoint.resume_from)
-        except Exception:
+        except Exception as exc:
             # a load failure (path missing on this host, corrupt pickle) must
             # surface on the player's weight plane like any learner crash —
             # otherwise the player blocks on params_q.get until the channel
-            # timeout with the real traceback buried here
+            # timeout with the real traceback buried here. The put is a real
+            # write only on the params src rank; the KV marker covers the rest.
+            _publish_channel_error(f"checkpoint resume load failed: {exc!r:.300}")
             try:
                 params_q.put(None)
             except _ChannelError:

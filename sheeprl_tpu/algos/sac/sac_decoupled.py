@@ -28,7 +28,12 @@ from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_sampler
-from sheeprl_tpu.parallel.distributed import BroadcastChannel, ChannelError, replicated_to_host
+from sheeprl_tpu.parallel.distributed import (
+    BroadcastChannel,
+    ChannelError,
+    publish_channel_error,
+    replicated_to_host,
+)
 from sheeprl_tpu.obs import NullTelemetry, build_role_telemetry, build_telemetry
 from sheeprl_tpu.resilience import (
     NullResilience,
@@ -142,6 +147,10 @@ def _trainer_loop(
             resilience.step(last_step)
     except BaseException as e:
         error["exc"] = e
+        # out-of-band marker FIRST: on a non-src learner rank the channel put
+        # below is a sequence-counter no-op (BroadcastChannel writes only on
+        # src), so the marker is the only signal the blocked peers ever get
+        publish_channel_error(f"learner train loop failed: {e!r:.300}")
         # If the crash came from a channel collective the broadcast plane is
         # desynced — another lockstep put can block forever and bury the real
         # traceback. Only unblock the player while the channel is healthy.
@@ -190,9 +199,12 @@ def _learner_process(fabric, cfg: Dict[str, Any]):
 
         try:
             resume_state = load_checkpoint(cfg.checkpoint.resume_from)
-        except Exception:
+        except Exception as exc:
             # surface a load failure on the weight plane like any learner crash
-            # (the player otherwise blocks on params_q.get until the channel timeout)
+            # (the player otherwise blocks on params_q.get until the channel
+            # timeout). The put is a real write only on the params src rank;
+            # the KV marker covers every other learner rank.
+            publish_channel_error(f"checkpoint resume load failed: {exc!r:.300}")
             try:
                 params_q.put(None)
             except ChannelError:

@@ -535,6 +535,19 @@ def diagnose(args: Optional[Sequence[str]] = None) -> int:
     return diagnose_main(list(args if args is not None else sys.argv[1:]))
 
 
+def slo(args: Optional[Sequence[str]] = None) -> int:
+    """``python sheeprl.py slo <run_dir|fleet_dir|live_dir>`` — replay the
+    run's telemetry windows through its declared SLOs (``metric.telemetry.slo``
+    + per-run ``slo.yaml``): per-objective burn rates, error budget remaining,
+    and the alert lifecycle recomputed offline and cross-checked against the
+    in-loop ``alert`` events; writes machine-readable ``slo.json`` next to the
+    streams. ``--fail-on warning|critical`` gates on FIRING alerts. See
+    ``howto/observability.md`` ("SLOs, error budgets, and alerts")."""
+    from sheeprl_tpu.obs.slo import main as slo_main
+
+    return slo_main(list(args if args is not None else sys.argv[1:]))
+
+
 def profile(args: Optional[Sequence[str]] = None) -> int:
     """``python sheeprl.py profile <run_dir>`` — parse the run's
     ``jax.profiler`` window capture(s) (``metric.profiler.mode=window``) into
@@ -773,8 +786,11 @@ def one_train_phase_steps(cfg: dotdict) -> int:
     devices = cfg.fabric.get("devices", 1)
     try:
         world_size = int(devices)
-    except (TypeError, ValueError):  # "auto"
-        world_size = 1
+    except (TypeError, ValueError):
+        # "auto" (and any other non-integer spelling) means "all local devices"
+        # exactly like -1 — resolving it to 1 would under-budget a multi-device
+        # priming run, which then never reaches its first train phase
+        world_size = 0
     if world_size <= 0:  # -1 = "all local devices" (dp-cpu/dp-tpu fabric configs)
         import jax
 

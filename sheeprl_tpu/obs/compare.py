@@ -47,6 +47,7 @@ DEFAULT_BENCH_THRESHOLD = 0.05  # bench-diff per-metric relative threshold
 DATAFLOW_GROWTH = 0.25  # ≥25% staleness/latency growth flags (lower-is-better)
 WEIGHT_LAG_DELTA = 2  # absolute extra weight versions of actor lag that flag
 LEARNING_LOSS_GROWTH = 0.25  # ≥25% median loss growth flags (lower-is-better)
+SLO_BUDGET_DROP = 0.10  # ≥10 points less error budget remaining flags
 
 _PHASE_KEYS = (
     "env",
@@ -306,6 +307,20 @@ def profile_run(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         att = int(e.get("attempt") or 0)
         restarts_per_attempt[att] = max(restarts_per_attempt.get(att, 0), total)
     env_restarts = sum(restarts_per_attempt.values())
+    # SLO end-state (obs/slo.py): each summary's final `slo` block, worst
+    # budget-remaining per objective across every stream that declared SLOs
+    # (a live gang ends with one per role); runs without SLOs profile None
+    slo_objectives: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("event") != "summary" or not isinstance(e.get("slo"), dict):
+            continue
+        for name, obj in (e["slo"].get("objectives") or {}).items():
+            if not isinstance(obj, Mapping):
+                continue
+            held = slo_objectives.get(name)
+            if held is None or _f(obj.get("budget_remaining")) < _f(held.get("budget_remaining")):
+                slo_objectives[str(name)] = dict(obj)
+
     return {
         "fingerprint": (starts[-1].get("fingerprint") if starts else None),
         "windows": len(windows),
@@ -320,6 +335,7 @@ def profile_run(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         "env_restarts": env_restarts,
         "dataflow": dataflow,
         "xla": xla,
+        "slo": slo_objectives or None,
         # training-health curves (windows carrying a `learning` block): the
         # sample-efficiency half of the comparison — None on old/serving runs
         "learning": _profile_learning(events),
@@ -600,6 +616,43 @@ def compare_profiles(
                     )
                 )
         metrics["learning"]["entropy"] = _delta_metric(la.get("entropy"), lb.get("entropy"))
+
+    # SLO error budgets (obs/slo.py): an objective that ended run B with
+    # materially less budget than run A — or exhausted (negative) when A was
+    # not — burned its error budget faster at the same declared targets. Gated
+    # on the runs' FINAL budget state (the whole-run compliance verdict), not
+    # a window distribution: budget_remaining is already window-integrated.
+    slo_a, slo_b = profile_a.get("slo") or {}, profile_b.get("slo") or {}
+    if slo_a and slo_b:
+        metrics["slo"] = {}
+        for name in sorted(set(slo_a) & set(slo_b)):
+            oa, ob = slo_a.get(name) or {}, slo_b.get(name) or {}
+            ba, bb = oa.get("budget_remaining"), ob.get("budget_remaining")
+            if not isinstance(ba, (int, float)) or not isinstance(bb, (int, float)):
+                continue
+            drop = float(ba) - float(bb)
+            metrics["slo"][name] = {
+                "a": round(float(ba), 4),
+                "b": round(float(bb), 4),
+                "drop": round(drop, 4),
+            }
+            exhausted = float(bb) < 0.0 <= float(ba)
+            if drop >= SLO_BUDGET_DROP or exhausted:
+                findings.append(
+                    _finding(
+                        "slo_budget_regression",
+                        "critical" if exhausted else "warning",
+                        f"run B ended with {float(bb):+.0%} of the `{name}` error "
+                        f"budget remaining vs run A's {float(ba):+.0%}"
+                        + (" — the objective is EXHAUSTED in B" if exhausted else ""),
+                        "`sheeprl.py slo` run B for the burn-rate report and which "
+                        "windows breached; `sheeprl.py diagnose` names the cause",
+                        objective=name,
+                        drop=round(drop, 4),
+                        value_a=oa.get("value"),
+                        value_b=ob.get("value"),
+                    )
+                )
 
     # env stability
     ra, rb = int(_f(profile_a.get("env_restarts"))), int(_f(profile_b.get("env_restarts")))
@@ -898,7 +951,16 @@ def bench_diff(
         # (new-old)/old would call an entropy collapse an "improvement"
         rel = (new_v - old_v) / abs(old_v) if old_v else None
         row["rel_change"] = round(rel, 4) if rel is not None else None
-        lower_better = _lower_is_better(str(w.get("unit") or prev.get("unit") or ""))
+        # an explicit per-workload direction pin beats the unit heuristic —
+        # serve_load_budget_remaining gates in "fraction" (normally a failure
+        # share, lower-is-better) but MORE budget remaining is better
+        pinned = str(w.get("direction") or prev.get("direction") or "").lower()
+        if pinned.startswith("lower"):
+            lower_better = True
+        elif pinned.startswith("higher"):
+            lower_better = False
+        else:
+            lower_better = _lower_is_better(str(w.get("unit") or prev.get("unit") or ""))
         row["direction"] = "lower-is-better" if lower_better else "higher-is-better"
         if rel is None:
             row["status"] = "unreadable"

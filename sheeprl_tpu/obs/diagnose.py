@@ -1562,6 +1562,120 @@ def detect_host_gap(events: Events) -> List[Finding]:
     ]
 
 
+VERSION_REGRESSION_MIN_STEPS = 20  # per-version ticks before the split is judged
+
+
+def detect_version_regression(events: Events) -> List[Finding]:
+    """A hot-reloaded weight version serves WORSE than its predecessor: either
+    the in-loop promotion judge (serve/telemetry.py) already recorded a
+    ``regressed`` verdict, or the cumulative per-version split shows the newest
+    version's latency p50 beyond both versions' own p50→p90 spread."""
+    regressed = [
+        e
+        for e in events
+        if e.get("event") == "promotion" and e.get("verdict") == "regressed"
+    ]
+    if regressed:
+        last = regressed[-1]
+        return [
+            _finding(
+                "version_regression",
+                "warning",
+                f"the in-loop promotion judge marked weight v{last.get('version')} "
+                f"REGRESSED vs v{last.get('baseline')}"
+                + (f": {last.get('reason')}" if last.get("reason") else ""),
+                regressed,
+                "hot-reload the previous checkpoint back (howto/serving.md §hot "
+                "reload) and `sheeprl.py compare` the learner run that published "
+                "it against the last good one",
+                version=last.get("version"),
+                baseline=last.get("baseline"),
+                reason=last.get("reason"),
+            )
+        ]
+    carrier = None
+    for e in reversed(events):
+        if e.get("event") not in ("summary", "window"):
+            continue
+        serve = e.get("serve")
+        versions = serve.get("versions") if isinstance(serve, dict) else None
+        if isinstance(versions, dict) and len(versions) >= 2:
+            carrier = e
+            break
+    if carrier is None:
+        return []
+    versions = carrier["serve"]["versions"]
+    try:
+        order = sorted(versions, key=lambda k: int(k))
+    except (TypeError, ValueError):
+        return []
+    new_key, base_key = order[-1], order[-2]
+    new, base = versions.get(new_key) or {}, versions.get(base_key) or {}
+    if min(_f(new.get("steps")), _f(base.get("steps"))) < VERSION_REGRESSION_MIN_STEPS:
+        return []
+    nl, bl = new.get("latency_ms") or {}, base.get("latency_ms") or {}
+    new_p50, base_p50 = _f(nl.get("p50")), _f(bl.get("p50"))
+    spread = max(_f(nl.get("p90")) - new_p50, 0.0) + max(_f(bl.get("p90")) - base_p50, 0.0)
+    if new_p50 <= 0 or base_p50 <= 0 or new_p50 <= base_p50 + spread:
+        return []
+    return [
+        _finding(
+            "version_regression",
+            "warning",
+            f"weight v{int(new_key)} serves slower than v{int(base_key)}: latency "
+            f"p50 {new_p50:.1f}ms vs {base_p50:.1f}ms — beyond both versions' own "
+            "p50→p90 spread",
+            [carrier],
+            "hot-reload the previous checkpoint back (howto/serving.md §hot "
+            "reload); `sheeprl.py compare` the publishing learner run against "
+            "the last good one for why the new policy got heavier",
+            version=int(new_key),
+            baseline=int(base_key),
+            latency_p50_ms=round(new_p50, 3),
+            baseline_latency_p50_ms=round(base_p50, 3),
+        )
+    ]
+
+
+def detect_slo_alert(events: Events) -> List[Finding]:
+    """SLO alerts still FIRING when the stream ended (obs/alerts.py): the
+    stateful in-loop engine's verdict surfaces as a diagnosis finding, at the
+    objective's own severity, so ``diagnose --fail-on`` gates on burned error
+    budgets like any other defect."""
+    last: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("event") == "alert" and (e.get("name") or e.get("objective")):
+            last[str(e.get("name") or e.get("objective"))] = e
+    findings: List[Finding] = []
+    for name in sorted(last):
+        e = last[name]
+        if e.get("status") != "firing":
+            continue
+        severity = e.get("severity") if e.get("severity") in _SEVERITY_RANK else "warning"
+        value, target = e.get("value"), e.get("target")
+        detail = (
+            f" (value {value:g} vs target {target:g})"
+            if isinstance(value, (int, float)) and isinstance(target, (int, float))
+            else ""
+        )
+        findings.append(
+            _finding(
+                "slo_alert",
+                str(severity),
+                f"the `{name}` SLO alert was still firing when the stream ended"
+                + detail,
+                [e],
+                "`sheeprl.py slo` prints the burn-rate report; the objective's "
+                "signal names the subsystem the other detectors here diagnose",
+                objective=name,
+                value=value,
+                target=target,
+                budget_remaining=e.get("budget_remaining"),
+            )
+        )
+    return findings
+
+
 DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "recompile_storm": detect_recompile_storm,
     "prefetch_starvation": detect_prefetch_starvation,
@@ -1578,6 +1692,8 @@ DETECTORS: Dict[str, Callable[[Events], List[Finding]]] = {
     "shed_rate": detect_shed_rate,
     "deadline_misses": detect_deadline_misses,
     "reload_stall": detect_reload_stall,
+    "version_regression": detect_version_regression,
+    "slo_alert": detect_slo_alert,
     "weight_staleness": detect_weight_staleness,
     "row_age_drift": detect_row_age_drift,
     "ingest_backpressure": detect_ingest_backpressure,

@@ -99,6 +99,9 @@ _WINDOW: Dict[str, Tuple[tuple, bool]] = {
     # {rounds, stats: {grad_norm/<g>, entropy, td_error_p50, ...},
     #  episodes: {count, return_mean, return_p10/p50/p90, len_mean}, nonfinite}
     "learning": (_DICT, False),
+    # SLO error-budget block (obs/slo.py): {worst: {objective, budget_remaining},
+    # objectives: {<name>: {value, target, budget_remaining, burn_fast/slow}}}
+    "slo": (_DICT, False),
 }
 
 _SUMMARY: Dict[str, Tuple[tuple, bool]] = {
@@ -123,6 +126,7 @@ _SUMMARY: Dict[str, Tuple[tuple, bool]] = {
     "learning": (_DICT, False),  # run-level learning rollup (+ last window)
     "programs": (_DICT, False),
     "serve": (_DICT, False),
+    "slo": (_DICT, False),  # final error-budget accounting (obs/slo.py)
 }
 
 _PROFILER: Dict[str, Tuple[tuple, bool]] = {
@@ -226,6 +230,37 @@ _OPEN_EVENTS: Dict[str, Dict[str, Tuple[tuple, bool]]] = {
         "rows": (_INT, False),
         "messages": (_INT, False),
         "weight_version": (_INT, False),
+    },
+    # SLO/alerting plane (obs/slo.py + obs/alerts.py): the stateful alert
+    # lifecycle (pending/firing/resolved with burn-rate evidence) and the
+    # per-weight-version promotion verdict the canary router gates on — emitted
+    # once a hot-reloaded version accumulates enough post-swap samples to judge
+    # against its predecessor (sheeprl_tpu/serve/telemetry.py)
+    "alert": {
+        "status": (_STR, True),
+        "name": (_STR, False),
+        "objective": (_STR, False),
+        "severity": (_STR, False),
+        "value": (_NUM, False),
+        "target": (_NUM, False),
+        "budget_remaining": (_NUM, False),
+        "burn_fast": (_NUM, False),
+        "burn_slow": (_NUM, False),
+        "for_windows": (_INT, False),
+    },
+    "promotion": {
+        "status": (_STR, True),
+        "verdict": (_STR, False),
+        "version": (_INT, False),
+        "baseline": (_INT, False),
+        "samples": (_INT, False),
+        "latency_p50_ms": (_NUM, False),
+        "baseline_latency_p50_ms": (_NUM, False),
+        "latency_spread_ms": (_NUM, False),
+        "return_mean": (_NUM, False),
+        "baseline_return_mean": (_NUM, False),
+        "return_spread": (_NUM, False),
+        "reason": (_STR, False),
     },
     "checkpoint": {},
     "restart": {"reason": (_STR, False)},

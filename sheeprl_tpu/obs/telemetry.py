@@ -305,6 +305,25 @@ class RunTelemetry:
         self.diagnosis = bool(tcfg.get("diagnosis", True))
         self.learning = bool(tcfg.get("learning", True))
 
+        # SLO plane (obs/slo.py + obs/alerts.py): objectives resolved from
+        # metric.telemetry.slo + a per-run slo.yaml. On a pure training stream
+        # the serving objectives never see their signal (structural no-ops);
+        # the training floors (step_rate/mfu/episode_return) default to null
+        # targets and only judge when declared per experiment.
+        self._slo_evaluator: Any = None
+        self._alert_engine: Any = None
+        if self.enabled:
+            try:
+                from sheeprl_tpu.obs.alerts import AlertEngine
+                from sheeprl_tpu.obs.slo import SloEvaluator, load_objectives
+
+                objectives = load_objectives(tcfg.get("slo"), run_dir=log_dir)
+            except Exception:
+                objectives = []
+            if objectives:
+                self._slo_evaluator = SloEvaluator(objectives)
+                self._alert_engine = AlertEngine(objectives)
+
         self._sink: Optional[JsonlEventSink] = None
         if self.enabled and bool(tcfg.get("jsonl", True)):
             path = jsonl_path or tcfg.get("jsonl_path") or (
@@ -729,6 +748,13 @@ class RunTelemetry:
                 # leaderboard rolls up
                 learning=self._learning_summary() or None,
                 programs={k: v for k, v in self._programs.items()},
+                # final error-budget accounting; None when no objective ever
+                # saw its signal (pure training stream with default objectives)
+                slo=(
+                    self._slo_evaluator.slo_block()
+                    if self._slo_evaluator is not None
+                    else None
+                ),
             )
             self._sink.close()
             self._sink = None
@@ -1180,12 +1206,66 @@ class RunTelemetry:
             window_event["dataflow"] = dataflow
         if learning is not None:
             window_event["learning"] = learning
+        # SLO plane: feed this window to the burn-rate evaluator, attach the
+        # budget block, advance the stateful alert engine — the same machinery
+        # `sheeprl.py slo` replays offline, so verdicts cannot drift
+        alert_transitions: list = []
+        slo_snapshot: Dict[str, Any] = {}
+        if self._slo_evaluator is not None:
+            self._slo_evaluator.observe_window(window_event)
+            slo_block = self._slo_evaluator.slo_block()
+            if slo_block is not None:
+                window_event["slo"] = slo_block
+            slo_snapshot = self._slo_evaluator.snapshot()
+            alert_transitions = self._alert_engine.evaluate(slo_snapshot)
         self._append_history("window", window_event)
         if self._sink is not None:
             self._sink.emit("window", **window_event)
             if health is not None:
                 self._append_history("health", {"step": policy_step, **health})
                 self._sink.emit("health", step=policy_step, **health)
+            for transition in alert_transitions:
+                self._sink.emit("alert", step=policy_step, **transition)
+                # critical alerts escalate through the existing health path
+                if (
+                    transition["status"] == "firing"
+                    and transition.get("severity") == "critical"
+                ):
+                    self._sink.emit(
+                        "health",
+                        step=policy_step,
+                        status="alert",
+                        findings=[
+                            {
+                                "detector": f"slo:{transition['name']}",
+                                "severity": "critical",
+                                "summary": (
+                                    f"SLO alert {transition['name']} firing "
+                                    f"(budget remaining {transition.get('budget_remaining')})"
+                                ),
+                                "suggestion": "see `sheeprl.py slo` for the budget breakdown",
+                            }
+                        ],
+                    )
+        if self.metrics_endpoint is not None and slo_snapshot:
+            # merged on top of this window's replace=True push; the NEXT window's
+            # full push wipes anything resolved, so firing gauges never linger
+            slo_gauges: Dict[str, float] = {}
+            worst_remaining = None
+            for name, stats in slo_snapshot.items():
+                if not stats.get("samples"):
+                    continue
+                remaining = stats.get("budget_remaining")
+                slo_gauges[f"Slo/budget_remaining/{name}"] = remaining
+                if worst_remaining is None or remaining < worst_remaining:
+                    worst_remaining = remaining
+            if worst_remaining is not None:
+                slo_gauges["Slo/worst_budget_remaining"] = worst_remaining
+            firing = self._alert_engine.firing()
+            slo_gauges["Alerts/firing"] = float(len(firing))
+            for name in firing:
+                slo_gauges[f"Alerts/firing/{name}"] = 1.0
+            self.metrics_endpoint.update(slo_gauges, replace=False)
         if self.diagnosis:
             self._run_live_diagnosis(policy_step)
 

@@ -357,6 +357,22 @@ def _emit_instants(tb: _TraceBuilder, pid: int, tid: int, event: Mapping[str, An
             )
             if event.get(k) is not None
         }
+    elif kind == "alert" and event.get("status") in ("firing", "resolved"):
+        # SLO alert lifecycle on the timeline: pending transitions are noise
+        # at trace zoom, firing/resolved mark the incident's span ends
+        name = f"alert:{event.get('status')}:{event.get('name')}"
+        args = {
+            k: event.get(k)
+            for k in ("severity", "value", "target", "budget_remaining", "burn_fast")
+            if event.get(k) is not None
+        }
+    elif kind == "promotion":
+        name = f"promotion:{event.get('verdict')}"
+        args = {
+            k: event.get(k)
+            for k in ("version", "baseline", "samples", "reason")
+            if event.get(k) is not None
+        }
     if name is None:
         return
     tb.events.append(

@@ -104,6 +104,11 @@ class WatchState:
         # point is cross-process visibility
         self.dataflow: Dict[str, Dict[str, Any]] = {}
         self._actor_dataflow: Dict[Any, Dict[str, Any]] = {}  # stream -> latest block
+        # SLO plane: each stream's latest window `slo` block (a live gang has
+        # one per role stream — render the worst), plus the firing-alert board
+        # driven by the stateful `alert` events (firing adds, resolved clears)
+        self._slo_by_stream: Dict[Any, Dict[str, Any]] = {}
+        self.alerts: Dict[str, Dict[str, Any]] = {}
         # per-rank liveness of a multi-process (gang) run: every event's rank
         # identity marks its writer alive; a health status=rank_dead names the
         # dead peer; the gang supervisor's attempt_exit carries exit codes. A
@@ -127,6 +132,10 @@ class WatchState:
                 self._consume_dataflow(
                     event["dataflow"], event.get("stream") or f"rank{event.get('rank', 0)}"
                 )
+            if kind == "window" and isinstance(event.get("slo"), dict):
+                self._slo_by_stream[
+                    event.get("stream") or f"rank{event.get('rank', 0)}"
+                ] = event["slo"]
             if kind == "start" and _is_primary(event):
                 self.start = event
             elif kind == "window" and _is_primary(event):
@@ -167,6 +176,8 @@ class WatchState:
                         continue
                     if not str(self.ranks.get(rank, "")).startswith("DEAD"):
                         self.ranks[rank] = "exited 0" if code == 0 else f"EXITED {code}"
+            elif kind == "alert":
+                self._consume_alert(event)
             elif kind == "profile_analysis":
                 self.profile = event
             elif kind == "giveup":
@@ -188,6 +199,28 @@ class WatchState:
             )
         elif role == "learner":
             self.dataflow["learner"] = dataflow
+
+    def _consume_alert(self, event: Dict[str, Any]) -> None:
+        name = str(event.get("name") or event.get("objective") or "?")
+        status = event.get("status")
+        if status == "firing":
+            self.alerts[name] = event
+        elif status == "resolved":
+            self.alerts.pop(name, None)
+
+    @property
+    def slo_worst(self) -> Optional[Dict[str, Any]]:
+        """The worst objective (by budget remaining) across every stream's
+        latest window `slo` block."""
+        worsts = [
+            block.get("worst")
+            for block in self._slo_by_stream.values()
+            if isinstance(block.get("worst"), dict)
+            and isinstance(block["worst"].get("budget_remaining"), (int, float))
+        ]
+        if not worsts:
+            return None
+        return min(worsts, key=lambda w: float(w["budget_remaining"]))
 
     @property
     def weight_lag(self) -> Optional[float]:
@@ -366,6 +399,23 @@ class WatchState:
                 if self.draining:
                     bits.append("DRAINING")
                 lines.append("  serve: " + " · ".join(bits))
+                versions = serve.get("versions")
+                if isinstance(versions, dict) and versions:
+                    # the per-weight-version split: this window's traffic keyed
+                    # by the policy version that served it — the promotion
+                    # question ("is the new version worse?") at a glance
+                    vbits = []
+                    for key in sorted(versions, key=lambda k: int(k)):
+                        vb = versions[key] or {}
+                        vlat = vb.get("latency_ms") or {}
+                        bit = f"v{int(key)} {int(vb.get('steps') or 0)} steps"
+                        if vlat.get("p50") is not None:
+                            bit += f" p50 {float(vlat['p50']):.1f}ms"
+                        returns = vb.get("returns") or {}
+                        if isinstance(returns.get("mean"), (int, float)):
+                            bit += f" ret {float(returns['mean']):g}"
+                        vbits.append(bit)
+                    lines.append("  versions: " + " · ".join(vbits))
             learning = w.get("learning")
             if isinstance(learning, dict):
                 # the training-health line: is the run actually LEARNING?
@@ -416,6 +466,25 @@ class WatchState:
                 bits.append(f"rows {int(actor['rows'])}")
             if bits:
                 lines.append("  dataflow: " + " · ".join(bits))
+        worst = self.slo_worst
+        if worst is not None or self.alerts:
+            # the SLO line: the objective closest to (or past) budget
+            # exhaustion, plus the firing-alert board
+            bits = []
+            if worst is not None:
+                bits.append(
+                    f"worst {worst.get('objective')} "
+                    f"budget {float(worst.get('budget_remaining') or 0.0):+.2f}"
+                )
+            if self.alerts:
+                names = ", ".join(
+                    f"{n}[{str((a or {}).get('severity') or '?')}]"
+                    for n, a in sorted(self.alerts.items())
+                )
+                bits.append(f"FIRING {names}")
+            else:
+                bits.append("alerts none")
+            lines.append("  slo: " + " · ".join(bits))
         health_bits = [f"health {self.health}"]
         if self.env_restarts:
             health_bits.append(f"{self.env_restarts} env restart(s)")
@@ -534,6 +603,8 @@ class FleetWatchState:
             age = ((state.dataflow.get("learner") or {}).get("row_age") or {}).get("seconds") or {}
             if age.get("p50") is not None:
                 bits.append(f"row age {float(age['p50']):.1f}s")
+            if state.alerts:
+                bits.append(f"{len(state.alerts)} alert(s) FIRING")
             findings = [f for f in state.findings if f.get("severity") in ("warning", "critical")]
             if findings:
                 bits.append(f"{len(findings)} finding(s)")

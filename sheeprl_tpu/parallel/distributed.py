@@ -145,6 +145,66 @@ class ChannelTimeout(ChannelError):
     can treat "nobody is talking" as a liveness failure)."""
 
 
+class ChannelPeerError(ChannelError):
+    """A peer rank published a failure marker (:func:`publish_channel_error`)
+    while this rank was blocked on the channel. The message names the failed
+    rank and its reason — the wait ends immediately instead of burning the full
+    channel deadline with the real traceback buried in another process."""
+
+
+def _channel_error_key() -> str:
+    # attempt-scoped so a restart attempt never reads the marker that KILLED
+    # the previous attempt; aligned across ranks because the supervisor exports
+    # the same attempt index to the whole gang
+    import os
+
+    return f"sheeprl_chan/err/a{os.environ.get('SHEEPRL_GANG_ATTEMPT', '0')}"
+
+
+def publish_channel_error(reason: str, *, rank: int | None = None, kv: Any = None) -> bool:
+    """Best-effort cross-rank failure marker on the coordination KV plane.
+
+    ``BroadcastChannel.put`` is a real write only on the channel's SRC rank —
+    on any other rank it just advances the sequence counter, so a non-src
+    learner that fails (checkpoint load, train-step crash) has NO channel-level
+    way to unblock the peers waiting on the src's next message: they hang for
+    the full channel deadline with the real traceback buried here. This marker
+    is the out-of-band path any rank can write; every ``_bounded_get`` polls it
+    between slices and raises :class:`ChannelPeerError` naming rank + reason.
+
+    Returns True when the marker was written (False outside a jax.distributed
+    session, or when the KV write itself fails — the original failure must
+    surface either way, so this never raises). ``kv`` injects the plane for
+    unit tests (:class:`~sheeprl_tpu.data.service.LocalKV`)."""
+    try:
+        if kv is None:
+            from sheeprl_tpu.data.service import coordination_kv
+
+            kv = coordination_kv()
+        if kv is None:
+            return False
+        who = rank if rank is not None else process_index()
+        kv.set(_channel_error_key(), f"rank {who}: {reason}"[:512])
+        return True
+    except Exception:
+        return False
+
+
+def poll_channel_error(kv: Any = None) -> str | None:
+    """Non-blocking probe for a peer's published failure marker (None when no
+    rank has failed, or outside a jax.distributed session)."""
+    try:
+        if kv is None:
+            from sheeprl_tpu.data.service import coordination_kv
+
+            kv = coordination_kv()
+        if kv is None:
+            return None
+        return kv.get(_channel_error_key())
+    except Exception:
+        return None
+
+
 _KV_CHUNK = 2 * 1024 * 1024  # stay under gRPC message-size defaults
 
 # Fault-injection hook (resilience/faults.py, kind=channel_drop): consulted once
@@ -265,7 +325,7 @@ class BroadcastChannel:
             self._seq += 1
             return pickle.loads(payload)
         except BaseException as e:
-            if isinstance(e, ChannelTimeout):
+            if isinstance(e, (ChannelTimeout, ChannelPeerError)):
                 raise
             # an abort_check verdict (a peer rank declared dead) must surface
             # under its own identity, not be buried in a generic channel error
@@ -287,6 +347,14 @@ class BroadcastChannel:
         while True:
             if self.abort_check is not None:
                 self.abort_check()  # raises to break the wait
+            # a NON-src peer that failed cannot unblock us through the channel
+            # (its put is a sequence-counter no-op) — its out-of-band marker
+            # ends this wait with the failure's identity instead of a timeout
+            peer_error = poll_channel_error()
+            if peer_error is not None:
+                raise ChannelPeerError(
+                    f"channel get (src={self.src}) aborted: a peer rank failed — {peer_error}"
+                )
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise ChannelTimeout(

@@ -24,7 +24,20 @@ renders), and each window carries a ``serve`` block:
   ``available`` version the reloader has seen (version > available never
   happens; available > version sustained = a stalled reload),
 - ``degraded``: whether the widened coalescing window is active,
-- ``ticks`` and ``state_bytes`` (the O(S) device session-state footprint).
+- ``ticks`` and ``state_bytes`` (the O(S) device session-state footprint),
+- ``versions``: the per-weight-version split — latency percentiles, session
+  lifecycle counts, deadline misses, and trajectory-plane episode returns keyed
+  by the serving weight version active when each request completed (swaps land
+  between ticks — ``PolicyServer._loop`` applies pending params at tick START —
+  so per-tick attribution is exact). The summary carries the cumulative split;
+  the ``promotion`` verdict event (emitted once a hot-reloaded version
+  accumulates enough post-swap samples to judge against its predecessor) is the
+  hook the canary router gates on,
+- ``returns``: window aggregate of captured episode returns (mean / count),
+- ``slo``: the error-budget block (``obs/slo.py``) — when objectives are
+  declared, every window feeds the in-loop burn-rate evaluator and the stateful
+  alert engine (``obs/alerts.py``); transitions land as ``alert`` events and
+  critical firing alerts escalate through the existing ``health`` path.
 
 Lifecycle events of the robustness plane (schema-registered in
 ``obs/schema.py``): ``reload`` (status=applied/rejected/stale with the version
@@ -59,6 +72,8 @@ __all__ = ["ServingTelemetry"]
 
 _HISTORY_CAP = 512
 _LATENCY_RESERVOIR = 65536  # bounded overall-latency sample for the summary
+_VERSION_RESERVOIR = 8192  # bounded per-version latency sample (promotion spread)
+_RETURN_RESERVOIR = 1024  # bounded per-version episode-return sample
 
 
 def _percentiles(samples) -> Optional[Dict[str, float]]:
@@ -71,6 +86,34 @@ def _percentiles(samples) -> Optional[Dict[str, float]]:
         "mean": round(float(arr.mean()), 3),
         "max": round(float(arr.max()), 3),
     }
+
+
+def _spread(samples) -> float:
+    """Half the p10–p90 span — the noise floor the promotion verdict and the
+    version_regression detector require a latency delta to clear."""
+    if len(samples) < 2:
+        return 0.0
+    arr = np.asarray(samples, dtype=np.float64)
+    return round(float(np.percentile(arr, 90) - np.percentile(arr, 10)) / 2.0, 3)
+
+
+def _slo_cfg_of(cfg: Any) -> Optional[Dict[str, Any]]:
+    """``metric.telemetry.slo`` out of whatever config shape the caller holds
+    (composed serve cfg, hydra DictConfig, a bare test stub) — None when the
+    group is absent; never raises."""
+    try:
+        metric = cfg.get("metric") if hasattr(cfg, "get") else getattr(cfg, "metric", None)
+        telemetry = (
+            metric.get("telemetry") if hasattr(metric, "get") else getattr(metric, "telemetry", None)
+        )
+        slo = (
+            telemetry.get("slo")
+            if hasattr(telemetry, "get")
+            else getattr(telemetry, "slo", None)
+        )
+        return dict(slo) if slo is not None else None
+    except Exception:
+        return None
 
 
 class ServingTelemetry:
@@ -93,6 +136,7 @@ class ServingTelemetry:
         http_host: str = "127.0.0.1",
         attempt: int = 0,
         rank: int = 0,
+        slo: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.enabled = bool(enabled)
         self.every = max(int(every), 1)
@@ -133,6 +177,36 @@ class ServingTelemetry:
         self._degraded = False
         self._draining = False
         self._drain_info: Optional[Dict[str, Any]] = None
+        # per-weight-version split: cumulative + per-window accumulators keyed
+        # by the version active when each request completed. Latency reservoirs
+        # are bounded (a long-lived version must not grow without bound) —
+        # enough samples for stable p50/p99 and the promotion verdict's spread.
+        self._versions: Dict[int, Dict[str, Any]] = {}
+        self._win_versions: Dict[int, Dict[str, Any]] = {}
+        # episode returns by version arrive from the trajectory-ingest plane's
+        # client threads — their own maps under _traj_lock, like the counters
+        self._ver_returns: Dict[int, deque] = {}
+        self._win_ver_returns: Dict[int, List[float]] = {}
+        self._win_returns: List[float] = []
+        # promotion verdicts: each applied reload anchors a pending judgment
+        # (new version vs its predecessor), judged at window cadence once the
+        # new version has accumulated enough post-swap samples
+        self._pending_promotions: List[Dict[str, Any]] = []
+        # SLO plane: objectives resolved from metric.telemetry.slo (catalog
+        # defaults + config overrides + per-run slo.yaml), evaluated in-loop
+        # at window cadence by the SAME machinery `sheeprl.py slo` replays
+        slo_cfg = slo if slo is not None else _slo_cfg_of(cfg)
+        self._promotion_min_samples = max(int((slo_cfg or {}).get("promotion_samples") or 32), 1)
+        self._slo_evaluator: Any = None
+        self._alert_engine: Any = None
+        if self.enabled:
+            from sheeprl_tpu.obs.alerts import AlertEngine
+            from sheeprl_tpu.obs.slo import SloEvaluator, load_objectives
+
+            objectives = load_objectives(slo_cfg, run_dir=log_dir)
+            if objectives:
+                self._slo_evaluator = SloEvaluator(objectives)
+                self._alert_engine = AlertEngine(objectives)
         # trajectory-capture counters (the live flywheel's serve-side ingest:
         # captured = finished sessions that produced transitions, dropped =
         # shed by the bounded ingest queue — the explicit overflow policy)
@@ -256,6 +330,20 @@ class ServingTelemetry:
         if latencies_ms:
             self._win_latencies.extend(float(v) for v in latencies_ms)
             self._all_latencies.extend(float(v) for v in latencies_ms)
+        # per-version attribution: swaps apply between ticks, so everything
+        # this tick carried belongs to the version now serving
+        if batch or started or finished or shed or deadline_missed or latencies_ms:
+            cum = self._version_slot(self._versions, self._weight_version)
+            win = self._version_slot(self._win_versions, self._weight_version)
+            for acc in (cum, win):
+                acc["steps"] += int(batch)
+                acc["started"] += int(started)
+                acc["finished"] += int(finished)
+                acc["shed"] += int(shed)
+                acc["deadline_missed"] += int(deadline_missed)
+            if latencies_ms:
+                cum["latencies"].extend(float(v) for v in latencies_ms)
+                win["latencies"].extend(float(v) for v in latencies_ms)
 
         if self._win_steps >= self.every:
             self._emit_window()
@@ -283,6 +371,49 @@ class ServingTelemetry:
         self._win_sessions_finished += int(finished)
         self._win_sessions_shed += int(shed)
         self._win_deadline_missed += int(deadline_missed)
+        if started or finished or shed or deadline_missed:
+            for acc in (
+                self._version_slot(self._versions, self._weight_version),
+                self._version_slot(self._win_versions, self._weight_version),
+            ):
+                acc["started"] += int(started)
+                acc["finished"] += int(finished)
+                acc["shed"] += int(shed)
+                acc["deadline_missed"] += int(deadline_missed)
+
+    @staticmethod
+    def _version_slot(table: Dict[int, Dict[str, Any]], version: int) -> Dict[str, Any]:
+        slot = table.get(int(version))
+        if slot is None:
+            slot = {
+                "steps": 0,
+                "started": 0,
+                "finished": 0,
+                "shed": 0,
+                "deadline_missed": 0,
+                "latencies": deque(maxlen=_VERSION_RESERVOIR),
+            }
+            table[int(version)] = slot
+        return slot
+
+    def observe_episode(
+        self, return_: float, *, version: Optional[int] = None
+    ) -> None:
+        """One captured episode's return, attributed to the weight version that
+        served it (the trajectory-ingest plane calls this from client threads
+        at session close — hence the lock). Feeds the window's ``serve.returns``
+        aggregate, the per-version split, and the promotion verdict's
+        return-regression check."""
+        if not self.enabled:
+            return
+        ver = int(version if version is not None else self._weight_version)
+        with self._traj_lock:
+            returns = self._ver_returns.get(ver)
+            if returns is None:
+                returns = self._ver_returns[ver] = deque(maxlen=_RETURN_RESERVOIR)
+            returns.append(float(return_))
+            self._win_ver_returns.setdefault(ver, []).append(float(return_))
+            self._win_returns.append(float(return_))
 
     def observe_trajectories(
         self,
@@ -365,9 +496,17 @@ class ServingTelemetry:
             )
             return
         if version is not None:
+            baseline = self._weight_version
             self._weight_version = int(version)
             self._weight_available = max(self._weight_available, int(version))
             self._reloads += 1
+            # anchor a promotion judgment: once the new version accumulates
+            # enough post-swap samples, _emit_window compares it against the
+            # version it replaced and emits the one-shot `promotion` verdict
+            if int(version) != baseline:
+                self._pending_promotions.append(
+                    {"version": int(version), "baseline": int(baseline)}
+                )
             self.emit_event(
                 "reload",
                 status="applied",
@@ -428,12 +567,62 @@ class ServingTelemetry:
 
     # -- window / summary ----------------------------------------------------------
 
+    def _versions_block(
+        self,
+        table: Dict[int, Dict[str, Any]],
+        returns: Dict[int, Any],
+    ) -> Optional[Dict[str, Any]]:
+        """The per-weight-version split (string keys — JSON object keys), only
+        for versions that actually served or returned something."""
+        out: Dict[str, Any] = {}
+        for ver in sorted(set(table) | set(returns)):
+            acc = table.get(ver)
+            ver_returns = returns.get(ver)
+            if not (acc and acc["steps"]) and not ver_returns:
+                continue
+            entry: Dict[str, Any] = {}
+            if acc:
+                entry.update(
+                    {
+                        "steps": acc["steps"],
+                        "latency_ms": _percentiles(acc["latencies"]),
+                        "sessions": {
+                            "started": acc["started"],
+                            "finished": acc["finished"],
+                            "shed": acc["shed"],
+                        },
+                        "deadline_missed": acc["deadline_missed"],
+                    }
+                )
+            if ver_returns:
+                entry["returns"] = {
+                    "mean": round(float(np.mean(ver_returns)), 4),
+                    "n": len(ver_returns),
+                }
+            out[str(ver)] = entry
+        return out or None
+
     def _serve_block(self, wall: float) -> Dict[str, Any]:
         ticks = max(self._win_ticks, 1)
+        with self._traj_lock:
+            win_ver_returns = {k: list(v) for k, v in self._win_ver_returns.items()}
+            win_returns = list(self._win_returns)
+        versions = self._versions_block(self._win_versions, win_ver_returns)
         # shed_rate: shed / offered, where offered = sessions that ASKED for
         # admission this window (started already excludes the shed ones)
         offered = self._win_sessions_started + self._win_sessions_shed
         return {
+            **({"versions": versions} if versions else {}),
+            **(
+                {
+                    "returns": {
+                        "mean": round(float(np.mean(win_returns)), 4),
+                        "n": len(win_returns),
+                    }
+                }
+                if win_returns
+                else {}
+            ),
             "latency_ms": _percentiles(self._win_latencies),
             "occupancy": round(self._win_occupancy_sum / ticks, 4),
             "sessions": {
@@ -516,14 +705,53 @@ class ServingTelemetry:
         dataflow = self._dataflow_block()
         if dataflow is not None:
             window_event["dataflow"] = dataflow
+        # the in-loop SLO plane: feed THIS window to the burn-rate evaluator,
+        # attach the budget block the window carries, and advance the alert
+        # engine — identical machinery to `sheeprl.py slo`'s offline replay
+        alert_transitions: List[Dict[str, Any]] = []
+        slo_snapshot: Dict[str, Any] = {}
+        if self._slo_evaluator is not None:
+            self._slo_evaluator.observe_window(window_event)
+            slo_block = self._slo_evaluator.slo_block()
+            if slo_block is not None:
+                window_event["slo"] = slo_block
+            slo_snapshot = self._slo_evaluator.snapshot()
+            alert_transitions = self._alert_engine.evaluate(slo_snapshot)
         self._append_history("window", window_event)
         if self._sink is not None:
             self._sink.emit("window", **window_event)
+        # emit through the sink directly: the final window runs after close()
+        # already flipped `enabled` off, and its transitions must still land
+        for transition in alert_transitions:
+            if self._sink is None:
+                break
+            self._sink.emit("alert", step=self._steps, **transition)
+            # critical alerts escalate through the existing health path, so
+            # every consumer already watching health sees them without growing
+            # an alert-specific ear
+            if transition["status"] == "firing" and transition.get("severity") == "critical":
+                self._sink.emit(
+                    "health",
+                    step=self._steps,
+                    status="alert",
+                    findings=[
+                        {
+                            "detector": f"slo:{transition['name']}",
+                            "severity": "critical",
+                            "summary": (
+                                f"SLO alert {transition['name']} firing "
+                                f"(budget remaining {transition.get('budget_remaining')})"
+                            ),
+                            "suggestion": "see `sheeprl.py slo` for the budget breakdown",
+                        }
+                    ],
+                )
+        self._judge_promotions()
         if self.metrics_endpoint is not None:
             serve_block = window_event["serve"]
             lat = serve_block.get("latency_ms") or {}
             sessions = serve_block.get("sessions") or {}
-            self.metrics_endpoint.update(
+            gauges = dict(
                 {
                     "Perf/sps": window_event["sps"],
                     "Serve/latency_p50_ms": lat.get("p50"),
@@ -550,6 +778,32 @@ class ServingTelemetry:
                     "Compile/count": (window_event.get("compile") or {}).get("count"),
                 }
             )
+            # per-objective budget gauges + ALERTS-style firing gauges: the
+            # single replace=True push keeps resolved alerts from lingering
+            worst_remaining = None
+            for name, stats in slo_snapshot.items():
+                if not stats.get("samples"):
+                    continue
+                remaining = stats.get("budget_remaining")
+                gauges[f"Slo/budget_remaining/{name}"] = remaining
+                gauges[f"Slo/burn_fast/{name}"] = stats.get("burn_fast")
+                if worst_remaining is None or remaining < worst_remaining:
+                    worst_remaining = remaining
+            if worst_remaining is not None:
+                gauges["Slo/worst_budget_remaining"] = worst_remaining
+            if self._alert_engine is not None:
+                firing = self._alert_engine.firing()
+                gauges["Alerts/firing"] = len(firing)
+                for name in firing:
+                    gauges[f"Alerts/firing/{name}"] = 1.0
+            for ver, entry in (serve_block.get("versions") or {}).items():
+                ver_lat = entry.get("latency_ms") or {}
+                gauges[f"Serve/versions/v{ver}/latency_p50_ms"] = ver_lat.get("p50")
+                gauges[f"Serve/versions/v{ver}/latency_p99_ms"] = ver_lat.get("p99")
+                gauges[f"Serve/versions/v{ver}/steps"] = entry.get("steps")
+                if entry.get("returns"):
+                    gauges[f"Serve/versions/v{ver}/return_mean"] = entry["returns"].get("mean")
+            self.metrics_endpoint.update(gauges)
         if self.diagnosis:
             self._run_live_diagnosis()
 
@@ -566,12 +820,74 @@ class ServingTelemetry:
         self._win_sessions_shed = 0
         self._win_sessions_drained = 0
         self._win_deadline_missed = 0
+        self._win_versions = {}
         with self._traj_lock:
             self._win_traj_captured = 0
             self._win_traj_ingested = 0
             self._win_traj_dropped = 0
             self._win_traj_rows = 0
+            self._win_ver_returns = {}
+            self._win_returns = []
         self._anchor_time = now
+
+    def _judge_promotions(self) -> None:
+        """Judge pending reload promotions that accumulated enough post-swap
+        samples: the new version regresses when its latency p50 sits beyond
+        BOTH versions' spread above the baseline's, or its episode-return mean
+        falls beyond both spreads below — one one-shot `promotion` event per
+        applied version, the gate the canary router consumes."""
+        if not self._pending_promotions:
+            return
+        still_pending: List[Dict[str, Any]] = []
+        for pending in self._pending_promotions:
+            version, baseline = pending["version"], pending["baseline"]
+            acc = self._versions.get(version)
+            samples = acc["steps"] if acc else 0
+            if samples < self._promotion_min_samples:
+                still_pending.append(pending)
+                continue
+            base = self._versions.get(baseline)
+            with self._traj_lock:
+                ver_returns = list(self._ver_returns.get(version) or ())
+                base_returns = list(self._ver_returns.get(baseline) or ())
+            fields: Dict[str, Any] = {
+                "version": version,
+                "baseline": baseline,
+                "samples": samples,
+            }
+            regressions = []
+            if acc and len(acc["latencies"]):
+                lat = _percentiles(acc["latencies"]) or {}
+                fields["latency_p50_ms"] = lat.get("p50")
+                if base is not None and len(base["latencies"]):
+                    base_lat = _percentiles(base["latencies"]) or {}
+                    noise = _spread(acc["latencies"]) + _spread(base["latencies"])
+                    fields["baseline_latency_p50_ms"] = base_lat.get("p50")
+                    fields["latency_spread_ms"] = round(noise, 3)
+                    if lat.get("p50", 0.0) > (base_lat.get("p50") or 0.0) + noise:
+                        regressions.append("latency")
+            if len(ver_returns) >= 4 and len(base_returns) >= 4:
+                noise = _spread(ver_returns) + _spread(base_returns)
+                mean = float(np.mean(ver_returns))
+                base_mean = float(np.mean(base_returns))
+                fields["return_mean"] = round(mean, 4)
+                fields["baseline_return_mean"] = round(base_mean, 4)
+                fields["return_spread"] = round(noise, 4)
+                if mean < base_mean - noise:
+                    regressions.append("return")
+            if base is None or not len(base["latencies"]):
+                fields["reason"] = "no baseline samples"
+            elif regressions:
+                fields["reason"] = "+".join(regressions) + " beyond both versions' spread"
+            if self._sink is not None:
+                self._sink.emit(
+                    "promotion",
+                    step=self._steps,
+                    status="verdict",
+                    verdict="regressed" if regressions else "promote",
+                    **fields,
+                )
+        self._pending_promotions = still_pending
 
     def close(self, clean_exit: bool = True) -> None:
         """Flush the last partial window and the run summary; idempotent."""
@@ -590,10 +906,17 @@ class ServingTelemetry:
         hbm = device_memory(self._device) if self._device is not None else None
         peak_hbm = max(self._peak_hbm, (hbm or {}).get("peak_bytes", 0)) or None
         dataflow = self._dataflow_block()
+        with self._traj_lock:
+            ver_returns = {k: list(v) for k, v in self._ver_returns.items()}
+        versions = self._versions_block(self._versions, ver_returns)
+        slo_block = (
+            self._slo_evaluator.slo_block() if self._slo_evaluator is not None else None
+        )
         self._sink.emit(
             "summary",
             step=self._steps,
             **({"dataflow": dataflow} if dataflow is not None else {}),
+            **({"slo": slo_block} if slo_block is not None else {}),
             clean_exit=bool(clean_exit),
             windows=self._window_idx,
             total_steps=self._steps,
@@ -624,6 +947,7 @@ class ServingTelemetry:
                     "reloads": self._reloads,
                     "failures": self._reload_failures,
                 },
+                **({"versions": versions} if versions else {}),
                 **({"drain": self._drain_info} if self._drain_info else {}),
                 "trajectories": {
                     "captured": self._traj_captured,

@@ -188,6 +188,26 @@ class TrajectoryIngest:
             self.telemetry.observe_trajectories(
                 captured=1, dropped=1 if dropped else 0
             )
+            # episode return attributed to the weight version serving NOW —
+            # the session just closed, so the live version is the one that
+            # produced (at least the tail of) this trajectory; feeds the
+            # per-version split + the promotion verdict's return check
+            observe_episode = getattr(self.telemetry, "observe_episode", None)
+            if observe_episode is not None and transitions:
+                ended = bool(
+                    transitions[-1].get("terminated") or transitions[-1].get("truncated")
+                )
+                if ended:
+                    try:
+                        return_ = float(sum(t["reward"] for t in transitions))
+                        version = (
+                            int(self.weight_version_of())
+                            if self.weight_version_of is not None
+                            else None
+                        )
+                        observe_episode(return_, version=version)
+                    except Exception:
+                        pass  # return accounting must never break capture
         return not dropped
 
     # -- worker side ---------------------------------------------------------------

@@ -1,0 +1,583 @@
+"""SLO & alerting plane (sheeprl_tpu/obs/slo.py + obs/alerts.py, ISSUE 19):
+objective resolution (catalog → config group → per-run slo.yaml), burn-rate
+math, the stateful pending→firing→resolved alert engine, offline replay exit
+codes on the recorded serving fixture, the version_regression / slo_alert
+detectors, the in-loop ServingTelemetry integration (alert + promotion events,
+health escalation, Prometheus gauges), and the consumer wiring (watch, trace,
+compare, bench-diff direction pin)."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+import urllib.request
+
+import jax
+import pytest
+
+from sheeprl_tpu.obs.alerts import AlertEngine
+from sheeprl_tpu.obs.slo import (
+    OBJECTIVE_CATALOG,
+    SloEvaluator,
+    evaluate_events,
+    load_objectives,
+    main as slo_main,
+)
+
+pytestmark = pytest.mark.slo
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_FIXTURE = os.path.join(_REPO, "tests", "data", "recorded_run_serve", "telemetry.jsonl")
+
+
+def _fixture_events():
+    return [json.loads(line) for line in open(_FIXTURE) if line.strip()]
+
+
+def _serve_window(step, p99=20.0, shed_rate=0.0, version=0, available=None, **serve):
+    return {
+        "event": "window",
+        "step": step,
+        "window": step // 100,
+        "wall_seconds": 10.0,
+        "sps": 10.0,
+        "steps": 100,
+        "serve": {
+            "latency_ms": {"p50": p99 / 2, "p99": p99, "mean": p99 / 2, "max": p99},
+            "shed_rate": shed_rate,
+            "deadline_missed": 0,
+            "weights": {
+                "version": version,
+                "available": available if available is not None else version,
+            },
+            **serve,
+        },
+    }
+
+
+# -- objective resolution -------------------------------------------------------------
+
+
+def test_load_objectives_serving_defaults_enabled_training_floors_off():
+    objectives = {o.name: o for o in load_objectives()}
+    assert set(objectives) == {
+        "serving_latency_p99",
+        "availability",
+        "weight_staleness",
+        "deadline_miss",
+    }
+    # the training floors exist in the catalog but ship disabled (target null)
+    assert {"step_rate", "mfu", "episode_return"} <= set(OBJECTIVE_CATALOG)
+    assert objectives["serving_latency_p99"].kind == "le"
+    assert objectives["availability"].kind == "ge"
+    assert objectives["availability"].severity == "critical"
+
+
+def test_load_objectives_config_group_enables_floor_and_disables_plane():
+    objectives = {
+        o.name: o
+        for o in load_objectives({"objectives": {"step_rate": {"target": 5000.0}}})
+    }
+    assert "step_rate" in objectives and objectives["step_rate"].target == 5000.0
+    assert load_objectives({"enabled": False}) == []
+    # unknown objective names are ignored (forward-compat spec, not a crash)
+    assert load_objectives({"objectives": {"not_a_thing": {"target": 1.0}}})
+
+
+def test_per_run_slo_yaml_overrides_config_group(tmp_path):
+    (tmp_path / "slo.yaml").write_text(
+        "objectives:\n  serving_latency_p99:\n    target: 100.0\n    severity: critical\n"
+    )
+    cfg = {"objectives": {"serving_latency_p99": {"target": 200.0}}}
+    objectives = {o.name: o for o in load_objectives(cfg, run_dir=str(tmp_path))}
+    assert objectives["serving_latency_p99"].target == 100.0
+    assert objectives["serving_latency_p99"].severity == "critical"
+    # without the file the config group wins
+    objectives = {o.name: o for o in load_objectives(cfg, run_dir=str(tmp_path / "nope"))}
+    assert objectives["serving_latency_p99"].target == 200.0
+
+
+# -- burn-rate math -------------------------------------------------------------------
+
+
+def test_burn_rates_and_budget_remaining_exact():
+    objectives = [
+        o
+        for o in load_objectives(
+            {"objectives": {"serving_latency_p99": {"target": 50.0, "budget": 0.25, "window": 12}}}
+        )
+        if o.name == "serving_latency_p99"
+    ]
+    ev = SloEvaluator(objectives)
+    for i in range(9):
+        ev.observe_window(_serve_window(i * 100, p99=20.0))
+    for i in range(9, 12):
+        ev.observe_window(_serve_window(i * 100, p99=500.0))
+    s = ev.snapshot()["serving_latency_p99"]
+    # slow burn = (3 breaches / 12 windows) / 0.25 budget = 1.0 → budget spent
+    assert s["samples"] == 12 and s["breaches"] == 3
+    assert s["burn_slow"] == pytest.approx(1.0)
+    assert s["budget_remaining"] == pytest.approx(0.0)
+    # fast window = 12 // 6 = 2 most recent, both breached: (2/2) / 0.25 = 4.0
+    assert s["burn_fast"] == pytest.approx(4.0)
+    block = ev.slo_block()
+    assert block["worst"]["objective"] == "serving_latency_p99"
+
+
+def test_windows_without_the_plane_contribute_nothing():
+    ev = SloEvaluator(load_objectives())
+    ev.observe_window({"event": "window", "step": 100, "wall_seconds": 10.0, "sps": 9.0})
+    assert all(s["samples"] == 0 for s in ev.snapshot().values())
+    assert ev.slo_block() is None
+
+
+# -- alert engine lifecycle -----------------------------------------------------------
+
+
+def _latency_objective(for_windows=2):
+    return [
+        o
+        for o in load_objectives(
+            {
+                "objectives": {
+                    "serving_latency_p99": {
+                        "target": 50.0,
+                        "budget": 0.05,
+                        "window": 6,
+                        "for": for_windows,
+                    }
+                }
+            }
+        )
+        if o.name == "serving_latency_p99"
+    ]
+
+
+def test_alert_pending_firing_resolved_lifecycle():
+    objectives = _latency_objective()
+    ev, engine = SloEvaluator(objectives), AlertEngine(objectives)
+
+    ev.observe_window(_serve_window(100, p99=500.0))
+    t1 = engine.evaluate(ev.snapshot())
+    assert [t["status"] for t in t1] == ["pending"]
+    assert engine.firing() == {}
+
+    ev.observe_window(_serve_window(200, p99=500.0))
+    t2 = engine.evaluate(ev.snapshot())
+    assert [t["status"] for t in t2] == ["firing"]
+    assert "serving_latency_p99" in engine.firing()
+    assert t2[0]["budget_remaining"] < 0
+
+    # recovery: healthy windows age the breaches out of the fast window; the
+    # firing alert emits exactly one `resolved` and deactivates
+    resolved = []
+    for i in range(3, 9):
+        ev.observe_window(_serve_window(i * 100, p99=20.0))
+        resolved.extend(engine.evaluate(ev.snapshot()))
+    assert [t["status"] for t in resolved] == ["resolved"]
+    assert engine.firing() == {}
+
+
+def test_one_bad_window_pages_nobody():
+    objectives = _latency_objective(for_windows=2)
+    ev, engine = SloEvaluator(objectives), AlertEngine(objectives)
+    ev.observe_window(_serve_window(100, p99=500.0))
+    engine.evaluate(ev.snapshot())  # pending
+    for i in range(2, 8):
+        ev.observe_window(_serve_window(i * 100, p99=20.0))
+        transitions = engine.evaluate(ev.snapshot())
+        assert all(t["status"] != "firing" for t in transitions)
+    assert engine.firing() == {}
+
+
+def test_missing_signal_holds_alert_state():
+    objectives = _latency_objective()
+    ev, engine = SloEvaluator(objectives), AlertEngine(objectives)
+    for step in (100, 200):
+        ev.observe_window(_serve_window(step, p99=500.0))
+        engine.evaluate(ev.snapshot())
+    assert "serving_latency_p99" in engine.firing()
+    # a window without the serve plane is no evidence either way
+    ev.observe_window({"event": "window", "step": 300, "wall_seconds": 10.0})
+    assert engine.evaluate(ev.snapshot()) == []
+    assert "serving_latency_p99" in engine.firing()
+
+
+# -- offline replay on the recorded serving fixture -----------------------------------
+
+
+def test_fixture_replay_agrees_with_recorded_alerts():
+    events = _fixture_events()
+    result = evaluate_events(events, load_objectives())
+    assert result["windows"] == 12
+    assert result["alerts"]["firing"] == ["serving_latency_p99"]
+    # the stream's in-loop alert events were generated by the same machinery:
+    # replay and recording must agree (the drift the report would flag)
+    assert sorted(result["alerts"]["recorded_firing"]) == ["serving_latency_p99"]
+    assert result["worst_firing_severity"] == "warning"
+    latency = result["objectives"]["serving_latency_p99"]
+    assert latency["breaches"] == 2 and latency["budget_remaining"] < 0
+    # the healthy objectives keep their full budget
+    assert result["objectives"]["availability"]["budget_remaining"] == pytest.approx(1.0)
+    assert result["objectives"]["weight_staleness"]["budget_remaining"] == pytest.approx(1.0)
+
+
+def test_slo_cli_exit_codes_and_report(tmp_path, capsys):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    shutil.copy(_FIXTURE, run_dir / "telemetry.jsonl")
+    assert slo_main([str(run_dir)]) == 0  # no gate requested
+    out = capsys.readouterr().out
+    assert "serving_latency_p99" in out and "FIRING" in out
+    report = json.load(open(run_dir / "slo.json"))
+    assert report["alerts"]["firing"] == ["serving_latency_p99"]
+    assert report["declared"] == [o.name for o in load_objectives()]
+    # warning-level gate trips on the firing warning alert; critical does not
+    assert slo_main([str(run_dir), "--quiet", "--fail-on", "warning"]) == 1
+    assert slo_main([str(run_dir), "--quiet", "--fail-on", "critical"]) == 0
+    assert slo_main([str(tmp_path / "nope"), "--quiet"]) == 2
+
+
+def test_slo_cli_training_run_without_serving_signal_is_green(tmp_path):
+    src = os.path.join(_REPO, "tests", "data", "recorded_run")
+    run_dir = tmp_path / "train"
+    shutil.copytree(src, run_dir)
+    # training floors ship disabled and the serving objectives never see their
+    # plane on a training stream — nothing to judge, gate green
+    assert slo_main([str(run_dir), "--quiet", "--fail-on", "warning"]) == 0
+    report = json.load(open(run_dir / "slo.json"))
+    assert report["alerts"]["firing"] == []
+
+
+# -- diagnose detectors ---------------------------------------------------------------
+
+
+def test_version_regression_detector_trusts_recorded_verdict():
+    from sheeprl_tpu.obs.diagnose import detect_version_regression
+
+    events = [
+        {
+            "event": "promotion",
+            "status": "verdict",
+            "verdict": "regressed",
+            "version": 3,
+            "baseline": 2,
+            "reason": "latency beyond both versions' spread",
+        }
+    ]
+    findings = detect_version_regression(events)
+    assert findings and findings[0]["severity"] == "warning"
+    assert "v3" in findings[0]["summary"]
+
+
+def test_version_regression_detector_computes_from_versions_split():
+    from sheeprl_tpu.obs.diagnose import detect_version_regression
+
+    def split(new_p50):
+        return {
+            "event": "summary",
+            "clean_exit": True,
+            "serve": {
+                "versions": {
+                    "1": {
+                        "steps": 200,
+                        "latency_ms": {"p50": 10.0, "p90": 12.0, "p99": 14.0},
+                    },
+                    "2": {
+                        "steps": 200,
+                        "latency_ms": {"p50": new_p50, "p90": new_p50 + 2.0, "p99": new_p50 + 4.0},
+                    },
+                }
+            },
+        }
+
+    assert detect_version_regression([split(100.0)])  # 10x the noise spread
+    assert detect_version_regression([split(10.5)]) == []  # inside the spread
+
+
+def test_slo_alert_detector_reports_last_firing_state():
+    from sheeprl_tpu.obs.diagnose import detect_slo_alert
+
+    firing = {
+        "event": "alert",
+        "status": "firing",
+        "name": "availability",
+        "severity": "critical",
+        "value": 0.9,
+        "target": 0.99,
+        "budget_remaining": -0.5,
+    }
+    findings = detect_slo_alert([firing])
+    assert findings and findings[0]["severity"] == "critical"
+    assert "availability" in findings[0]["summary"]
+    # a later resolved clears it — only the LAST state per alert counts
+    resolved = dict(firing, status="resolved")
+    assert detect_slo_alert([firing, resolved]) == []
+
+
+def test_fixture_diagnosis_includes_slo_alert_finding():
+    from sheeprl_tpu.obs.diagnose import diagnose_events
+
+    report = diagnose_events(_fixture_events())
+    detectors = {f["detector"] for f in report["findings"]}
+    assert "slo_alert" in detectors
+
+
+# -- in-loop ServingTelemetry integration ---------------------------------------------
+
+
+class _Fabric:
+    device = jax.devices("cpu")[0]
+
+
+_CFG = {"algo": {"name": "counter"}, "env": {}}
+
+
+def _tight_latency_slo(**extra):
+    return {
+        "enabled": True,
+        "objectives": {
+            "serving_latency_p99": {
+                "target": 10.0,
+                "budget": 0.05,
+                "window": 6,
+                "for": 2,
+                "severity": "critical",
+            }
+        },
+        **extra,
+    }
+
+
+def _tick(tel, latency, version=0):
+    tel.observe_tick(
+        batch=2,
+        slots=2,
+        active=2,
+        queue_depth=0,
+        step_seconds=0.001,
+        wait_seconds=0.001,
+        latencies_ms=[latency, latency],
+        started=1,
+        finished=1,
+        weight_version=version,
+    )
+
+
+def test_serving_telemetry_emits_slo_blocks_alerts_and_health(tmp_path):
+    from sheeprl_tpu.obs.schema import validate_stream
+    from sheeprl_tpu.serve.telemetry import ServingTelemetry
+
+    path = str(tmp_path / "telemetry.jsonl")
+    tel = ServingTelemetry(
+        _Fabric(), _CFG, str(tmp_path), every=2, jsonl_path=path, slo=_tight_latency_slo()
+    )
+    for _ in range(4):  # 4 windows, every one breaching the 10 ms target
+        _tick(tel, 100.0)
+    tel.close()
+
+    assert validate_stream(path) == []
+    events = [json.loads(line) for line in open(path) if line.strip()]
+    windows = [e for e in events if e["event"] == "window"]
+    assert windows and all("slo" in w for w in windows)
+    assert windows[-1]["slo"]["worst"]["objective"] == "serving_latency_p99"
+    statuses = [(e["status"], e.get("name")) for e in events if e["event"] == "alert"]
+    assert ("pending", "serving_latency_p99") in statuses
+    assert ("firing", "serving_latency_p99") in statuses
+    # the critical firing alert escalates through the existing health path
+    escalations = [
+        e for e in events if e["event"] == "health" and e.get("status") == "alert"
+    ]
+    assert escalations and escalations[0]["findings"][0]["severity"] == "critical"
+    summary = events[-1]
+    assert summary["event"] == "summary" and summary["slo"]["worst"]["budget_remaining"] < 0
+
+
+def test_serving_telemetry_promotion_verdicts(tmp_path):
+    from sheeprl_tpu.obs.schema import validate_stream
+    from sheeprl_tpu.serve.telemetry import ServingTelemetry
+
+    path = str(tmp_path / "telemetry.jsonl")
+    tel = ServingTelemetry(
+        _Fabric(),
+        _CFG,
+        str(tmp_path),
+        every=2,
+        jsonl_path=path,
+        slo={"enabled": False, "promotion_samples": 4},
+    )
+    for _ in range(3):
+        _tick(tel, 10.0, version=0)
+    tel.observe_reload(version=1)
+    for _ in range(3):  # v1 serves at parity → promote
+        _tick(tel, 10.0, version=1)
+    tel.observe_reload(version=2)
+    for _ in range(3):  # v2 is 10x slower → regressed
+        _tick(tel, 100.0, version=2)
+    tel.close()
+
+    assert validate_stream(path) == []
+    events = [json.loads(line) for line in open(path) if line.strip()]
+    verdicts = {e["version"]: e for e in events if e["event"] == "promotion"}
+    assert verdicts[1]["verdict"] == "promote" and verdicts[1]["baseline"] == 0
+    assert verdicts[2]["verdict"] == "regressed"
+    assert "latency" in verdicts[2]["reason"]
+    # the per-version split rides windows and the summary
+    summary = events[-1]
+    assert set(summary["serve"]["versions"]) == {"0", "1", "2"}
+
+
+def test_serving_telemetry_returns_feed_version_split(tmp_path):
+    from sheeprl_tpu.serve.telemetry import ServingTelemetry
+
+    path = str(tmp_path / "telemetry.jsonl")
+    tel = ServingTelemetry(
+        _Fabric(), _CFG, str(tmp_path), every=2, jsonl_path=path, slo={"enabled": False}
+    )
+    _tick(tel, 10.0, version=0)
+    tel.observe_episode(3.5, version=0)
+    tel.observe_episode(4.5, version=0)
+    tel.close()
+    events = [json.loads(line) for line in open(path) if line.strip()]
+    entry = events[-1]["serve"]["versions"]["0"]
+    assert entry["returns"] == {"mean": 4.0, "n": 2}
+
+
+def test_prometheus_alert_and_budget_gauges(tmp_path):
+    from types import SimpleNamespace
+
+    from sheeprl_tpu.serve.telemetry import ServingTelemetry
+
+    tel = ServingTelemetry(
+        _Fabric(),
+        SimpleNamespace(algo=SimpleNamespace(name="counter"), env={}),  # endpoint labels read cfg.algo.name
+        str(tmp_path),
+        every=2,
+        jsonl_path=str(tmp_path / "telemetry.jsonl"),
+        http_port=0,
+        slo=_tight_latency_slo(),
+    )
+    try:
+        for _ in range(3):
+            _tick(tel, 100.0)
+        port = tel.metrics_endpoint.port
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            body = resp.read().decode()
+    finally:
+        tel.close()
+    assert "sheeprl_slo_budget_remaining_serving_latency_p99" in body
+    assert "sheeprl_slo_worst_budget_remaining" in body
+    # ALERTS-style firing gauges: the count and the per-alert 1.0
+    assert "sheeprl_alerts_firing_serving_latency_p99" in body
+    assert "sheeprl_serve_versions_v0_latency_p50_ms" in body
+
+
+# -- consumer wiring: watch / trace / compare / bench-diff ----------------------------
+
+
+def test_watch_renders_slo_line_versions_split_and_alert_board():
+    from sheeprl_tpu.obs.watch import WatchState
+
+    state = WatchState()
+    state.consume([dict(e, stream="telemetry.jsonl") for e in _fixture_events()])
+    assert state.slo_worst is not None
+    assert state.slo_worst["objective"] == "serving_latency_p99"
+    assert "serving_latency_p99" in state.alerts
+    frame = state.render("run", 60.0, ["telemetry.jsonl"])
+    assert "slo:" in frame and "FIRING serving_latency_p99" in frame
+    assert "versions:" in frame and "v1" in frame
+
+
+def test_watch_alert_board_clears_on_resolved():
+    from sheeprl_tpu.obs.watch import WatchState
+
+    state = WatchState()
+    events = _fixture_events()
+    resolved = {
+        "event": "alert",
+        "status": "resolved",
+        "name": "serving_latency_p99",
+        "severity": "warning",
+        "stream": "telemetry.jsonl",
+    }
+    state.consume([dict(e, stream="telemetry.jsonl") for e in events] + [resolved])
+    assert state.alerts == {}
+    frame = state.render("run", 60.0, ["telemetry.jsonl"])
+    assert "alerts none" in frame
+
+
+def test_trace_emits_alert_and_promotion_instants(tmp_path):
+    from sheeprl_tpu.cli import trace
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    shutil.copy(_FIXTURE, run_dir / "telemetry.jsonl")
+    assert trace([str(run_dir)]) == 0
+    tr = json.load(open(run_dir / "trace.json"))["traceEvents"]
+    instants = {e["name"] for e in tr if e.get("ph") == "i"}
+    assert "alert:firing:serving_latency_p99" in instants
+    assert "alert:pending:serving_latency_p99" not in instants  # only firing/resolved
+    assert "promotion:promote" in instants
+
+
+def test_compare_flags_slo_budget_regression(tmp_path):
+    from sheeprl_tpu.obs.compare import compare_profiles, profile_run
+
+    healthy = [
+        {"event": "start", "fingerprint": {"algo": "sac"}},
+        _serve_window(100),
+        {
+            "event": "summary",
+            "clean_exit": True,
+            "slo": {
+                "worst": {"objective": "serving_latency_p99", "budget_remaining": 0.9},
+                "objectives": {
+                    "serving_latency_p99": {"budget_remaining": 0.9, "value": 20.0}
+                },
+            },
+        },
+    ]
+    profile_a = profile_run(healthy)
+    profile_b = profile_run(_fixture_events())
+    result = compare_profiles(profile_a, profile_b)
+    findings = [f for f in result["findings"] if f["detector"] == "slo_budget_regression"]
+    assert findings and findings[0]["severity"] == "critical"  # budget went negative
+    assert findings[0]["metrics"]["objective"] == "serving_latency_p99"
+    assert "serving_latency_p99" in result["metrics"]["slo"]
+    # same direction both ways: B→A is an improvement, not a regression
+    reverse = compare_profiles(profile_b, profile_a)
+    assert not [
+        f for f in reverse["findings"] if f["detector"] == "slo_budget_regression"
+    ]
+
+
+def test_bench_diff_direction_pin_beats_unit_heuristic():
+    from sheeprl_tpu.obs.compare import bench_diff
+
+    def bench(budget):
+        return {
+            "metric": "serve_load_sessions_per_sec",
+            "value": 10.0,
+            "unit": "sessions/sec",
+            "extras": [
+                {
+                    "metric": "serve_load_budget_remaining",
+                    "value": budget,
+                    "unit": "fraction (worst-objective error budget remaining)",
+                    "direction": "higher",
+                }
+            ],
+        }
+
+    # "fraction" units default to lower-is-better; the explicit direction pin
+    # makes budget REMAINING gate the other way — burning it down regresses
+    diff = bench_diff(bench(1.0), bench(0.2))
+    by_metric = {w["metric"]: w for w in diff["workloads"]}
+    assert by_metric["serve_load_budget_remaining"]["status"] == "regression"
+    assert by_metric["serve_load_budget_remaining"]["direction"] == "higher-is-better"
+    # and recovering budget is an improvement, not a regression
+    diff = bench_diff(bench(0.2), bench(1.0))
+    by_metric = {w["metric"]: w for w in diff["workloads"]}
+    assert by_metric["serve_load_budget_remaining"]["status"] in ("ok", "improvement")

@@ -1,0 +1,115 @@
+"""Decoupled-learner failure path (ISSUE 19 satellite): a NON-src rank's
+``BroadcastChannel.put`` is a sequence-counter no-op, so a failed non-src
+learner has no channel-level way to unblock waiting peers. The out-of-band
+marker — ``publish_channel_error`` on the coordination KV plane, polled by
+every ``_bounded_get`` slice — must end those waits with the failure's
+identity (:class:`ChannelPeerError`) instead of a full-deadline timeout.
+All units run on :class:`LocalKV`, no jax.distributed session."""
+
+from __future__ import annotations
+
+import pytest
+
+from sheeprl_tpu.data.service import (
+    LocalKV,
+    clear_local_service_plane,
+    install_local_service_plane,
+)
+from sheeprl_tpu.parallel.distributed import (
+    BroadcastChannel,
+    ChannelError,
+    ChannelPeerError,
+    ChannelTimeout,
+    poll_channel_error,
+    publish_channel_error,
+)
+
+
+def test_publish_and_poll_round_trip_on_injected_kv():
+    kv = LocalKV()
+    assert poll_channel_error(kv) is None
+    assert publish_channel_error("checkpoint load failed", rank=3, kv=kv) is True
+    marker = poll_channel_error(kv)
+    assert marker == "rank 3: checkpoint load failed"
+
+
+def test_publish_without_any_kv_plane_is_a_quiet_no_op():
+    # outside a jax.distributed session (and with no local plane installed)
+    # the marker cannot be written — the original failure must still surface,
+    # so this path reports False instead of raising
+    clear_local_service_plane()
+    assert publish_channel_error("boom", rank=0) is False
+    assert poll_channel_error() is None
+
+
+def test_marker_is_attempt_scoped(monkeypatch):
+    # a restart attempt must never read the marker that killed the previous
+    # attempt: the key embeds SHEEPRL_GANG_ATTEMPT
+    kv = LocalKV()
+    monkeypatch.setenv("SHEEPRL_GANG_ATTEMPT", "0")
+    assert publish_channel_error("died in attempt 0", rank=1, kv=kv)
+    monkeypatch.setenv("SHEEPRL_GANG_ATTEMPT", "1")
+    assert poll_channel_error(kv) is None
+    assert publish_channel_error("died in attempt 1", rank=2, kv=kv)
+    assert poll_channel_error(kv) == "rank 2: died in attempt 1"
+    monkeypatch.setenv("SHEEPRL_GANG_ATTEMPT", "0")
+    assert poll_channel_error(kv) == "rank 1: died in attempt 0"
+
+
+def test_reason_is_bounded():
+    kv = LocalKV()
+    publish_channel_error("x" * 10_000, rank=0, kv=kv)
+    assert len(poll_channel_error(kv)) <= 512
+
+
+class _DeadlineKV:
+    """Stands in for the jaxlib KV client's blocking get: every slice expires."""
+
+    def __call__(self, key, timeout_ms):
+        raise RuntimeError("DEADLINE_EXCEEDED: timed out waiting for key")
+
+
+@pytest.fixture
+def local_plane():
+    kv, _ = install_local_service_plane(LocalKV())
+    try:
+        yield kv
+    finally:
+        clear_local_service_plane()
+
+
+def test_bounded_get_raises_peer_error_on_published_marker(local_plane):
+    publish_channel_error("train step crashed", rank=1, kv=local_plane)
+    chan = BroadcastChannel(src=0, timeout_s=30.0, poll_s=0.05)
+    with pytest.raises(ChannelPeerError, match="rank 1: train step crashed"):
+        chan._bounded_get(_DeadlineKV(), "sheeprl_chan/test/0")
+
+
+def test_bounded_get_times_out_without_a_marker(local_plane):
+    chan = BroadcastChannel(src=0, timeout_s=0.2, poll_s=0.05)
+    with pytest.raises(ChannelTimeout, match="timed out"):
+        chan._bounded_get(_DeadlineKV(), "sheeprl_chan/test/0")
+
+
+def test_bounded_get_marker_published_mid_wait(local_plane):
+    # the marker lands while the receiver is already blocked: the NEXT slice
+    # must see it, long before the 30 s channel deadline
+    chan = BroadcastChannel(src=0, timeout_s=30.0, poll_s=0.05)
+    slices = {"n": 0}
+
+    def fn(key, timeout_ms):
+        slices["n"] += 1
+        if slices["n"] == 2:
+            publish_channel_error("late failure", rank=2, kv=local_plane)
+        raise RuntimeError("DEADLINE_EXCEEDED")
+
+    with pytest.raises(ChannelPeerError, match="rank 2: late failure"):
+        chan._bounded_get(fn, "sheeprl_chan/test/0")
+    assert slices["n"] <= 3
+
+
+def test_peer_error_is_a_channel_error():
+    # supervisors catch ChannelError for the restart decision — the peer-abort
+    # subtype must ride the same handler
+    assert issubclass(ChannelPeerError, ChannelError)
+    assert issubclass(ChannelTimeout, ChannelError)
